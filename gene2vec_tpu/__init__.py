@@ -1,0 +1,25 @@
+"""gene2vec_tpu — a TPU-native gene-embedding framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the reference
+Gene2vec pipeline (corpus construction → skip-gram embedding training →
+intrinsic/extrinsic evaluation → visualization), built TPU-first:
+
+  * the SGNS/CBOW/HS hot loop is a single jitted ``lax.scan`` over the whole
+    epoch with the corpus, negative-sampling table and both embedding tables
+    resident in HBM (reference: gensim Cython Hogwild threads,
+    ``src/gene2vec.py:70,87``);
+  * scale-out is expressed as ``jax.sharding`` specs over a Mesh — data
+    parallelism shards the pair stream, model parallelism shards the
+    embedding-table rows over the vocab axis — with XLA inserting the
+    collectives (reference has no distributed backend at all, SURVEY §2.4);
+  * the GGIPNN gene-gene-interaction MLP is Flax + optax on the same
+    on-device table (reference: TF1 graph with the table pinned to
+    ``/cpu:0``, ``src/GGIPNN.py:18``);
+  * native C++ components live in ``native/``: an mmap'ed pair-corpus
+    reader/encoder and a Hogwild SGNS CPU oracle that stands in for the
+    gensim baseline.
+"""
+
+__version__ = "0.1.0"
+
+from gene2vec_tpu.config import SGNSConfig, GGIPNNConfig, MeshConfig  # noqa: F401

@@ -1,0 +1,114 @@
+"""Single dataclass-based config system.
+
+The reference uses three ad-hoc flag styles (positional argparse in
+``src/gene2vec.py:8-15``, rich argparse in ``src/generate_gene_pairs.py:12-42``,
+TF1 ``tf.flags`` in ``src/GGIPNN_Classification.py:14-32``) plus hardcoded
+constant blocks (``src/gene2vec.py:57-63``).  Here every subsystem reads one
+frozen dataclass; CLI front-ends populate them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SGNSConfig:
+    """Embedding-training configuration.
+
+    Defaults mirror the reference's hardcoded parameter block
+    (``src/gene2vec.py:57-63``: dim=200, sg=1, window=1, min_count=1,
+    max_iter=10) and gensim-3.4's own SGNS defaults (5 negatives,
+    alpha 0.025 → 1e-4, unigram^0.75 noise distribution).
+    """
+
+    dim: int = 200
+    num_iters: int = 10            # outer iterations, each = 1 epoch + checkpoint
+    objective: str = "sgns"        # "sgns" | "cbow" | "sg_hs" | "cbow_hs"
+    window: int = 1                # corpus lines are pairs; window>1 is accepted
+                                   # for longer "sentences" but pairs degenerate
+                                   # to symmetric pair prediction (SURVEY §2.2.1)
+    min_count: int = 1
+    negatives: int = 5
+    ns_exponent: float = 0.75
+    lr: float = 0.025              # start learning rate (gensim alpha)
+    min_lr: float = 1e-4           # linear decay floor (gensim min_alpha)
+    batch_pairs: int = 4096        # corpus pairs per step (×2 training examples)
+    seed: int = 1
+    table_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    both_directions: bool = True   # emit (a→b) and (b→a) per corpus pair
+    shuffle_each_iter: bool = True # reference reshuffles every iteration
+                                   # (src/gene2vec.py:80)
+    txt_output: bool = True        # also export matrix-txt + w2v-format per iter
+
+    # parallelism
+    data_axis: str = "data"
+    model_axis: str = "model"
+    vocab_sharded: bool = False    # shard table rows over the model axis
+    donate: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class GGIPNNConfig:
+    """Gene-gene-interaction MLP config.
+
+    Defaults mirror ``src/GGIPNN_Classification.py:14-32`` and
+    ``src/GGIPNN.py``: batch 128, 1 epoch, Adam 1e-3, dropout keep 0.5,
+    hidden widths (100, 100, 10), L2 λ=0, frozen pretrained embedding.
+    """
+
+    embedding_dim: int = 200
+    sequence_length: int = 2
+    num_classes: int = 2
+    hidden_dims: Tuple[int, int, int] = (100, 100, 10)
+    dropout_keep_prob: float = 0.5
+    l2_lambda: float = 0.0
+    embed_train: bool = False
+    use_pretrained: bool = True
+    batch_size: int = 128
+    num_epochs: int = 1
+    learning_rate: float = 1e-3
+    evaluate_every: int = 200
+    checkpoint_every: int = 1000
+    seed: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape. axes (data, model); product must divide device count."""
+
+    data: int = -1                 # -1: all remaining devices
+    model: int = 1
+    data_axis: str = "data"
+    model_axis: str = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusConfig:
+    """Co-expression pair-corpus construction (reference
+    ``src/generate_gene_pairs.py:12-42``)."""
+
+    corr_threshold: float = 0.9
+    min_study_samples: int = 20
+    min_total_counts: float = 10.0
+    parallel: bool = False
+    ensembl: bool = False
+    num_workers: int = 0           # 0 → os.cpu_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class TSNEConfig:
+    """t-SNE defaults from ``src/tsne_multi_core.py:31,42-52``."""
+
+    pca_dims: int = 50
+    perplexity: float = 30.0
+    learning_rate: float = 200.0
+    n_iter: int = 1000
+    early_exaggeration: float = 12.0
+    exaggeration_iters: int = 250
+    momentum_start: float = 0.5
+    momentum_final: float = 0.8
+    momentum_switch_iter: int = 250
+    seed: int = 0

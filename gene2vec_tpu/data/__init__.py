@@ -1,0 +1,5 @@
+from gene2vec_tpu.data.negative_sampling import (  # noqa: F401
+    noise_distribution,
+    NegativeSampler,
+)
+from gene2vec_tpu.data.pipeline import PairCorpus  # noqa: F401

@@ -1,0 +1,53 @@
+"""Unigram^0.75 negative sampling, TPU-resident.
+
+gensim materializes a 100M-entry cumulative table and draws by indexing
+random positions into it (the Cython hot loop behind ``src/gene2vec.py:70``).
+On TPU we keep only the V-entry cumulative distribution in HBM and draw by
+``searchsorted`` on uniform variates — O(log V) per draw, fully vectorized,
+and exact rather than quantized to table resolution.
+
+Collision semantics: gensim skips a negative draw when it equals the positive
+target word.  We mask such draws out of the loss/update instead (their
+gradient contribution is zeroed), which preserves the expectation without a
+data-dependent resampling loop that XLA could not compile statically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def noise_distribution(counts: np.ndarray, ns_exponent: float = 0.75) -> np.ndarray:
+    """Normalized unigram^ns_exponent noise distribution over the vocab."""
+    p = np.asarray(counts, dtype=np.float64) ** ns_exponent
+    return (p / p.sum()).astype(np.float32)
+
+
+class NegativeSampler:
+    """Batched categorical sampler via inverse-CDF searchsorted."""
+
+    def __init__(self, counts: np.ndarray, ns_exponent: float = 0.75):
+        probs = noise_distribution(counts, ns_exponent)
+        # float64 cumsum on host for accuracy, then f32 on device; clamp the
+        # final entry to 1 so searchsorted can never fall off the end.
+        cdf = np.cumsum(probs.astype(np.float64))
+        cdf[-1] = 1.0
+        self.cdf = jnp.asarray(cdf, dtype=jnp.float32)
+        self.vocab_size = int(len(probs))
+
+    def sample(self, key: jax.Array, shape) -> jax.Array:
+        """Draw int32 token ids with the noise distribution."""
+        u = jax.random.uniform(key, shape, dtype=jnp.float32)
+        idx = jnp.searchsorted(self.cdf, u, side="right")
+        return jnp.clip(idx, 0, self.vocab_size - 1).astype(jnp.int32)
+
+
+def sample_negatives(cdf: jax.Array, key: jax.Array, shape) -> jax.Array:
+    """Functional form of :meth:`NegativeSampler.sample` for use inside
+    jitted training steps (cdf passed as a traced array)."""
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)
+    idx = jnp.searchsorted(cdf, u, side="right")
+    return jnp.clip(idx, 0, cdf.shape[0] - 1).astype(jnp.int32)

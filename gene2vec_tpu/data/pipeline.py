@@ -1,0 +1,82 @@
+"""Pair-stream pipeline: the corpus lives in HBM, shuffling happens on device.
+
+The reference keeps the whole corpus as a Python list of 2-element lists and
+reshuffles it with ``random.shuffle`` every iteration (``src/gene2vec.py:32-52,80``)
+— hundreds of millions of Python objects.  Here the encoded corpus is one
+(N, 2) int32 device array; an epoch's shuffle is a ``jax.random.permutation``
+folded into the jitted epoch scan, so the host never touches pair data after
+the initial upload.
+
+Batching drops the ragged tail (< batch_pairs pairs) of each epoch — with the
+per-epoch reshuffle every pair still gets seen in expectation, and static
+shapes are what keep XLA from recompiling.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.io.vocab import Vocab
+
+
+class PairCorpus:
+    """Encoded pair corpus + vocab, with device-resident batching helpers."""
+
+    def __init__(self, vocab: Vocab, pairs: np.ndarray):
+        pairs = np.asarray(pairs, dtype=np.int32)
+        if pairs.ndim != 2 or pairs.shape[1] != 2:
+            raise ValueError(f"pairs must be (N, 2), got {pairs.shape}")
+        self.vocab = vocab
+        self.pairs = pairs
+
+    @property
+    def num_pairs(self) -> int:
+        return int(self.pairs.shape[0])
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def num_batches(self, batch_pairs: int) -> int:
+        return self.num_pairs // batch_pairs
+
+    def device_pairs(self, sharding: Optional[jax.sharding.Sharding] = None) -> jax.Array:
+        """Upload the corpus once; optionally sharded over the data axis."""
+        if sharding is not None:
+            return jax.device_put(self.pairs, sharding)
+        return jnp.asarray(self.pairs)
+
+    def pad_to_multiple(self, multiple: int) -> "PairCorpus":
+        """Pad (by wrapping around) so num_pairs is divisible by ``multiple``
+        — needed to shard the corpus evenly across data-parallel devices."""
+        n = self.num_pairs
+        rem = n % multiple
+        if rem == 0:
+            return self
+        extra = self.pairs[: multiple - rem]
+        return PairCorpus(self.vocab, np.concatenate([self.pairs, extra], axis=0))
+
+    def host_batches(
+        self, batch_pairs: int, rng: np.random.Generator, shuffle: bool = True
+    ) -> Iterator[np.ndarray]:
+        """Host-side batch iterator (CPU oracle paths / tests)."""
+        order = (
+            rng.permutation(self.num_pairs) if shuffle else np.arange(self.num_pairs)
+        )
+        for b in range(self.num_batches(batch_pairs)):
+            yield self.pairs[order[b * batch_pairs : (b + 1) * batch_pairs]]
+
+
+def epoch_permutation(key: jax.Array, num_pairs: int, batch_pairs: int) -> jax.Array:
+    """(num_batches, batch_pairs) int32 shuffled index matrix for one epoch —
+    the device-side equivalent of the reference's per-iteration
+    ``random.shuffle(gene_pairs)`` (``src/gene2vec.py:80``)."""
+    num_batches = num_pairs // batch_pairs
+    perm = jax.random.permutation(key, num_pairs)[: num_batches * batch_pairs]
+    return perm.reshape(num_batches, batch_pairs).astype(jnp.int32)

@@ -1,0 +1,8 @@
+from gene2vec_tpu.io.vocab import Vocab  # noqa: F401
+from gene2vec_tpu.io.pair_reader import read_pair_files, read_pair_lines  # noqa: F401
+from gene2vec_tpu.io.emb_io import (  # noqa: F401
+    write_matrix_txt,
+    write_word2vec_format,
+    read_matrix_txt,
+    read_word2vec_format,
+)

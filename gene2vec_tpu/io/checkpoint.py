@@ -1,0 +1,92 @@
+"""Per-iteration checkpoint/resume.
+
+The reference's de-facto checkpoint mechanism is gensim ``model.save`` every
+iteration plus reload-previous at the start of the next
+(``src/gene2vec.py:71,86-88``) — a crash loses at most one iteration.  We
+keep exactly that cadence and naming, with a portable ``.npz`` payload
+(emb + ctx tables + meta) alongside the vocab, and the same two text exports
+per iteration (matrix-txt and word2vec-format; formats in io/emb_io.py).
+
+Layout in <export_dir>:
+    vocab.tsv                               token \t count, id order
+    gene2vec_dim_<D>_iter_<N>.npz           emb, ctx, meta json
+    gene2vec_dim_<D>_iter_<N>.txt           matrix-txt export
+    gene2vec_dim_<D>_iter_<N>_w2v.txt       word2vec-format export
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.io.emb_io import write_matrix_txt, write_word2vec_format
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns.model import SGNSParams
+
+_CKPT_RE = re.compile(r"^gene2vec_dim_(\d+)_iter_(\d+)\.npz$")
+
+
+def ckpt_prefix(export_dir: str, dim: int, iteration: int) -> str:
+    return os.path.join(export_dir, f"gene2vec_dim_{dim}_iter_{iteration}")
+
+
+def save_iteration(
+    export_dir: str,
+    dim: int,
+    iteration: int,
+    params: SGNSParams,
+    vocab: Vocab,
+    txt_output: bool = True,
+    meta: Optional[dict] = None,
+) -> str:
+    os.makedirs(export_dir, exist_ok=True)
+    vocab_path = os.path.join(export_dir, "vocab.tsv")
+    if os.path.exists(vocab_path):
+        existing = Vocab.load(vocab_path)
+        if existing.id_to_token != vocab.id_to_token:
+            raise ValueError(
+                f"{vocab_path} was written for a different corpus "
+                f"({len(existing)} tokens vs {len(vocab)}); refusing to mix "
+                "checkpoints with mismatched vocabularies in one export dir"
+            )
+    else:
+        vocab.save(vocab_path)
+    prefix = ckpt_prefix(export_dir, dim, iteration)
+    emb = np.asarray(params.emb)
+    ctx = np.asarray(params.ctx)
+    meta = dict(meta or {}, dim=dim, iteration=iteration, vocab_size=len(vocab))
+    np.savez(prefix + ".npz", emb=emb, ctx=ctx, meta=json.dumps(meta))
+    if txt_output:
+        write_matrix_txt(prefix + ".txt", vocab.id_to_token, emb)
+        write_word2vec_format(prefix + "_w2v.txt", vocab.id_to_token, emb)
+    return prefix + ".npz"
+
+
+def load_iteration(
+    export_dir: str, dim: int, iteration: int
+) -> Tuple[SGNSParams, Vocab, dict]:
+    import jax.numpy as jnp
+
+    prefix = ckpt_prefix(export_dir, dim, iteration)
+    with np.load(prefix + ".npz") as z:
+        emb = jnp.asarray(z["emb"])
+        ctx = jnp.asarray(z["ctx"])
+        meta = json.loads(str(z["meta"]))
+    vocab = Vocab.load(os.path.join(export_dir, "vocab.tsv"))
+    return SGNSParams(emb=emb, ctx=ctx), vocab, meta
+
+
+def latest_iteration(export_dir: str, dim: int) -> int:
+    """Highest saved iteration for ``dim`` in ``export_dir``, or 0."""
+    best = 0
+    if not os.path.isdir(export_dir):
+        return 0
+    for name in os.listdir(export_dir):
+        m = _CKPT_RE.match(name)
+        if m and int(m.group(1)) == dim:
+            best = max(best, int(m.group(2)))
+    return best

@@ -1,0 +1,77 @@
+"""Pair-corpus reading.
+
+The reference loads every ``*.txt`` file in a directory with windows-1252
+decoding and splits each line on whitespace (``src/gene2vec.py:36-47``).  We
+keep that contract (directory + filename-suffix pattern, windows-1252
+tolerant) and add a fast path: the native C++ reader in ``native/pairio.cpp``
+(mmap + string interning) when its shared library has been built, falling
+back to pure Python otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.io.vocab import Vocab
+
+
+def iter_pair_files(source_dir: str, ending_pattern: str = "txt") -> List[str]:
+    """Files in ``source_dir`` whose names end with ``ending_pattern``,
+    sorted for determinism (the reference shuffles file order,
+    ``src/gene2vec.py:33`` — order is irrelevant because the corpus is
+    reshuffled afterwards anyway)."""
+    names = sorted(n for n in os.listdir(source_dir) if n.endswith(ending_pattern))
+    return [os.path.join(source_dir, n) for n in names]
+
+
+def read_pair_lines(path: str, encoding: str = "windows-1252") -> Iterator[List[str]]:
+    """Yield whitespace-split token lists, one per non-empty line."""
+    with open(path, "r", encoding=encoding) as f:
+        for line in f:
+            toks = line.strip().split()
+            if toks:
+                yield toks
+
+
+def read_pair_files(
+    source_dir: str,
+    ending_pattern: str = "txt",
+    encoding: str = "windows-1252",
+) -> List[List[str]]:
+    """All pairs from all matching files, as token lists."""
+    pairs: List[List[str]] = []
+    for path in iter_pair_files(source_dir, ending_pattern):
+        pairs.extend(read_pair_lines(path, encoding=encoding))
+    return pairs
+
+
+def load_corpus(
+    source_dir: str,
+    ending_pattern: str = "txt",
+    min_count: int = 1,
+    encoding: str = "windows-1252",
+    use_native: bool = True,
+) -> Tuple[Vocab, np.ndarray]:
+    """Read a pair corpus directory → (Vocab, (N,2) int32 encoded pairs).
+
+    Uses the native C++ reader (native/pairio.cpp) when its shared library
+    has been built (``make -C native``); the Python fallback is
+    behavior-identical.
+    """
+    if use_native:
+        try:
+            from gene2vec_tpu.io import native_pairio
+
+            if native_pairio.available():
+                return native_pairio.load_corpus(
+                    iter_pair_files(source_dir, ending_pattern), min_count=min_count
+                )
+        except ImportError:
+            pass
+    token_pairs = read_pair_files(source_dir, ending_pattern, encoding=encoding)
+    vocab = Vocab.from_pairs(token_pairs, min_count=min_count)
+    encoded = vocab.encode_pairs(token_pairs)
+    return vocab, encoded

@@ -1,0 +1,2 @@
+from gene2vec_tpu.parallel.mesh import make_mesh  # noqa: F401
+from gene2vec_tpu.parallel.sharding import SGNSSharding  # noqa: F401

@@ -1,0 +1,44 @@
+"""Device-mesh construction.
+
+The reference has no distributed backend at all (SURVEY §2.4: no
+NCCL/MPI/Gloo; parallelism is gensim Hogwild threads + Ray tasks).  The
+TPU-native communication layer is: pick a Mesh, annotate shardings, let XLA
+emit the collectives over ICI/DCN.  Two logical axes:
+
+* ``data``  — shards the pair stream (data parallelism);
+* ``model`` — shards embedding-table rows over the vocab (row parallelism,
+  BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from gene2vec_tpu.config import MeshConfig
+
+
+def make_mesh(
+    config: MeshConfig = MeshConfig(), devices: Optional[Sequence] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = max(1, config.model)
+    data = config.data if config.data > 0 else n // model
+    if data * model != n:
+        raise ValueError(
+            f"mesh {data}x{model} does not cover {n} devices; "
+            f"set MeshConfig(data=..., model=...) so data*model == len(devices)"
+        )
+    dev_array = np.asarray(devices).reshape(data, model)
+    return Mesh(dev_array, (config.data_axis, config.model_axis))
+
+
+def single_device_mesh() -> Mesh:
+    """1x1 mesh over the default device — lets all sharded code paths run
+    unchanged on one chip."""
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), ("data", "model"))

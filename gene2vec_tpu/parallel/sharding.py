@@ -1,0 +1,78 @@
+"""Sharding specs for SGNS training.
+
+Two strategies (SURVEY §2.4 / BASELINE configs 2 & 5):
+
+* **Data parallel** — tables replicated, the example axis of each batch
+  sharded over ``data``.  The scatter-add updates into a replicated table
+  force XLA to all-reduce the per-shard contributions over ICI; that psum
+  IS the gradient all-reduce, emitted from sharding annotations rather
+  than written as NCCL calls.
+* **Row parallel (vocab-sharded)** — table rows sharded over ``model``
+  (each device owns V/P contiguous rows), batch sharded over ``data``.
+  XLA lowers ``table[idx]`` gathers / ``at[idx].add`` scatters on the
+  sharded operand into masked local ops + collectives (all-gather of
+  touched rows forward, reduce-scatter of row grads backward) — the
+  communication-efficient pattern for a table too big to replicate
+  (dim=512 × full vocab and beyond).
+
+Both are expressed purely as ``NamedSharding`` trees + in-step
+``with_sharding_constraint`` — the step code in sgns/step.py is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+@dataclasses.dataclass(frozen=True)
+class SGNSSharding:
+    """Bundle of shardings for params / corpus / batch under a mesh."""
+
+    mesh: Mesh
+    vocab_sharded: bool = False
+    data_axis: str = "data"
+    model_axis: str = "model"
+
+    # -- specs -------------------------------------------------------------
+
+    def param_spec(self) -> P:
+        return P(self.model_axis, None) if self.vocab_sharded else P(None, None)
+
+    def params_sharding(self) -> SGNSParams:
+        s = NamedSharding(self.mesh, self.param_spec())
+        return SGNSParams(emb=s, ctx=s)
+
+    def corpus_sharding(self) -> NamedSharding:
+        # Corpus rows spread over the data axis; reshuffle gathers across
+        # shards (cheap relative to the step itself).
+        return NamedSharding(self.mesh, P(self.data_axis, None))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # -- in-step constraints ----------------------------------------------
+
+    def constrain_batch(self, batch: jax.Array) -> jax.Array:
+        """Shard the pair-batch axis over ``data`` — this single annotation
+        is what makes the whole step data-parallel."""
+        return jax.lax.with_sharding_constraint(
+            batch, NamedSharding(self.mesh, P(self.data_axis, None))
+        )
+
+    def constrain_params(self, params: SGNSParams) -> SGNSParams:
+        s = NamedSharding(self.mesh, self.param_spec())
+        return SGNSParams(
+            emb=jax.lax.with_sharding_constraint(params.emb, s),
+            ctx=jax.lax.with_sharding_constraint(params.ctx, s),
+        )
+
+
+def no_sharding() -> Optional[SGNSSharding]:
+    """Single-device marker (constraints become no-ops in the trainer)."""
+    return None

@@ -1,0 +1,3 @@
+from gene2vec_tpu.sgns.model import SGNSParams, init_params  # noqa: F401
+from gene2vec_tpu.sgns.step import sgns_loss_and_grads, sgns_step  # noqa: F401
+from gene2vec_tpu.sgns.train import SGNSTrainer  # noqa: F401

@@ -1,0 +1,41 @@
+"""SGNS parameters: an input ("emb") and output ("ctx") table.
+
+Initialization follows the word2vec convention the reference relies on via
+gensim (``src/gene2vec.py:70``): input vectors U(−0.5/D, 0.5/D), output
+(context) vectors zero.  Published artifacts are the *input* table.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class SGNSParams(NamedTuple):
+    emb: jax.Array  # (V, D) input/center vectors — the published embedding
+    ctx: jax.Array  # (V, D) output/context vectors
+
+
+def init_params(
+    key: jax.Array, vocab_size: int, dim: int, dtype=jnp.float32
+) -> SGNSParams:
+    emb = jax.random.uniform(
+        key, (vocab_size, dim), dtype=dtype, minval=-0.5 / dim, maxval=0.5 / dim
+    )
+    ctx = jnp.zeros((vocab_size, dim), dtype=dtype)
+    return SGNSParams(emb=emb, ctx=ctx)
+
+
+def init_params_numpy(
+    seed: int, vocab_size: int, dim: int, dtype=np.float32
+) -> SGNSParams:
+    """Host-side init (used to hand identical starting points to the CPU
+    oracle in parity tests)."""
+    rng = np.random.RandomState(seed)
+    emb = rng.uniform(-0.5 / dim, 0.5 / dim, (vocab_size, dim)).astype(dtype)
+    ctx = np.zeros((vocab_size, dim), dtype=dtype)
+    return SGNSParams(emb=jnp.asarray(emb), ctx=jnp.asarray(ctx))

@@ -1,0 +1,113 @@
+"""The SGNS training step — the framework's hot loop.
+
+This replaces gensim's Cython/Hogwild inner loop (the engine behind
+``src/gene2vec.py:70,87``): per (center, context) pair, gather rows, draw k
+negatives from the unigram^0.75 table, sigmoid dot-products, SGD row updates.
+
+TPU-first formulation:
+
+* a batch of B corpus pairs becomes 2B training examples — each pair is a
+  2-token "sentence" with window=1 (SURVEY §2.2 #1), so skip-gram
+  degenerates to symmetric pair prediction and we emit both directions
+  explicitly;
+* gradients are closed-form (the loss is a sum of log-sigmoids of rank-1
+  dots — autodiff would materialize the same expressions with more
+  bookkeeping), applied with deterministic ``.at[].add`` scatter-adds.
+  Duplicate indices within a batch sum their contributions — the
+  deterministic analogue of gensim's benign Hogwild races (SURVEY §7 hard
+  part 1);
+* negatives that collide with the positive target are masked out of loss and
+  update (gensim skips them; a resampling loop would be data-dependent
+  control flow XLA can't tile).
+
+Everything is shape-static and jit-safe; under a Mesh the same code runs
+data-parallel (sharded batch, replicated tables → XLA all-reduces the
+scatter updates) or row-parallel (vocab-sharded tables → XLA turns
+gather/scatter into ICI collectives). See gene2vec_tpu/parallel/sharding.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.data.negative_sampling import sample_negatives
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+def _examples_from_pairs(
+    pairs: jax.Array, both_directions: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """(B, 2) pairs → (E,) centers, (E,) contexts with E = 2B (or B)."""
+    if both_directions:
+        centers = jnp.concatenate([pairs[:, 0], pairs[:, 1]])
+        contexts = jnp.concatenate([pairs[:, 1], pairs[:, 0]])
+    else:
+        centers, contexts = pairs[:, 0], pairs[:, 1]
+    return centers, contexts
+
+
+def sgns_loss_and_grads(
+    params: SGNSParams,
+    centers: jax.Array,   # (E,) int32
+    contexts: jax.Array,  # (E,) int32
+    negatives: jax.Array, # (E, K) int32
+    compute_dtype=jnp.float32,
+):
+    """Per-example loss and closed-form row gradients.
+
+    Returns (loss_mean, (d_center (E,D), d_pos (E,D), d_neg (E,K,D), neg_mask)).
+    """
+    emb, ctx = params.emb, params.ctx
+    v = emb[centers].astype(compute_dtype)        # (E, D)
+    u_pos = ctx[contexts].astype(compute_dtype)   # (E, D)
+    u_neg = ctx[negatives].astype(compute_dtype)  # (E, K, D)
+
+    pos_logit = jnp.sum(v * u_pos, axis=-1)                    # (E,)
+    neg_logit = jnp.einsum("ed,ekd->ek", v, u_neg)             # (E, K)
+
+    # gensim skips a negative equal to the positive target; we zero it.
+    neg_mask = (negatives != contexts[:, None]).astype(compute_dtype)
+
+    # loss = -log σ(pos) - Σ_k log σ(-neg_k)
+    loss = jax.nn.softplus(-pos_logit) + jnp.sum(
+        neg_mask * jax.nn.softplus(neg_logit), axis=-1
+    )
+
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0                    # (E,)  dL/dpos_logit
+    g_neg = jax.nn.sigmoid(neg_logit) * neg_mask               # (E, K)
+
+    d_center = g_pos[:, None] * u_pos + jnp.einsum("ek,ekd->ed", g_neg, u_neg)
+    d_pos = g_pos[:, None] * v
+    d_neg = g_neg[:, :, None] * v[:, None, :]
+    return jnp.mean(loss), (d_center, d_pos, d_neg)
+
+
+def sgns_step(
+    params: SGNSParams,
+    pairs: jax.Array,  # (B, 2) int32
+    cdf: jax.Array,    # (V,) noise CDF
+    key: jax.Array,
+    lr: jax.Array,
+    negatives: int = 5,
+    both_directions: bool = True,
+    compute_dtype=jnp.float32,
+) -> Tuple[SGNSParams, jax.Array]:
+    """One fused SGD step over a batch of corpus pairs."""
+    centers, contexts = _examples_from_pairs(pairs, both_directions)
+    negs = sample_negatives(cdf, key, (centers.shape[0], negatives))
+
+    loss, (d_center, d_pos, d_neg) = sgns_loss_and_grads(
+        params, centers, contexts, negs, compute_dtype
+    )
+
+    dtype = params.emb.dtype
+    lr = jnp.asarray(lr, compute_dtype)
+    emb = params.emb.at[centers].add((-lr * d_center).astype(dtype))
+    ctx = params.ctx.at[contexts].add((-lr * d_pos).astype(dtype))
+    ctx = ctx.at[negs.reshape(-1)].add(
+        (-lr * d_neg).reshape(-1, d_neg.shape[-1]).astype(dtype)
+    )
+    return SGNSParams(emb=emb, ctx=ctx), loss

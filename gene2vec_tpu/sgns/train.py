@@ -1,0 +1,207 @@
+"""SGNS trainer: whole-epoch jitted scan + reference-shaped iteration loop.
+
+Replaces the driver in ``src/gene2vec.py``: load corpus → shuffle → N
+iterations of (reshuffle, 1 training epoch, checkpoint, txt export), with
+resume-from-previous-iteration semantics (``src/gene2vec.py:67-92``).
+
+TPU shape: one ``jax.jit`` call per epoch.  The corpus, noise CDF and both
+tables live in HBM; the epoch is a ``lax.scan`` over shuffled batches with
+the learning rate decaying linearly from ``lr`` to ``min_lr`` across the
+epoch — the same per-``train()``-call alpha sweep gensim performs for each
+of the reference's 10 iterations.  Buffers are donated, so the tables are
+updated in place.  The host does nothing between checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import TYPE_CHECKING, Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NegativeSampler
+from gene2vec_tpu.data.pipeline import PairCorpus, epoch_permutation
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.sgns.model import SGNSParams, init_params
+from gene2vec_tpu.sgns.step import sgns_step
+from gene2vec_tpu.utils.profiling import StepTimer
+
+if TYPE_CHECKING:  # runtime import would cycle through gene2vec_tpu.parallel
+    from gene2vec_tpu.parallel.sharding import SGNSSharding
+
+
+def make_train_epoch(
+    num_pairs: int,
+    num_batches: int,
+    config: SGNSConfig,
+    sharding: Optional["SGNSSharding"] = None,
+) -> Callable:
+    """Build the jitted epoch function.
+
+    Signature: (params, pairs, cdf, key) -> (params, mean_loss).
+    All loop structure is static; only array contents are traced.
+    """
+    batch_pairs = config.batch_pairs
+    compute_dtype = jnp.dtype(config.compute_dtype)
+
+    def train_epoch(params, pairs, cdf, key):
+        shuffle_key, step_key = jax.random.split(key)
+        perm = epoch_permutation(shuffle_key, num_pairs, batch_pairs)
+
+        def body(params, xs):
+            idx, step = xs
+            batch = pairs[idx]
+            if sharding is not None:
+                batch = sharding.constrain_batch(batch)
+            frac = step.astype(compute_dtype) / max(num_batches, 1)
+            lr = config.lr * (1.0 - frac) + config.min_lr * frac
+            params, loss = sgns_step(
+                params,
+                batch,
+                cdf,
+                jax.random.fold_in(step_key, step),
+                lr,
+                negatives=config.negatives,
+                both_directions=config.both_directions,
+                compute_dtype=compute_dtype,
+            )
+            if sharding is not None:
+                params = sharding.constrain_params(params)
+            return params, loss
+
+        params, losses = jax.lax.scan(
+            body, params, (perm, jnp.arange(num_batches, dtype=jnp.int32))
+        )
+        return params, jnp.mean(losses)
+
+    donate = (0,) if config.donate else ()
+    return jax.jit(train_epoch, donate_argnums=donate)
+
+
+class SGNSTrainer:
+    """End-to-end trainer over an encoded :class:`PairCorpus`."""
+
+    def __init__(
+        self,
+        corpus: PairCorpus,
+        config: SGNSConfig = SGNSConfig(),
+        sharding: Optional["SGNSSharding"] = None,
+    ):
+        if corpus.num_pairs == 0 or corpus.vocab_size == 0:
+            raise ValueError(
+                "corpus is empty — no pair lines matched the source "
+                "directory/pattern (or min_count filtered every token)"
+            )
+        if config.objective != "sgns":
+            raise NotImplementedError(
+                f"objective={config.objective!r}: use CBOWHSTrainer from "
+                "gene2vec_tpu.sgns.cbow_hs for the cbow/hierarchical-softmax "
+                "variants"
+            )
+        if sharding is not None:
+            # even row count per data shard is required to device_put the
+            # corpus with a sharded axis
+            corpus = corpus.pad_to_multiple(sharding.mesh.shape[sharding.data_axis])
+        if corpus.num_pairs < config.batch_pairs:
+            # shrink the batch rather than failing on tiny corpora
+            # (the reference smoke corpus data/test.txt has 39 pairs)
+            config = dataclasses.replace(
+                config, batch_pairs=max(1, corpus.num_pairs)
+            )
+        self.config = config
+        self.corpus = corpus
+        self.sharding = sharding
+        self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
+        self.num_batches = corpus.num_batches(config.batch_pairs)
+
+        if sharding is not None:
+            self.cdf = jax.device_put(self.sampler.cdf, sharding.replicated())
+            self.pairs = corpus.device_pairs(sharding.corpus_sharding())
+        else:
+            self.cdf = self.sampler.cdf
+            self.pairs = corpus.device_pairs()
+
+        self._epoch_fn = make_train_epoch(
+            corpus.num_pairs, self.num_batches, self.config, sharding
+        )
+        self.timer = StepTimer()
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        key = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        if self.sharding is not None:
+            init_fn = jax.jit(
+                functools.partial(
+                    init_params,
+                    vocab_size=self.corpus.vocab_size,
+                    dim=self.config.dim,
+                    dtype=jnp.dtype(self.config.table_dtype),
+                ),
+                out_shardings=self.sharding.params_sharding(),
+            )
+            return init_fn(key)
+        return init_params(
+            key,
+            self.corpus.vocab_size,
+            self.config.dim,
+            jnp.dtype(self.config.table_dtype),
+        )
+
+    # -- training ----------------------------------------------------------
+
+    def train_epoch(
+        self, params: SGNSParams, epoch_key: jax.Array
+    ) -> Tuple[SGNSParams, float]:
+        params, loss = self._epoch_fn(params, self.pairs, self.cdf, epoch_key)
+        return params, loss
+
+    def run(
+        self,
+        export_dir: str,
+        start_iter: Optional[int] = None,
+        log: Callable[[str], None] = print,
+    ) -> SGNSParams:
+        """The reference iteration loop: resume from the last saved
+        iteration if present, else init fresh; each iteration reshuffles
+        (a fresh PRNG fold), trains one epoch, checkpoints and exports."""
+        cfg = self.config
+        if start_iter is None:
+            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+        if start_iter > 1:
+            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            log(f"resuming from iteration {start_iter - 1}")
+        else:
+            params = self.init()
+            start_iter = 1
+
+        root_key = jax.random.PRNGKey(cfg.seed)
+        pairs_per_epoch = self.num_batches * cfg.batch_pairs
+        for it in range(start_iter, cfg.num_iters + 1):
+            log(f"gene2vec dimension {cfg.dim} iteration {it} start")
+            t0 = time.perf_counter()
+            params, loss = self.train_epoch(params, jax.random.fold_in(root_key, it))
+            loss = float(loss)  # blocks until the epoch finishes on device
+            dt = time.perf_counter() - t0
+            rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+            self.timer.record(pairs_per_epoch, dt)
+            log(
+                f"gene2vec dimension {cfg.dim} iteration {it} done: "
+                f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
+            )
+            ckpt.save_iteration(
+                export_dir,
+                cfg.dim,
+                it,
+                params,
+                self.corpus.vocab,
+                txt_output=cfg.txt_output,
+                meta={"loss": loss, "pairs_per_sec": rate},
+            )
+        return params

@@ -1,0 +1,1 @@
+from gene2vec_tpu.utils.profiling import StepTimer, trace_context  # noqa: F401

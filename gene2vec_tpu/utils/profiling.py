@@ -1,0 +1,52 @@
+"""Profiling/observability.
+
+The reference's only instrumentation is wall-clock prints around
+load/shuffle/train (``src/gene2vec.py:40-55,77-83``).  Here: a step timer
+that accumulates the north-star metric (gene-pairs/sec) and an optional
+``jax.profiler`` trace context for real TPU profiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class StepTimer:
+    pairs: List[int] = field(default_factory=list)
+    seconds: List[float] = field(default_factory=list)
+
+    def record(self, num_pairs: int, elapsed_s: float) -> None:
+        self.pairs.append(int(num_pairs))
+        self.seconds.append(float(elapsed_s))
+
+    @property
+    def total_pairs(self) -> int:
+        return sum(self.pairs)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(self.seconds)
+
+    def pairs_per_sec(self, skip_first: bool = True) -> float:
+        """Throughput; drops the first record by default (it includes jit
+        compilation)."""
+        ps, ss = self.pairs, self.seconds
+        if skip_first and len(ps) > 1:
+            ps, ss = ps[1:], ss[1:]
+        t = sum(ss)
+        return sum(ps) / t if t > 0 else 0.0
+
+
+@contextlib.contextmanager
+def trace_context(log_dir: str | None):
+    """``jax.profiler.trace`` when a log dir is given, else a no-op."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,34 @@
+"""Test env: 8 virtual CPU devices so mesh/sharding paths run without TPUs.
+
+Must set flags before jax initializes its backends (standard JAX practice,
+SURVEY §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def synthetic_corpus_dir(tmp_path_factory):
+    """A small gene-pair corpus directory shaped like the reference's
+    ``data/test.txt`` smoke fixture (2 tokens per line, txt suffix)."""
+    rng = np.random.RandomState(7)
+    d = tmp_path_factory.mktemp("corpus")
+    genes = [f"GENE{i}" for i in range(40)]
+    lines = []
+    for _ in range(300):
+        a, b = rng.choice(len(genes), 2, replace=False)
+        lines.append(f"{genes[a]} {genes[b]}")
+    (d / "pairs_a.txt").write_text("\n".join(lines[:150]) + "\n")
+    (d / "pairs_b.txt").write_text("\n".join(lines[150:]) + "\n")
+    (d / "ignored.csv").write_text("not,a,pair,file\n")
+    return str(d)

@@ -1,0 +1,34 @@
+import numpy as np
+
+import jax
+
+from gene2vec_tpu.data.negative_sampling import NegativeSampler, noise_distribution
+
+
+def test_noise_distribution_unigram_exponent():
+    counts = np.array([8, 4, 2, 1], dtype=np.int64)
+    p = noise_distribution(counts, 0.75)
+    expected = counts.astype(np.float64) ** 0.75
+    expected /= expected.sum()
+    np.testing.assert_allclose(p, expected, rtol=1e-6)
+    assert abs(p.sum() - 1.0) < 1e-6
+
+
+def test_sampler_matches_distribution():
+    rngc = np.random.RandomState(0)
+    counts = rngc.randint(1, 1000, size=50)
+    sampler = NegativeSampler(counts, 0.75)
+    draws = sampler.sample(jax.random.PRNGKey(0), (200_000,))
+    draws = np.asarray(draws)
+    assert draws.min() >= 0 and draws.max() < 50
+    emp = np.bincount(draws, minlength=50) / draws.size
+    expected = noise_distribution(counts, 0.75)
+    # generous tolerance: 200k draws, compare in absolute probability
+    np.testing.assert_allclose(emp, expected, atol=5e-3)
+
+
+def test_sampler_covers_rare_tokens():
+    counts = np.array([10_000] * 5 + [1], dtype=np.int64)
+    sampler = NegativeSampler(counts, 0.75)
+    draws = np.asarray(sampler.sample(jax.random.PRNGKey(1), (500_000,)))
+    assert (draws == 5).sum() > 0  # the rare token is reachable

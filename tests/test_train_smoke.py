@@ -1,0 +1,87 @@
+"""End-to-end smoke: train on the synthetic fixture, check loss decrease,
+checkpoint cadence, export formats, and resume (SURVEY §4 implications)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.io.emb_io import read_matrix_txt, read_word2vec_format
+from gene2vec_tpu.io.pair_reader import load_corpus
+from gene2vec_tpu.sgns.train import SGNSTrainer
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory, synthetic_corpus_dir):
+    out = str(tmp_path_factory.mktemp("emb"))
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    corpus = PairCorpus(vocab, pairs)
+    cfg = SGNSConfig(dim=16, num_iters=3, batch_pairs=50, negatives=5, seed=0)
+    trainer = SGNSTrainer(corpus, cfg)
+    params = trainer.run(out, log=lambda s: None)
+    return out, corpus, cfg, trainer, params
+
+
+def test_loss_decreases(trained):
+    out, corpus, cfg, trainer, params = trained
+    losses = []
+    for it in range(1, cfg.num_iters + 1):
+        _, _, meta = ckpt.load_iteration(out, cfg.dim, it)
+        losses.append(meta["loss"])
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_files_and_formats(trained):
+    out, corpus, cfg, _, params = trained
+    for it in range(1, cfg.num_iters + 1):
+        prefix = ckpt.ckpt_prefix(out, cfg.dim, it)
+        assert os.path.exists(prefix + ".npz")
+        assert os.path.exists(prefix + ".txt")
+        assert os.path.exists(prefix + "_w2v.txt")
+    toks, m = read_matrix_txt(ckpt.ckpt_prefix(out, cfg.dim, cfg.num_iters) + ".txt")
+    assert toks == corpus.vocab.id_to_token
+    np.testing.assert_allclose(m, np.asarray(params.emb), rtol=1e-6)
+    toks2, m2 = read_word2vec_format(
+        ckpt.ckpt_prefix(out, cfg.dim, cfg.num_iters) + "_w2v.txt"
+    )
+    assert toks2 == toks
+    np.testing.assert_allclose(m2, m, rtol=1e-6)
+
+
+def test_resume_continues_from_latest(trained, tmp_path):
+    out, corpus, cfg, _, _ = trained
+    assert ckpt.latest_iteration(out, cfg.dim) == cfg.num_iters
+    # extend num_iters and resume: iterations 1..3 must not be retrained
+    cfg5 = SGNSConfig(
+        dim=cfg.dim, num_iters=5, batch_pairs=50, negatives=5, seed=0
+    )
+    trainer = SGNSTrainer(corpus, cfg5)
+    logs = []
+    trainer.run(out, log=logs.append)
+    assert any("resuming from iteration 3" in s for s in logs)
+    assert ckpt.latest_iteration(out, cfg.dim) == 5
+    started = [s for s in logs if s.endswith("start")]
+    assert len(started) == 2  # only iterations 4 and 5
+
+
+def test_embedding_quality_sanity(trained):
+    """Pairs seen in the corpus should, on average, be more similar than
+    random pairs — the de-facto correctness oracle the reference relies
+    on (target-function shape, src/evaluation_target_function.py:54-60)."""
+    out, corpus, cfg, _, params = trained
+    emb = np.asarray(params.emb)
+    unit = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    pair_sims = np.einsum(
+        "nd,nd->n", unit[corpus.pairs[:, 0]], unit[corpus.pairs[:, 1]]
+    )
+    rng = np.random.RandomState(0)
+    ra = rng.randint(0, len(corpus.vocab), 2000)
+    rb = rng.randint(0, len(corpus.vocab), 2000)
+    keep = ra != rb
+    rand_sims = np.einsum("nd,nd->n", unit[ra[keep]], unit[rb[keep]])
+    assert pair_sims.mean() > rand_sims.mean()

@@ -1,0 +1,113 @@
+import numpy as np
+import pytest
+
+from gene2vec_tpu.io.emb_io import (
+    load_embedding_any,
+    load_embedding_for_vocab,
+    read_matrix_txt,
+    read_word2vec_format,
+    write_matrix_txt,
+    write_word2vec_format,
+)
+from gene2vec_tpu.io.pair_reader import load_corpus, read_pair_files
+from gene2vec_tpu.io.vocab import Vocab
+
+
+def test_vocab_frequency_order():
+    pairs = [["A", "B"], ["A", "C"], ["A", "B"], ["D", "C"]]
+    v = Vocab.from_pairs(pairs)
+    assert v.id_to_token[0] == "A"  # count 3
+    # ties (B:2, C:2) break by first appearance
+    assert v.id_to_token[1] == "B" and v.id_to_token[2] == "C"
+    assert v.id_to_token[3] == "D"
+    assert v.counts.tolist() == [3, 2, 2, 1]
+
+
+def test_vocab_min_count_and_encode():
+    pairs = [["A", "B"], ["A", "C"], ["B", "A"]]
+    v = Vocab.from_pairs(pairs, min_count=2)
+    assert "C" not in v
+    enc = v.encode_pairs(pairs)
+    # the A-C pair is dropped
+    assert enc.shape == (2, 2)
+    assert set(map(tuple, enc.tolist())) == {
+        (v.token_to_id["A"], v.token_to_id["B"]),
+        (v.token_to_id["B"], v.token_to_id["A"]),
+    }
+
+
+def test_vocab_roundtrip(tmp_path):
+    v = Vocab.from_pairs([["X", "Y"], ["X", "Z"]])
+    p = tmp_path / "vocab.tsv"
+    v.save(str(p))
+    v2 = Vocab.load(str(p))
+    assert v2.id_to_token == v.id_to_token
+    assert v2.counts.tolist() == v.counts.tolist()
+    assert v2.token_to_id == v.token_to_id
+
+
+def test_read_pair_files_filters_pattern(synthetic_corpus_dir):
+    pairs = read_pair_files(synthetic_corpus_dir, "txt")
+    assert len(pairs) == 300
+    assert all(len(p) == 2 for p in pairs)
+
+
+def test_load_corpus(synthetic_corpus_dir):
+    vocab, enc = load_corpus(synthetic_corpus_dir, "txt")
+    assert enc.shape == (300, 2)
+    assert enc.max() < len(vocab)
+    # counts must equal occurrences in the corpus
+    flat = enc.reshape(-1)
+    binc = np.bincount(flat, minlength=len(vocab))
+    assert binc.tolist() == vocab.counts.tolist()
+
+
+def test_matrix_txt_roundtrip(tmp_path):
+    toks = ["TP53", "BRCA1", "EGFR"]
+    m = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    p = str(tmp_path / "emb.txt")
+    write_matrix_txt(p, toks, m)
+    # format check: gene \t v v v ... v<space>\n  (src/generateMatrix.py:19-23)
+    first = open(p).readline()
+    assert first.startswith("TP53\t") and first.endswith(" \n")
+    toks2, m2 = read_matrix_txt(p)
+    assert toks2 == toks
+    np.testing.assert_allclose(m2, m, rtol=1e-6)
+
+
+def test_word2vec_format_roundtrip(tmp_path):
+    toks = ["TP53", "BRCA1"]
+    m = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    p = str(tmp_path / "emb_w2v.txt")
+    write_word2vec_format(p, toks, m)
+    header = open(p).readline().split()
+    assert header == ["2", "4"]  # "<count> <dim>" header the reference detects
+    toks2, m2 = read_word2vec_format(p)
+    assert toks2 == toks
+    np.testing.assert_allclose(m2, m, rtol=1e-6)
+
+
+def test_load_embedding_any_detects_format(tmp_path):
+    toks = ["A", "B"]
+    m = np.eye(2, 3, dtype=np.float32)
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    write_matrix_txt(p1, toks, m)
+    write_word2vec_format(p2, toks, m)
+    for p in (p1, p2):
+        t, mm = load_embedding_any(p)
+        assert t == toks
+        np.testing.assert_allclose(mm, m)
+
+
+def test_load_embedding_for_vocab_missing_fallback(tmp_path):
+    # present genes get file vectors; missing genes keep U(-0.25,0.25)
+    # random init (src/GGIPNN_util.py:6-14)
+    toks = ["A", "B"]
+    m = np.full((2, 4), 3.0, dtype=np.float32)
+    p = str(tmp_path / "emb.txt")
+    write_matrix_txt(p, toks, m)
+    vocab = {"A": 0, "MISSING": 1, "B": 2}
+    out = load_embedding_for_vocab(vocab, p, 4)
+    np.testing.assert_allclose(out[0], 3.0)
+    np.testing.assert_allclose(out[2], 3.0)
+    assert np.all(np.abs(out[1]) <= 0.25) and not np.allclose(out[1], 3.0)
