@@ -1,0 +1,10 @@
+"""Co-expression pair-corpus construction (layer L1)."""
+
+from gene2vec_tpu.corpus.builder import (  # noqa: F401
+    abs_correlation,
+    build_pairs,
+    clean_and_normalize,
+    coexpression_pairs,
+    gene_annotated_data,
+    half_min,
+)

@@ -1,0 +1,229 @@
+"""Pair-corpus builder: per-study co-expression thresholding.
+
+Behavioral re-design of ``src/generate_gene_pairs.py``.  The recipe
+(SURVEY §2.2 #15), preserved exactly:
+
+* keep studies with ≥ ``min_study_samples`` samples (``:163-164``);
+* per study: drop genes whose **per-study** total raw counts are < 10
+  (``:88-95``), replace zeros with half of the **global** non-zero minimum
+  of the full TPM matrix (``:73-79,99`` — global, not per-study, a quirk we
+  keep), log2 (``:100-101``);
+* optionally map ``ENSEMBL|SYMBOL`` gene ids to symbols, dropping empty and
+  non-unique symbols (``:105-125``);
+* abs Pearson correlation > threshold emits a pair; the scan over the full
+  symmetric matrix emits **both (i, j) and (j, i)**, diagonal excluded
+  (``:59-63``), so every co-expressed pair appears twice in the corpus.
+
+TPU-first hot loop: the reference's ``data.corr()`` (``:49``) is
+O(genes² · samples) BLAS per study.  Here correlation is computed as one
+standardized matmul — corr = ZᵀZ/(n−1) with Z the column-standardized
+matrix — which ``backend="jax"`` runs on the TPU MXU in float32 (genes² ≫
+samples, a textbook systolic-array workload).  Zero-variance columns are
+masked out (pandas yields NaN there, which never passes the threshold).
+
+Parallelism: the reference ships a Ray cluster for what is an
+embarrassingly parallel per-study map (``:167-191``); here ``parallel=True``
+uses a ``multiprocessing.Pool`` — no cluster runtime — and the JAX backend
+typically makes even the serial path faster than parallel CPU pandas.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MIN_TOTAL_COUNTS = 10.0
+
+
+def half_min(x: np.ndarray) -> float:
+    """Half of the smallest positive entry (zero-replacement value)."""
+    pos = x[x > 0]
+    if pos.size == 0:
+        raise ValueError("matrix has no positive entries")
+    return float(pos.min() / 2.0)
+
+
+def _split_gene_ids(gene_ids: Sequence[str]) -> Tuple[List[str], List[str]]:
+    """'ENSEMBL|SYMBOL' ids → (ensembl list, symbol list; '' if absent)."""
+    ens, sym = [], []
+    for gid in gene_ids:
+        parts = str(gid).split("|")
+        ens.append(parts[0])
+        sym.append(parts[1] if len(parts) > 1 else "")
+    return ens, sym
+
+
+def clean_and_normalize(
+    data,
+    gene_counts,
+    sample_ids: Optional[List[str]] = None,
+    *,
+    min_total_counts: float = MIN_TOTAL_COUNTS,
+    global_half_min: Optional[float] = None,
+):
+    """Per-study cleaned + log2 TPM slice (pandas in, pandas out).
+
+    ``data``: samples × genes TPM; ``gene_counts``: raw counts with a
+    ``gene_id`` column and per-sample columns.  Gene totals are computed
+    over the study's samples; the zero-replacement half-min over the
+    **global** matrix.
+    """
+    import pandas as pd
+
+    if sample_ids is None:
+        sample_ids = data.index.tolist()
+    ens, _ = _split_gene_ids(gene_counts["gene_id"])
+    totals = pd.Series(
+        index=ens, data=gene_counts.loc[:, sample_ids].sum(axis=1).values
+    )
+    keep = totals >= min_total_counts
+    sub = data.loc[sample_ids, keep.values].copy()
+    hm = half_min(data.values) if global_half_min is None else global_half_min
+    sub = sub.replace(0.0, hm)
+    return np.log2(sub)
+
+
+def gene_annotated_data(
+    data,
+    gene_counts,
+    sample_ids: Optional[List[str]] = None,
+    *,
+    min_total_counts: float = MIN_TOTAL_COUNTS,
+    global_half_min: Optional[float] = None,
+):
+    """clean_and_normalize + rename columns to gene symbols, keeping only
+    genes with a non-empty, unique symbol."""
+    normed = clean_and_normalize(
+        data,
+        gene_counts,
+        sample_ids,
+        min_total_counts=min_total_counts,
+        global_half_min=global_half_min,
+    )
+    ens, sym = _split_gene_ids(gene_counts["gene_id"])
+    names = dict(zip(ens, sym))
+    normed = normed.rename(columns=names)
+    normed = normed.loc[:, normed.columns != ""]
+    vc = normed.columns.value_counts()
+    return normed.loc[:, vc.index[vc == 1]]
+
+
+def abs_correlation(matrix: np.ndarray, backend: str = "numpy") -> np.ndarray:
+    """|Pearson correlation| between columns, as a standardized matmul.
+
+    Zero-variance columns get 0 everywhere (they can never pass a positive
+    threshold — matching pandas' NaN-never-compares behavior).
+    """
+    x = np.asarray(matrix, dtype=np.float64)
+    n = x.shape[0]
+    mean = x.mean(axis=0)
+    std = x.std(axis=0, ddof=1)
+    ok = std > 0
+    z = np.where(ok, (x - mean) / np.where(ok, std, 1.0), 0.0)
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        zj = jnp.asarray(z, dtype=jnp.float32)
+        corr = np.asarray(jnp.abs(zj.T @ zj) / (n - 1))
+    elif backend == "numpy":
+        corr = np.abs(z.T @ z) / (n - 1)
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    return np.clip(corr, 0.0, 1.0)
+
+
+def coexpression_pairs(
+    normed, *, corr_threshold: float = 0.9, backend: str = "numpy"
+) -> List[str]:
+    """'g1 g2' lines for every |corr| > threshold column pair — both
+    directions, no self-pairs."""
+    genes = list(normed.columns)
+    corr = abs_correlation(normed.values, backend=backend)
+    rows, cols = (corr > corr_threshold).nonzero()
+    return [f"{genes[r]} {genes[c]}" for r, c in zip(rows, cols) if r != c]
+
+
+def _study_pairs(args) -> List[str]:
+    (
+        data,
+        gene_counts,
+        sample_ids,
+        ensembl,
+        corr_threshold,
+        min_total_counts,
+        ghm,
+        backend,
+    ) = args
+    fn = clean_and_normalize if ensembl else gene_annotated_data
+    normed = fn(
+        data,
+        gene_counts,
+        sample_ids,
+        min_total_counts=min_total_counts,
+        global_half_min=ghm,
+    )
+    return coexpression_pairs(
+        normed, corr_threshold=corr_threshold, backend=backend
+    )
+
+
+def build_pairs(
+    query_dir: str,
+    out_path: Optional[str] = None,
+    *,
+    corr_threshold: float = 0.9,
+    min_study_samples: int = 20,
+    min_total_counts: float = MIN_TOTAL_COUNTS,
+    ensembl: bool = False,
+    parallel: bool = False,
+    num_workers: Optional[int] = None,
+    backend: str = "numpy",
+    log: Callable[[str], None] = print,
+) -> List[str]:
+    """End-to-end: query dir (``data/SRARunTable.csv``,
+    ``data/gene_counts_TPM.csv``, ``data/gene_counts.csv``) → pair lines,
+    optionally written to ``out_path``."""
+    import pandas as pd
+
+    run_table = pd.read_csv(os.path.join(query_dir, "data", "SRARunTable.csv"), index_col=0)
+    data = pd.read_csv(
+        os.path.join(query_dir, "data", "gene_counts_TPM.csv"), index_col=0
+    )
+    gene_counts = pd.read_csv(os.path.join(query_dir, "data", "gene_counts.csv"))
+    data = data.loc[run_table.index.tolist()]
+
+    study_counts = run_table["SRA Study"].value_counts()
+    studies = study_counts.index[study_counts >= min_study_samples].tolist()
+    log(f"{len(studies)} studies with ≥{min_study_samples} samples")
+
+    ghm = half_min(data.values)  # global, computed once (reference quirk)
+    jobs = [
+        (
+            data,
+            gene_counts,
+            run_table.index[run_table["SRA Study"] == s].tolist(),
+            ensembl,
+            corr_threshold,
+            min_total_counts,
+            ghm,
+            backend,
+        )
+        for s in studies
+    ]
+    if parallel and len(jobs) > 1:
+        import multiprocessing as mp
+
+        with mp.Pool(num_workers or os.cpu_count()) as pool:
+            results = pool.map(_study_pairs, jobs)
+    else:
+        results = [_study_pairs(j) for j in jobs]
+
+    pairs = [p for r in results for p in r]
+    log(f"{len(pairs):,} total co-expression gene pairs computed")
+    if out_path is not None:
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write("\n".join(pairs))
+        log(f"wrote {out_path}")
+    return pairs

@@ -1,0 +1,3 @@
+"""Evaluation suite: intrinsic target function, extrinsic AUC, parity harness."""
+
+from gene2vec_tpu.eval.metrics import roc_auc_score  # noqa: F401

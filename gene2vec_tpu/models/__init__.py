@@ -1,0 +1,9 @@
+"""Model families: GGIPNN (gene-gene-interaction MLP) and friends."""
+
+from gene2vec_tpu.models.ggipnn import GGIPNN  # noqa: F401
+from gene2vec_tpu.models.ggipnn_data import (  # noqa: F401
+    PairTextVocab,
+    batch_iter,
+    one_hot_labels,
+)
+from gene2vec_tpu.models.ggipnn_train import GGIPNNTrainer  # noqa: F401

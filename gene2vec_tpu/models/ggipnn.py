@@ -1,0 +1,94 @@
+"""GGIPNN — gene-gene-interaction prediction MLP, as a Flax module.
+
+Behavioral re-design of the TF1 graph in ``src/GGIPNN.py:3-83``: embedding
+lookup of a (B, 2) gene-id batch → flatten to (B, 2·D) → Dense(100)+ReLU →
+dropout → Dense(100)+ReLU → dropout → Dense(10)+ReLU → dropout →
+Dense(num_classes) softmax.  Quirks preserved where behaviorally relevant
+(SURVEY §2.2):
+
+* dropout **also after the last hidden layer**, keep-prob 0.5 train / 1.0
+  eval (#12, ``src/GGIPNN.py:56-58``);
+* hidden widths hardcoded (100, 100, 10) — the reference's
+  ``hidden_dimension`` flag is mostly decorative (#8);
+* L2 applies to kernels only, default λ=0 (#10 — the reference's bias
+  filter is a no-op anyway);
+* the TF1 ``/cpu:0`` pin on the table (#9) is deliberately inverted: on TPU
+  the table lives in HBM with everything else.
+
+The frozen-vs-trainable pretrained-table switch (``embedTrain``,
+``src/GGIPNN_Classification.py:16``) is handled in the optimizer (see
+ggipnn_train.py), not the module — functionally the cleaner seam in JAX.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from gene2vec_tpu.config import GGIPNNConfig
+
+
+class GGIPNN(nn.Module):
+    """MLP over concatenated pair embeddings."""
+
+    vocab_size: int
+    embedding_dim: int = 200
+    hidden_dims: Sequence[int] = (100, 100, 10)
+    num_classes: int = 2
+    dropout_keep_prob: float = 0.5
+
+    @nn.compact
+    def __call__(self, gene_ids: jax.Array, train: bool = False) -> jax.Array:
+        """(B, 2) int ids → (B, num_classes) logits."""
+        # U(-1, 1) table init as in the reference (src/GGIPNN.py:17);
+        # overwritten when a pretrained table is loaded.
+        table = self.param(
+            "embedding",
+            lambda key, shape: jax.random.uniform(key, shape, jnp.float32, -1.0, 1.0),
+            (self.vocab_size, self.embedding_dim),
+        )
+        x = table[gene_ids]                               # (B, 2, D)
+        x = x.reshape((x.shape[0], -1))                   # (B, 2·D)
+        drop = nn.Dropout(
+            rate=1.0 - self.dropout_keep_prob, deterministic=not train
+        )
+        for i, width in enumerate(self.hidden_dims):
+            x = nn.Dense(width, name=f"hidden{i + 1}")(x)
+            x = nn.relu(x)
+            x = drop(x)
+        return nn.Dense(self.num_classes, name="output")(x)
+
+    @classmethod
+    def from_config(cls, cfg: GGIPNNConfig, vocab_size: int) -> "GGIPNN":
+        return cls(
+            vocab_size=vocab_size,
+            embedding_dim=cfg.embedding_dim,
+            hidden_dims=tuple(cfg.hidden_dims),
+            num_classes=cfg.num_classes,
+            dropout_keep_prob=cfg.dropout_keep_prob,
+        )
+
+
+def loss_fn(
+    logits: jax.Array, labels_onehot: jax.Array, params, l2_lambda: float = 0.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Softmax cross-entropy (+ optional kernel L2) and accuracy — the
+    reference's loss/accuracy pair (``src/GGIPNN.py:72-83``)."""
+    logp = jax.nn.log_softmax(logits)
+    xent = -jnp.mean(jnp.sum(labels_onehot * logp, axis=-1))
+    if l2_lambda:
+        l2 = sum(
+            jnp.sum(jnp.square(leaf))
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+            if any(
+                getattr(p, "key", None) == "kernel" for p in path
+            )
+        )
+        xent = xent + l2_lambda * l2
+    acc = jnp.mean(
+        (jnp.argmax(logits, -1) == jnp.argmax(labels_onehot, -1)).astype(jnp.float32)
+    )
+    return xent, acc

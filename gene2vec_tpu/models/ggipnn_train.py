@@ -1,0 +1,231 @@
+"""GGIPNN train/eval harness.
+
+Re-design of ``src/GGIPNN_Classification.py``: transductive vocab over all
+splits, one-hot labels, Adam(1e-3), train loop with periodic dev evaluation
+and checkpointing, then a single-pass test inference producing softmax
+scores; ROC-AUC computed from the positive-class column
+(``scores[:, 1]``, SURVEY §2.2 #11).
+
+TPU shape vs the reference:
+
+* the per-batch ``sess.run`` feed-dict boundary becomes one donated jitted
+  train step; the thrice-repeated test-time ``sess.run`` per batch
+  (``src/GGIPNN_Classification.py:238-244``) collapses into one jitted call
+  returning scores and predictions together;
+* ``embed_train=False`` freezes the table via a masked optimizer (zero
+  updates) instead of TF's trainable=False variable flag;
+* evaluation pads the final ragged batch to keep shapes static — XLA
+  compiles each (batch, seq) shape once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from gene2vec_tpu.config import GGIPNNConfig
+from gene2vec_tpu.eval.metrics import roc_auc_score
+from gene2vec_tpu.io.emb_io import load_embedding_for_vocab
+from gene2vec_tpu.models.ggipnn import GGIPNN, loss_fn
+from gene2vec_tpu.models.ggipnn_data import (
+    PairTextVocab,
+    batch_iter,
+    one_hot_labels,
+    read_lines,
+)
+
+
+class GGIPNNTrainer:
+    """Trains a :class:`GGIPNN` on encoded (N, 2) id pairs + one-hot labels."""
+
+    def __init__(self, config: GGIPNNConfig, vocab: PairTextVocab):
+        self.config = config
+        self.vocab = vocab
+        self.model = GGIPNN.from_config(config, vocab_size=len(vocab))
+        label = "frozen" if not config.embed_train else "train"
+        self.tx = optax.multi_transform(
+            {
+                "train": optax.adam(config.learning_rate),
+                "frozen": optax.set_to_zero(),
+            },
+            param_labels=functools.partial(self._labels, label),
+        )
+        self._step = 0
+
+    @staticmethod
+    def _labels(embedding_label: str, params) -> dict:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: embedding_label
+            if any(getattr(p, "key", None) == "embedding" for p in path)
+            else "train",
+            params,
+        )
+
+    # -- setup -------------------------------------------------------------
+
+    def init_state(
+        self, pretrained_emb_path: Optional[str] = None
+    ) -> Tuple[dict, optax.OptState]:
+        key = jax.random.PRNGKey(self.config.seed)
+        dummy = jnp.zeros((1, self.config.sequence_length), jnp.int32)
+        params = self.model.init({"params": key}, dummy)["params"]
+        if pretrained_emb_path is not None and self.config.use_pretrained:
+            table = load_embedding_for_vocab(
+                self.vocab.token_to_id,
+                pretrained_emb_path,
+                self.config.embedding_dim,
+                rng=np.random.RandomState(self.config.seed),
+            )
+            params = dict(params)
+            params["embedding"] = jnp.asarray(table)
+        opt_state = self.tx.init(params)
+        return params, opt_state
+
+    # -- jitted steps ------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+    def train_step(self, params, opt_state, batch_x, batch_y, dropout_key):
+        def loss_of(p):
+            logits = self.model.apply(
+                {"params": p}, batch_x, train=True, rngs={"dropout": dropout_key}
+            )
+            return loss_fn(logits, batch_y, p, self.config.l2_lambda)
+
+        (loss, acc), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, acc
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def eval_step(self, params, batch_x, batch_y):
+        logits = self.model.apply({"params": params}, batch_x, train=False)
+        loss, acc = loss_fn(logits, batch_y, params, self.config.l2_lambda)
+        scores = jax.nn.softmax(logits)
+        return loss, acc, scores, jnp.argmax(logits, -1)
+
+    # -- loops -------------------------------------------------------------
+
+    def fit(
+        self,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        x_valid: Optional[np.ndarray] = None,
+        y_valid: Optional[np.ndarray] = None,
+        log: Callable[[str], None] = print,
+        checkpoint_fn: Optional[Callable[[int, dict], None]] = None,
+    ) -> Tuple[dict, optax.OptState]:
+        cfg = self.config
+        params, opt_state = getattr(self, "_state", (None, None))
+        if params is None:
+            params, opt_state = self.init_state()
+        key = jax.random.PRNGKey(cfg.seed + 1)
+        stacked = np.concatenate([x_train, y_train], axis=1)
+        nx = x_train.shape[1]
+        for batch in batch_iter(stacked, cfg.batch_size, cfg.num_epochs, seed=cfg.seed):
+            bx = jnp.asarray(batch[:, :nx].astype(np.int32))
+            by = jnp.asarray(batch[:, nx:].astype(np.float32))
+            key, sub = jax.random.split(key)
+            params, opt_state, loss, acc = self.train_step(
+                params, opt_state, bx, by, sub
+            )
+            self._step += 1
+            if self._step % cfg.evaluate_every == 0:
+                msg = f"step {self._step}: loss {float(loss):.4f} acc {float(acc):.4f}"
+                if x_valid is not None and y_valid is not None:
+                    dev = self.evaluate(params, x_valid, y_valid)
+                    msg += (
+                        f" | dev loss {dev['loss']:.4f} acc {dev['accuracy']:.4f}"
+                    )
+                log(msg)
+            if checkpoint_fn is not None and self._step % cfg.checkpoint_every == 0:
+                checkpoint_fn(self._step, params)
+        self._state = (params, opt_state)
+        return params, opt_state
+
+    def evaluate(
+        self, params, x: np.ndarray, y_onehot: np.ndarray
+    ) -> Dict[str, float]:
+        """Full-split evaluation in static-shape batches; returns loss,
+        accuracy, and (when both classes present) ROC-AUC from
+        ``scores[:, 1]``."""
+        scores, preds, losses = self.predict(params, x, y_onehot)
+        labels = np.argmax(y_onehot, axis=1)
+        out = {
+            "loss": float(np.mean(losses)),
+            "accuracy": float((preds == labels).mean()),
+        }
+        if len(np.unique(labels)) == 2:
+            out["auc"] = roc_auc_score(labels, scores[:, 1])
+        return out
+
+    def predict(
+        self, params, x: np.ndarray, y_onehot: Optional[np.ndarray] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(softmax scores, argmax predictions, per-batch losses) over a
+        split, batched at config.batch_size with tail padding."""
+        cfg = self.config
+        n = x.shape[0]
+        if y_onehot is None:
+            y_onehot = np.zeros((n, cfg.num_classes), np.float32)
+        bs = cfg.batch_size
+        scores_out: List[np.ndarray] = []
+        preds_out: List[np.ndarray] = []
+        losses: List[float] = []
+        for start in range(0, n, bs):
+            bx = x[start : start + bs]
+            by = y_onehot[start : start + bs]
+            pad = bs - bx.shape[0]
+            if pad:
+                bx = np.concatenate([bx, np.repeat(bx[-1:], pad, 0)], 0)
+                by = np.concatenate([by, np.repeat(by[-1:], pad, 0)], 0)
+            loss, _, scores, preds = self.eval_step(
+                params, jnp.asarray(bx, jnp.int32), jnp.asarray(by, jnp.float32)
+            )
+            take = bs - pad
+            scores_out.append(np.asarray(scores)[:take])
+            preds_out.append(np.asarray(preds)[:take])
+            losses.append(float(loss))
+        return (
+            np.concatenate(scores_out, 0),
+            np.concatenate(preds_out, 0),
+            np.asarray(losses),
+        )
+
+
+def run_classification(
+    data_dir: str,
+    emb_path: Optional[str],
+    config: GGIPNNConfig = GGIPNNConfig(),
+    log: Callable[[str], None] = print,
+) -> Dict[str, float]:
+    """End-to-end: the reference's main flow
+    (``src/GGIPNN_Classification.py:40-254``) over a ``predictionData/``-shaped
+    directory (train/valid/test ``_text.txt`` + ``_label.txt``)."""
+    splits = {}
+    for split in ("train", "valid", "test"):
+        splits[split] = (
+            read_lines(f"{data_dir}/{split}_text.txt"),
+            read_lines(f"{data_dir}/{split}_label.txt"),
+        )
+    vocab = PairTextVocab().fit(*(text for text, _ in splits.values()))
+    log(f"vocab size: {len(vocab)}")
+
+    enc = {
+        s: (vocab.transform(text), one_hot_labels(labels, config.num_classes))
+        for s, (text, labels) in splits.items()
+    }
+    trainer = GGIPNNTrainer(config, vocab)
+    params, opt_state = trainer.init_state(pretrained_emb_path=emb_path)
+    trainer._state = (params, opt_state)
+    params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log)
+    result = trainer.evaluate(params, *enc["test"])
+    log(f"test accuracy: {result['accuracy']:.4f}")
+    if "auc" in result:
+        log(f"The AUC score is {result['auc']:.6f}")
+    return result

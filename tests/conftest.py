@@ -6,7 +6,10 @@ SURVEY §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the session env pins JAX_PLATFORMS to the real TPU tunnel
+# (axon), which would make every test compile against (and contend for) the
+# single chip. Tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

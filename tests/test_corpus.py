@@ -1,0 +1,116 @@
+"""Corpus-builder tests: correlation matmul vs pandas oracle, normalization
+recipe, pair emission semantics, end-to-end on a synthetic query dir."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gene2vec_tpu.corpus import (
+    abs_correlation,
+    build_pairs,
+    clean_and_normalize,
+    coexpression_pairs,
+    gene_annotated_data,
+    half_min,
+)
+
+
+def test_half_min():
+    x = np.array([[0.0, 4.0], [2.0, 0.0]])
+    assert half_min(x) == 1.0
+
+
+def test_abs_correlation_matches_pandas():
+    rng = np.random.RandomState(0)
+    x = rng.randn(30, 12)
+    x[:, 3] = 2.0 * x[:, 1] + 0.1 * rng.randn(30)   # correlated pair
+    x[:, 5] = 7.0                                    # zero variance
+    df = pd.DataFrame(x)
+    oracle = df.corr().abs().values
+    ours = abs_correlation(x, backend="numpy")
+    mask = ~np.isnan(oracle)
+    np.testing.assert_allclose(ours[mask], oracle[mask], atol=1e-10)
+    # zero-variance col: pandas NaN (never passes threshold) → ours 0
+    assert (ours[5] == 0).all()
+
+
+def test_abs_correlation_jax_backend():
+    rng = np.random.RandomState(1)
+    x = rng.randn(25, 8)
+    np.testing.assert_allclose(
+        abs_correlation(x, "jax"), abs_correlation(x, "numpy"), atol=1e-4
+    )
+
+
+def _toy_query(tmp_path, n_samples=25, seed=0):
+    """Synthetic query dir: 2 studies, gene_id 'ENSG|SYM' with one dup
+    symbol, one low-count gene, one planted correlated gene pair."""
+    rng = np.random.RandomState(seed)
+    samples = [f"S{i}" for i in range(2 * n_samples)]
+    gene_ids = [
+        "ENSG01|GA", "ENSG02|GB", "ENSG03|GC", "ENSG04|GD",
+        "ENSG05|DUP", "ENSG06|DUP", "ENSG07|", "ENSG08|GLOW",
+    ]
+    ens = [g.split("|")[0] for g in gene_ids]
+    tpm = rng.rand(len(samples), len(ens)) * 10
+    tpm[:, 1] = tpm[:, 0] * 3.0 + 0.01 * rng.rand(len(samples))  # GA~GB corr
+    tpm[0, 2] = 0.0  # a zero to exercise half-min replacement
+    counts = (tpm * 100).round()
+    counts[:, 7] = 0.0  # GLOW: low total counts → dropped
+
+    d = tmp_path / "query" / "data"
+    d.mkdir(parents=True)
+    pd.DataFrame(
+        {"SRA Study": ["ST1"] * n_samples + ["ST2"] * n_samples},
+        index=pd.Index(samples, name="Run"),
+    ).to_csv(d / "SRARunTable.csv")
+    pd.DataFrame(tpm, index=pd.Index(samples, name="run"), columns=ens).to_csv(
+        d / "gene_counts_TPM.csv"
+    )
+    cdf = pd.DataFrame(counts.T, columns=samples)
+    cdf.insert(0, "gene_id", gene_ids)
+    cdf.to_csv(d / "gene_counts.csv", index=False)
+    return str(tmp_path / "query")
+
+
+def test_clean_and_normalize_drops_low_count_genes(tmp_path):
+    q = _toy_query(tmp_path)
+    data = pd.read_csv(f"{q}/data/gene_counts_TPM.csv", index_col=0)
+    gene_counts = pd.read_csv(f"{q}/data/gene_counts.csv")
+    normed = clean_and_normalize(data, gene_counts, data.index[:25].tolist())
+    assert "ENSG08" not in normed.columns        # low counts dropped
+    assert "ENSG01" in normed.columns
+    assert np.isfinite(normed.values).all()      # zeros half-min-replaced pre-log2
+
+
+def test_gene_annotation_unique_symbols(tmp_path):
+    q = _toy_query(tmp_path)
+    data = pd.read_csv(f"{q}/data/gene_counts_TPM.csv", index_col=0)
+    gene_counts = pd.read_csv(f"{q}/data/gene_counts.csv")
+    normed = gene_annotated_data(data, gene_counts)
+    assert "DUP" not in normed.columns           # duplicate symbol dropped
+    assert "" not in normed.columns              # empty symbol dropped
+    assert {"GA", "GB", "GC", "GD"} <= set(normed.columns)
+
+
+def test_coexpression_emits_both_directions():
+    rng = np.random.RandomState(2)
+    base = rng.randn(40)
+    df = pd.DataFrame(
+        {"A": base, "B": base * 2 + 1e-3 * rng.randn(40), "C": rng.randn(40)}
+    )
+    pairs = coexpression_pairs(df, corr_threshold=0.9)
+    assert "A B" in pairs and "B A" in pairs     # symmetric double emission
+    assert not any("A A" in p.split() [0] == p.split()[1] for p in pairs)
+    assert len(pairs) == 2
+
+
+def test_build_pairs_end_to_end(tmp_path):
+    q = _toy_query(tmp_path)
+    out = tmp_path / "pairs.txt"
+    pairs = build_pairs(q, str(out), log=lambda s: None)
+    assert "GA GB" in pairs and "GB GA" in pairs
+    assert out.read_text().count("GA GB") >= 1
+    # parallel path agrees with serial
+    parallel = build_pairs(q, parallel=True, num_workers=2, log=lambda s: None)
+    assert sorted(parallel) == sorted(pairs)

@@ -1,0 +1,127 @@
+"""GGIPNN model family tests: data utils, model math, training, AUC."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import GGIPNNConfig
+from gene2vec_tpu.eval.metrics import roc_auc_score
+from gene2vec_tpu.models import GGIPNN, GGIPNNTrainer, PairTextVocab
+from gene2vec_tpu.models.ggipnn_data import batch_iter, one_hot_labels
+
+
+def test_auc_matches_sklearn():
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 2, 500)
+    s = rng.rand(500)
+    s[y == 1] += 0.3  # separable-ish, with ties impossible
+    assert roc_auc_score(y, s) == pytest.approx(
+        sklearn_metrics.roc_auc_score(y, s), abs=1e-12
+    )
+    # with heavy ties
+    s_t = np.round(s, 1)
+    assert roc_auc_score(y, s_t) == pytest.approx(
+        sklearn_metrics.roc_auc_score(y, s_t), abs=1e-12
+    )
+
+
+def test_pair_vocab_transductive():
+    train = ["A B", "B C"]
+    test = ["C D"]  # D appears only in test → transductive fit must include it
+    v = PairTextVocab().fit(train, test)
+    assert len(v) == 4
+    enc = v.transform(test)
+    assert enc.shape == (1, 2)
+    assert v.id_to_token[enc[0, 1]] == "D"
+
+
+def test_one_hot_and_batch_iter():
+    oh = one_hot_labels(["0", "1", "1"])
+    assert oh.tolist() == [[1, 0], [0, 1], [0, 1]]
+    data = np.arange(10)[:, None]
+    batches = list(batch_iter(data, batch_size=4, num_epochs=2, shuffle=False))
+    # ragged tail kept: 4+4+2 per epoch
+    assert [len(b) for b in batches] == [4, 4, 2, 4, 4, 2]
+
+
+def test_ggipnn_forward_shapes():
+    model = GGIPNN(vocab_size=20, embedding_dim=8, hidden_dims=(16, 16, 4))
+    x = jnp.zeros((3, 2), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.shape == (3, 2)
+    # dropout active only in train mode and changes outputs
+    l1 = model.apply(
+        {"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    l2 = model.apply(
+        {"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)}
+    )
+    assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+
+def _toy_problem(n=600, vocab=30, seed=3):
+    """Pairs labeled by a planted rule: positive iff both ids < vocab/2."""
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, vocab, (n, 2)).astype(np.int32)
+    y = ((x[:, 0] < vocab // 2) & (x[:, 1] < vocab // 2)).astype(int)
+    return x, y
+
+
+def test_ggipnn_learns_planted_rule():
+    x, y = _toy_problem()
+    cfg = GGIPNNConfig(
+        embedding_dim=16,
+        hidden_dims=(32, 32, 8),
+        embed_train=True,
+        use_pretrained=False,
+        num_epochs=30,
+        batch_size=64,
+        evaluate_every=10**9,
+    )
+    vocab = PairTextVocab().fit([f"g{a} g{b}" for a, b in x])
+    trainer = GGIPNNTrainer(cfg, vocab)
+    enc = vocab.transform([f"g{a} g{b}" for a, b in x])
+    yoh = one_hot_labels(y)
+    params, _ = trainer.fit(enc, yoh, log=lambda s: None)
+    res = trainer.evaluate(params, enc, yoh)
+    assert res["accuracy"] > 0.9
+    assert res["auc"] > 0.95
+
+
+def test_frozen_embedding_not_updated(tmp_path):
+    x, y = _toy_problem(n=200)
+    lines = [f"g{a} g{b}" for a, b in x]
+    vocab = PairTextVocab().fit(lines)
+
+    # write a pretrained emb file covering half the vocab
+    from gene2vec_tpu.io.emb_io import write_word2vec_format
+
+    toks = vocab.id_to_token[: len(vocab) // 2]
+    mat = np.random.RandomState(0).randn(len(toks), 8).astype(np.float32)
+    emb_file = tmp_path / "emb.txt"
+    write_word2vec_format(str(emb_file), toks, mat)
+
+    cfg = GGIPNNConfig(
+        embedding_dim=8,
+        hidden_dims=(16, 16, 4),
+        embed_train=False,
+        num_epochs=3,
+        batch_size=32,
+        evaluate_every=10**9,
+    )
+    trainer = GGIPNNTrainer(cfg, vocab)
+    params, opt_state = trainer.init_state(pretrained_emb_path=str(emb_file))
+    # pretrained rows present; missing rows random U(-0.25, 0.25) (quirk #6)
+    table0 = np.asarray(params["embedding"])
+    np.testing.assert_allclose(table0[vocab.token_to_id[toks[0]]], mat[0], rtol=1e-6)
+    missing = table0[len(vocab) // 2 :]
+    assert np.abs(missing).max() <= 0.25
+
+    trainer._state = (params, opt_state)
+    enc = vocab.transform(lines)
+    params_after, _ = trainer.fit(enc, one_hot_labels(y), log=lambda s: None)
+    np.testing.assert_array_equal(np.asarray(params_after["embedding"]), table0)
