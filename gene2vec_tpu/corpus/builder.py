@@ -123,10 +123,15 @@ def abs_correlation(matrix: np.ndarray, backend: str = "numpy") -> np.ndarray:
     ok = std > 0
     z = np.where(ok, (x - mean) / np.where(ok, std, 1.0), 0.0)
     if backend == "jax":
+        import jax
         import jax.numpy as jnp
 
         zj = jnp.asarray(z, dtype=jnp.float32)
-        corr = np.asarray(jnp.abs(zj.T @ zj) / (n - 1))
+        # HIGHEST keeps full f32 on the MXU — the default bf16 passes loses
+        # ~3 decimal digits, enough to flip pairs sitting near the 0.9
+        # threshold.
+        prod = jnp.matmul(zj.T, zj, precision=jax.lax.Precision.HIGHEST)
+        corr = np.asarray(jnp.abs(prod) / (n - 1))
     elif backend == "numpy":
         corr = np.abs(z.T @ z) / (n - 1)
     else:

@@ -5,6 +5,7 @@ SURVEY §4).
 """
 
 import os
+import sys
 
 # Force-override: the session env pins JAX_PLATFORMS to the real TPU tunnel
 # (axon), which would make every test compile against (and contend for) the
@@ -15,6 +16,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The interpreter's sitecustomize imports jax at startup, so jax.config
+# latched the env *before* the overrides above.  Re-pin via the config API
+# (valid any time before backend initialization).
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
