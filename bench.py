@@ -1,0 +1,142 @@
+"""Headline benchmark: SGNS training throughput (gene-pairs/sec).
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": "sgns_pairs_per_sec", "value": N, "unit": "pairs/s",
+     "vs_baseline": N}
+
+``vs_baseline`` is measured, not assumed: the same training step is timed on
+the host CPU (XLA CPU backend, all cores — the stand-in for the reference's
+32-thread gensim-Cython Hogwild loop, ``src/gene2vec.py:59``) in a
+subprocess, on a smaller slice of the same workload, and the TPU rate is
+divided by the CPU rate.  All progress/log output goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def synth_corpus(vocab_size: int, num_pairs: int, seed: int = 0):
+    """Zipf-ish pair corpus at human-gene scale (reference: ~24k genes)."""
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+
+    rng = np.random.RandomState(seed)
+    # Zipf ranks give gensim-like skewed unigram counts.
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    pairs = rng.choice(vocab_size, size=(num_pairs, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=vocab_size).astype(np.int64)
+    vocab = Vocab([f"G{i}" for i in range(vocab_size)], counts)
+    return PairCorpus(vocab, pairs)
+
+
+def measure_pairs_per_sec(
+    dim: int, vocab_size: int, num_pairs: int, batch_pairs: int, epochs: int = 3
+) -> float:
+    """Steady-state epoch throughput (first epoch = compile, excluded)."""
+    import jax
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    corpus = synth_corpus(vocab_size, num_pairs)
+    config = SGNSConfig(dim=dim, batch_pairs=batch_pairs, num_iters=epochs)
+    trainer = SGNSTrainer(corpus, config)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+
+    params, loss = trainer.train_epoch(params, key)  # compile + warmup
+    float(loss)
+    pairs_per_epoch = trainer.num_batches * trainer.config.batch_pairs
+    t0 = time.perf_counter()
+    for e in range(1, epochs):
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, e))
+    float(loss)  # block
+    dt = time.perf_counter() - t0
+    rate = pairs_per_epoch * (epochs - 1) / dt
+    log(
+        f"platform={jax.devices()[0].platform} dim={dim} V={vocab_size} "
+        f"N={num_pairs} batch={batch_pairs}: {rate:,.0f} pairs/s "
+        f"({dt:.2f}s / {epochs - 1} epochs), final loss {float(loss):.4f}"
+    )
+    return rate
+
+
+def cpu_baseline(dim: int, vocab_size: int, batch_pairs: int, num_pairs: int) -> float:
+    """Measure the CPU rate in a subprocess (fresh backend, all host cores)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CPU_CHILD="1")
+    env.pop("XLA_FLAGS", None)  # single CPU "device", all cores via Eigen
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            f"--dim={dim}",
+            f"--vocab={vocab_size}",
+            f"--pairs={num_pairs}",
+            f"--batch={batch_pairs}",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1800,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        raise RuntimeError(f"CPU baseline subprocess failed:\n{out.stdout}")
+    return float(json.loads(out.stdout.strip().splitlines()[-1])["value"])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=200)
+    ap.add_argument("--vocab", type=int, default=24447)  # reference gene count scale
+    ap.add_argument("--pairs", type=int, default=4_000_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--cpu-pairs", type=int, default=200_000)
+    args = ap.parse_args()
+
+    if os.environ.get("BENCH_CPU_CHILD"):
+        # Child mode: measure on this process's (CPU) backend, emit one line.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        rate = measure_pairs_per_sec(
+            args.dim, args.vocab, args.pairs, args.batch, epochs=2
+        )
+        print(json.dumps({"metric": "cpu", "value": rate, "unit": "pairs/s"}))
+        return
+
+    tpu_rate = measure_pairs_per_sec(args.dim, args.vocab, args.pairs, args.batch)
+    try:
+        cpu_rate = cpu_baseline(args.dim, args.vocab, args.batch, args.cpu_pairs)
+        vs = tpu_rate / cpu_rate
+    except Exception as e:  # CPU baseline is best-effort; headline still prints
+        log(f"cpu baseline failed: {e}")
+        vs = float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "sgns_pairs_per_sec",
+                "value": round(tpu_rate, 1),
+                "unit": "pairs/s",
+                "vs_baseline": round(vs, 2) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,15 @@ class SGNSConfig:
     table_dtype: str = "float32"
     compute_dtype: str = "float32"
     both_directions: bool = True   # emit (a→b) and (b→a) per corpus pair
+    combiner: str = "capped"       # duplicate-row gradients: "capped" (sum, capped
+                                   # at C x mean for hot rows — stable at any batch
+                                   # size) | "mean" | "sum" (sequential-SGD-like,
+                                   # oracle parity at batch≈1)
+    negative_mode: str = "shared"  # "shared": one noise pool per step (MXU
+                                   # matmuls, pool-row scatter) | "per_example":
+                                   # gensim's per-example draws (oracle parity)
+    shared_pool: int = 64          # shared-mode noise-pool size (importance-
+                                   # weighted down to `negatives` per example)
     shuffle_each_iter: bool = True # reference reshuffles every iteration
                                    # (src/gene2vec.py:80)
     txt_output: bool = True        # also export matrix-txt + w2v-format per iter

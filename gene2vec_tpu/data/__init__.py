@@ -1,5 +1,7 @@
 from gene2vec_tpu.data.negative_sampling import (  # noqa: F401
+    build_alias_table,
     noise_distribution,
     NegativeSampler,
+    NoiseTable,
 )
 from gene2vec_tpu.data.pipeline import PairCorpus  # noqa: F401

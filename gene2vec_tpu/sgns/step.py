@@ -13,12 +13,26 @@ TPU-first formulation:
 * gradients are closed-form (the loss is a sum of log-sigmoids of rank-1
   dots — autodiff would materialize the same expressions with more
   bookkeeping), applied with deterministic ``.at[].add`` scatter-adds.
-  Duplicate indices within a batch sum their contributions — the
-  deterministic analogue of gensim's benign Hogwild races (SURVEY §7 hard
-  part 1);
+  Duplicate indices within a batch combine via ``combiner`` (default
+  ``"capped"``): plain summing matches sequential SGD for typical duplicate
+  counts but diverges when a hot token appears thousands of times per batch
+  (all those gradients are evaluated at the same stale parameter value —
+  gensim never hits this because its Hogwild loop applies updates one pair
+  at a time), so the per-row sum is capped at C x mean (see
+  :func:`_row_divisor`, SURVEY §7 hard part 1).  ``combiner="sum"``
+  restores raw summing for small-batch oracle comparisons;
 * negatives that collide with the positive target are masked out of loss and
   update (gensim skips them; a resampling loop would be data-dependent
-  control flow XLA can't tile).
+  control flow XLA can't tile);
+* by default negatives are **shared across the batch** (``negative_mode=
+  "shared"``): one pool of P = ``shared_pool`` noise draws per step (each
+  example's negative term is the pool mean importance-weighted by K/P, an
+  unbiased estimate of the K-negative SGNS objective), so the negative
+  logits are a single (E, D) x (D, P) MXU matmul and the negative update is
+  a (P, E) x (E, D) matmul scattered into just P rows — versus a
+  per-example (E, K, D) gather plus an E*K-row scatter, which profiling
+  showed dominated the step.  ``negative_mode="per_example"`` keeps
+  gensim's exact per-example draws for oracle comparisons.
 
 Everything is shape-static and jit-safe; under a Mesh the same code runs
 data-parallel (sharded batch, replicated tables → XLA all-reduces the
@@ -33,7 +47,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from gene2vec_tpu.data.negative_sampling import sample_negatives
+from gene2vec_tpu.data.negative_sampling import NoiseTable, sample_negatives
 from gene2vec_tpu.sgns.model import SGNSParams
 
 
@@ -85,23 +99,68 @@ def sgns_loss_and_grads(
     return jnp.mean(loss), (d_center, d_pos, d_neg)
 
 
-def sgns_step(
-    params: SGNSParams,
-    pairs: jax.Array,  # (B, 2) int32
-    cdf: jax.Array,    # (V,) noise CDF
-    key: jax.Array,
-    lr: jax.Array,
-    negatives: int = 5,
-    both_directions: bool = True,
-    compute_dtype=jnp.float32,
-) -> Tuple[SGNSParams, jax.Array]:
-    """One fused SGD step over a batch of corpus pairs."""
-    centers, contexts = _examples_from_pairs(pairs, both_directions)
-    negs = sample_negatives(cdf, key, (centers.shape[0], negatives))
+_CAP = 32.0  # "capped": sum up to this many duplicates, then scale as C x mean
 
+
+def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
+    """Divisor applied to each example's gradient given its row's duplicate
+    count within the batch.
+
+    * ``"sum"``    — 1 (sequential-SGD-like; diverges when a hot token is
+      duplicated thousands of times per batch, since all those gradients are
+      evaluated at the same stale parameter value);
+    * ``"mean"``   — cnt (always stable, but under-trains hot rows: a row
+      advances one averaged step per batch no matter how often it occurred);
+    * ``"capped"`` — max(cnt / C, 1): exact sum while a row has at most
+      C = 32 duplicates (bitwise-equal to "sum" on typical corpora), smoothly
+      capped at C x mean beyond, which keeps the hot-row step bounded at any
+      batch size.  The default (SURVEY §7 hard part 1).
+    """
+    cnt = jnp.maximum(cnt, 1.0)
+    if combiner == "mean":
+        return cnt
+    if combiner == "capped":
+        return jnp.maximum(cnt / _CAP, 1.0)
+    raise ValueError(f"unknown combiner {combiner!r}")
+
+
+def _step_per_example(
+    params: SGNSParams,
+    centers: jax.Array,
+    contexts: jax.Array,
+    negs: jax.Array,  # (E, K)
+    lr: jax.Array,
+    compute_dtype,
+    combiner: str,
+) -> Tuple[SGNSParams, jax.Array]:
     loss, (d_center, d_pos, d_neg) = sgns_loss_and_grads(
         params, centers, contexts, negs, compute_dtype
     )
+
+    if combiner != "sum":
+        # Per-row occurrence counts; each example's gradient is pre-divided
+        # by a per-row factor so the scatter-add below lands the combined row
+        # update (see _row_divisor).
+        vocab_size = params.emb.shape[0]
+        neg_mask = (negs != contexts[:, None]).astype(jnp.float32)
+        # counts always in f32 — bf16 scatter-adds of 1.0 saturate at 256
+        cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
+        cnt_ctx = (
+            jnp.zeros(vocab_size, jnp.float32)
+            .at[contexts]
+            .add(1.0)
+            .at[negs.reshape(-1)]
+            .add(neg_mask.reshape(-1))
+        )
+        d_center = d_center / _row_divisor(
+            cnt_emb[centers], combiner
+        ).astype(compute_dtype)[:, None]
+        d_pos = d_pos / _row_divisor(
+            cnt_ctx[contexts], combiner
+        ).astype(compute_dtype)[:, None]
+        d_neg = d_neg / _row_divisor(
+            cnt_ctx[negs], combiner
+        ).astype(compute_dtype)[:, :, None]
 
     dtype = params.emb.dtype
     lr = jnp.asarray(lr, compute_dtype)
@@ -111,3 +170,98 @@ def sgns_step(
         (-lr * d_neg).reshape(-1, d_neg.shape[-1]).astype(dtype)
     )
     return SGNSParams(emb=emb, ctx=ctx), loss
+
+
+def _step_shared(
+    params: SGNSParams,
+    centers: jax.Array,   # (E,)
+    contexts: jax.Array,  # (E,)
+    negs: jax.Array,      # (P,) — one noise pool for the whole batch
+    k_negatives: int,     # the objective's K (negative-term weight)
+    lr: jax.Array,
+    compute_dtype,
+    combiner: str,
+) -> Tuple[SGNSParams, jax.Array]:
+    emb_t, ctx_t = params.emb, params.ctx
+    vocab_size = emb_t.shape[0]
+    v = emb_t[centers].astype(compute_dtype)      # (E, D)
+    u_pos = ctx_t[contexts].astype(compute_dtype) # (E, D)
+    u_neg = ctx_t[negs].astype(compute_dtype)     # (P, D)
+
+    pos_logit = jnp.sum(v * u_pos, axis=-1)                     # (E,)
+    neg_logit = v @ u_neg.T                                     # (E, P) — MXU
+    neg_mask = (negs[None, :] != contexts[:, None]).astype(compute_dtype)
+
+    # The pool holds P >= K draws for vocab coverage; weighting the mean of
+    # P noise terms by K keeps the SGNS objective's negative-term weight
+    # unchanged in expectation (a K/P importance weight per draw).
+    scale = jnp.asarray(k_negatives / negs.shape[0], compute_dtype)
+    loss = jax.nn.softplus(-pos_logit) + scale * jnp.sum(
+        neg_mask * jax.nn.softplus(neg_logit), axis=-1
+    )
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0                     # (E,)
+    g_neg = scale * jax.nn.sigmoid(neg_logit) * neg_mask        # (E, P)
+
+    d_center = g_pos[:, None] * u_pos + g_neg @ u_neg           # (E, D) — MXU
+    d_pos = g_pos[:, None] * v                                  # (E, D)
+    d_negrow = g_neg.T @ v                                      # (P, D) — MXU
+
+    if combiner != "sum":
+        # Counts always accumulate in f32: in bf16 the partial sum saturates
+        # at 256 (1.0 < ULP) and the cap under-divides hot rows.  Each pool
+        # contribution counts at its K/P importance weight, so the divisor
+        # measures *effective* occurrences — a token drawn into the pool must
+        # not have its positive-pair update divided by the raw example count.
+        cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
+        cnt_ctx = (
+            jnp.zeros(vocab_size, jnp.float32)
+            .at[contexts]
+            .add(1.0)
+            .at[negs]
+            .add(scale * neg_mask.sum(axis=0))
+        )
+        d_center = d_center / _row_divisor(
+            cnt_emb[centers], combiner
+        ).astype(compute_dtype)[:, None]
+        d_pos = d_pos / _row_divisor(
+            cnt_ctx[contexts], combiner
+        ).astype(compute_dtype)[:, None]
+        d_negrow = d_negrow / _row_divisor(
+            cnt_ctx[negs], combiner
+        ).astype(compute_dtype)[:, None]
+
+    dtype = emb_t.dtype
+    lr = jnp.asarray(lr, compute_dtype)
+    emb = emb_t.at[centers].add((-lr * d_center).astype(dtype))
+    ctx = ctx_t.at[contexts].add((-lr * d_pos).astype(dtype))
+    ctx = ctx.at[negs].add((-lr * d_negrow).astype(dtype))
+    return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
+
+
+def sgns_step(
+    params: SGNSParams,
+    pairs: jax.Array,  # (B, 2) int32
+    noise: "NoiseTable",  # alias-method noise table (see data/negative_sampling)
+    key: jax.Array,
+    lr: jax.Array,
+    negatives: int = 5,
+    both_directions: bool = True,
+    compute_dtype=jnp.float32,
+    combiner: str = "capped",
+    negative_mode: str = "shared",
+    shared_pool: int = 64,
+) -> Tuple[SGNSParams, jax.Array]:
+    """One fused SGD step over a batch of corpus pairs."""
+    centers, contexts = _examples_from_pairs(pairs, both_directions)
+    if negative_mode == "shared":
+        pool = max(negatives, shared_pool)
+        negs = sample_negatives(noise, key, (pool,))
+        return _step_shared(
+            params, centers, contexts, negs, negatives, lr, compute_dtype, combiner
+        )
+    if negative_mode != "per_example":
+        raise ValueError(f"unknown negative_mode {negative_mode!r}")
+    negs = sample_negatives(noise, key, (centers.shape[0], negatives))
+    return _step_per_example(
+        params, centers, contexts, negs, lr, compute_dtype, combiner
+    )

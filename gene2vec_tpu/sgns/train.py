@@ -44,13 +44,13 @@ def make_train_epoch(
 ) -> Callable:
     """Build the jitted epoch function.
 
-    Signature: (params, pairs, cdf, key) -> (params, mean_loss).
+    Signature: (params, pairs, noise, key) -> (params, mean_loss).
     All loop structure is static; only array contents are traced.
     """
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
 
-    def train_epoch(params, pairs, cdf, key):
+    def train_epoch(params, pairs, noise, key):
         shuffle_key, step_key = jax.random.split(key)
         perm = epoch_permutation(shuffle_key, num_pairs, batch_pairs)
 
@@ -64,12 +64,15 @@ def make_train_epoch(
             params, loss = sgns_step(
                 params,
                 batch,
-                cdf,
+                noise,
                 jax.random.fold_in(step_key, step),
                 lr,
                 negatives=config.negatives,
                 both_directions=config.both_directions,
                 compute_dtype=compute_dtype,
+                combiner=config.combiner,
+                negative_mode=config.negative_mode,
+                shared_pool=config.shared_pool,
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)
@@ -121,10 +124,10 @@ class SGNSTrainer:
         self.num_batches = corpus.num_batches(config.batch_pairs)
 
         if sharding is not None:
-            self.cdf = jax.device_put(self.sampler.cdf, sharding.replicated())
+            self.noise = jax.device_put(self.sampler.table, sharding.replicated())
             self.pairs = corpus.device_pairs(sharding.corpus_sharding())
         else:
-            self.cdf = self.sampler.cdf
+            self.noise = self.sampler.table
             self.pairs = corpus.device_pairs()
 
         self._epoch_fn = make_train_epoch(
@@ -159,7 +162,7 @@ class SGNSTrainer:
     def train_epoch(
         self, params: SGNSParams, epoch_key: jax.Array
     ) -> Tuple[SGNSParams, float]:
-        params, loss = self._epoch_fn(params, self.pairs, self.cdf, epoch_key)
+        params, loss = self._epoch_fn(params, self.pairs, self.noise, epoch_key)
         return params, loss
 
     def run(

@@ -37,9 +37,12 @@ def synthetic_corpus_dir(tmp_path_factory):
     rng = np.random.RandomState(7)
     d = tmp_path_factory.mktemp("corpus")
     genes = [f"GENE{i}" for i in range(40)]
+    # pairs drawn within 4 clusters of 10 genes → planted co-expression
+    # structure that SGNS can actually learn (loss must decrease)
     lines = []
     for _ in range(300):
-        a, b = rng.choice(len(genes), 2, replace=False)
+        c = rng.randint(4)
+        a, b = rng.choice(10, 2, replace=False) + 10 * c
         lines.append(f"{genes[a]} {genes[b]}")
     (d / "pairs_a.txt").write_text("\n".join(lines[:150]) + "\n")
     (d / "pairs_b.txt").write_text("\n".join(lines[150:]) + "\n")
