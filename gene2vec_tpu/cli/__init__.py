@@ -1,0 +1,16 @@
+"""Command-line front-ends.
+
+One argparse CLI per reference script, unified over the dataclass config
+system (SURVEY §5 "config/flag system"):
+
+* ``python -m gene2vec_tpu.cli.gene2vec data_dir out_dir txt``
+  (+ ``--backend``, training flags) — ``src/gene2vec.py:8-15`` parity;
+* ``python -m gene2vec_tpu.cli.generate_pairs --query Q --out O ...``
+  — ``src/generate_gene_pairs.py:12-42`` parity;
+* ``python -m gene2vec_tpu.cli.ggipnn --data-dir D --emb E ...``
+  — ``src/GGIPNN_Classification.py:14-32`` parity;
+* ``python -m gene2vec_tpu.cli.evaluate emb.txt msigdb.gmt``
+  — ``src/evaluation_target_function.py`` parity;
+* ``python -m gene2vec_tpu.cli.tsne`` / ``...cli.plot``
+  — ``src/tsne_multi_core.py`` / ``src/plot_gene2vec.py`` parity.
+"""

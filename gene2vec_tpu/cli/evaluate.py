@@ -1,0 +1,50 @@
+"""Intrinsic-evaluation CLI: the pathway/random "target function".
+
+``python -m gene2vec_tpu.cli.evaluate emb_file gmt_file`` prints the score
+the reference's ``src/evaluation_target_function.py`` computes (pathways
+over 50 genes skipped, fixed seed 35 for the random-pair denominator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from gene2vec_tpu.eval.target_function import (
+    MAX_PATHWAY_GENES,
+    RANDOM_PAIR_GENES,
+    RANDOM_SEED,
+    target_function,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="evaluate",
+        description="Pathway-vs-random cosine similarity ratio of an "
+                    "embedding file.",
+    )
+    p.add_argument("emb_file", help="matrix-txt or word2vec-format embedding")
+    p.add_argument("gmt_file", help="MSigDB .gmt pathway file")
+    p.add_argument("--max-pathway-genes", type=int, default=MAX_PATHWAY_GENES)
+    p.add_argument("--num-random-genes", type=int, default=RANDOM_PAIR_GENES)
+    p.add_argument("--seed", type=int, default=RANDOM_SEED)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    score = target_function(
+        args.emb_file,
+        args.gmt_file,
+        max_pathway_genes=args.max_pathway_genes,
+        num_random_genes=args.num_random_genes,
+        seed=args.seed,
+    )
+    print(score)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

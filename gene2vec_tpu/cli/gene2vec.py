@@ -1,0 +1,128 @@
+"""Embedding-training CLI — reference-shape compatible.
+
+The reference invocation is ``python gene2vec.py data_dir out_dir txt``
+(positional; ``src/gene2vec.py:8-15``, ``README.md:36-38``).  Same three
+positionals here, plus flags for everything the reference hardcodes
+(``src/gene2vec.py:57-63``) and the BASELINE-mandated ``--backend`` switch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from gene2vec_tpu.config import MeshConfig, SGNSConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gene2vec",
+        description="Train gene embeddings from a directory of pair files.",
+    )
+    p.add_argument("data_dir", help="directory of gene-pair text files")
+    p.add_argument("export_dir", help="output directory for embeddings")
+    p.add_argument(
+        "ending_pattern", nargs="?", default="txt",
+        help="filename suffix of corpus files (default: txt)",
+    )
+    p.add_argument(
+        "--backend", choices=("jax", "numpy", "gensim"), default="jax",
+        help="jax = TPU path (default); numpy/gensim = CPU oracles",
+    )
+    d = SGNSConfig()
+    p.add_argument("--dim", type=int, default=d.dim)
+    p.add_argument("--iters", type=int, default=d.num_iters)
+    p.add_argument(
+        "--objective", choices=("sgns", "cbow", "sg_hs", "cbow_hs"),
+        default=d.objective,
+    )
+    p.add_argument("--min-count", type=int, default=d.min_count)
+    p.add_argument("--negatives", type=int, default=d.negatives)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--min-lr", type=float, default=d.min_lr)
+    p.add_argument("--batch-pairs", type=int, default=d.batch_pairs)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument(
+        "--combiner", choices=("capped", "mean", "sum"), default=d.combiner
+    )
+    p.add_argument(
+        "--negative-mode", choices=("shared", "per_example"),
+        default=d.negative_mode,
+    )
+    p.add_argument(
+        "--vocab-sharded", action="store_true",
+        help="shard embedding-table rows over the mesh model axis "
+             "(BASELINE config 5)",
+    )
+    p.add_argument(
+        "--mesh-data", type=int, default=-1,
+        help="mesh data-axis size (-1: all remaining devices)",
+    )
+    p.add_argument(
+        "--mesh-model", type=int, default=1, help="mesh model-axis size"
+    )
+    p.add_argument(
+        "--no-txt-output", action="store_true",
+        help="skip matrix-txt / word2vec-format exports per iteration",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config = SGNSConfig(
+        dim=args.dim,
+        num_iters=args.iters,
+        objective=args.objective,
+        min_count=args.min_count,
+        negatives=args.negatives,
+        lr=args.lr,
+        min_lr=args.min_lr,
+        batch_pairs=args.batch_pairs,
+        seed=args.seed,
+        combiner=args.combiner,
+        negative_mode=args.negative_mode,
+        vocab_sharded=args.vocab_sharded,
+        txt_output=not args.no_txt_output,
+    )
+
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.pair_reader import load_corpus
+
+    print(f"loading corpus from {args.data_dir} (*.{args.ending_pattern})")
+    vocab, pairs = load_corpus(
+        args.data_dir, args.ending_pattern, min_count=config.min_count
+    )
+    corpus = PairCorpus(vocab, pairs)
+    print(f"{corpus.num_pairs:,} pairs, vocab {corpus.vocab_size:,}")
+
+    if args.backend == "jax" and (args.vocab_sharded or args.mesh_model > 1):
+        import jax
+
+        from gene2vec_tpu.parallel.mesh import make_mesh
+        from gene2vec_tpu.parallel.sharding import SGNSSharding
+        from gene2vec_tpu.sgns.train import SGNSTrainer
+
+        if config.objective != "sgns":
+            raise SystemExit("--vocab-sharded supports the sgns objective")
+        mesh = make_mesh(
+            MeshConfig(data=args.mesh_data, model=args.mesh_model)
+        )
+        print(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+              f"over {len(jax.devices())} devices")
+        trainer = SGNSTrainer(
+            corpus, config,
+            sharding=SGNSSharding(mesh, vocab_sharded=args.vocab_sharded),
+        )
+    else:
+        from gene2vec_tpu.sgns.backends import make_backend_trainer
+
+        trainer = make_backend_trainer(corpus, config, backend=args.backend)
+
+    trainer.run(args.export_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

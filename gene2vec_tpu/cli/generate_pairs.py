@@ -1,0 +1,62 @@
+"""Co-expression pair-corpus construction CLI.
+
+Flag-compatible with the reference (``src/generate_gene_pairs.py:12-42``):
+``--query --out --corr-threshold --min-study-samples --parallel --ensembl``,
+plus ``--backend`` to run the correlation matmul on TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="generate-pairs",
+        description="Build a gene co-expression pair corpus from a query "
+                    "directory (data/SRARunTable.csv, data/gene_counts_TPM.csv, "
+                    "data/gene_counts.csv).",
+    )
+    p.add_argument("--query", required=True, help="query directory")
+    p.add_argument("--out", required=True, help="output pair-file path")
+    p.add_argument("--corr-threshold", type=float, default=0.9)
+    p.add_argument("--min-study-samples", type=int, default=20)
+    p.add_argument("--min-total-counts", type=float, default=10.0)
+    p.add_argument(
+        "--parallel", action="store_true",
+        help="per-study multiprocessing (the reference used a Ray cluster)",
+    )
+    p.add_argument("--num-workers", type=int, default=0)
+    p.add_argument(
+        "--ensembl", action="store_true",
+        help="keep ENSEMBL ids instead of annotating gene symbols",
+    )
+    p.add_argument(
+        "--backend", choices=("numpy", "jax"), default="numpy",
+        help="correlation matmul backend (jax = TPU MXU)",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from gene2vec_tpu.corpus.builder import build_pairs
+
+    build_pairs(
+        args.query,
+        args.out,
+        corr_threshold=args.corr_threshold,
+        min_study_samples=args.min_study_samples,
+        min_total_counts=args.min_total_counts,
+        ensembl=args.ensembl,
+        parallel=args.parallel,
+        num_workers=args.num_workers or None,
+        backend=args.backend,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""Backend dispatch for embedding training: jax (TPU) | numpy | gensim.
+
+BASELINE.json mandates a ``--backend={gensim,jax}`` switch with gensim as
+the CPU oracle (the reference's engine, ``src/gene2vec.py:70,87``).  gensim
+is not part of this image's baked-in dependency set, so its wrapper is
+import-gated with an actionable error; the numpy oracle (numpy_backend.py)
+is the always-available CPU reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+
+BACKENDS = ("jax", "numpy", "gensim")
+
+
+def make_backend_trainer(
+    corpus: PairCorpus, config: SGNSConfig, backend: str = "jax"
+):
+    """Trainer with the common init/train_epoch/run interface."""
+    if backend == "jax":
+        from gene2vec_tpu.sgns.cbow_hs import make_trainer
+
+        return make_trainer(corpus, config)
+    if backend == "numpy":
+        if config.objective != "sgns":
+            raise NotImplementedError(
+                "numpy backend implements the sgns objective only"
+            )
+        from gene2vec_tpu.sgns.numpy_backend import NumpySGNSTrainer
+
+        return NumpySGNSTrainer(corpus, config)
+    if backend == "gensim":
+        return GensimTrainer(corpus, config)
+    raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")
+
+
+class GensimTrainer:
+    """The reference's gensim path, kept as a CPU oracle behind an import gate.
+
+    Reproduces ``src/gene2vec.py:57-92``: dim/window/min_count/workers/sg
+    parameters, one ``train()`` epoch per iteration with reshuffle, save +
+    txt export per iteration.
+    """
+
+    def __init__(
+        self, corpus: PairCorpus, config: SGNSConfig, workers: int = 32
+    ):
+        try:
+            import gensim  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "backend='gensim' requires the gensim package, which is not "
+                "installed in this environment; use backend='numpy' for a "
+                "CPU oracle or backend='jax' for the TPU path"
+            ) from e
+        self.corpus = corpus
+        self.config = config
+        self.workers = workers
+
+    def run(
+        self,
+        export_dir: str,
+        start_iter: Optional[int] = None,
+        log: Callable[[str], None] = print,
+    ):
+        import os
+        import random
+
+        import gensim
+
+        from gene2vec_tpu.io import checkpoint as ckpt
+        from gene2vec_tpu.sgns.model import SGNSParams
+
+        cfg = self.config
+        vocab = self.corpus.vocab
+        sentences = [
+            [vocab.id_to_token[a], vocab.id_to_token[b]]
+            for a, b in self.corpus.pairs
+        ]
+        random.seed(cfg.seed)
+        model = None
+        os.makedirs(export_dir, exist_ok=True)
+        sg = 0 if cfg.objective.startswith("cbow") else 1
+        hs = 1 if cfg.objective.endswith("_hs") else 0
+        # pure HS when hs=1: gensim would otherwise train hierarchical
+        # softmax AND negative sampling together, a different objective
+        # from the jax *_hs path and useless as an oracle for it
+        negative = 0 if hs else cfg.negatives
+        import numpy as np
+
+        for it in range(1, cfg.num_iters + 1):
+            random.shuffle(sentences)
+            if model is None:
+                kwargs = dict(
+                    vector_size=cfg.dim, window=cfg.window,
+                    min_count=cfg.min_count, workers=self.workers,
+                    epochs=1, sg=sg, hs=hs, negative=negative,
+                    alpha=cfg.lr, min_alpha=cfg.min_lr, seed=cfg.seed,
+                )
+                try:
+                    model = gensim.models.Word2Vec(sentences, **kwargs)
+                except TypeError:  # gensim<4 used size=/iter=
+                    kwargs["size"] = kwargs.pop("vector_size")
+                    kwargs["iter"] = kwargs.pop("epochs")
+                    model = gensim.models.Word2Vec(sentences, **kwargs)
+            else:
+                model.train(
+                    sentences, total_examples=model.corpus_count, epochs=1
+                )
+            # export through the same checkpoint layout as the other
+            # backends, row-aligned to OUR vocab: gensim may drop tokens
+            # (its min_count reapplies over possibly-different counts), so
+            # missing rows stay zero rather than shifting every row after
+            # them onto the wrong gene
+            toks = getattr(model.wv, "index_to_key", None)
+            if toks is None:  # gensim<4
+                toks = model.wv.index2word
+            pos = {t: i for i, t in enumerate(toks)}
+            mat = np.asarray(model.wv.vectors, np.float32)
+            emb = np.zeros((len(vocab), cfg.dim), np.float32)
+            for row, t in enumerate(vocab.id_to_token):
+                i = pos.get(t)
+                if i is not None:
+                    emb[row] = mat[i]
+            params = SGNSParams(emb=emb, ctx=np.zeros_like(emb))
+            ckpt.save_iteration(
+                export_dir, cfg.dim, it, params, vocab,
+                txt_output=cfg.txt_output, meta={"backend": "gensim"},
+            )
+            log(f"gene2vec [gensim] dimension {cfg.dim} iteration {it} done")
+        return model

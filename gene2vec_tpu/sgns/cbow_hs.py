@@ -1,0 +1,283 @@
+"""CBOW and hierarchical-softmax word2vec variants (BASELINE config 4).
+
+The reference trains skip-gram + negative sampling only (``sg=1`` and
+gensim defaults, ``src/gene2vec.py:59-63``), but gensim's constructor — the
+reference's de-facto API — exposes ``sg=0`` (CBOW) and ``hs=1``
+(hierarchical softmax); BASELINE.json config 4 requires both variants.
+
+With the reference's corpus shape (2-token "sentences", ``window=1``,
+SURVEY §2.2 #1) CBOW degenerates to single-context prediction: the CBOW
+"context mean" is one vector, so CBOW and skip-gram differ only in which
+table (input vs output) hosts which role.  We keep the roles explicit so
+the exported *input* table matches gensim's for each variant:
+
+* ``cbow``     — input = context token's emb row, target = center, negative
+  sampling against the center's noise draws;
+* ``sg_hs``    — input = center's emb row, output = sigmoid per
+  Huffman-path node of the context token;
+* ``cbow_hs``  — input = context row, path of the center token.
+
+Hierarchical softmax on TPU: each token's padded root-to-leaf path (see
+huffman.py) is gathered as (E, L) node ids + branch bits; the per-node
+logits are one einsum against the gathered node vectors; masked softplus
+gives the loss; updates scatter into the (V-1, D) node table with the same
+capped duplicate-row combiner as the SGNS step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NegativeSampler
+from gene2vec_tpu.data.pipeline import PairCorpus, epoch_permutation
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.sgns.huffman import HuffmanTree, build_huffman_tree
+from gene2vec_tpu.sgns.model import SGNSParams
+from gene2vec_tpu.sgns.step import _examples_from_pairs, _row_divisor, sgns_step
+from gene2vec_tpu.utils.profiling import StepTimer
+
+OBJECTIVES = ("cbow", "sg_hs", "cbow_hs")
+
+
+def hs_loss_and_grads(
+    emb: jax.Array,        # (V, D) input table
+    node: jax.Array,       # (V-1, D) internal-node (output) table
+    inputs: jax.Array,     # (E,) input token ids
+    targets: jax.Array,    # (E,) tokens whose Huffman path is scored
+    points: jax.Array,     # (V, L) path node ids
+    codes: jax.Array,      # (V, L) branch bits
+    lengths: jax.Array,    # (V,) path lengths
+    compute_dtype=jnp.float32,
+):
+    """Masked per-path-node logistic loss and closed-form gradients.
+
+    word2vec HS: loss = -Σ_l log σ((1 − 2·code_l) · v·w_l) over the target
+    token's path; dL/dlogit_l = σ(logit_l) − (1 − code_l).
+    """
+    v = emb[inputs].astype(compute_dtype)              # (E, D)
+    pts = points[targets]                              # (E, L)
+    cds = codes[targets].astype(compute_dtype)         # (E, L)
+    max_len = points.shape[1]
+    mask = (
+        jnp.arange(max_len, dtype=jnp.int32)[None, :] < lengths[targets][:, None]
+    ).astype(compute_dtype)                            # (E, L)
+
+    w = node[pts].astype(compute_dtype)                # (E, L, D)
+    logit = jnp.einsum("ed,eld->el", v, w)             # (E, L)
+    sign = 1.0 - 2.0 * cds
+    loss = jnp.sum(mask * jax.nn.softplus(-sign * logit), axis=-1)  # (E,)
+
+    g = (jax.nn.sigmoid(logit) - (1.0 - cds)) * mask   # (E, L) dL/dlogit
+    d_input = jnp.einsum("el,eld->ed", g, w)           # (E, D)
+    d_node = g[:, :, None] * v[:, None, :]             # (E, L, D)
+    return jnp.mean(loss), d_input, d_node, pts, mask
+
+
+def hs_step(
+    params: SGNSParams,   # emb = input table, ctx = (V-1, D) node table
+    pairs: jax.Array,
+    tree_points: jax.Array,
+    tree_codes: jax.Array,
+    tree_lengths: jax.Array,
+    lr: jax.Array,
+    *,
+    cbow: bool,
+    both_directions: bool = True,
+    compute_dtype=jnp.float32,
+    combiner: str = "capped",
+) -> Tuple[SGNSParams, jax.Array]:
+    """One hierarchical-softmax SGD step over a batch of corpus pairs."""
+    centers, contexts = _examples_from_pairs(pairs, both_directions)
+    # sg_hs: input center, path of context. cbow_hs: input context, path of
+    # center (the 1-token-context CBOW degeneration).
+    inputs, targets = (contexts, centers) if cbow else (centers, contexts)
+
+    loss, d_input, d_node, pts, mask = hs_loss_and_grads(
+        params.emb, params.ctx, inputs, targets,
+        tree_points, tree_codes, tree_lengths, compute_dtype,
+    )
+
+    if combiner != "sum":
+        vocab_size = params.emb.shape[0]
+        num_nodes = params.ctx.shape[0]
+        cnt_in = jnp.zeros(vocab_size, jnp.float32).at[inputs].add(1.0)
+        cnt_nd = jnp.zeros(num_nodes, jnp.float32).at[pts.reshape(-1)].add(
+            mask.reshape(-1)
+        )
+        d_input = d_input / _row_divisor(
+            cnt_in[inputs], combiner
+        ).astype(compute_dtype)[:, None]
+        d_node = d_node / _row_divisor(
+            cnt_nd[pts], combiner
+        ).astype(compute_dtype)[:, :, None]
+
+    dtype = params.emb.dtype
+    lr = jnp.asarray(lr, compute_dtype)
+    emb = params.emb.at[inputs].add((-lr * d_input).astype(dtype))
+    node = params.ctx.at[pts.reshape(-1)].add(
+        (-lr * d_node).reshape(-1, d_node.shape[-1]).astype(dtype)
+    )
+    return SGNSParams(emb=emb, ctx=node), loss
+
+
+class CBOWHSTrainer:
+    """Trainer for the cbow / sg_hs / cbow_hs objectives.
+
+    Mirrors :class:`gene2vec_tpu.sgns.train.SGNSTrainer`'s interface (init /
+    train_epoch / run with per-iteration checkpoint + txt export).
+    """
+
+    def __init__(self, corpus: PairCorpus, config: SGNSConfig):
+        if config.objective not in OBJECTIVES:
+            raise ValueError(
+                f"objective={config.objective!r} not in {OBJECTIVES}; plain "
+                "'sgns' uses SGNSTrainer"
+            )
+        if corpus.num_pairs == 0 or corpus.vocab_size == 0:
+            raise ValueError("corpus is empty")
+        if corpus.num_pairs < config.batch_pairs:
+            config = dataclasses.replace(config, batch_pairs=max(1, corpus.num_pairs))
+        self.config = config
+        self.corpus = corpus
+        self.num_batches = corpus.num_batches(config.batch_pairs)
+        self.pairs = corpus.device_pairs()
+        self.timer = StepTimer()
+        self.hs = config.objective.endswith("_hs")
+        if self.hs:
+            self.tree: Optional[HuffmanTree] = build_huffman_tree(corpus.vocab.counts)
+            self._points = jnp.asarray(self.tree.points)
+            self._codes = jnp.asarray(self.tree.codes)
+            self._lengths = jnp.asarray(self.tree.lengths)
+        else:
+            self.tree = None
+            self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
+            self.noise = self.sampler.table
+        self._epoch_fn = self._make_epoch()
+
+    def _make_epoch(self) -> Callable:
+        cfg = self.config
+        compute_dtype = jnp.dtype(cfg.compute_dtype)
+        num_pairs, num_batches = self.corpus.num_pairs, self.num_batches
+        cbow = cfg.objective.startswith("cbow")
+
+        def epoch(params, pairs, key):
+            shuffle_key, step_key = jax.random.split(key)
+            perm = epoch_permutation(shuffle_key, num_pairs, cfg.batch_pairs)
+
+            def body(params, xs):
+                idx, step = xs
+                batch = pairs[idx]
+                frac = step.astype(compute_dtype) / max(num_batches, 1)
+                lr = cfg.lr * (1.0 - frac) + cfg.min_lr * frac
+                if self.hs:
+                    params, loss = hs_step(
+                        params, batch,
+                        self._points, self._codes, self._lengths,
+                        lr,
+                        cbow=cbow,
+                        both_directions=cfg.both_directions,
+                        compute_dtype=compute_dtype,
+                        combiner=cfg.combiner,
+                    )
+                else:
+                    # cbow + negative sampling: swap roles so the *input*
+                    # table hosts the context vector (gensim's cbow layout);
+                    # with both_directions the example set is symmetric.
+                    swapped = batch[:, ::-1]
+                    params, loss = sgns_step(
+                        params, swapped, self.noise,
+                        jax.random.fold_in(step_key, step),
+                        lr,
+                        negatives=cfg.negatives,
+                        both_directions=cfg.both_directions,
+                        compute_dtype=compute_dtype,
+                        combiner=cfg.combiner,
+                        negative_mode=cfg.negative_mode,
+                        shared_pool=cfg.shared_pool,
+                    )
+                return params, loss
+
+            params, losses = jax.lax.scan(
+                body, params, (perm, jnp.arange(num_batches, dtype=jnp.int32))
+            )
+            return params, jnp.mean(losses)
+
+        donate = (0,) if cfg.donate else ()
+        return jax.jit(epoch, donate_argnums=donate)
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        cfg = self.config
+        key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
+        dtype = jnp.dtype(cfg.table_dtype)
+        v = self.corpus.vocab_size
+        emb = jax.random.uniform(
+            key, (v, cfg.dim), dtype=dtype,
+            minval=-0.5 / cfg.dim, maxval=0.5 / cfg.dim,
+        )
+        out_rows = self.tree.num_nodes if self.hs else v
+        ctx = jnp.zeros((max(out_rows, 1), cfg.dim), dtype=dtype)
+        return SGNSParams(emb=emb, ctx=ctx)
+
+    # -- training ----------------------------------------------------------
+
+    def train_epoch(self, params: SGNSParams, key: jax.Array):
+        return self._epoch_fn(params, self.pairs, key)
+
+    def run(
+        self,
+        export_dir: str,
+        start_iter: Optional[int] = None,
+        log: Callable[[str], None] = print,
+    ) -> SGNSParams:
+        cfg = self.config
+        if start_iter is None:
+            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+        if start_iter > 1:
+            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            log(f"resuming from iteration {start_iter - 1}")
+        else:
+            params = self.init()
+            start_iter = 1
+
+        root_key = jax.random.PRNGKey(cfg.seed)
+        pairs_per_epoch = self.num_batches * cfg.batch_pairs
+        for it in range(start_iter, cfg.num_iters + 1):
+            t0 = time.perf_counter()
+            params, loss = self.train_epoch(params, jax.random.fold_in(root_key, it))
+            loss = float(loss)
+            dt = time.perf_counter() - t0
+            rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+            self.timer.record(pairs_per_epoch, dt)
+            log(
+                f"gene2vec [{cfg.objective}] dimension {cfg.dim} iteration "
+                f"{it} done: loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
+            )
+            ckpt.save_iteration(
+                export_dir, cfg.dim, it, params, self.corpus.vocab,
+                txt_output=cfg.txt_output,
+                meta={
+                    "loss": loss,
+                    "pairs_per_sec": rate,
+                    "objective": cfg.objective,
+                },
+            )
+        return params
+
+
+def make_trainer(corpus: PairCorpus, config: SGNSConfig):
+    """Objective-dispatching factory: 'sgns' → SGNSTrainer, else CBOWHSTrainer."""
+    if config.objective == "sgns":
+        from gene2vec_tpu.sgns.train import SGNSTrainer
+
+        return SGNSTrainer(corpus, config)
+    return CBOWHSTrainer(corpus, config)

@@ -1,0 +1,97 @@
+"""Huffman coding tree for hierarchical softmax.
+
+word2vec's HS variant (gensim builds this in ``build_vocab`` when ``hs=1``;
+the reference's trainer exposes it implicitly through gensim's constructor,
+``src/gene2vec.py:70``) assigns each vocab token a root-to-leaf path through
+V-1 internal nodes; the output layer scores one sigmoid per node on the
+path.  With ``min_count=1`` (the reference's setting) the tree spans the
+full vocabulary.
+
+TPU shape: paths are padded to the tree's max code length L and stored as
+two dense (V, L) arrays — ``points`` (internal-node ids) and ``codes``
+(branch bits) — plus a (V,) ``lengths`` vector, so a batch's paths are one
+gather and every step is shape-static.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import NamedTuple
+
+import numpy as np
+
+
+class HuffmanTree(NamedTuple):
+    points: np.ndarray   # (V, L) int32 — internal-node ids per token path
+    codes: np.ndarray    # (V, L) float32 — branch bit per path node (0/1)
+    lengths: np.ndarray  # (V,) int32 — true path length per token
+    num_nodes: int       # V - 1 internal nodes
+
+    @property
+    def max_code_length(self) -> int:
+        return int(self.points.shape[1])
+
+
+def build_huffman_tree(counts: np.ndarray) -> HuffmanTree:
+    """Standard word2vec Huffman construction over token counts.
+
+    Token ids are the vocab's frequency-sorted ids; internal nodes get ids
+    0..V-2 in creation order (leaves merged first = deepest).
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    v = int(counts.size)
+    if v == 0:
+        raise ValueError("empty vocabulary")
+    if v == 1:
+        # degenerate: single token, empty path
+        return HuffmanTree(
+            points=np.zeros((1, 1), np.int32),
+            codes=np.zeros((1, 1), np.float32),
+            lengths=np.zeros(1, np.int32),
+            num_nodes=0,
+        )
+
+    # heap items: (count, tiebreak, node). Leaves are ints < v; internal
+    # nodes are ints >= v (id - v = internal node index).
+    tiebreak = itertools.count()
+    heap = [(int(c), next(tiebreak), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent = {}
+    bit = {}
+    next_internal = 0
+    while len(heap) > 1:
+        c1, _, n1 = heapq.heappop(heap)
+        c2, _, n2 = heapq.heappop(heap)
+        node = v + next_internal
+        next_internal += 1
+        parent[n1], bit[n1] = node, 0.0
+        parent[n2], bit[n2] = node, 1.0
+        heapq.heappush(heap, (c1 + c2, next(tiebreak), node))
+
+    num_nodes = next_internal  # == v - 1
+    # walk each leaf to the root, collecting (node, bit) pairs leaf→root,
+    # then reverse to get root→leaf order (word2vec convention).
+    paths = []
+    max_len = 0
+    for leaf in range(v):
+        pts, cds = [], []
+        n = leaf
+        while n in parent:
+            p = parent[n]
+            pts.append(p - v)
+            cds.append(bit[n])
+            n = p
+        pts.reverse()
+        cds.reverse()
+        paths.append((pts, cds))
+        max_len = max(max_len, len(pts))
+
+    points = np.zeros((v, max_len), np.int32)
+    codes = np.zeros((v, max_len), np.float32)
+    lengths = np.zeros(v, np.int32)
+    for i, (pts, cds) in enumerate(paths):
+        points[i, : len(pts)] = pts
+        codes[i, : len(cds)] = cds
+        lengths[i] = len(pts)
+    return HuffmanTree(points=points, codes=codes, lengths=lengths, num_nodes=num_nodes)

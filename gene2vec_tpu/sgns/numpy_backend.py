@@ -1,0 +1,140 @@
+"""Pure-numpy SGNS trainer — the CPU oracle backend.
+
+Two jobs (SURVEY §7 steps 2-3):
+
+* an independent implementation of the exact word2vec SGNS recipe
+  (per-example negatives, sequential-minded sum updates, linear alpha decay)
+  that parity tests and the target-function gate compare the TPU path
+  against;
+* a measured stand-in CPU baseline when gensim (the reference's engine,
+  ``src/gene2vec.py:70``) is not installed — see backends.py for the gated
+  gensim wrapper.
+
+Vectorized over small batches for practicality, but with gensim's summed
+(sequential-SGD-like) duplicate handling, per-example noise draws, and the
+same alpha sweep per iteration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import noise_distribution
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+def _sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class NumpySGNSTrainer:
+    """CPU oracle with the SGNSTrainer interface (init/train_epoch/run)."""
+
+    def __init__(self, corpus: PairCorpus, config: SGNSConfig = SGNSConfig()):
+        if corpus.num_pairs == 0:
+            raise ValueError("corpus is empty")
+        self.corpus = corpus
+        self.config = config
+        self.probs = noise_distribution(
+            corpus.vocab.counts, config.ns_exponent
+        ).astype(np.float64)
+        self.probs /= self.probs.sum()
+        self.batch = min(max(config.batch_pairs, 1), 1024, corpus.num_pairs)
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        cfg = self.config
+        rng = np.random.RandomState(cfg.seed if seed is None else seed)
+        emb = rng.uniform(
+            -0.5 / cfg.dim, 0.5 / cfg.dim, (self.corpus.vocab_size, cfg.dim)
+        ).astype(np.float32)
+        ctx = np.zeros((self.corpus.vocab_size, cfg.dim), np.float32)
+        return SGNSParams(emb=emb, ctx=ctx)
+
+    def train_epoch(self, params: SGNSParams, rng: np.random.RandomState):
+        cfg = self.config
+        emb = np.asarray(params.emb).copy()
+        ctx = np.asarray(params.ctx).copy()
+        pairs = self.corpus.pairs
+        order = rng.permutation(len(pairs))
+        num_batches = len(pairs) // self.batch
+        losses = []
+        for b in range(num_batches):
+            batch = pairs[order[b * self.batch : (b + 1) * self.batch]]
+            frac = b / max(num_batches, 1)
+            lr = cfg.lr * (1.0 - frac) + cfg.min_lr * frac
+            if cfg.both_directions:
+                centers = np.concatenate([batch[:, 0], batch[:, 1]])
+                contexts = np.concatenate([batch[:, 1], batch[:, 0]])
+            else:
+                centers, contexts = batch[:, 0], batch[:, 1]
+            e = len(centers)
+            negs = rng.choice(
+                self.corpus.vocab_size, size=(e, cfg.negatives), p=self.probs
+            )
+            v = emb[centers]                       # (E, D)
+            u = ctx[contexts]                      # (E, D)
+            un = ctx[negs]                         # (E, K, D)
+            pos = np.sum(v * u, axis=-1)
+            neg = np.einsum("ed,ekd->ek", v, un)
+            mask = (negs != contexts[:, None]).astype(np.float32)
+            losses.append(
+                float(
+                    np.mean(
+                        np.logaddexp(0, -pos)
+                        + np.sum(mask * np.logaddexp(0, neg), axis=-1)
+                    )
+                )
+            )
+            g_pos = _sigmoid(pos) - 1.0
+            g_neg = _sigmoid(neg) * mask
+            d_c = g_pos[:, None] * u + np.einsum("ek,ekd->ed", g_neg, un)
+            np.add.at(emb, centers, -lr * d_c)
+            np.add.at(ctx, contexts, -lr * (g_pos[:, None] * v))
+            np.add.at(
+                ctx,
+                negs.reshape(-1),
+                -lr * (g_neg[:, :, None] * v[:, None, :]).reshape(-1, v.shape[1]),
+            )
+        return SGNSParams(emb=emb, ctx=ctx), float(np.mean(losses))
+
+    def run(
+        self,
+        export_dir: str,
+        start_iter: Optional[int] = None,
+        log: Callable[[str], None] = print,
+    ) -> SGNSParams:
+        cfg = self.config
+        if start_iter is None:
+            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+        if start_iter > 1:
+            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            params = SGNSParams(
+                emb=np.asarray(params.emb), ctx=np.asarray(params.ctx)
+            )
+            log(f"resuming from iteration {start_iter - 1}")
+        else:
+            params = self.init()
+            start_iter = 1
+        rng = np.random.RandomState(cfg.seed)
+        pairs_per_epoch = (self.corpus.num_pairs // self.batch) * self.batch
+        for it in range(start_iter, cfg.num_iters + 1):
+            t0 = time.perf_counter()
+            params, loss = self.train_epoch(params, rng)
+            dt = time.perf_counter() - t0
+            rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+            log(
+                f"gene2vec [numpy] dimension {cfg.dim} iteration {it} done: "
+                f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
+            )
+            ckpt.save_iteration(
+                export_dir, cfg.dim, it, params, self.corpus.vocab,
+                txt_output=cfg.txt_output,
+                meta={"loss": loss, "pairs_per_sec": rate, "backend": "numpy"},
+            )
+        return params

@@ -72,7 +72,7 @@ def sgns_loss_and_grads(
 ):
     """Per-example loss and closed-form row gradients.
 
-    Returns (loss_mean, (d_center (E,D), d_pos (E,D), d_neg (E,K,D), neg_mask)).
+    Returns (loss_mean, (d_center (E,D), d_pos (E,D), d_neg (E,K,D)), neg_mask).
     """
     emb, ctx = params.emb, params.ctx
     v = emb[centers].astype(compute_dtype)        # (E, D)
@@ -96,7 +96,7 @@ def sgns_loss_and_grads(
     d_center = g_pos[:, None] * u_pos + jnp.einsum("ek,ekd->ed", g_neg, u_neg)
     d_pos = g_pos[:, None] * v
     d_neg = g_neg[:, :, None] * v[:, None, :]
-    return jnp.mean(loss), (d_center, d_pos, d_neg)
+    return jnp.mean(loss), (d_center, d_pos, d_neg), neg_mask
 
 
 _CAP = 32.0  # "capped": sum up to this many duplicates, then scale as C x mean
@@ -117,11 +117,46 @@ def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
       batch size.  The default (SURVEY §7 hard part 1).
     """
     cnt = jnp.maximum(cnt, 1.0)
+    if combiner == "sum":
+        return jnp.ones_like(cnt)
     if combiner == "mean":
         return cnt
     if combiner == "capped":
         return jnp.maximum(cnt / _CAP, 1.0)
     raise ValueError(f"unknown combiner {combiner!r}")
+
+
+def _combiner_divisors(
+    vocab_size: int,
+    centers: jax.Array,
+    contexts: jax.Array,
+    neg_idx: jax.Array,
+    neg_weights: jax.Array,  # per-slot occurrence weight, same shape as neg_idx
+    combiner: str,
+    compute_dtype,
+):
+    """(div over centers, div over contexts, div over neg_idx slots).
+
+    Per-row occurrence counts always accumulate in f32: in bf16 the partial
+    sum saturates at 256 (1.0 < ULP) and the cap under-divides hot rows.
+    Negative slots count at their given weight (1 per draw in per-example
+    mode; the K/P importance weight in shared mode — a token drawn into the
+    pool must not have its positive-pair update divided by the raw example
+    count).
+    """
+    cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
+    cnt_ctx = (
+        jnp.zeros(vocab_size, jnp.float32)
+        .at[contexts]
+        .add(1.0)
+        .at[neg_idx.reshape(-1)]
+        .add(neg_weights.astype(jnp.float32).reshape(-1))
+    )
+    return (
+        _row_divisor(cnt_emb[centers], combiner).astype(compute_dtype),
+        _row_divisor(cnt_ctx[contexts], combiner).astype(compute_dtype),
+        _row_divisor(cnt_ctx[neg_idx], combiner).astype(compute_dtype),
+    )
 
 
 def _step_per_example(
@@ -133,34 +168,18 @@ def _step_per_example(
     compute_dtype,
     combiner: str,
 ) -> Tuple[SGNSParams, jax.Array]:
-    loss, (d_center, d_pos, d_neg) = sgns_loss_and_grads(
+    loss, (d_center, d_pos, d_neg), neg_mask = sgns_loss_and_grads(
         params, centers, contexts, negs, compute_dtype
     )
 
     if combiner != "sum":
-        # Per-row occurrence counts; each example's gradient is pre-divided
-        # by a per-row factor so the scatter-add below lands the combined row
-        # update (see _row_divisor).
-        vocab_size = params.emb.shape[0]
-        neg_mask = (negs != contexts[:, None]).astype(jnp.float32)
-        # counts always in f32 — bf16 scatter-adds of 1.0 saturate at 256
-        cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
-        cnt_ctx = (
-            jnp.zeros(vocab_size, jnp.float32)
-            .at[contexts]
-            .add(1.0)
-            .at[negs.reshape(-1)]
-            .add(neg_mask.reshape(-1))
+        div_c, div_p, div_n = _combiner_divisors(
+            params.emb.shape[0], centers, contexts, negs, neg_mask,
+            combiner, compute_dtype,
         )
-        d_center = d_center / _row_divisor(
-            cnt_emb[centers], combiner
-        ).astype(compute_dtype)[:, None]
-        d_pos = d_pos / _row_divisor(
-            cnt_ctx[contexts], combiner
-        ).astype(compute_dtype)[:, None]
-        d_neg = d_neg / _row_divisor(
-            cnt_ctx[negs], combiner
-        ).astype(compute_dtype)[:, :, None]
+        d_center = d_center / div_c[:, None]
+        d_pos = d_pos / div_p[:, None]
+        d_neg = d_neg / div_n[:, :, None]
 
     dtype = params.emb.dtype
     lr = jnp.asarray(lr, compute_dtype)
@@ -207,28 +226,13 @@ def _step_shared(
     d_negrow = g_neg.T @ v                                      # (P, D) — MXU
 
     if combiner != "sum":
-        # Counts always accumulate in f32: in bf16 the partial sum saturates
-        # at 256 (1.0 < ULP) and the cap under-divides hot rows.  Each pool
-        # contribution counts at its K/P importance weight, so the divisor
-        # measures *effective* occurrences — a token drawn into the pool must
-        # not have its positive-pair update divided by the raw example count.
-        cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
-        cnt_ctx = (
-            jnp.zeros(vocab_size, jnp.float32)
-            .at[contexts]
-            .add(1.0)
-            .at[negs]
-            .add(scale * neg_mask.sum(axis=0))
+        div_c, div_p, div_n = _combiner_divisors(
+            vocab_size, centers, contexts, negs, scale * neg_mask.sum(axis=0),
+            combiner, compute_dtype,
         )
-        d_center = d_center / _row_divisor(
-            cnt_emb[centers], combiner
-        ).astype(compute_dtype)[:, None]
-        d_pos = d_pos / _row_divisor(
-            cnt_ctx[contexts], combiner
-        ).astype(compute_dtype)[:, None]
-        d_negrow = d_negrow / _row_divisor(
-            cnt_ctx[negs], combiner
-        ).astype(compute_dtype)[:, None]
+        d_center = d_center / div_c[:, None]
+        d_pos = d_pos / div_p[:, None]
+        d_negrow = d_negrow / div_n[:, None]
 
     dtype = emb_t.dtype
     lr = jnp.asarray(lr, compute_dtype)

@@ -48,3 +48,15 @@ def synthetic_corpus_dir(tmp_path_factory):
     (d / "pairs_b.txt").write_text("\n".join(lines[150:]) + "\n")
     (d / "ignored.csv").write_text("not,a,pair,file\n")
     return str(d)
+
+
+def cluster_separation(emb, tokens, prefix="GENE", cluster_size=10):
+    """Mean intra-cluster minus inter-cluster cosine for the synthetic
+    corpus's planted clusters (shared by backend/variant quality tests)."""
+    emb = np.asarray(emb, dtype=np.float64)
+    emb = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    cluster = np.array([int(t[len(prefix):]) // cluster_size for t in tokens])
+    sims = emb @ emb.T
+    intra = sims[cluster[:, None] == cluster[None, :]].mean()
+    inter = sims[cluster[:, None] != cluster[None, :]].mean()
+    return float(intra - inter)

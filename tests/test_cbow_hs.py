@@ -1,0 +1,168 @@
+"""CBOW + hierarchical-softmax variants (BASELINE config 4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.pair_reader import load_corpus
+from gene2vec_tpu.sgns.cbow_hs import (
+    CBOWHSTrainer,
+    hs_loss_and_grads,
+    make_trainer,
+)
+from gene2vec_tpu.sgns.huffman import build_huffman_tree
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+# -- Huffman tree ---------------------------------------------------------
+
+
+def test_huffman_prefix_free_and_complete():
+    counts = np.array([50, 30, 10, 5, 3, 1, 1], np.int64)
+    tree = build_huffman_tree(counts)
+    v = len(counts)
+    assert tree.num_nodes == v - 1
+    codes = []
+    for i in range(v):
+        n = int(tree.lengths[i])
+        assert n > 0
+        codes.append("".join(str(int(b)) for b in tree.codes[i, :n]))
+    # prefix-free: no code is a prefix of another
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not b.startswith(a)
+    # Kraft equality for a full binary tree
+    assert sum(2.0 ** -len(c) for c in codes) == pytest.approx(1.0)
+
+
+def test_huffman_frequent_tokens_get_short_codes():
+    counts = np.array([1000, 500, 100, 10, 5, 2, 1, 1], np.int64)
+    tree = build_huffman_tree(counts)
+    lengths = tree.lengths
+    assert lengths[0] == lengths.min()
+    assert lengths[-1] == lengths.max()
+    # expected code length within 1 bit of entropy (Huffman optimality)
+    p = counts / counts.sum()
+    entropy = -(p * np.log2(p)).sum()
+    expected_len = (p * lengths).sum()
+    assert entropy <= expected_len <= entropy + 1.0
+
+
+def test_huffman_points_in_range():
+    counts = np.random.RandomState(0).randint(1, 100, 64).astype(np.int64)
+    tree = build_huffman_tree(counts)
+    for i in range(64):
+        n = int(tree.lengths[i])
+        assert (tree.points[i, :n] >= 0).all()
+        assert (tree.points[i, :n] < tree.num_nodes).all()
+        # root (created last) starts every path
+        assert tree.points[i, 0] == tree.num_nodes - 1
+
+
+# -- HS loss/grads vs numpy oracle ---------------------------------------
+
+
+def _np_hs_oracle(emb, node, inputs, targets, tree):
+    """Per-example sequential HS loss and summed gradients."""
+    d_emb = np.zeros_like(emb)
+    d_node = np.zeros_like(node)
+    losses = []
+    for e in range(len(inputs)):
+        v = emb[inputs[e]]
+        t = targets[e]
+        n = int(tree.lengths[t])
+        loss = 0.0
+        for l in range(n):
+            w = node[tree.points[t, l]]
+            logit = float(v @ w)
+            code = float(tree.codes[t, l])
+            sign = 1.0 - 2.0 * code
+            loss += np.log1p(np.exp(-sign * logit))
+            g = 1.0 / (1.0 + np.exp(-logit)) - (1.0 - code)
+            d_emb[inputs[e]] += g * w
+            d_node[tree.points[t, l]] += g * v
+        losses.append(loss)
+    return np.mean(losses), d_emb, d_node
+
+
+def test_hs_loss_matches_oracle():
+    rng = np.random.RandomState(0)
+    V, D, E = 12, 6, 20
+    counts = rng.randint(1, 50, V).astype(np.int64)
+    tree = build_huffman_tree(counts)
+    emb = rng.randn(V, D).astype(np.float32) * 0.2
+    node = rng.randn(tree.num_nodes, D).astype(np.float32) * 0.2
+    inputs = rng.randint(0, V, E).astype(np.int32)
+    targets = rng.randint(0, V, E).astype(np.int32)
+
+    loss, d_in, d_nd, pts, mask = hs_loss_and_grads(
+        jnp.asarray(emb), jnp.asarray(node),
+        jnp.asarray(inputs), jnp.asarray(targets),
+        jnp.asarray(tree.points), jnp.asarray(tree.codes),
+        jnp.asarray(tree.lengths),
+    )
+    exp_loss, exp_demb, exp_dnode = _np_hs_oracle(emb, node, inputs, targets, tree)
+    np.testing.assert_allclose(float(loss), exp_loss, rtol=1e-5)
+    # scatter the per-example grads like the step would (sum semantics)
+    got_demb = np.zeros_like(emb)
+    np.add.at(got_demb, inputs, np.asarray(d_in))
+    np.testing.assert_allclose(got_demb, exp_demb, atol=1e-5)
+    got_dnode = np.zeros_like(node)
+    np.add.at(
+        got_dnode,
+        np.asarray(pts).reshape(-1),
+        np.asarray(d_nd).reshape(-1, D) * np.asarray(mask).reshape(-1, 1),
+    )
+    np.testing.assert_allclose(got_dnode, exp_dnode, atol=1e-5)
+
+
+# -- training smoke -------------------------------------------------------
+
+
+@pytest.mark.parametrize("objective", ["cbow", "sg_hs", "cbow_hs"])
+def test_variant_learns_cluster_structure(objective, synthetic_corpus_dir):
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    # ~30 epochs: the zero-initialized HS node table needs ~15 to break
+    # symmetry before the loss starts dropping
+    cfg = SGNSConfig(
+        dim=16, num_iters=30, batch_pairs=64, objective=objective, seed=0
+    )
+    trainer = make_trainer(PairCorpus(vocab, pairs), cfg)
+    assert isinstance(trainer, CBOWHSTrainer)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    first_loss = last_loss = None
+    for it in range(cfg.num_iters):
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, it))
+        loss = float(loss)
+        if first_loss is None:
+            first_loss = loss
+        last_loss = loss
+    assert np.isfinite(last_loss)
+    assert last_loss < first_loss
+    # cluster separation: the synthetic corpus pairs genes within 4 clusters
+    from conftest import cluster_separation
+
+    sep = cluster_separation(np.asarray(params.emb), vocab.id_to_token)
+    assert sep > 0.1, (objective, sep)
+
+
+def test_hs_checkpoint_roundtrip(tmp_path, synthetic_corpus_dir):
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    cfg = SGNSConfig(dim=8, num_iters=2, batch_pairs=64, objective="sg_hs")
+    trainer = CBOWHSTrainer(PairCorpus(vocab, pairs), cfg)
+    out = str(tmp_path / "emb")
+    params = trainer.run(out, log=lambda s: None)
+    # node table has V-1 rows, emb has V
+    assert params.emb.shape[0] == len(vocab)
+    assert params.ctx.shape[0] == len(vocab) - 1
+    # resume trains nothing further
+    msgs = []
+    trainer2 = CBOWHSTrainer(PairCorpus(vocab, pairs), cfg)
+    trainer2.run(out, log=msgs.append)
+    assert any("resuming from iteration 2" in m for m in msgs)

@@ -1,0 +1,134 @@
+"""CLI front-end parity tests (reference invocation shapes, SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.cli import evaluate as evaluate_cli
+from gene2vec_tpu.cli import gene2vec as gene2vec_cli
+from gene2vec_tpu.cli import ggipnn as ggipnn_cli
+from gene2vec_tpu.io.emb_io import write_word2vec_format
+
+
+def test_gene2vec_cli_positional_shape(tmp_path, synthetic_corpus_dir, capsys):
+    """Reference invocation: gene2vec <data_dir> <out_dir> txt."""
+    out = tmp_path / "emb"
+    rc = gene2vec_cli.main(
+        [
+            synthetic_corpus_dir,
+            str(out),
+            "txt",
+            "--dim=8",
+            "--iters=2",
+            "--batch-pairs=64",
+        ]
+    )
+    assert rc == 0
+    assert (out / "gene2vec_dim_8_iter_2.txt").exists()
+    assert (out / "gene2vec_dim_8_iter_2_w2v.txt").exists()
+    assert (out / "vocab.tsv").exists()
+
+
+def test_gene2vec_cli_numpy_backend(tmp_path, synthetic_corpus_dir):
+    out = tmp_path / "emb_np"
+    rc = gene2vec_cli.main(
+        [
+            synthetic_corpus_dir,
+            str(out),
+            "txt",
+            "--backend=numpy",
+            "--dim=8",
+            "--iters=1",
+        ]
+    )
+    assert rc == 0
+    assert (out / "gene2vec_dim_8_iter_1.npz").exists()
+
+
+def test_gene2vec_cli_gensim_backend_gated(tmp_path, synthetic_corpus_dir):
+    try:
+        import gensim  # noqa: F401
+
+        pytest.skip("gensim installed; gating not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="gensim"):
+        gene2vec_cli.main(
+            [synthetic_corpus_dir, str(tmp_path / "x"), "txt", "--backend=gensim"]
+        )
+
+
+def test_gene2vec_cli_vocab_sharded_mesh(tmp_path, synthetic_corpus_dir):
+    """BASELINE config 5 path through the CLI on the 8-device CPU mesh."""
+    out = tmp_path / "emb_sharded"
+    rc = gene2vec_cli.main(
+        [
+            synthetic_corpus_dir,
+            str(out),
+            "txt",
+            "--dim=16",
+            "--iters=1",
+            "--batch-pairs=64",
+            "--vocab-sharded",
+            "--mesh-model=2",
+        ]
+    )
+    assert rc == 0
+    assert (out / "gene2vec_dim_16_iter_1.npz").exists()
+
+
+def test_evaluate_cli(tmp_path, capsys):
+    """Pathway genes trained similar → score > 1."""
+    rng = np.random.RandomState(0)
+    toks = [f"G{i}" for i in range(50)]
+    mat = rng.randn(50, 8).astype(np.float32)
+    mat[:10] = rng.randn(1, 8) + 0.05 * rng.randn(10, 8)  # pathway cluster
+    emb = tmp_path / "emb_w2v.txt"
+    write_word2vec_format(str(emb), toks, mat)
+    gmt = tmp_path / "p.gmt"
+    gmt.write_text(
+        "PATH1\thttp://x\t" + "\t".join(toks[:10]) + "\n"
+        "TOOBIG\thttp://x\t" + "\t".join(f"G{i}" for i in range(60)) + "\n"
+    )
+    rc = evaluate_cli.main([str(emb), str(gmt)])
+    assert rc == 0
+    score = float(capsys.readouterr().out.strip())
+    assert score > 1.0
+
+
+def test_ggipnn_cli_end_to_end(tmp_path, capsys):
+    """predictionData/-shaped splits → printed AUC line."""
+    rng = np.random.RandomState(0)
+    d = tmp_path / "pred"
+    d.mkdir()
+    genes = [f"g{i}" for i in range(30)]
+
+    def write_split(name, n):
+        xs, ys = [], []
+        for _ in range(n):
+            a, b = rng.randint(0, 30, 2)
+            xs.append(f"{genes[a]} {genes[b]}")
+            ys.append(str(int(a < 15 and b < 15)))
+        (d / f"{name}_text.txt").write_text("\n".join(xs) + "\n")
+        (d / f"{name}_label.txt").write_text("\n".join(ys) + "\n")
+
+    write_split("train", 300)
+    write_split("valid", 60)
+    write_split("test", 60)
+
+    emb = tmp_path / "emb.txt"
+    mat = rng.randn(30, 8).astype(np.float32)
+    write_word2vec_format(str(emb), genes, mat)
+
+    rc = ggipnn_cli.main(
+        [
+            "--data-dir", str(d),
+            "--emb", str(emb),
+            "--embedding-dim=8",
+            "--num-epochs=2",
+            "--batch-size=32",
+            "--evaluate-every=1000000",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "The AUC score is" in out

@@ -60,7 +60,7 @@ def test_loss_and_grads_match_oracle():
     negs = rng.randint(0, V, (E, K)).astype(np.int32)
 
     params = SGNSParams(jnp.asarray(emb), jnp.asarray(ctx))
-    loss, _ = sgns_loss_and_grads(
+    loss, _, _ = sgns_loss_and_grads(
         params, jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(negs)
     )
     exp_loss, _, _ = numpy_sgns_oracle(emb, ctx, centers, contexts, negs, 0.0)
