@@ -97,7 +97,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     corpus = PairCorpus(vocab, pairs)
     print(f"{corpus.num_pairs:,} pairs, vocab {corpus.vocab_size:,}")
 
-    if args.backend == "jax" and (args.vocab_sharded or args.mesh_model > 1):
+    wants_mesh = args.vocab_sharded or args.mesh_model > 1 or args.mesh_data > 0
+    if args.backend == "jax" and wants_mesh:
         import jax
 
         from gene2vec_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,46 @@
+"""Embedding plot CLI — ``src/plot_gene2vec.py`` parity."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="plot",
+        description="Reduce an embedding to 2-D/3-D and export an "
+                    "interactive scatter (json + html/png).",
+    )
+    p.add_argument("emb_file")
+    p.add_argument("out_prefix", help="output path prefix (no extension)")
+    p.add_argument(
+        "--method", choices=("auto", "umap", "tsne", "pca"), default="auto"
+    )
+    p.add_argument("--components", type=int, choices=(2, 3), default=2)
+    p.add_argument(
+        "--annotate", action="store_true",
+        help="query NCBI gene info via mygene (network; gated)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from gene2vec_tpu.viz.plot import plot_gene2vec
+
+    plot_gene2vec(
+        args.emb_file,
+        args.out_prefix,
+        method=args.method,
+        n_components=args.components,
+        annotate=args.annotate,
+        seed=args.seed,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+"""ctypes bridge to the native corpus reader (native/pairio.cpp).
+
+The shared library is built with ``make -C native`` (plain g++, no
+pybind11); if it is absent, :func:`available` triggers one silent build
+attempt (disable with ``GENE2VEC_TPU_NO_NATIVE_BUILD=1``) and the pure
+Python reader in pair_reader.py remains the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.io.vocab import Vocab
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpairio.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_attempted = False
+
+
+class _PairioResult(ctypes.Structure):
+    _fields_ = [
+        ("num_pairs", ctypes.c_int64),
+        ("pairs", ctypes.POINTER(ctypes.c_int32)),
+        ("vocab_size", ctypes.c_int64),
+        ("counts", ctypes.POINTER(ctypes.c_int64)),
+        ("tokens", ctypes.c_char_p),
+        ("tokens_len", ctypes.c_int64),
+    ]
+
+
+def _try_build() -> None:
+    global _build_attempted
+    if _build_attempted or os.environ.get("GENE2VEC_TPU_NO_NATIVE_BUILD"):
+        return
+    _build_attempted = True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True,
+            timeout=120,
+            check=False,
+        )
+    except Exception:
+        pass
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _try_build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.pairio_load_files.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.POINTER(_PairioResult),
+    ]
+    lib.pairio_load_files.restype = ctypes.c_int
+    lib.pairio_free.argtypes = [ctypes.POINTER(_PairioResult)]
+    lib.pairio_free.restype = None
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_corpus(
+    paths: Sequence[str], min_count: int = 1, encoding: str = "windows-1252"
+) -> Tuple[Vocab, np.ndarray]:
+    """(Vocab, (N, 2) int32 pairs) — behavior-identical to the Python path."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native pairio library not available")
+    paths = list(paths)
+    c_paths = (ctypes.c_char_p * len(paths))(
+        *[p.encode("utf-8") for p in paths]
+    )
+    res = _PairioResult()
+    rc = lib.pairio_load_files(c_paths, len(paths), min_count, ctypes.byref(res))
+    if rc != 0:
+        lib.pairio_free(ctypes.byref(res))
+        raise OSError(f"pairio_load_files failed with code {rc}")
+    try:
+        n = int(res.num_pairs)
+        pairs = np.ctypeslib.as_array(res.pairs, shape=(n, 2)).copy() if n else (
+            np.zeros((0, 2), np.int32)
+        )
+        v = int(res.vocab_size)
+        counts = (
+            np.ctypeslib.as_array(res.counts, shape=(v,)).copy()
+            if v
+            else np.zeros(0, np.int64)
+        )
+        raw = ctypes.string_at(
+            ctypes.cast(res.tokens, ctypes.c_void_p), int(res.tokens_len)
+        )
+        tokens: List[str] = (
+            raw.decode(encoding).split("\n")[:-1] if res.tokens_len else []
+        )
+    finally:
+        lib.pairio_free(ctypes.byref(res))
+    if len(tokens) != v:
+        raise RuntimeError(
+            f"native reader token/count mismatch: {len(tokens)} vs {v}"
+        )
+    return Vocab(tokens, counts), pairs.astype(np.int32)

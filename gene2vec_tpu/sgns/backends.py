@@ -20,7 +20,9 @@ BACKENDS = ("jax", "numpy", "gensim")
 def make_backend_trainer(
     corpus: PairCorpus, config: SGNSConfig, backend: str = "jax"
 ):
-    """Trainer with the common init/train_epoch/run interface."""
+    """Trainer with the common ``run(export_dir)`` interface (jax and numpy
+    backends additionally expose init/train_epoch; gensim drives its own
+    training loop internally)."""
     if backend == "jax":
         from gene2vec_tpu.sgns.cbow_hs import make_trainer
 
@@ -77,6 +79,20 @@ class GensimTrainer:
 
         cfg = self.config
         vocab = self.corpus.vocab
+        if start_iter is None:
+            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+        if start_iter > cfg.num_iters:
+            log(f"resuming from iteration {start_iter - 1}")
+            return None
+        if start_iter > 1:
+            # gensim's binary model is not part of our checkpoint layout, so
+            # a partial run restarts from scratch rather than resuming
+            # mid-stream (the reference reloads its own .save files,
+            # src/gene2vec.py:86-88; our layout keeps only the tables)
+            log(
+                f"gensim backend cannot resume mid-run from iteration "
+                f"{start_iter - 1}; retraining from iteration 1"
+            )
         sentences = [
             [vocab.id_to_token[a], vocab.id_to_token[b]]
             for a, b in self.corpus.pairs

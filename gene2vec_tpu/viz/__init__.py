@@ -1,0 +1,10 @@
+"""Visualization subsystem (reference L5, ``src/tsne_multi_core.py`` /
+``src/plot_gene2vec.py`` / ``src/GTExFigure.py`` / ``src/gene2vec_dash_app.py``).
+
+The 2-D projection (the compute-heavy part) runs on TPU as exact t-SNE
+matmuls; figure/dashboard rendering is CPU-side and gated on the optional
+plotting stacks (matplotlib in-image; plotly/umap/dash/mygene/goatools
+import-gated with actionable errors).
+"""
+
+from gene2vec_tpu.viz.tsne import TSNE, pca_reduce  # noqa: F401

@@ -1,0 +1,168 @@
+"""GeneView dashboard — ``src/gene2vec_dash_app.py`` parity.
+
+The reference's Dash app loads the plotly-JSON scatter exported by the plot
+generator, adds GO-term and Reactome-pathway dropdowns, and recolors member
+genes on selection (active yellow, inactive near-invisible,
+``src/gene2vec_dash_app.py:65,189-235``).
+
+The data/logic layer here (annotation tables, marker restyling) is
+dependency-free and unit-tested; only ``serve()`` needs dash (gated), and
+GO-DAG/taxid enrichment needs goatools/ete3 (gated separately).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ACTIVE_COLOR = "#fcf803"          # the reference's highlight yellow
+INACTIVE_COLOR = "rgba(100, 100, 100, 0.12)"
+BASE_COLOR = "#636efa"
+
+
+def load_figure_json(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def parse_annotation_table(
+    path: str, id_col: int = 0, gene_col: int = 1, name_col: Optional[int] = 2
+) -> Tuple[Dict[str, List[str]], Dict[str, str]]:
+    """TSV of (term id, gene, [description]) rows → (term → genes,
+    term → description)."""
+    members: Dict[str, List[str]] = {}
+    descriptions: Dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) <= max(id_col, gene_col):
+                continue
+            term, gene = parts[id_col], parts[gene_col]
+            if not term or not gene:
+                continue
+            members.setdefault(term, []).append(gene)
+            if name_col is not None and len(parts) > name_col:
+                descriptions.setdefault(term, parts[name_col])
+    return members, descriptions
+
+
+def load_gmt_terms(path: str) -> Tuple[Dict[str, List[str]], Dict[str, str]]:
+    """MSigDB .gmt as (term → genes, term → url/description)."""
+    members: Dict[str, List[str]] = {}
+    descriptions: Dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            fields = line.rstrip("\n").split("\t")
+            if len(fields) < 3:
+                continue
+            members[fields[0]] = [g for g in fields[2:] if g]
+            descriptions[fields[0]] = fields[1]
+    return members, descriptions
+
+
+def highlight_genes(figure: dict, selected: Sequence[str]) -> dict:
+    """Recolor the scatter: selected genes active-yellow, the rest
+    near-invisible; empty selection restores the base color.  Pure function
+    over the figure dict (the reference mutates the same fields in its
+    callback, ``src/gene2vec_dash_app.py:189-235``)."""
+    out = json.loads(json.dumps(figure))  # deep copy
+    sel = set(selected)
+    for trace in out.get("data", []):
+        genes = trace.get("customdata") or trace.get("text") or []
+        if not sel:
+            trace.setdefault("marker", {})["color"] = BASE_COLOR
+            continue
+        trace.setdefault("marker", {})["color"] = [
+            ACTIVE_COLOR if g in sel else INACTIVE_COLOR for g in genes
+        ]
+    return out
+
+
+def term_options(
+    members: Dict[str, List[str]], descriptions: Dict[str, str]
+) -> List[dict]:
+    """Dropdown options sorted by term id."""
+    return [
+        {
+            "label": f"{term} — {descriptions.get(term, '')}".rstrip(" —"),
+            "value": term,
+        }
+        for term in sorted(members)
+    ]
+
+
+def go_dag_descriptions(obo_path: str) -> Dict[str, str]:
+    """GO id → name via goatools (``src/gene2vec_dash_app.py:30-44``); gated."""
+    try:
+        from goatools.obo_parser import GODag
+    except ImportError as e:
+        raise ImportError(
+            "GO-DAG descriptions require the goatools package; provide a "
+            "TSV annotation table instead"
+        ) from e
+    dag = GODag(obo_path, prt=None)
+    return {go_id: term.name for go_id, term in dag.items()}
+
+
+def serve(
+    figure_json: str,
+    go_table: Optional[str] = None,
+    reactome_table: Optional[str] = None,
+    host: str = "127.0.0.1",
+    port: int = 8050,
+):  # pragma: no cover - needs dash + a browser
+    """Launch the dashboard (requires the dash package)."""
+    try:
+        import dash
+        from dash import dcc, html
+        from dash.dependencies import Input, Output
+    except ImportError as e:
+        raise ImportError(
+            "the GeneView dashboard requires the dash package; the figure "
+            "json/html exports from viz.plot work without it"
+        ) from e
+
+    figure = load_figure_json(figure_json)
+    tables = {}
+    if go_table:
+        tables["GO"] = parse_annotation_table(go_table)
+    if reactome_table:
+        tables["Reactome"] = parse_annotation_table(reactome_table)
+
+    app = dash.Dash("GeneView")
+    dropdowns = []
+    for kind, (members, desc) in tables.items():
+        dropdowns.append(html.Label(kind))
+        dropdowns.append(
+            dcc.Dropdown(
+                id=f"dd-{kind.lower()}",
+                options=term_options(members, desc),
+                multi=False,
+            )
+        )
+    app.layout = html.Div(
+        [
+            html.H2("GeneView — gene2vec embedding"),
+            *dropdowns,
+            dcc.Graph(id="scatter", figure=figure),
+            html.Pre(id="description"),
+        ]
+    )
+
+    for kind, (members, desc) in tables.items():
+        @app.callback(
+            Output("scatter", "figure", allow_duplicate=True),
+            Output("description", "children", allow_duplicate=True),
+            Input(f"dd-{kind.lower()}", "value"),
+            prevent_initial_call=True,
+        )
+        def _update(term, members=members, desc=desc):
+            if not term:
+                return highlight_genes(figure, []), ""
+            return (
+                highlight_genes(figure, members.get(term, [])),
+                desc.get(term, ""),
+            )
+
+    app.run(host=host, port=port)
+    return app

@@ -1,0 +1,160 @@
+"""Interactive scatter of the embedding — ``src/plot_gene2vec.py`` parity.
+
+Pipeline: load embedding → 2-D/3-D reduction (UMAP when installed, else
+t-SNE on TPU, else PCA) → optional NCBI annotation via mygene (gated) →
+figure exported as ``.html`` + ``.json`` when plotly is installed, else a
+matplotlib ``.png`` plus the same ``.json`` payload (the dash app consumes
+the json, ``src/gene2vec_dash_app.py:68``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from gene2vec_tpu.io.emb_io import load_embedding_any
+
+
+def reduce_embedding(
+    matrix: np.ndarray,
+    method: str = "auto",
+    n_components: int = 2,
+    seed: int = 0,
+) -> np.ndarray:
+    """2-D/3-D coordinates via umap | tsne | pca (auto = first available)."""
+    if method == "auto":
+        try:
+            import umap  # noqa: F401
+
+            method = "umap"
+        except ImportError:
+            method = "tsne"  # dependency-free, runs on TPU
+    if method == "umap":
+        try:
+            import umap
+        except ImportError as e:
+            raise ImportError(
+                "method='umap' requires the umap-learn package; use "
+                "method='tsne' (TPU) or method='pca'"
+            ) from e
+        return np.asarray(
+            umap.UMAP(
+                n_components=n_components, random_state=seed
+            ).fit_transform(matrix),
+            np.float32,
+        )
+    if method == "tsne":
+        from gene2vec_tpu.config import TSNEConfig
+        from gene2vec_tpu.viz.tsne import TSNE
+
+        cfg = TSNEConfig(seed=seed, n_iter=1000)
+        return TSNE(config=cfg, n_components=n_components).fit(
+            matrix, log=lambda s: None
+        )[cfg.n_iter]
+    if method == "pca":
+        from gene2vec_tpu.viz.tsne import pca_reduce
+
+        return pca_reduce(matrix, n_components)
+    raise ValueError(f"unknown reduction method {method!r}")
+
+
+def query_gene_info(genes: Sequence[str]) -> Dict[str, dict]:
+    """NCBI annotation via mygene (``src/plot_gene2vec.py:74-96``); gated."""
+    try:
+        import mygene
+    except ImportError as e:
+        raise ImportError(
+            "gene annotation requires the mygene package; pass "
+            "annotate=False to skip"
+        ) from e
+    mg = mygene.MyGeneInfo()
+    res = mg.querymany(
+        list(genes), scopes="symbol", fields="name,summary", species="human"
+    )
+    return {r["query"]: r for r in res if not r.get("notfound")}
+
+
+def scatter_payload(
+    tokens: Sequence[str],
+    coords: np.ndarray,
+    info: Optional[Dict[str, dict]] = None,
+) -> dict:
+    """Plotly-figure-shaped dict (consumed by the dash app and exports)."""
+    dims = coords.shape[1]
+    hover: List[str] = []
+    for t in tokens:
+        meta = (info or {}).get(t)
+        hover.append(
+            f"{t}<br>{meta['name']}" if meta and "name" in meta else str(t)
+        )
+    trace = {
+        "type": "scatter3d" if dims == 3 else "scattergl",
+        "mode": "markers",
+        "x": coords[:, 0].tolist(),
+        "y": coords[:, 1].tolist(),
+        "text": hover,
+        "customdata": list(tokens),
+        "marker": {"size": 3, "opacity": 0.8},
+    }
+    if dims == 3:
+        trace["z"] = coords[:, 2].tolist()
+    return {
+        "data": [trace],
+        "layout": {"title": {"text": "gene2vec embedding"}, "height": 800},
+    }
+
+
+def export_figure(payload: dict, out_prefix: str) -> List[str]:
+    """Write ``<prefix>.json`` always; ``.html`` via plotly when installed,
+    else a matplotlib ``.png`` fallback."""
+    written = []
+    json_path = out_prefix + ".json"
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    written.append(json_path)
+    try:
+        import plotly.graph_objects as go
+
+        fig = go.Figure(payload)
+        html = out_prefix + ".html"
+        fig.write_html(html)
+        written.append(html)
+    except ImportError:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        trace = payload["data"][0]
+        fig, ax = plt.subplots(figsize=(10, 10))
+        ax.scatter(trace["x"], trace["y"], s=2, alpha=0.6)
+        ax.set_title(payload["layout"]["title"]["text"])
+        png = out_prefix + ".png"
+        fig.savefig(png, dpi=150)
+        plt.close(fig)
+        written.append(png)
+    return written
+
+
+def plot_gene2vec(
+    emb_path: str,
+    out_prefix: str,
+    method: str = "auto",
+    n_components: int = 2,
+    annotate: bool = False,
+    seed: int = 0,
+    log=print,
+) -> List[str]:
+    """End-to-end ``src/plot_gene2vec.py`` flow."""
+    tokens, matrix = load_embedding_any(emb_path)
+    log(f"{len(tokens)} genes loaded; reducing with {method}")
+    coords = reduce_embedding(matrix, method, n_components, seed)
+    info = query_gene_info(tokens) if annotate else None
+    payload = scatter_payload(tokens, coords, info)
+    os.makedirs(os.path.dirname(os.path.abspath(out_prefix)), exist_ok=True)
+    written = export_figure(payload, out_prefix)
+    log(f"wrote {', '.join(written)}")
+    return written

@@ -1,0 +1,207 @@
+// Native pair-corpus reader: tokenize + vocab count + encode in one pass
+// over mmap'd files.
+//
+// TPU-native replacement for the corpus-ingest work the reference delegates
+// to gensim's Python/Cython loader (src/gene2vec.py:30-47 reads every file
+// into a Python list of 2-element lists — hundreds of millions of Python
+// objects at full-corpus scale). Here the host-side runtime cost is one
+// byte scan per file; the output is the (N, 2) int32 pair array that goes
+// straight to the device.
+//
+// Behavior contract (must match gene2vec_tpu/io/pair_reader.py exactly):
+//   * tokens are maximal runs of non-whitespace bytes (Python str.split());
+//   * every token of every non-empty line counts toward the vocab;
+//   * only lines with exactly 2 tokens yield a pair;
+//   * vocab ids are assigned by count descending, ties by first appearance
+//     (stable sort — gensim's ordering, io/vocab.py);
+//   * min_count filters tokens, dropping pairs with a filtered member;
+//   * bytes are treated as windows-1252 (single-byte, order-preserving —
+//     the Python wrapper decodes token bytes with that codec).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct TokenInfo {
+  int64_t count = 0;
+  int32_t first_appearance = -1;
+};
+
+inline bool is_space(unsigned char c) {
+  // Python str.split() splits on unicode whitespace. For windows-1252 input
+  // that is ASCII whitespace, the 0x1C-0x1F separator controls, and 0xA0
+  // (NBSP). NOT 0x85: cp1252 decodes it to U+2026 "...", a printable char.
+  return c == ' ' || (c >= '\t' && c <= '\r') || (c >= 0x1C && c <= 0x1F) ||
+         c == 0xA0;
+}
+
+struct MappedFile {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open_file(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      ::close(fd);
+      fd = -1;  // destructor must not close it again
+      return false;
+    }
+    size = static_cast<size_t>(st.st_size);
+    if (size == 0) {
+      data = nullptr;
+      return true;
+    }
+    void* p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) {
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    data = static_cast<const char*>(p);
+    return true;
+  }
+
+  ~MappedFile() {
+    if (data) munmap(const_cast<char*>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct PairioResult {
+  int64_t num_pairs = 0;
+  int32_t* pairs = nullptr;      // 2 * num_pairs, row-major
+  int64_t vocab_size = 0;
+  int64_t* counts = nullptr;     // vocab_size, id order
+  char* tokens = nullptr;        // '\n'-joined token bytes, id order
+  int64_t tokens_len = 0;
+};
+
+// Returns 0 on success, negative on error (-1 io, -2 alloc).
+int pairio_load_files(const char** paths, int32_t n_paths, int64_t min_count,
+                      PairioResult* out) {
+  std::unordered_map<std::string_view, TokenInfo> table;
+  std::vector<std::string_view> by_first;           // first-appearance order
+  std::vector<std::pair<int32_t, int32_t>> raw_pairs;  // first-appearance ids
+  std::vector<MappedFile> files(n_paths);
+
+  for (int32_t f = 0; f < n_paths; ++f) {
+    if (!files[f].open_file(paths[f])) return -1;
+    const char* p = files[f].data;
+    const char* end = p + files[f].size;
+    while (p < end) {
+      // one line
+      const char* line_end = static_cast<const char*>(
+          memchr(p, '\n', static_cast<size_t>(end - p)));
+      if (!line_end) line_end = end;
+      int32_t ids[2];
+      int n_tok = 0;
+      const char* q = p;
+      while (q < line_end) {
+        while (q < line_end && is_space(static_cast<unsigned char>(*q))) ++q;
+        if (q == line_end) break;
+        const char* tok_start = q;
+        while (q < line_end && !is_space(static_cast<unsigned char>(*q))) ++q;
+        std::string_view tok(tok_start, static_cast<size_t>(q - tok_start));
+        auto it = table.find(tok);
+        if (it == table.end()) {
+          TokenInfo info;
+          info.count = 1;
+          info.first_appearance = static_cast<int32_t>(by_first.size());
+          it = table.emplace(tok, info).first;
+          by_first.push_back(tok);
+        } else {
+          ++it->second.count;
+        }
+        if (n_tok < 2) ids[n_tok] = it->second.first_appearance;
+        ++n_tok;
+      }
+      if (n_tok == 2) raw_pairs.emplace_back(ids[0], ids[1]);
+      p = (line_end < end) ? line_end + 1 : end;
+    }
+  }
+
+  const int64_t n_all = static_cast<int64_t>(by_first.size());
+  // order: count desc, first appearance asc (stable tie-break)
+  std::vector<int32_t> order(static_cast<size_t>(n_all));
+  for (int64_t i = 0; i < n_all; ++i) order[static_cast<size_t>(i)] = static_cast<int32_t>(i);
+  std::stable_sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return table[by_first[static_cast<size_t>(a)]].count >
+           table[by_first[static_cast<size_t>(b)]].count;
+  });
+
+  std::vector<int32_t> id_of(static_cast<size_t>(n_all), -1);
+  int64_t vocab_size = 0;
+  size_t tokens_bytes = 0;
+  for (int32_t fa : order) {
+    const auto& info = table[by_first[static_cast<size_t>(fa)]];
+    if (info.count < min_count) break;  // sorted: all later are rarer
+    id_of[static_cast<size_t>(fa)] = static_cast<int32_t>(vocab_size++);
+    tokens_bytes += by_first[static_cast<size_t>(fa)].size() + 1;
+  }
+
+  out->vocab_size = vocab_size;
+  out->counts = static_cast<int64_t*>(malloc(sizeof(int64_t) * static_cast<size_t>(vocab_size ? vocab_size : 1)));
+  out->tokens = static_cast<char*>(malloc(tokens_bytes ? tokens_bytes : 1));
+  if (!out->counts || !out->tokens) return -2;
+  char* tp = out->tokens;
+  for (int64_t i = 0; i < vocab_size; ++i) {
+    std::string_view tok = by_first[static_cast<size_t>(order[static_cast<size_t>(i)])];
+    out->counts[i] = table[tok].count;
+    memcpy(tp, tok.data(), tok.size());
+    tp += tok.size();
+    *tp++ = '\n';
+  }
+  out->tokens_len = static_cast<int64_t>(tp - out->tokens);
+
+  // encode pairs, dropping any with a filtered token
+  out->pairs = static_cast<int32_t*>(
+      malloc(sizeof(int32_t) * 2 * (raw_pairs.size() ? raw_pairs.size() : 1)));
+  if (!out->pairs) return -2;
+  int64_t np = 0;
+  for (const auto& pr : raw_pairs) {
+    int32_t a = id_of[static_cast<size_t>(pr.first)];
+    int32_t b = id_of[static_cast<size_t>(pr.second)];
+    if (a >= 0 && b >= 0) {
+      out->pairs[2 * np] = a;
+      out->pairs[2 * np + 1] = b;
+      ++np;
+    }
+  }
+  out->num_pairs = np;
+  return 0;
+}
+
+void pairio_free(PairioResult* r) {
+  if (!r) return;
+  free(r->pairs);
+  free(r->counts);
+  free(r->tokens);
+  r->pairs = nullptr;
+  r->counts = nullptr;
+  r->tokens = nullptr;
+  r->num_pairs = r->vocab_size = r->tokens_len = 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+"""Native C++ corpus reader vs the pure-Python reader: exact parity."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.io import native_pairio
+from gene2vec_tpu.io.pair_reader import iter_pair_files, load_corpus
+from gene2vec_tpu.io.vocab import Vocab
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    if not native_pairio.available():
+        pytest.skip("native library unavailable and build failed")
+
+
+def _python_load(source_dir, pattern="txt", min_count=1):
+    return load_corpus(source_dir, pattern, min_count=min_count, use_native=False)
+
+
+def _native_load(source_dir, pattern="txt", min_count=1):
+    return native_pairio.load_corpus(
+        iter_pair_files(source_dir, pattern), min_count=min_count
+    )
+
+
+def _assert_same(a, b):
+    vocab_a, pairs_a = a
+    vocab_b, pairs_b = b
+    assert vocab_a.id_to_token == vocab_b.id_to_token
+    np.testing.assert_array_equal(vocab_a.counts, vocab_b.counts)
+    np.testing.assert_array_equal(pairs_a, pairs_b)
+
+
+def test_parity_on_synthetic_corpus(synthetic_corpus_dir):
+    _assert_same(
+        _python_load(synthetic_corpus_dir), _native_load(synthetic_corpus_dir)
+    )
+
+
+def test_parity_with_messy_lines(tmp_path):
+    d = tmp_path / "c"
+    d.mkdir()
+    # blank lines, 1-token and 3-token lines (count tokens, drop as pairs),
+    # tabs, repeated tokens with tie counts, windows-1252 high bytes
+    (d / "a.txt").write_bytes(
+        b"A B\n"
+        b"\n"
+        b"C\n"
+        b"D E F\n"
+        b"B\tA\n"
+        b"G\xe9NE1 G\xe9NE2\n"   # e-acute in windows-1252
+        b"  A   B  \n"
+    )
+    (d / "b.txt").write_bytes(b"H I\nI H\nH I\n")
+    _assert_same(_python_load(str(d)), _native_load(str(d)))
+
+
+def test_parity_min_count(tmp_path):
+    d = tmp_path / "c"
+    d.mkdir()
+    (d / "a.txt").write_text("A B\nA C\nA B\nD E\n")
+    _assert_same(
+        _python_load(str(d), min_count=2), _native_load(str(d), min_count=2)
+    )
+    vocab, pairs = _native_load(str(d), min_count=2)
+    assert set(vocab.id_to_token) == {"A", "B"}
+    assert pairs.shape == (2, 2)  # both "A B" lines survive, "A C"/"D E" drop
+
+
+def test_load_corpus_uses_native_by_default(synthetic_corpus_dir):
+    v1, p1 = load_corpus(synthetic_corpus_dir, "txt", use_native=True)
+    v2, p2 = load_corpus(synthetic_corpus_dir, "txt", use_native=False)
+    assert v1.id_to_token == v2.id_to_token
+    np.testing.assert_array_equal(p1, p2)
+
+
+def test_empty_file(tmp_path):
+    d = tmp_path / "c"
+    d.mkdir()
+    (d / "a.txt").write_text("")
+    vocab, pairs = _native_load(str(d))
+    assert len(vocab) == 0 and pairs.shape == (0, 2)
+
+
+def test_native_speed_sanity(tmp_path):
+    """Native reader should beat Python comfortably on a larger corpus."""
+    import time
+
+    rng = np.random.RandomState(0)
+    d = tmp_path / "c"
+    d.mkdir()
+    genes = [f"GENE{i}" for i in range(5000)]
+    lines = [
+        f"{genes[a]} {genes[b]}"
+        for a, b in rng.randint(0, 5000, (200_000, 2))
+    ]
+    (d / "big.txt").write_text("\n".join(lines) + "\n")
+
+    t0 = time.perf_counter()
+    _native_load(str(d))
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _python_load(str(d))
+    t_python = time.perf_counter() - t0
+    assert t_native < t_python, (t_native, t_python)

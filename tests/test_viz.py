@@ -1,0 +1,178 @@
+"""Visualization subsystem: t-SNE quality, sweep file parity, plot exports,
+GTEx figures, dash logic layer."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.config import TSNEConfig
+from gene2vec_tpu.io.emb_io import write_matrix_txt
+from gene2vec_tpu.viz.tsne import TSNE, pca_reduce, run_tsne_sweep
+
+
+def _blobs(n_per=50, d=20, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 6.0
+    x = np.concatenate(
+        [centers[i] + rng.randn(n_per, d) for i in range(k)], axis=0
+    ).astype(np.float32)
+    labels = np.repeat(np.arange(k), n_per)
+    return x, labels
+
+
+def test_pca_reduce_shapes_and_variance():
+    x, _ = _blobs()
+    r = pca_reduce(x, 5)
+    assert r.shape == (x.shape[0], 5)
+    # first component captures the most variance
+    var = r.var(axis=0)
+    assert np.all(np.diff(var) <= 1e-6)
+
+
+def test_tsne_separates_blobs():
+    x, labels = _blobs()
+    cfg = TSNEConfig(pca_dims=10, n_iter=500, seed=0)
+    out = TSNE(config=cfg).fit(x, log=lambda s: None)
+    y = out[500]
+    assert y.shape == (x.shape[0], 2)
+    # mean intra-cluster distance well below inter-cluster distance
+    dists = np.linalg.norm(y[:, None] - y[None, :], axis=-1)
+    same = labels[:, None] == labels[None, :]
+    np.fill_diagonal(same, False)
+    intra = dists[same].mean()
+    inter = dists[~same & ~np.eye(len(y), dtype=bool)].mean()
+    assert inter > 2.0 * intra, (intra, inter)
+
+
+def test_tsne_snapshots_share_one_run():
+    x, _ = _blobs(n_per=30)
+    cfg = TSNEConfig(pca_dims=10, seed=0)
+    out = TSNE(config=cfg).fit(x, snapshot_iters=[50, 150], log=lambda s: None)
+    assert set(out) == {50, 150}
+    assert not np.allclose(out[50], out[150])  # training continued
+
+
+def test_tsne_sweep_file_parity(tmp_path):
+    """labels.txt + one coord file per snapshot, row-aligned."""
+    x, _ = _blobs(n_per=20)
+    toks = [f"G{i}" for i in range(len(x))]
+    emb = tmp_path / "emb.txt"
+    write_matrix_txt(str(emb), toks, x)
+    out = tmp_path / "tsne"
+    written = run_tsne_sweep(
+        str(emb), str(out), iters=[30, 60],
+        config=TSNEConfig(pca_dims=10), log=lambda s: None,
+    )
+    assert (out / "labels.txt").exists()
+    assert (out / "tsne_iter_30.txt").exists()
+    assert (out / "tsne_iter_60.txt").exists()
+    labels = (out / "labels.txt").read_text().split()
+    coords = np.loadtxt(out / "tsne_iter_60.txt")
+    assert len(labels) == coords.shape[0] == len(x)
+    assert set(labels) == set(toks)  # shuffled but complete
+    assert len(written) == 3
+
+
+def test_plot_exports_json_and_figure(tmp_path):
+    from gene2vec_tpu.viz.plot import plot_gene2vec
+
+    x, _ = _blobs(n_per=15, d=8)
+    toks = [f"G{i}" for i in range(len(x))]
+    emb = tmp_path / "emb.txt"
+    write_matrix_txt(str(emb), toks, x)
+    written = plot_gene2vec(
+        str(emb), str(tmp_path / "fig"), method="pca", log=lambda s: None
+    )
+    payload = json.load(open(tmp_path / "fig.json"))
+    assert payload["data"][0]["customdata"] == toks
+    assert len(payload["data"][0]["x"]) == len(toks)
+    # html (plotly) or png (matplotlib fallback) — exactly one of them
+    assert any(w.endswith((".html", ".png")) for w in written)
+
+
+def test_gtex_figure(tmp_path):
+    from gene2vec_tpu.viz.gtex import run_gtex_figures
+
+    rng = np.random.RandomState(0)
+    genes = [f"G{i}" for i in range(40)]
+    (tmp_path / "labels.txt").write_text("\n".join(genes) + "\n")
+    np.savetxt(tmp_path / "coords.txt", rng.randn(40, 2))
+    (tmp_path / "Liver_specific_genes.txt").write_text(
+        "gene z\n" + "\n".join(f"G{i} {rng.randn() + 2:.3f}" for i in range(10))
+    )
+    written = run_gtex_figures(
+        str(tmp_path / "labels.txt"),
+        str(tmp_path / "coords.txt"),
+        str(tmp_path / "*specific_genes.txt"),
+        str(tmp_path / "figs"),
+        log=lambda s: None,
+    )
+    assert len(written) == 1
+    assert os.path.getsize(written[0]) > 10_000  # a real png
+
+
+def test_dash_logic_highlight_and_tables(tmp_path):
+    from gene2vec_tpu.viz.dash_app import (
+        ACTIVE_COLOR,
+        BASE_COLOR,
+        INACTIVE_COLOR,
+        highlight_genes,
+        load_gmt_terms,
+        parse_annotation_table,
+        term_options,
+    )
+
+    figure = {
+        "data": [
+            {"type": "scattergl", "customdata": ["A", "B", "C"], "x": [0, 1, 2]}
+        ],
+        "layout": {},
+    }
+    hi = highlight_genes(figure, ["B"])
+    assert hi["data"][0]["marker"]["color"] == [
+        INACTIVE_COLOR, ACTIVE_COLOR, INACTIVE_COLOR,
+    ]
+    assert figure["data"][0].get("marker") is None  # pure function
+    reset = highlight_genes(figure, [])
+    assert reset["data"][0]["marker"]["color"] == BASE_COLOR
+
+    tsv = tmp_path / "go.tsv"
+    tsv.write_text("GO:1\tA\tthing one\nGO:1\tB\tthing one\nGO:2\tC\tother\n")
+    members, desc = parse_annotation_table(str(tsv))
+    assert members == {"GO:1": ["A", "B"], "GO:2": ["C"]}
+    assert desc["GO:2"] == "other"
+    opts = term_options(members, desc)
+    assert opts[0]["value"] == "GO:1" and "thing one" in opts[0]["label"]
+
+    gmt = tmp_path / "p.gmt"
+    gmt.write_text("P1\thttp://u\tA\tB\n")
+    m2, d2 = load_gmt_terms(str(gmt))
+    assert m2 == {"P1": ["A", "B"]} and d2["P1"] == "http://u"
+
+
+def test_dash_serve_gated():
+    try:
+        import dash  # noqa: F401
+
+        pytest.skip("dash installed; gating not exercised")
+    except ImportError:
+        pass
+    from gene2vec_tpu.viz.dash_app import serve
+
+    with pytest.raises(ImportError, match="dash"):
+        serve("/nonexistent.json")
+
+
+def test_umap_gated():
+    try:
+        import umap  # noqa: F401
+
+        pytest.skip("umap installed; gating not exercised")
+    except ImportError:
+        pass
+    from gene2vec_tpu.viz.plot import reduce_embedding
+
+    with pytest.raises(ImportError, match="umap"):
+        reduce_embedding(np.zeros((10, 4), np.float32), method="umap")
