@@ -43,7 +43,7 @@ def synth_corpus(vocab_size: int, num_pairs: int, seed: int = 0):
 
 
 def measure_pairs_per_sec(
-    dim: int, vocab_size: int, num_pairs: int, batch_pairs: int, epochs: int = 3
+    dim: int, vocab_size: int, num_pairs: int, batch_pairs: int, epochs: int = 4
 ) -> float:
     """Steady-state epoch throughput (first epoch = compile, excluded)."""
     import jax

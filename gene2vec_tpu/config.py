@@ -50,6 +50,11 @@ class SGNSConfig:
                                    # weighted down to `negatives` per example)
     shuffle_each_iter: bool = True # reference reshuffles every iteration
                                    # (src/gene2vec.py:80)
+    shuffle_mode: str = "offset"   # per-epoch reshuffle: "offset" (host-shuffled
+                                   # corpus + random circular offset + random
+                                   # batch order — O(1) gathers) | "full" (exact
+                                   # per-epoch permutation; a V-row random
+                                   # gather per epoch, latency-bound on TPU)
     txt_output: bool = True        # also export matrix-txt + w2v-format per iter
 
     # parallelism

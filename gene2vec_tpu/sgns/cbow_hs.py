@@ -170,11 +170,14 @@ class CBOWHSTrainer:
 
         def epoch(params, pairs, key):
             shuffle_key, step_key = jax.random.split(key)
+            # one gather per epoch, contiguous slices per step (see train.py)
             perm = epoch_permutation(shuffle_key, num_pairs, cfg.batch_pairs)
+            shuffled = pairs[perm.reshape(-1)]
 
-            def body(params, xs):
-                idx, step = xs
-                batch = pairs[idx]
+            def body(params, step):
+                batch = jax.lax.dynamic_slice_in_dim(
+                    shuffled, step * cfg.batch_pairs, cfg.batch_pairs
+                )
                 frac = step.astype(compute_dtype) / max(num_batches, 1)
                 lr = cfg.lr * (1.0 - frac) + cfg.min_lr * frac
                 if self.hs:
@@ -206,7 +209,7 @@ class CBOWHSTrainer:
                 return params, loss
 
             params, losses = jax.lax.scan(
-                body, params, (perm, jnp.arange(num_batches, dtype=jnp.int32))
+                body, params, jnp.arange(num_batches, dtype=jnp.int32)
             )
             return params, jnp.mean(losses)
 

@@ -126,37 +126,33 @@ def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
     raise ValueError(f"unknown combiner {combiner!r}")
 
 
-def _combiner_divisors(
-    vocab_size: int,
-    centers: jax.Array,
-    contexts: jax.Array,
-    neg_idx: jax.Array,
-    neg_weights: jax.Array,  # per-slot occurrence weight, same shape as neg_idx
+def _apply_row_updates(
+    table: jax.Array,        # (V, D)
+    idx: jax.Array,          # (R,) row per gradient
+    grads: jax.Array,        # (R, D)
+    weights: jax.Array,      # (R,) occurrence weight per gradient row
+    lr: jax.Array,
     combiner: str,
     compute_dtype,
-):
-    """(div over centers, div over contexts, div over neg_idx slots).
+) -> jax.Array:
+    """table − lr · combined row updates, via ONE fused scatter.
 
-    Per-row occurrence counts always accumulate in f32: in bf16 the partial
-    sum saturates at 256 (1.0 < ULP) and the cap under-divides hot rows.
-    Negative slots count at their given weight (1 per draw in per-example
-    mode; the K/P importance weight in shared mode — a token drawn into the
-    pool must not have its positive-pair update divided by the raw example
-    count).
+    Gradients and occurrence weights scatter together into a (V, D+1)
+    accumulator — one scatter instead of a count scatter + count gather +
+    grad scatter (profiling showed scatter count, not scatter payload,
+    dominates) — and the combiner divisor is applied row-wise on the dense
+    accumulator afterwards.  Weights accumulate in f32 via the accumulator's
+    dtype; see :func:`_row_divisor` for the combiner semantics.
     """
-    cnt_emb = jnp.zeros(vocab_size, jnp.float32).at[centers].add(1.0)
-    cnt_ctx = (
-        jnp.zeros(vocab_size, jnp.float32)
-        .at[contexts]
-        .add(1.0)
-        .at[neg_idx.reshape(-1)]
-        .add(neg_weights.astype(jnp.float32).reshape(-1))
+    v, d = table.shape
+    acc_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+    payload = jnp.concatenate(
+        [grads.astype(acc_dtype), weights.astype(acc_dtype)[:, None]], axis=1
     )
-    return (
-        _row_divisor(cnt_emb[centers], combiner).astype(compute_dtype),
-        _row_divisor(cnt_ctx[contexts], combiner).astype(compute_dtype),
-        _row_divisor(cnt_ctx[neg_idx], combiner).astype(compute_dtype),
-    )
+    acc = jnp.zeros((v, d + 1), acc_dtype).at[idx].add(payload)
+    update = acc[:, :d] / _row_divisor(acc[:, d], combiner)[:, None]
+    lr = jnp.asarray(lr, acc_dtype)
+    return (table.astype(acc_dtype) - lr * update).astype(table.dtype)
 
 
 def _step_per_example(
@@ -171,22 +167,26 @@ def _step_per_example(
     loss, (d_center, d_pos, d_neg), neg_mask = sgns_loss_and_grads(
         params, centers, contexts, negs, compute_dtype
     )
-
-    if combiner != "sum":
-        div_c, div_p, div_n = _combiner_divisors(
-            params.emb.shape[0], centers, contexts, negs, neg_mask,
-            combiner, compute_dtype,
-        )
-        d_center = d_center / div_c[:, None]
-        d_pos = d_pos / div_p[:, None]
-        d_neg = d_neg / div_n[:, :, None]
-
-    dtype = params.emb.dtype
-    lr = jnp.asarray(lr, compute_dtype)
-    emb = params.emb.at[centers].add((-lr * d_center).astype(dtype))
-    ctx = params.ctx.at[contexts].add((-lr * d_pos).astype(dtype))
-    ctx = ctx.at[negs.reshape(-1)].add(
-        (-lr * d_neg).reshape(-1, d_neg.shape[-1]).astype(dtype)
+    d = d_center.shape[-1]
+    emb = _apply_row_updates(
+        params.emb,
+        centers,
+        d_center,
+        jnp.ones_like(centers, compute_dtype),
+        lr,
+        combiner,
+        compute_dtype,
+    )
+    ctx = _apply_row_updates(
+        params.ctx,
+        jnp.concatenate([contexts, negs.reshape(-1)]),
+        jnp.concatenate([d_pos, d_neg.reshape(-1, d)]),
+        jnp.concatenate(
+            [jnp.ones_like(contexts, compute_dtype), neg_mask.reshape(-1)]
+        ),
+        lr,
+        combiner,
+        compute_dtype,
     )
     return SGNSParams(emb=emb, ctx=ctx), loss
 
@@ -225,20 +225,32 @@ def _step_shared(
     d_pos = g_pos[:, None] * v                                  # (E, D)
     d_negrow = g_neg.T @ v                                      # (P, D) — MXU
 
-    if combiner != "sum":
-        div_c, div_p, div_n = _combiner_divisors(
-            vocab_size, centers, contexts, negs, scale * neg_mask.sum(axis=0),
-            combiner, compute_dtype,
-        )
-        d_center = d_center / div_c[:, None]
-        d_pos = d_pos / div_p[:, None]
-        d_negrow = d_negrow / div_n[:, None]
-
-    dtype = emb_t.dtype
-    lr = jnp.asarray(lr, compute_dtype)
-    emb = emb_t.at[centers].add((-lr * d_center).astype(dtype))
-    ctx = ctx_t.at[contexts].add((-lr * d_pos).astype(dtype))
-    ctx = ctx.at[negs].add((-lr * d_negrow).astype(dtype))
+    emb = _apply_row_updates(
+        emb_t,
+        centers,
+        d_center,
+        jnp.ones_like(centers, compute_dtype),
+        lr,
+        combiner,
+        compute_dtype,
+    )
+    ctx = _apply_row_updates(
+        ctx_t,
+        jnp.concatenate([contexts, negs]),
+        jnp.concatenate([d_pos, d_negrow]),
+        jnp.concatenate(
+            [
+                jnp.ones_like(contexts, jnp.float32),
+                # f32 reduction: a bf16 sum of ones saturates at 256, which
+                # would defeat the capped divisor for hot pool rows
+                scale.astype(jnp.float32)
+                * neg_mask.sum(axis=0, dtype=jnp.float32),
+            ]
+        ),
+        lr,
+        combiner,
+        compute_dtype,
+    )
     return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
 
 

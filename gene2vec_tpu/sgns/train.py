@@ -52,11 +52,32 @@ def make_train_epoch(
 
     def train_epoch(params, pairs, noise, key):
         shuffle_key, step_key = jax.random.split(key)
-        perm = epoch_permutation(shuffle_key, num_pairs, batch_pairs)
+        # Random row gathers are latency-bound on TPU (8-byte rows measured
+        # ~175 ns/row — more time than the training step itself, whether done
+        # per step or as one big epoch gather).  Default "offset" mode keeps
+        # the corpus host-shuffled once (trainer __init__) and decorrelates
+        # epochs with a random circular offset (one contiguous roll) plus a
+        # random batch visiting order — no gathers at all.  "full" restores
+        # the reference's exact per-epoch permutation semantics.
+        if not config.shuffle_each_iter:
+            shuffled, order = pairs, None
+        elif config.shuffle_mode == "full":
+            perm = epoch_permutation(shuffle_key, num_pairs, batch_pairs)
+            shuffled = pairs[perm.reshape(-1)]
+            order = None
+        else:
+            off_key, ord_key = jax.random.split(shuffle_key)
+            offset = jax.random.randint(off_key, (), 0, num_pairs)
+            shuffled = jnp.roll(pairs, offset, axis=0)
+            order = jax.random.permutation(ord_key, num_batches)
+        if sharding is not None:
+            shuffled = sharding.constrain_batch(shuffled)
 
-        def body(params, xs):
-            idx, step = xs
-            batch = pairs[idx]
+        def body(params, step):
+            slot = step if order is None else order[step]
+            batch = jax.lax.dynamic_slice_in_dim(
+                shuffled, slot * batch_pairs, batch_pairs
+            )
             if sharding is not None:
                 batch = sharding.constrain_batch(batch)
             frac = step.astype(compute_dtype) / max(num_batches, 1)
@@ -79,7 +100,7 @@ def make_train_epoch(
             return params, loss
 
         params, losses = jax.lax.scan(
-            body, params, (perm, jnp.arange(num_batches, dtype=jnp.int32))
+            body, params, jnp.arange(num_batches, dtype=jnp.int32)
         )
         return params, jnp.mean(losses)
 
@@ -116,6 +137,16 @@ class SGNSTrainer:
             # (the reference smoke corpus data/test.txt has 39 pairs)
             config = dataclasses.replace(
                 config, batch_pairs=max(1, corpus.num_pairs)
+            )
+        if config.shuffle_mode not in ("offset", "full"):
+            raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
+        if config.shuffle_mode == "offset":
+            # one-time host-side shuffle, unconditional like the reference's
+            # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
+            # decorrelation then needs no device gathers
+            rng = np.random.RandomState(config.seed)
+            corpus = PairCorpus(
+                corpus.vocab, corpus.pairs[rng.permutation(corpus.num_pairs)]
             )
         self.config = config
         self.corpus = corpus
