@@ -13,6 +13,7 @@ import sys
 from typing import List, Optional
 
 from gene2vec_tpu.config import MeshConfig, SGNSConfig
+from gene2vec_tpu.sgns.backends import BACKENDS
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -27,8 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="filename suffix of corpus files (default: txt)",
     )
     p.add_argument(
-        "--backend", choices=("jax", "numpy", "gensim"), default="jax",
-        help="jax = TPU path (default); numpy/gensim = CPU oracles",
+        "--backend", choices=BACKENDS, default="jax",
+        help="jax = TPU path (default); numpy/hogwild/gensim = CPU oracles "
+             "(hogwild = native C++ multithreaded)",
     )
     d = SGNSConfig()
     p.add_argument("--dim", type=int, default=d.dim)

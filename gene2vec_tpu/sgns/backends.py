@@ -14,7 +14,7 @@ from typing import Callable, Optional
 from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.pipeline import PairCorpus
 
-BACKENDS = ("jax", "numpy", "gensim")
+BACKENDS = ("jax", "numpy", "hogwild", "gensim")
 
 
 def make_backend_trainer(
@@ -35,6 +35,14 @@ def make_backend_trainer(
         from gene2vec_tpu.sgns.numpy_backend import NumpySGNSTrainer
 
         return NumpySGNSTrainer(corpus, config)
+    if backend == "hogwild":
+        if config.objective != "sgns":
+            raise NotImplementedError(
+                "hogwild backend implements the sgns objective only"
+            )
+        from gene2vec_tpu.sgns.native_backend import HogwildSGNSTrainer
+
+        return HogwildSGNSTrainer(corpus, config)
     if backend == "gensim":
         return GensimTrainer(corpus, config)
     raise ValueError(f"unknown backend {backend!r}; choose from {BACKENDS}")

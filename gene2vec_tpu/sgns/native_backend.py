@@ -1,0 +1,180 @@
+"""ctypes bridge + trainer for the native Hogwild SGNS CPU oracle.
+
+This is the measured stand-in for the reference's gensim-Cython engine
+(32 lock-free threads over shared tables, ``src/gene2vec.py:59``): the
+benchmark's ``vs_baseline`` divides the TPU rate by THIS kernel's rate, so
+the baseline is a real multithreaded C++ loop, not Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NegativeSampler
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.sgns.model import SGNSParams
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsgns_hogwild.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_attempted = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_attempted
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build_attempted:
+        _build_attempted = True
+        if not os.environ.get("GENE2VEC_TPU_NO_NATIVE_BUILD"):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    capture_output=True, timeout=120, check=False,
+                )
+            except Exception:
+                pass
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.sgns_hogwild_epoch.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # emb
+        ctypes.POINTER(ctypes.c_float),   # ctx
+        ctypes.c_int64,                   # vocab
+        ctypes.c_int32,                   # dim
+        ctypes.POINTER(ctypes.c_int32),   # pairs
+        ctypes.c_int64,                   # n_pairs
+        ctypes.POINTER(ctypes.c_float),   # alias prob
+        ctypes.POINTER(ctypes.c_int32),   # alias alias
+        ctypes.c_int32,                   # negatives
+        ctypes.c_float,                   # lr_start
+        ctypes.c_float,                   # lr_end
+        ctypes.c_int32,                   # n_threads
+        ctypes.c_uint64,                  # seed
+        ctypes.c_int32,                   # both_directions
+    ]
+    lib.sgns_hogwild_epoch.restype = ctypes.c_float
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _iptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class HogwildSGNSTrainer:
+    """Native CPU trainer with the common init/train_epoch/run interface."""
+
+    def __init__(
+        self,
+        corpus: PairCorpus,
+        config: SGNSConfig = SGNSConfig(),
+        n_threads: Optional[int] = None,
+    ):
+        if _load() is None:
+            raise RuntimeError(
+                "native Hogwild library not available (make -C native failed?)"
+            )
+        if corpus.num_pairs == 0:
+            raise ValueError("corpus is empty")
+        self.corpus = corpus
+        self.config = config
+        self.n_threads = n_threads or os.cpu_count() or 1
+        sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
+        self._prob = np.ascontiguousarray(
+            np.asarray(sampler.table.prob), np.float32
+        )
+        self._alias = np.ascontiguousarray(
+            np.asarray(sampler.table.alias), np.int32
+        )
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        cfg = self.config
+        rng = np.random.RandomState(cfg.seed if seed is None else seed)
+        emb = rng.uniform(
+            -0.5 / cfg.dim, 0.5 / cfg.dim, (self.corpus.vocab_size, cfg.dim)
+        ).astype(np.float32)
+        ctx = np.zeros((self.corpus.vocab_size, cfg.dim), np.float32)
+        return SGNSParams(emb=emb, ctx=ctx)
+
+    def train_epoch(
+        self, params: SGNSParams, seed: int, rng: Optional[np.random.RandomState] = None
+    ):
+        """One Hogwild epoch, updating the tables in place."""
+        cfg = self.config
+        emb = np.ascontiguousarray(np.asarray(params.emb), np.float32)
+        ctx = np.ascontiguousarray(np.asarray(params.ctx), np.float32)
+        pairs = self.corpus.pairs
+        if rng is not None:  # reference reshuffle per iteration
+            pairs = pairs[rng.permutation(len(pairs))]
+        pairs = np.ascontiguousarray(pairs, np.int32)
+        loss = _load().sgns_hogwild_epoch(
+            _fptr(emb), _fptr(ctx),
+            self.corpus.vocab_size, cfg.dim,
+            _iptr(pairs), len(pairs),
+            _fptr(self._prob), _iptr(self._alias),
+            cfg.negatives, cfg.lr, cfg.min_lr,
+            self.n_threads, seed, int(cfg.both_directions),
+        )
+        return SGNSParams(emb=emb, ctx=ctx), float(loss)
+
+    def run(
+        self,
+        export_dir: str,
+        start_iter: Optional[int] = None,
+        log: Callable[[str], None] = print,
+    ) -> SGNSParams:
+        cfg = self.config
+        if start_iter is None:
+            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+        if start_iter > 1:
+            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            params = SGNSParams(
+                emb=np.asarray(params.emb), ctx=np.asarray(params.ctx)
+            )
+            log(f"resuming from iteration {start_iter - 1}")
+        else:
+            params = self.init()
+            start_iter = 1
+        from gene2vec_tpu.utils.metrics import MetricsLogger
+
+        rng = np.random.RandomState(cfg.seed)
+        metrics = MetricsLogger(os.path.join(export_dir, "training_log.csv"))
+        for it in range(start_iter, cfg.num_iters + 1):
+            t0 = time.perf_counter()
+            params, loss = self.train_epoch(params, seed=cfg.seed + it, rng=rng)
+            dt = time.perf_counter() - t0
+            rate = self.corpus.num_pairs / dt if dt > 0 else float("inf")
+            log(
+                f"gene2vec [hogwild x{self.n_threads}] dimension {cfg.dim} "
+                f"iteration {it} done: loss={loss:.4f} {rate:,.0f} pairs/s "
+                f"({dt:.2f}s)"
+            )
+            metrics.log(it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt})
+            ckpt.save_iteration(
+                export_dir, cfg.dim, it, params, self.corpus.vocab,
+                txt_output=cfg.txt_output,
+                meta={"loss": loss, "pairs_per_sec": rate, "backend": "hogwild"},
+            )
+        metrics.close()
+        return params

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import TYPE_CHECKING, Callable, Optional, Tuple
 
@@ -201,10 +202,19 @@ class SGNSTrainer:
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
+        profile_dir: Optional[str] = None,
     ) -> SGNSParams:
         """The reference iteration loop: resume from the last saved
         iteration if present, else init fresh; each iteration reshuffles
-        (a fresh PRNG fold), trains one epoch, checkpoints and exports."""
+        (a fresh PRNG fold), trains one epoch, checkpoints and exports.
+
+        ``profile_dir`` wraps the first post-resume epoch in a
+        ``jax.profiler`` trace.  Per-iteration metrics (loss, pairs/sec)
+        append to ``<export_dir>/training_log.csv``.
+        """
+        from gene2vec_tpu.utils.metrics import MetricsLogger
+        from gene2vec_tpu.utils.profiling import trace_context
+
         cfg = self.config
         if start_iter is None:
             start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
@@ -217,11 +227,15 @@ class SGNSTrainer:
 
         root_key = jax.random.PRNGKey(cfg.seed)
         pairs_per_epoch = self.num_batches * cfg.batch_pairs
+        metrics = MetricsLogger(os.path.join(export_dir, "training_log.csv"))
         for it in range(start_iter, cfg.num_iters + 1):
             log(f"gene2vec dimension {cfg.dim} iteration {it} start")
             t0 = time.perf_counter()
-            params, loss = self.train_epoch(params, jax.random.fold_in(root_key, it))
-            loss = float(loss)  # blocks until the epoch finishes on device
+            with trace_context(profile_dir if it == start_iter else None):
+                params, loss = self.train_epoch(
+                    params, jax.random.fold_in(root_key, it)
+                )
+                loss = float(loss)  # blocks until the epoch finishes
             dt = time.perf_counter() - t0
             rate = pairs_per_epoch / dt if dt > 0 else float("inf")
             self.timer.record(pairs_per_epoch, dt)
@@ -229,6 +243,7 @@ class SGNSTrainer:
                 f"gene2vec dimension {cfg.dim} iteration {it} done: "
                 f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
             )
+            metrics.log(it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt})
             ckpt.save_iteration(
                 export_dir,
                 cfg.dim,
@@ -238,4 +253,5 @@ class SGNSTrainer:
                 txt_output=cfg.txt_output,
                 meta={"loss": loss, "pairs_per_sec": rate},
             )
+        metrics.close()
         return params

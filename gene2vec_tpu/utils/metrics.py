@@ -1,0 +1,70 @@
+"""Structured step metrics — CSV always, TensorBoard when available.
+
+The reference logs through gensim INFO prints and TF1 summary writers
+(loss/accuracy scalars + grad histograms, ``src/GGIPNN_Classification.py:
+130-156``).  Here every trainer can emit one row per iteration/step to a
+CSV next to its checkpoints, and mirror scalars to tensorboardX when that
+package is installed.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only metrics log: CSV file + optional TensorBoard scalars."""
+
+    def __init__(self, csv_path: Optional[str], tensorboard_dir: Optional[str] = None):
+        self.csv_path = csv_path
+        self._fieldnames: Optional[list] = None
+        self._warned_dropped = False
+        self._tb = None
+        if csv_path:
+            os.makedirs(os.path.dirname(os.path.abspath(csv_path)), exist_ok=True)
+        if tensorboard_dir:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except ImportError:
+                pass  # CSV remains the source of truth
+
+    def log(self, step: int, metrics: Dict[str, float]) -> None:
+        row = {"step": int(step), "time": time.time(), **metrics}
+        if self.csv_path:
+            new_fields = sorted(row)
+            if self._fieldnames is None:
+                exists = os.path.exists(self.csv_path)
+                if exists:
+                    with open(self.csv_path, "r", encoding="utf-8") as f:
+                        header = f.readline().strip()
+                    self._fieldnames = header.split(",") if header else new_fields
+                else:
+                    self._fieldnames = new_fields
+                    with open(self.csv_path, "w", encoding="utf-8", newline="") as f:
+                        csv.DictWriter(f, self._fieldnames).writeheader()
+            dropped = set(row) - set(self._fieldnames)
+            if dropped and not self._warned_dropped:
+                self._warned_dropped = True
+                print(
+                    f"MetricsLogger: {self.csv_path} header lacks columns "
+                    f"{sorted(dropped)}; their values are not recorded",
+                    file=sys.stderr,
+                )
+            with open(self.csv_path, "a", encoding="utf-8", newline="") as f:
+                csv.DictWriter(
+                    f, self._fieldnames, extrasaction="ignore"
+                ).writerow(row)
+        if self._tb is not None:
+            for k, v in metrics.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()

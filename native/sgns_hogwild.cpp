@@ -1,0 +1,155 @@
+// Hogwild SGNS CPU oracle — the measured stand-in for gensim's Cython
+// kernel (the engine behind src/gene2vec.py:70,87: 32 lock-free threads,
+// negative-sampling table, linear alpha decay, classic word2vec exp table).
+//
+// This is the framework's honest CPU baseline: bench.py divides the TPU
+// rate by this kernel's rate, so it must be a competent multithreaded
+// implementation, not a strawman. Matches word2vec semantics:
+//   * per (center, context) example (both directions of each pair),
+//     k negatives drawn from unigram^0.75 via a Vose alias table;
+//   * a negative equal to the positive target is skipped;
+//   * lock-free (racy-by-design) SGD updates shared tables — Hogwild;
+//   * learning rate decays linearly with global progress.
+//
+// C ABI for ctypes; built by native/Makefile.
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kExpTableSize = 1024;
+constexpr float kMaxExp = 6.0f;
+
+struct ExpTable {
+  float sigmoid[kExpTableSize];
+  float logsig[kExpTableSize];  // log(sigmoid(x)) for loss reporting
+  ExpTable() {
+    for (int i = 0; i < kExpTableSize; ++i) {
+      float x = (2.0f * i / kExpTableSize - 1.0f) * kMaxExp;
+      float e = std::exp(x);
+      sigmoid[i] = e / (e + 1.0f);
+      logsig[i] = std::log(sigmoid[i] > 1e-12f ? sigmoid[i] : 1e-12f);
+    }
+  }
+  inline int idx(float x) const {
+    if (x >= kMaxExp) return kExpTableSize - 1;
+    if (x <= -kMaxExp) return 0;
+    return static_cast<int>((x + kMaxExp) * (kExpTableSize / (2.0f * kMaxExp)));
+  }
+  inline float sig(float x) const { return sigmoid[idx(x)]; }
+  inline float logsigf(float x) const { return logsig[idx(x)]; }
+};
+
+const ExpTable g_exp;
+
+struct XorShift {
+  uint64_t state;
+  explicit XorShift(uint64_t seed) : state(seed ? seed : 0x9E3779B97F4A7C15ull) {}
+  inline uint64_t next() {
+    state ^= state << 13;
+    state ^= state >> 7;
+    state ^= state << 17;
+    return state;
+  }
+  inline float uniform() {  // [0, 1)
+    return (next() >> 40) * (1.0f / (1ull << 24));
+  }
+  inline int64_t below(int64_t n) {
+    return static_cast<int64_t>(next() % static_cast<uint64_t>(n));
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Trains one epoch in place. Returns the mean per-example loss.
+float sgns_hogwild_epoch(
+    float* emb, float* ctx, int64_t vocab, int32_t dim,
+    const int32_t* pairs, int64_t n_pairs,
+    const float* alias_prob, const int32_t* alias_alias,
+    int32_t negatives, float lr_start, float lr_end,
+    int32_t n_threads, uint64_t seed, int32_t both_directions) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> progress{0};
+  std::vector<double> thread_loss(static_cast<size_t>(n_threads), 0.0);
+  std::vector<int64_t> thread_examples(static_cast<size_t>(n_threads), 0);
+
+  auto worker = [&](int tid) {
+    XorShift rng(seed + 0x9E3779B97F4A7C15ull * static_cast<uint64_t>(tid + 1));
+    std::vector<float> grad(static_cast<size_t>(dim));
+    int64_t lo = n_pairs * tid / n_threads;
+    int64_t hi = n_pairs * (tid + 1) / n_threads;
+    double loss_sum = 0.0;
+    int64_t examples = 0;
+    const int64_t kProgressChunk = 4096;
+    float lr = lr_start;
+
+    for (int64_t p = lo; p < hi; ++p) {
+      if ((p - lo) % kProgressChunk == 0) {
+        int64_t done = progress.fetch_add(kProgressChunk);
+        float frac = static_cast<float>(done) / static_cast<float>(n_pairs);
+        if (frac > 1.0f) frac = 1.0f;
+        lr = lr_start + (lr_end - lr_start) * frac;
+      }
+      for (int dir = 0; dir < (both_directions ? 2 : 1); ++dir) {
+        int32_t center = pairs[2 * p + dir];
+        int32_t context = pairs[2 * p + 1 - dir];
+        float* v = emb + static_cast<int64_t>(center) * dim;
+        std::memset(grad.data(), 0, sizeof(float) * static_cast<size_t>(dim));
+
+        // positive + k negatives against the ctx table
+        for (int k = 0; k < negatives + 1; ++k) {
+          int32_t target;
+          float label;
+          if (k == 0) {
+            target = context;
+            label = 1.0f;
+          } else {
+            int64_t j = rng.below(vocab);
+            target = (rng.uniform() < alias_prob[j])
+                         ? static_cast<int32_t>(j)
+                         : alias_alias[j];
+            if (target == context) continue;  // word2vec skip
+            label = 0.0f;
+          }
+          float* u = ctx + static_cast<int64_t>(target) * dim;
+          float dot = 0.0f;
+          for (int d = 0; d < dim; ++d) dot += v[d] * u[d];
+          float s = g_exp.sig(dot);
+          loss_sum -= (label > 0.5f) ? g_exp.logsigf(dot) : g_exp.logsigf(-dot);
+          float g = (s - label) * lr;
+          for (int d = 0; d < dim; ++d) {
+            grad[d] += g * u[d];
+            u[d] -= g * v[d];
+          }
+        }
+        for (int d = 0; d < dim; ++d) v[d] -= grad[d];
+        ++examples;
+      }
+    }
+    thread_loss[static_cast<size_t>(tid)] = loss_sum;
+    thread_examples[static_cast<size_t>(tid)] = examples;
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads));
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& t : threads) t.join();
+
+  double loss = 0.0;
+  int64_t examples = 0;
+  for (int t = 0; t < n_threads; ++t) {
+    loss += thread_loss[static_cast<size_t>(t)];
+    examples += thread_examples[static_cast<size_t>(t)];
+  }
+  return examples ? static_cast<float>(loss / static_cast<double>(examples))
+                  : 0.0f;
+}
+
+}  // extern "C"

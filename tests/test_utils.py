@@ -1,0 +1,67 @@
+"""Aux subsystems: step timer, metrics logger, trace context."""
+
+import csv
+import os
+
+from gene2vec_tpu.utils.metrics import MetricsLogger
+from gene2vec_tpu.utils.profiling import StepTimer, trace_context
+
+
+def test_step_timer_skips_compile_epoch():
+    t = StepTimer()
+    t.record(1000, 10.0)  # compile epoch
+    t.record(1000, 1.0)
+    t.record(1000, 1.0)
+    assert t.pairs_per_sec() == 1000.0
+    assert t.pairs_per_sec(skip_first=False) < 500.0
+    assert t.total_pairs == 3000
+
+
+def test_metrics_logger_csv_roundtrip(tmp_path):
+    path = str(tmp_path / "m" / "log.csv")
+    m = MetricsLogger(path)
+    m.log(1, {"loss": 4.0, "pairs_per_sec": 100.0})
+    m.log(2, {"loss": 3.5, "pairs_per_sec": 120.0})
+    m.close()
+    # appending re-opens with the existing header
+    m2 = MetricsLogger(path)
+    m2.log(3, {"loss": 3.0, "pairs_per_sec": 130.0})
+    m2.close()
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    assert [r["step"] for r in rows] == ["1", "2", "3"]
+    assert float(rows[2]["loss"]) == 3.0
+
+
+def test_metrics_logger_none_path_is_noop():
+    m = MetricsLogger(None)
+    m.log(1, {"loss": 1.0})
+    m.close()
+
+
+def test_trainer_writes_training_log(tmp_path, synthetic_corpus_dir):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.pair_reader import load_corpus
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    out = str(tmp_path / "emb")
+    SGNSTrainer(
+        PairCorpus(vocab, pairs), SGNSConfig(dim=8, num_iters=3, batch_pairs=64)
+    ).run(out, log=lambda s: None)
+    with open(os.path.join(out, "training_log.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 3
+    assert {"loss", "pairs_per_sec", "seconds", "step", "time"} <= set(rows[0])
+
+
+def test_trace_context_noop_and_real(tmp_path):
+    with trace_context(None):
+        pass
+    import jax
+    import jax.numpy as jnp
+
+    with trace_context(str(tmp_path / "trace")):
+        jnp.sum(jnp.ones(8)).block_until_ready()
+    assert os.listdir(tmp_path / "trace")  # jax.profiler wrote something
