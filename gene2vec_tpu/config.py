@@ -87,6 +87,9 @@ class GGIPNNConfig:
     evaluate_every: int = 200
     checkpoint_every: int = 1000
     seed: int = 10
+    scan_fit: bool = True          # whole-epoch jitted scan (per-epoch dev
+                                   # eval); False = reference's per-batch
+                                   # step loop with every-N-steps evaluation
 
 
 @dataclasses.dataclass(frozen=True)
