@@ -1,0 +1,72 @@
+"""Careful XLA epoch benchmark: repetitions, batch sweep, unroll, shuffle
+variants. Ground truth for the round-2 optimization baseline."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NegativeSampler
+from gene2vec_tpu.sgns.model import SGNSParams
+from gene2vec_tpu.sgns.train import SGNSTrainer, make_train_epoch
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.vocab import Vocab
+
+V, D = 24447, 200
+N = 4_000_000
+REPS = 3
+
+
+def make_corpus(rng):
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    pairs = rng.choice(V, size=(N, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=V).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(V)], counts), pairs)
+
+
+def run(label, corpus, cfg):
+    trainer = SGNSTrainer(corpus, cfg)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    params, loss = trainer.train_epoch(params, key)  # compile
+    float(loss)
+    rates = []
+    for r in range(REPS):
+        t0 = time.perf_counter()
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, r))
+        float(loss)
+        dt = time.perf_counter() - t0
+        rates.append(trainer.num_batches * trainer.config.batch_pairs / dt)
+    rs = ", ".join(f"{r / 1e6:6.2f}" for r in rates)
+    print(f"{label:44s} [{rs}] M pairs/s  (best {max(rates)/1e6:.2f})")
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    corpus = make_corpus(rng)
+
+    run("B=16k offset (r1 default)", corpus, SGNSConfig(dim=D, batch_pairs=16384))
+    run("B=16k noshuffle", corpus,
+        SGNSConfig(dim=D, batch_pairs=16384, shuffle_each_iter=False))
+    run("B=16k full", corpus,
+        SGNSConfig(dim=D, batch_pairs=16384, shuffle_mode="full"))
+    run("B=65k noshuffle", corpus,
+        SGNSConfig(dim=D, batch_pairs=65536, shuffle_each_iter=False))
+    run("B=65k full", corpus,
+        SGNSConfig(dim=D, batch_pairs=65536, shuffle_mode="full"))
+    run("B=262k noshuffle", corpus,
+        SGNSConfig(dim=D, batch_pairs=262144, shuffle_each_iter=False))
+    run("B=16k noshuffle perexample", corpus,
+        SGNSConfig(dim=D, batch_pairs=16384, shuffle_each_iter=False,
+                   negative_mode="per_example"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Epoch sweep #2: in-place scatter step (new) x dtype x batch."""
+from __future__ import annotations
+import time
+import numpy as np
+import jax
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.sgns.train import SGNSTrainer
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.vocab import Vocab
+
+V, D, N, REPS = 24447, 200, 4_000_000, 3
+
+def make_corpus(rng):
+    p = 1.0 / np.arange(1, V + 1); p /= p.sum()
+    pairs = rng.choice(V, size=(N, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=V).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(V)], counts), pairs)
+
+def run(label, corpus, cfg):
+    trainer = SGNSTrainer(corpus, cfg)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    params, loss = trainer.train_epoch(params, key); float(loss)
+    rates = []
+    for r in range(REPS):
+        t0 = time.perf_counter()
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, r))
+        lv = float(loss)
+        dt = time.perf_counter() - t0
+        rates.append(trainer.num_batches * trainer.config.batch_pairs / dt)
+    rs = ", ".join(f"{r / 1e6:6.2f}" for r in rates)
+    print(f"{label:40s} [{rs}] M pairs/s (best {max(rates)/1e6:.2f}, loss {lv:.4f})")
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    corpus = make_corpus(rng)
+    run("inplace B=16k offset f32", corpus, SGNSConfig(dim=D, batch_pairs=16384))
+    run("inplace B=16k nosh f32", corpus,
+        SGNSConfig(dim=D, batch_pairs=16384, shuffle_each_iter=False))
+    run("inplace B=16k nosh bf16", corpus,
+        SGNSConfig(dim=D, batch_pairs=16384, shuffle_each_iter=False,
+                   table_dtype="bfloat16", compute_dtype="bfloat16"))
+    run("inplace B=65k nosh f32", corpus,
+        SGNSConfig(dim=D, batch_pairs=65536, shuffle_each_iter=False))
+    run("inplace B=65k nosh bf16", corpus,
+        SGNSConfig(dim=D, batch_pairs=65536, shuffle_each_iter=False,
+                   table_dtype="bfloat16", compute_dtype="bfloat16"))
+
+if __name__ == "__main__":
+    main()

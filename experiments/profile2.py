@@ -1,0 +1,139 @@
+"""Redo microbenchmarks with REAL synchronization (scalar transfer), since
+block_until_ready does not block on the axon tunnel backend."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V, D, B, P = 24447, 200, 16384, 64
+E = 2 * B
+NB = 50
+
+
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+
+def sync(x):
+    """Force completion: pull one scalar to host."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(_sum(leaf))
+
+
+def bench(label, fn, *args, iters=NB, pairs=None):
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    extra = f" -> {pairs / dt / 1e6:8.2f}M pairs/s" if pairs else ""
+    print(f"{label:46s} {dt * 1e3:8.3f} ms{extra}")
+    return dt
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    ctx = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    centers = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+    grads = jnp.asarray(rng.randn(E, D).astype(np.float32))
+    ones = jnp.ones(E, jnp.float32)
+
+    bench("gather (E,D) rows", jax.jit(lambda t, i: t[i]), emb, centers)
+    vrows = emb[centers]
+    urows = ctx[jnp.asarray(rng.randint(0, V, P).astype(np.int32))]
+    bench("matmul (E,D)x(D,P)", jax.jit(lambda a, b: a @ b.T), vrows, urows)
+
+    def scatter_acc(idx, g, w):
+        payload = jnp.concatenate([g, w[:, None]], axis=1)
+        return jnp.zeros((V, D + 1), jnp.float32).at[idx].add(payload)
+
+    bench("scatter-add E rows -> (V,D+1) zeros", jax.jit(scatter_acc), centers, grads, ones)
+
+    def scatter_plain(idx, g):
+        return jnp.zeros((V, D), jnp.float32).at[idx].add(g)
+
+    bench("scatter-add E rows -> (V,D) zeros", jax.jit(scatter_plain), centers, grads)
+
+    def cnt_only(idx, w):
+        return jnp.zeros((V,), jnp.float32).at[idx].add(w)
+
+    bench("scatter-add E -> (V,) counts", jax.jit(cnt_only), centers, ones)
+
+    bench(
+        "in-place scatter onto table (donated)",
+        jax.jit(lambda t, i, g: t.at[i].add(g), donate_argnums=(0,)),
+        emb + 0, centers, grads,
+    )
+    upd = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    bench(
+        "dense (V,D) axpy (donated)",
+        jax.jit(lambda t, u: t - 0.01 * u, donate_argnums=(0,)),
+        emb + 0, upd,
+    )
+
+    # sorted variants
+    def sorted_scatter(t, idx, g):
+        order = jnp.argsort(idx)
+        return t.at[idx[order]].add(g[order])
+
+    bench("argsort+inplace scatter (donated)",
+          jax.jit(sorted_scatter, donate_argnums=(0,)), emb + 0, centers, grads)
+
+    bench("argsort only (E,)", jax.jit(jnp.argsort), centers)
+
+    # full current step
+    from gene2vec_tpu.data.negative_sampling import NegativeSampler
+    from gene2vec_tpu.sgns.model import SGNSParams
+    from gene2vec_tpu.sgns.step import sgns_step
+
+    counts = np.maximum(rng.zipf(1.5, V), 1)
+    noise = NegativeSampler(counts).table
+
+    for b in (16384, 65536, 262144):
+        pairs_b = jnp.asarray(rng.randint(0, V, (b, 2)).astype(np.int32))
+        stepb = jax.jit(
+            lambda p, bb, n, k: sgns_step(p, bb, n, k, jnp.float32(0.01)),
+            donate_argnums=(0,),
+        )
+        p = SGNSParams(emb=emb + 0, ctx=ctx + 0)
+        key = jax.random.PRNGKey(0)
+        p, _ = stepb(p, pairs_b, noise, key)
+        sync(p)
+        t0 = time.perf_counter()
+        n = max(4, 1_000_000 // b)
+        for i in range(n):
+            p, _ = stepb(p, pairs_b, noise, jax.random.fold_in(key, i))
+        sync(p)
+        dt = (time.perf_counter() - t0) / n
+        print(f"{'FULL step B=%d' % b:46s} {dt * 1e3:8.3f} ms -> {b / dt / 1e6:8.2f}M pairs/s")
+
+    # per_example mode for comparison
+    pairs_b = jnp.asarray(rng.randint(0, V, (16384, 2)).astype(np.int32))
+    step_pe = jax.jit(
+        lambda p, bb, n, k: sgns_step(
+            p, bb, n, k, jnp.float32(0.01), negative_mode="per_example"
+        ),
+        donate_argnums=(0,),
+    )
+    p = SGNSParams(emb=emb + 0, ctx=ctx + 0)
+    key = jax.random.PRNGKey(0)
+    p, _ = step_pe(p, pairs_b, noise, key)
+    sync(p)
+    t0 = time.perf_counter()
+    for i in range(30):
+        p, _ = step_pe(p, pairs_b, noise, jax.random.fold_in(key, i))
+    sync(p)
+    dt = (time.perf_counter() - t0) / 30
+    print(f"{'FULL step per_example B=16384':46s} {dt * 1e3:8.3f} ms -> {16384 / dt / 1e6:8.2f}M pairs/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Round-2 experiment: why is the whole-epoch scan ~15x slower than the
+isolated step? Times make_train_epoch variants at bench shapes."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NegativeSampler
+from gene2vec_tpu.sgns.model import SGNSParams
+from gene2vec_tpu.sgns.train import make_train_epoch
+
+V, D, B = 24447, 200, 16384
+N = 4_000_000
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    pairs_np = rng.choice(V, size=(N, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs_np.reshape(-1), minlength=V).astype(np.int64)
+    noise = NegativeSampler(counts).table
+    pairs = jnp.asarray(pairs_np)
+    emb = jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.01)
+    ctx = jnp.zeros((V, D), jnp.float32)
+
+    num_batches = N // B
+
+    for label, cfg in [
+        ("offset shuffle (r1 default)", SGNSConfig(dim=D, batch_pairs=B)),
+        ("no shuffle", SGNSConfig(dim=D, batch_pairs=B, shuffle_each_iter=False)),
+        ("full shuffle", SGNSConfig(dim=D, batch_pairs=B, shuffle_mode="full")),
+        ("offset B=262144", SGNSConfig(dim=D, batch_pairs=262144)),
+        ("no shuffle B=262144", SGNSConfig(dim=D, batch_pairs=262144,
+                                           shuffle_each_iter=False)),
+    ]:
+        nb = N // cfg.batch_pairs
+        fn = make_train_epoch(N, nb, cfg)
+        params = SGNSParams(emb=emb + 0, ctx=ctx + 0)
+        key = jax.random.PRNGKey(0)
+        params, loss = fn(params, pairs, noise, key)  # compile
+        float(loss)
+        t0 = time.perf_counter()
+        params, loss = fn(params, pairs, noise, jax.random.fold_in(key, 1))
+        float(loss)
+        dt = time.perf_counter() - t0
+        print(f"{label:28s}: {dt:7.3f}s/epoch -> {nb * cfg.batch_pairs / dt / 1e6:8.2f}M pairs/s")
+
+
+if __name__ == "__main__":
+    main()

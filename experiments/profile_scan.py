@@ -1,0 +1,113 @@
+"""Isolate lax.scan overhead on the axon TPU backend.
+
+Hypotheses: (a) loop-invariant corpus buffer copied per iteration,
+(b) carried table state copied per iteration, (c) per-iteration dispatch
+round-trips over the tunnel.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V, D, B = 24447, 200, 16384
+NB = 244  # scan length
+
+
+def bench(label, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    print(f"{label:52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter")
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    corpus = jnp.asarray(rng.randint(0, V, (NB * B, 2)).astype(np.int32))
+    idx = jnp.asarray(rng.randint(0, V, 2 * B).astype(np.int32))
+    grads = jnp.asarray(rng.randn(2 * B, D).astype(np.float32))
+
+    # 1. trivial carry, no big buffers
+    @jax.jit
+    def scan_trivial(x):
+        def body(c, i):
+            return c + 1.0, ()
+        c, _ = jax.lax.scan(body, x, jnp.arange(NB))
+        return c
+    bench("scan trivial scalar carry", scan_trivial, jnp.float32(0))
+
+    # 2. big loop-invariant corpus, scalar carry, dynamic_slice per iter
+    @jax.jit
+    def scan_slice(corpus, x):
+        def body(c, i):
+            b = jax.lax.dynamic_slice_in_dim(corpus, i * B, B)
+            return c + jnp.sum(b.astype(jnp.float32)), ()
+        c, _ = jax.lax.scan(body, x, jnp.arange(NB))
+        return c
+    bench("scan + 32MB invariant + slice", scan_slice, corpus, jnp.float32(0))
+
+    # 3. big (V,D) carry, axpy per iter (carried-table copy test)
+    @jax.jit
+    def scan_axpy(t):
+        def body(t, i):
+            return t * 0.9999 + 0.0001, ()
+        t, _ = jax.lax.scan(body, t, jnp.arange(NB))
+        return t
+    bench("scan + (V,D) carry axpy", scan_axpy, table + 0)
+
+    # 4. big carry + scatter-add per iter (the real update pattern)
+    @jax.jit
+    def scan_scatter(t, idx, grads):
+        def body(t, i):
+            return t.at[idx].add(0.0001 * grads), ()
+        t, _ = jax.lax.scan(body, t, jnp.arange(NB))
+        return t
+    bench("scan + (V,D) carry scatter-add", scan_scatter, table + 0, idx, grads)
+
+    # 5. big carry + zeros-accumulator scatter + dense update (r1 pattern)
+    @jax.jit
+    def scan_acc(t, idx, grads):
+        def body(t, i):
+            acc = jnp.zeros((V, D), jnp.float32).at[idx].add(grads)
+            return t - 0.0001 * acc, ()
+        t, _ = jax.lax.scan(body, t, jnp.arange(NB))
+        return t
+    bench("scan + zeros-acc scatter + dense", scan_acc, table + 0, idx, grads)
+
+    # 6. same as 5 but as a host-side Python loop of jitted steps
+    step = jax.jit(
+        lambda t, idx, grads: t - 0.0001 * (jnp.zeros((V, D), jnp.float32).at[idx].add(grads)),
+        donate_argnums=(0,),
+    )
+    t = table + 0
+    t = step(t, idx, grads)
+    jax.block_until_ready(t)
+    t0 = time.perf_counter()
+    for _ in range(NB):
+        t = step(t, idx, grads)
+    jax.block_until_ready(t)
+    dt = time.perf_counter() - t0
+    print(f"{'python loop of jitted zeros-acc steps':52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter")
+
+    # 7. gather per iter from carried table
+    @jax.jit
+    def scan_gather(t, idx):
+        def body(t, i):
+            g = t[idx]
+            return t + 0.0 * jnp.sum(g), ()
+        t, _ = jax.lax.scan(body, t, jnp.arange(NB))
+        return t
+    bench("scan + (V,D) carry + (E,D) gather", scan_gather, table + 0, idx)
+
+
+if __name__ == "__main__":
+    main()

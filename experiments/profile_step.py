@@ -1,0 +1,145 @@
+"""Round-2 experiment: where does the SGNS step spend its time on v5e?
+
+Times isolated pieces of the shared-negative step at bench shapes
+(V=24447, D=200, B=16384 -> E=32768, P=64) to decide what the Pallas
+kernel must fuse. Run on the real TPU chip.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V, D, B, P = 24447, 200, 16384, 64
+E = 2 * B
+
+
+def timeit(name, fn, *args, iters=30):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:42s} {dt * 1e3:8.3f} ms")
+    return dt
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    ctx = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    centers = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+    contexts = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+    negs = jnp.asarray(rng.randint(0, V, P).astype(np.int32))
+    grads = jnp.asarray(rng.randn(E, D).astype(np.float32))
+    ones = jnp.ones(E, jnp.float32)
+
+    # 1. gather E rows
+    g1 = jax.jit(lambda t, i: t[i])
+    timeit("gather (E,D) rows", g1, emb, centers)
+
+    # 2. matmul (E,D)x(D,P)
+    vrows = emb[centers]
+    urows = ctx[negs]
+    mm = jax.jit(lambda a, b: a @ b.T)
+    timeit("matmul (E,D)x(D,P)", mm, vrows, urows)
+
+    # 3. dense (V,D+1) scatter accumulator
+    def scatter_acc(idx, g, w):
+        payload = jnp.concatenate([g, w[:, None]], axis=1)
+        return jnp.zeros((V, D + 1), jnp.float32).at[idx].add(payload)
+
+    timeit("scatter-add E rows -> (V,D+1) zeros", jax.jit(scatter_acc), centers, grads, ones)
+
+    # 3b. scatter without the concat payload (D only) + separate count
+    def scatter_sep(idx, g, w):
+        acc = jnp.zeros((V, D), jnp.float32).at[idx].add(g)
+        cnt = jnp.zeros((V,), jnp.float32).at[idx].add(w)
+        return acc, cnt
+
+    timeit("scatter-add (V,D) + (V,) separate", jax.jit(scatter_sep), centers, grads, ones)
+
+    # 3c. in-place scatter onto the table (donated) with pre-scaled grads
+    def scatter_inplace(t, idx, g):
+        return t.at[idx].add(g)
+
+    timeit(
+        "in-place scatter-add onto table (donated)",
+        jax.jit(scatter_inplace, donate_argnums=(0,)),
+        emb + 0,
+        centers,
+        grads,
+    )
+
+    # 4. dense table update t - lr*u
+    upd = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    dense = jax.jit(lambda t, u: t - 0.01 * u, donate_argnums=(0,))
+    timeit("dense (V,D) axpy (donated)", dense, emb + 0, upd)
+
+    # 5. sort-based segment combine: sort idx, segment-sum, then scatter
+    def sorted_scatter(t, idx, g):
+        order = jnp.argsort(idx)
+        return t.at[idx[order]].add(g[order])
+
+    timeit(
+        "argsort+scatter onto table (donated)",
+        jax.jit(sorted_scatter, donate_argnums=(0,)),
+        emb + 0,
+        centers,
+        grads,
+    )
+
+    # 6. the full current step, jitted alone (not in scan)
+    from gene2vec_tpu.data.negative_sampling import NegativeSampler
+    from gene2vec_tpu.sgns.model import SGNSParams
+    from gene2vec_tpu.sgns.step import sgns_step
+
+    counts = np.maximum(rng.zipf(1.5, V), 1)
+    noise = NegativeSampler(counts).table
+    params = SGNSParams(emb=emb, ctx=ctx)
+    pairs = jnp.asarray(rng.randint(0, V, (B, 2)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    step = jax.jit(
+        lambda p, b, n, k: sgns_step(p, b, n, k, jnp.float32(0.01)),
+        donate_argnums=(0,),
+    )
+    p2, _ = step(params, pairs, noise, key)
+    jax.block_until_ready(p2)
+    t0 = time.perf_counter()
+    iters = 30
+    for i in range(iters):
+        p2, loss = step(p2, pairs, noise, jax.random.fold_in(key, i))
+    jax.block_until_ready(p2)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{'FULL sgns_step (shared, donated)':42s} {dt * 1e3:8.3f} ms "
+          f"-> {B / dt / 1e6:.2f}M pairs/s")
+
+    # 7. batch-size sweep of the full step
+    for b in (4096, 16384, 65536, 262144):
+        pairs_b = jnp.asarray(rng.randint(0, V, (b, 2)).astype(np.int32))
+        p = SGNSParams(emb=emb + 0, ctx=ctx + 0)
+        stepb = jax.jit(
+            lambda p, bb, n, k: sgns_step(p, bb, n, k, jnp.float32(0.01)),
+            donate_argnums=(0,),
+        )
+        p, _ = stepb(p, pairs_b, noise, key)
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        n = max(4, 2_000_000 // b)
+        for i in range(n):
+            p, _ = stepb(p, pairs_b, noise, jax.random.fold_in(key, i))
+        jax.block_until_ready(p)
+        dt = (time.perf_counter() - t0) / n
+        print(f"  full step B={b:7d}: {dt * 1e3:8.3f} ms -> {b / dt / 1e6:7.2f}M pairs/s")
+
+
+if __name__ == "__main__":
+    main()

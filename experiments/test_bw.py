@@ -1,0 +1,85 @@
+"""What streaming HBM bandwidth can this chip actually sustain?"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+SCAN = 50
+
+
+def bench(label, loop, x, nbytes):
+    out = loop(x)
+    float(_sum(out))
+    t0 = time.perf_counter()
+    out = loop(x)
+    float(_sum(out))
+    dt = (time.perf_counter() - t0) / SCAN
+    print(f"{label:46s} {dt * 1e6:9.1f} us/call  {nbytes / dt / 1e9:7.1f} GB/s")
+
+
+def xla_axpy_loop(shape, dtype):
+    @jax.jit
+    def loop(x):
+        def body(c, _):
+            return c * 1.0000001, ()
+        c, _ = jax.lax.scan(body, x, jnp.arange(SCAN))
+        return c
+    return loop
+
+
+def pallas_copy_loop(shape, dtype, block_rows):
+    n, d = shape
+
+    def kernel(in_ref, out_ref):
+        out_ref[:] = in_ref[:] * 1.0000001
+
+    def call(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(n // block_rows,),
+            in_specs=[pl.BlockSpec((block_rows, d), lambda b: (b, 0), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((block_rows, d), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        )(x)
+
+    @jax.jit
+    def loop(x):
+        def body(c, _):
+            return call(c), ()
+        c, _ = jax.lax.scan(body, x, jnp.arange(SCAN))
+        return c
+
+    return loop
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+
+    for mb in (25, 100, 400):
+        n = mb * 1024 * 1024 // 4 // 256
+        x = jnp.asarray(rng.randn(n, 256).astype(np.float32))
+        nbytes = n * 256 * 4 * 2  # read + write
+        bench(f"XLA axpy f32 {mb}MB", xla_axpy_loop((n, 256), jnp.float32), x, nbytes)
+
+    n = 100 * 1024 * 1024 // 4 // 256
+    x = jnp.asarray(rng.randn(n, 256).astype(np.float32))
+    nbytes = n * 256 * 4 * 2
+    for br in (256, 1024, 4096):
+        bench(f"pallas copy f32 100MB block={br}x256",
+              pallas_copy_loop((n, 256), jnp.float32, br), x, nbytes)
+
+    xb = x.astype(jnp.bfloat16)
+    bench("XLA axpy bf16 50MB", xla_axpy_loop((n, 256), jnp.bfloat16), xb, nbytes // 2)
+
+
+if __name__ == "__main__":
+    main()

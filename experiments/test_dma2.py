@@ -1,0 +1,195 @@
+"""Pin down which dynamic-slice forms work: HBM-side dynamic DMA source,
+aligned dynamic VMEM writes, and then build + time the aligned DMA-ring
+row gather."""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V, D = 24576, 256
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+
+def try_kernel(label, fn, *args):
+    try:
+        out = jax.jit(fn)(*args)
+        float(_sum(out))
+        print(f"{label:56s} OK")
+        return out
+    except Exception as e:
+        lines = [l for l in str(e).splitlines() if "Mosaic" in l or "INTERNAL" in l or "Error" in l][:1]
+        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}")
+        return None
+
+
+def main():
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+
+    # g: dynamic-row DMA source from ANY (HBM) by prefetched scalar
+    idx1 = jnp.asarray([7], dtype=jnp.int32)
+
+    def kg(idx_ref, table_ref, out_ref):
+        def body(scratch, sem):
+            dma = pltpu.make_async_copy(
+                table_ref.at[pl.ds(idx_ref[0], 1), :], scratch, sem
+            )
+            dma.start()
+            dma.wait()
+            out_ref[:] = jnp.broadcast_to(scratch[:], (8, D))
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((1, D), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA,
+        )
+
+    def callg(idx, table):
+        return pl.pallas_call(
+            kg,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((8, D), jnp.float32),
+        )(idx, table)
+
+    out = try_kernel("g: dynamic-row HBM DMA source", callg, idx1, table)
+    if out is not None:
+        print("   err:", np.abs(np.asarray(out)[0] - np.asarray(table)[7]).max())
+
+    # h: aligned dynamic VMEM write in fori loop (start = 8*j)
+    E = 1024
+
+    def kh(in_ref, out_ref):
+        def loop(j, _):
+            s = pl.multiple_of(j * 8, 8)
+            out_ref[pl.ds(s, 8), :] = in_ref[pl.ds(s, 8), :] * 2.0
+            return 0
+
+        jax.lax.fori_loop(0, E // 8, loop, 0)
+
+    def callh(x):
+        return pl.pallas_call(
+            kh,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+        )(x)
+
+    try_kernel("h: aligned dynamic VMEM write (8-row tiles)", callh, table[:E])
+
+    # i: full aligned DMA-ring gather: tile of 8 rows via 8 DMAs into an
+    # aligned (8, D) scratch slot, K slots in flight, aligned writes out.
+    def make_gather(E, K):
+        def ki(idx_ref, table_ref, out_ref):
+            def body(scratch, sems):
+                ntiles = E // 8
+
+                def start_tile(slot, t):
+                    base = t * 8
+                    for r in range(8):
+                        pltpu.make_async_copy(
+                            table_ref.at[pl.ds(idx_ref[base + r], 1), :],
+                            scratch.at[slot, pl.ds(r, 1), :],
+                            sems.at[slot],
+                        ).start()
+
+                def wait_tile(slot):
+                    # one semaphore accumulates 8 DMA completions
+                    pltpu.semaphore_wait(sems.at[slot], 8)
+
+                def warm(t, _):
+                    start_tile(t, t)
+                    return 0
+
+                jax.lax.fori_loop(0, K, warm, 0)
+
+                def loop(t, _):
+                    slot = jax.lax.rem(t, K)
+                    wait_tile(slot)
+                    s = pl.multiple_of(t * 8, 8)
+                    out_ref[pl.ds(s, 8), :] = scratch[slot]
+
+                    @pl.when(t + K < ntiles)
+                    def _():
+                        start_tile(slot, t + K)
+
+                    return 0
+
+                jax.lax.fori_loop(0, ntiles, loop, 0)
+
+            pl.run_scoped(
+                body,
+                scratch=pltpu.VMEM((K, 8, D), jnp.float32),
+                sems=pltpu.SemaphoreType.DMA((K,)),
+            )
+
+        def call(idx, table):
+            return pl.pallas_call(
+                ki,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(1,),
+                    in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                ),
+                out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+            )(idx, table)
+
+        return call
+
+    E = 8192
+    idx = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+    for K in (4, 16):
+        call = make_gather(E, K)
+        out = try_kernel(f"i: aligned DMA-ring gather E={E} K={K}", call, idx, table)
+        if out is not None:
+            want = np.asarray(table)[np.asarray(idx)]
+            print("   err:", np.abs(np.asarray(out) - want).max())
+
+    # timing inside a scan (amortize dispatch): compare vs XLA gather
+    E = 32768
+    idxb = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+    call = make_gather(E, 16)
+
+    @jax.jit
+    def loop_pallas(table, idxb):
+        def body(c, _):
+            out = call(idxb, table)
+            return c + out[0, 0] * 1e-9, ()
+        c, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(20))
+        return c
+
+    @jax.jit
+    def loop_xla(table, idxb):
+        def body(c, _):
+            out = table[idxb]
+            return c + out[0, 0] * 1e-9, ()
+        c, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(20))
+        return c
+
+    for label, loop in (("pallas DMA-ring", loop_pallas), ("xla gather", loop_xla)):
+        try:
+            out = loop(table, idxb)
+            float(out)
+            t0 = time.perf_counter()
+            float(loop(table, idxb))
+            dt = (time.perf_counter() - t0) / 20
+            print(f"{label} gather 32768 rows: {dt * 1e6:8.1f} us/call  ({dt / E * 1e9:.1f} ns/row)")
+        except Exception as e:
+            print(f"{label} FAIL: {str(e).splitlines()[0][:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Build the DMA row-gather kernel up from minimal pieces to find what
+fails to compile on this backend."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+V, D, E = 24576, 256, 4096
+
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+
+def try_kernel(label, fn, *args):
+    try:
+        out = jax.jit(fn)(*args)
+        s = float(_sum(out))
+        print(f"{label:46s} OK (sum {s:.1f})")
+        return out
+    except Exception as e:
+        lines = [l for l in str(e).splitlines() if l.strip()][:3]
+        print(f"{label:46s} FAIL: {' | '.join(l[:120] for l in lines)}")
+        return None
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    idx = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
+
+    # 1. static single-row DMA from ANY-space input
+    def k1(idx_ref, table_ref, out_ref):
+        def body(scratch, sem):
+            dma = pltpu.make_async_copy(
+                table_ref.at[pl.ds(0, 1), :], scratch, sem
+            )
+            dma.start()
+            dma.wait()
+            out_ref[pl.ds(0, 1), :] = scratch[:]
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((1, D), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA,
+        )
+
+    def call1(idx, table):
+        return pl.pallas_call(
+            k1,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+        )(idx, table)
+
+    try_kernel("1: static 1-row DMA", call1, idx, table)
+
+    # 2. dynamic single-row DMA using prefetched scalar index
+    def k2(idx_ref, table_ref, out_ref):
+        def body(scratch, sem):
+            dma = pltpu.make_async_copy(
+                table_ref.at[pl.ds(idx_ref[0], 1), :], scratch, sem
+            )
+            dma.start()
+            dma.wait()
+            out_ref[pl.ds(0, 1), :] = scratch[:]
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((1, D), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA,
+        )
+
+    def call2(idx, table):
+        return pl.pallas_call(
+            k2,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+        )(idx, table)
+
+    try_kernel("2: dynamic 1-row DMA via scalar prefetch", call2, idx, table)
+
+    # 3. fori_loop of dynamic row DMAs, 1 in flight
+    def k3(idx_ref, table_ref, out_ref):
+        def body(scratch, sem):
+            def loop(i, _):
+                dma = pltpu.make_async_copy(
+                    table_ref.at[pl.ds(idx_ref[i], 1), :], scratch, sem
+                )
+                dma.start()
+                dma.wait()
+                out_ref[pl.ds(i, 1), :] = scratch[:]
+                return 0
+
+            jax.lax.fori_loop(0, E, loop, 0)
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((1, D), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA,
+        )
+
+    def call3(idx, table):
+        return pl.pallas_call(
+            k3,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+        )(idx, table)
+
+    out = try_kernel("3: fori of dynamic row DMAs (1 in flight)", call3, idx, table)
+    if out is not None:
+        want = np.asarray(table)[np.asarray(idx)]
+        print("   max err:", np.abs(np.asarray(out) - want).max())
+
+    # 4. ring with semaphore array, K in flight
+    K = 8
+
+    def k4(idx_ref, table_ref, out_ref):
+        def body(scratch, sems):
+            def get_dma(slot, i):
+                return pltpu.make_async_copy(
+                    table_ref.at[pl.ds(idx_ref[i], 1), :],
+                    scratch.at[pl.ds(slot, 1), :],
+                    sems.at[slot],
+                )
+
+            def warm(i, _):
+                get_dma(i, i).start()
+                return 0
+
+            jax.lax.fori_loop(0, K, warm, 0)
+
+            def loop(i, _):
+                slot = jax.lax.rem(i, K)
+                get_dma(slot, i).wait()
+                out_ref[pl.ds(i, 1), :] = scratch[pl.ds(slot, 1), :]
+
+                @pl.when(i + K < E)
+                def _():
+                    get_dma(slot, i + K).start()
+
+                return 0
+
+            jax.lax.fori_loop(0, E, loop, 0)
+
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((K, D), jnp.float32),
+            sems=pltpu.SemaphoreType.DMA((K,)),
+        )
+
+    def call4(idx, table):
+        return pl.pallas_call(
+            k4,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((E, D), jnp.float32),
+        )(idx, table)
+
+    out = try_kernel(f"4: DMA ring K={K}", call4, idx, table)
+    if out is not None:
+        want = np.asarray(table)[np.asarray(idx)]
+        print("   max err:", np.abs(np.asarray(out) - want).max())
+
+
+if __name__ == "__main__":
+    main()

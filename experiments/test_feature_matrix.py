@@ -1,0 +1,146 @@
+"""Which Pallas TPU feature crashes the axon compile helper?"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+N, D = 256, 256
+
+
+def try_kernel(label, fn, *args):
+    try:
+        out = jax.jit(fn)(*args)
+        float(_sum(out))
+        print(f"{label:56s} OK")
+    except Exception as e:
+        lines = [l for l in str(e).splitlines() if "Mosaic" in l or "NotImplemented" in l or "INTERNAL" in l][:1]
+        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}")
+
+
+def main():
+    x = jnp.asarray(np.random.RandomState(0).randn(N, D).astype(np.float32))
+    idx = jnp.arange(N, dtype=jnp.int32)
+
+    # a: PrefetchScalarGridSpec, trivial
+    def ka(idx_ref, in_ref, out_ref):
+        out_ref[:] = in_ref[:] * 2.0
+
+    def calla(idx, x):
+        return pl.pallas_call(
+            ka,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            ),
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(idx, x)
+
+    try_kernel("a: PrefetchScalarGridSpec trivial", calla, idx, x)
+
+    # b: pl.ANY input + DMA to VMEM scratch via run_scoped
+    def kb(in_ref, out_ref):
+        def body(scratch, sem):
+            dma = pltpu.make_async_copy(in_ref, scratch, sem)
+            dma.start()
+            dma.wait()
+            out_ref[:] = scratch[:]
+        pl.run_scoped(
+            body,
+            scratch=pltpu.VMEM((N, D), jnp.float32),
+            sem=pltpu.SemaphoreType.DMA,
+        )
+
+    def callb(x):
+        return pl.pallas_call(
+            kb,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(x)
+
+    try_kernel("b: ANY + run_scoped DMA", callb, x)
+
+    # c: run_scoped scratch, no DMA
+    def kc(in_ref, out_ref):
+        def body(scratch):
+            scratch[:] = in_ref[:] * 2.0
+            out_ref[:] = scratch[:]
+        pl.run_scoped(body, scratch=pltpu.VMEM((N, D), jnp.float32))
+
+    def callc(x):
+        return pl.pallas_call(
+            kc,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(x)
+
+    try_kernel("c: run_scoped scratch only", callc, x)
+
+    # d: scratch_shapes arg with semaphore, explicit DMA
+    def kd(in_ref, out_ref, scratch, sem):
+        dma = pltpu.make_async_copy(in_ref, scratch, sem)
+        dma.start()
+        dma.wait()
+        out_ref[:] = scratch[:]
+
+    def calld(x):
+        return pl.pallas_call(
+            kd,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((N, D), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+            ],
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(x)
+
+    try_kernel("d: scratch_shapes + DMA", calld, x)
+
+    # e: pl.ANY input, direct copy (no DMA — should fail gracefully or work)
+    def ke(in_ref, out_ref):
+        out_ref[:] = in_ref[:]
+
+    def calle(x):
+        return pl.pallas_call(
+            ke,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(x)
+
+    try_kernel("e: ANY input direct read", calle, x)
+
+    # f: dynamic slice of VMEM input by SMEM scalar
+    sidx = jnp.asarray([[3]], dtype=jnp.int32)
+
+    def kf(s_ref, in_ref, out_ref):
+        i = s_ref[0, 0]
+        out_ref[pl.ds(0, 8), :] = in_ref[pl.ds(i, 8), :]
+        out_ref[pl.ds(8, N - 8), :] = in_ref[pl.ds(0, N - 8), :]
+
+    def callf(s, x):
+        return pl.pallas_call(
+            kf,
+            in_specs=[
+                pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, D), jnp.float32),
+        )(s, x)
+
+    try_kernel("f: SMEM scalar dynamic slice", callf, sidx, x)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Is there a fixed per-iteration cost in lax.scan on this backend, and does
+unroll amortize it?"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+
+
+def bench(label, loop, x, iters_inside):
+    out = loop(x)
+    float(_sum(out))
+    t0 = time.perf_counter()
+    out = loop(x)
+    float(_sum(out))
+    dt = (time.perf_counter() - t0) / iters_inside
+    print(f"{label:52s} {dt * 1e6:9.2f} us/iter")
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+
+    for n_iter in (100, 1000):
+        @jax.jit
+        def loop(x, n_iter=n_iter):
+            def body(c, _):
+                return c * 1.0000001 + 1e-9, ()
+            c, _ = jax.lax.scan(body, x, jnp.arange(n_iter))
+            return c
+        bench(f"scalar scan x{n_iter}", loop, jnp.float32(1.0), n_iter)
+
+    for unroll in (1, 4, 16):
+        @jax.jit
+        def loop(x, unroll=unroll):
+            def body(c, _):
+                return c * 1.0000001 + 1e-9, ()
+            c, _ = jax.lax.scan(body, x, jnp.arange(1000), unroll=unroll)
+            return c
+        bench(f"scalar scan x1000 unroll={unroll}", loop, jnp.float32(1.0), 1000)
+
+    # 25MB axpy scan with unroll
+    n = 25 * 1024 * 1024 // 4 // 256
+    x = jnp.asarray(rng.randn(n, 256).astype(np.float32))
+    for unroll in (1, 4, 16):
+        @jax.jit
+        def loop(x, unroll=unroll):
+            def body(c, _):
+                return c * 1.0000001, ()
+            c, _ = jax.lax.scan(body, x, jnp.arange(100), unroll=unroll)
+            return c
+        bench(f"25MB axpy scan x100 unroll={unroll} (50MB/iter)", loop, x, 100)
+
+    # fori_loop comparison
+    @jax.jit
+    def floop(x):
+        return jax.lax.fori_loop(0, 1000, lambda i, c: c * 1.0000001 + 1e-9, x)
+    bench("scalar fori_loop x1000", floop, jnp.float32(1.0), 1000)
+
+
+if __name__ == "__main__":
+    main()

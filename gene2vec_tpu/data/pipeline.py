@@ -80,3 +80,49 @@ def epoch_permutation(key: jax.Array, num_pairs: int, batch_pairs: int) -> jax.A
     num_batches = num_pairs // batch_pairs
     perm = jax.random.permutation(key, num_pairs)[: num_batches * batch_pairs]
     return perm.reshape(num_batches, batch_pairs).astype(jnp.int32)
+
+
+def epoch_shuffle(
+    pairs: jax.Array,
+    key: jax.Array,
+    num_pairs: int,
+    num_batches: int,
+    batch_pairs: int,
+    mode: str,
+    enabled: bool = True,
+) -> jax.Array:
+    """Per-epoch corpus shuffle for jitted epoch loops (shared by the SGNS
+    and CBOW/HS trainers).  Returns an array the epoch scan slices
+    sequentially (length ≥ num_batches·batch_pairs rows).
+
+    Random row gathers are issue-bound on TPU (docs/PERF_NOTES.md), so the
+    default ``"offset"`` mode never does one: the corpus is host-shuffled
+    once at trainer construction, and each epoch applies a random circular
+    roll plus a permutation of fixed 512-pair blocks — block gathers stay
+    coalesced (a stream pass), while re-mixing batch composition every
+    epoch.  ``"full"`` is the reference's exact per-epoch row permutation
+    (``src/gene2vec.py:80``) at the price of an N-row random gather.
+    """
+    if not enabled:
+        return pairs
+    if mode == "full":
+        perm = epoch_permutation(key, num_pairs, batch_pairs)
+        return pairs[perm.reshape(-1)]
+    if mode != "offset":
+        raise ValueError(f"unknown shuffle_mode {mode!r}")
+    off_key, blk_key = jax.random.split(key)
+    offset = jax.random.randint(off_key, (), 0, num_pairs)
+    rolled = jnp.roll(pairs, offset, axis=0)
+    span = num_batches * batch_pairs
+    block = 512 if span % 512 == 0 else batch_pairs
+    nblocks = span // block
+    blocks = rolled[:span].reshape(nblocks, block, 2)
+    return blocks[jax.random.permutation(blk_key, nblocks)].reshape(span, 2)
+
+
+def host_preshuffle(corpus: "PairCorpus", seed: int) -> "PairCorpus":
+    """One-time host-side shuffle backing ``epoch_shuffle``'s offset mode —
+    the analogue of the reference's pre-training ``random.shuffle``
+    (``src/gene2vec.py:52``)."""
+    rng = np.random.RandomState(seed)
+    return PairCorpus(corpus.vocab, corpus.pairs[rng.permutation(corpus.num_pairs)])

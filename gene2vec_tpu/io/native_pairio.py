@@ -80,6 +80,33 @@ def available() -> bool:
     return _load() is not None
 
 
+# cp1252 leaves these five bytes undefined; Python's strict decoder raises
+# on them anywhere in a file, while the native reader only decodes kept
+# tokens.  Pre-validating keeps the two paths behavior-identical (round-1
+# advisor finding).
+_CP1252_UNDEFINED = np.array([0x81, 0x8D, 0x8F, 0x90, 0x9D], dtype=np.uint8)
+
+
+def _validate_cp1252(path: str, chunk_bytes: int = 1 << 22) -> None:
+    offset = 0
+    with open(path, "rb") as f:
+        while True:
+            raw = f.read(chunk_bytes)
+            if not raw:
+                return
+            data = np.frombuffer(raw, dtype=np.uint8)
+            bad = np.isin(data, _CP1252_UNDEFINED)
+            if bad.any():
+                pos = int(np.argmax(bad))
+                raise UnicodeDecodeError(
+                    "charmap", bytes(data[max(0, pos - 8): pos + 8]),
+                    min(pos, 8), min(pos, 8) + 1,
+                    f"byte 0x{data[pos]:02X} undefined in cp1252 "
+                    f"({path} offset {offset + pos})",
+                )
+            offset += len(raw)
+
+
 def load_corpus(
     paths: Sequence[str], min_count: int = 1, encoding: str = "windows-1252"
 ) -> Tuple[Vocab, np.ndarray]:
@@ -88,6 +115,9 @@ def load_corpus(
     if lib is None:
         raise RuntimeError("native pairio library not available")
     paths = list(paths)
+    if encoding.replace("-", "").lower() in ("windows1252", "cp1252"):
+        for p in paths:
+            _validate_cp1252(p)
     c_paths = (ctypes.c_char_p * len(paths))(
         *[p.encode("utf-8") for p in paths]
     )

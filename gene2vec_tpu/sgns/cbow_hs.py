@@ -27,22 +27,28 @@ capped duplicate-row combiner as the SGNS step.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
-from typing import Callable, Optional, Tuple
-
-import numpy as np
+from typing import TYPE_CHECKING, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
-from gene2vec_tpu.data.pipeline import PairCorpus, epoch_permutation
+from gene2vec_tpu.data.pipeline import PairCorpus, epoch_shuffle, host_preshuffle
 from gene2vec_tpu.io import checkpoint as ckpt
 from gene2vec_tpu.sgns.huffman import HuffmanTree, build_huffman_tree
 from gene2vec_tpu.sgns.model import SGNSParams
-from gene2vec_tpu.sgns.step import _examples_from_pairs, _row_divisor, sgns_step
+from gene2vec_tpu.sgns.step import (
+    _apply_row_updates,
+    _examples_from_pairs,
+    sgns_step,
+)
 from gene2vec_tpu.utils.profiling import StepTimer
+
+if TYPE_CHECKING:  # runtime import would cycle through gene2vec_tpu.parallel
+    from gene2vec_tpu.parallel.sharding import SGNSSharding
 
 OBJECTIVES = ("cbow", "sg_hs", "cbow_hs")
 
@@ -105,25 +111,30 @@ def hs_step(
         tree_points, tree_codes, tree_lengths, compute_dtype,
     )
 
-    if combiner != "sum":
-        vocab_size = params.emb.shape[0]
-        num_nodes = params.ctx.shape[0]
-        cnt_in = jnp.zeros(vocab_size, jnp.float32).at[inputs].add(1.0)
-        cnt_nd = jnp.zeros(num_nodes, jnp.float32).at[pts.reshape(-1)].add(
-            mask.reshape(-1)
-        )
-        d_input = d_input / _row_divisor(
-            cnt_in[inputs], combiner
-        ).astype(compute_dtype)[:, None]
-        d_node = d_node / _row_divisor(
-            cnt_nd[pts], combiner
-        ).astype(compute_dtype)[:, :, None]
-
-    dtype = params.emb.dtype
-    lr = jnp.asarray(lr, compute_dtype)
-    emb = params.emb.at[inputs].add((-lr * d_input).astype(dtype))
-    node = params.ctx.at[pts.reshape(-1)].add(
-        (-lr * d_node).reshape(-1, d_node.shape[-1]).astype(dtype)
+    # Same fused (rows, D+1) accumulator scatter + dense divisor/axpy as the
+    # SGNS step (step.py:_apply_row_updates) — one scatter per table instead
+    # of two count scatters, a count gather, and raw in-place adds, which
+    # roughly halves the per-row op count of the hot loop (round-1 VERDICT
+    # item 5).  Padded path entries carry weight 0 (mask), so they combine
+    # into row 0 with zero payload.
+    d = d_input.shape[-1]
+    emb = _apply_row_updates(
+        params.emb,
+        inputs,
+        d_input,
+        jnp.ones_like(inputs, compute_dtype),
+        lr,
+        combiner,
+        compute_dtype,
+    )
+    node = _apply_row_updates(
+        params.ctx,
+        pts.reshape(-1),
+        d_node.reshape(-1, d),
+        mask.reshape(-1),
+        lr,
+        combiner,
+        compute_dtype,
     )
     return SGNSParams(emb=emb, ctx=node), loss
 
@@ -132,10 +143,18 @@ class CBOWHSTrainer:
     """Trainer for the cbow / sg_hs / cbow_hs objectives.
 
     Mirrors :class:`gene2vec_tpu.sgns.train.SGNSTrainer`'s interface (init /
-    train_epoch / run with per-iteration checkpoint + txt export).
+    train_epoch / run with per-iteration checkpoint + txt export), including
+    mesh sharding: data-parallel batch sharding and vocab-sharded
+    (row-parallel) tables both apply — the HS node table row-shards over the
+    model axis exactly like the SGNS context table.
     """
 
-    def __init__(self, corpus: PairCorpus, config: SGNSConfig):
+    def __init__(
+        self,
+        corpus: PairCorpus,
+        config: SGNSConfig,
+        sharding: Optional["SGNSSharding"] = None,
+    ):
         if config.objective not in OBJECTIVES:
             raise ValueError(
                 f"objective={config.objective!r} not in {OBJECTIVES}; plain "
@@ -143,41 +162,68 @@ class CBOWHSTrainer:
             )
         if corpus.num_pairs == 0 or corpus.vocab_size == 0:
             raise ValueError("corpus is empty")
+        if sharding is not None:
+            corpus = corpus.pad_to_multiple(sharding.mesh.shape[sharding.data_axis])
         if corpus.num_pairs < config.batch_pairs:
             config = dataclasses.replace(config, batch_pairs=max(1, corpus.num_pairs))
+        if config.shuffle_mode not in ("offset", "full"):
+            raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
+        if config.shuffle_mode == "offset":
+            corpus = host_preshuffle(corpus, config.seed)
         self.config = config
         self.corpus = corpus
+        self.sharding = sharding
         self.num_batches = corpus.num_batches(config.batch_pairs)
-        self.pairs = corpus.device_pairs()
         self.timer = StepTimer()
         self.hs = config.objective.endswith("_hs")
         if self.hs:
             self.tree: Optional[HuffmanTree] = build_huffman_tree(corpus.vocab.counts)
-            self._points = jnp.asarray(self.tree.points)
-            self._codes = jnp.asarray(self.tree.codes)
-            self._lengths = jnp.asarray(self.tree.lengths)
+            points = jnp.asarray(self.tree.points)
+            codes = jnp.asarray(self.tree.codes)
+            lengths = jnp.asarray(self.tree.lengths)
+            if sharding is not None:
+                rep = sharding.replicated()
+                points = jax.device_put(points, rep)
+                codes = jax.device_put(codes, rep)
+                lengths = jax.device_put(lengths, rep)
+            self._points, self._codes, self._lengths = points, codes, lengths
         else:
             self.tree = None
             self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
-            self.noise = self.sampler.table
+            self.noise = (
+                jax.device_put(self.sampler.table, sharding.replicated())
+                if sharding is not None
+                else self.sampler.table
+            )
+        self.pairs = (
+            corpus.device_pairs(sharding.corpus_sharding())
+            if sharding is not None
+            else corpus.device_pairs()
+        )
         self._epoch_fn = self._make_epoch()
 
     def _make_epoch(self) -> Callable:
         cfg = self.config
+        sharding = self.sharding
         compute_dtype = jnp.dtype(cfg.compute_dtype)
         num_pairs, num_batches = self.corpus.num_pairs, self.num_batches
         cbow = cfg.objective.startswith("cbow")
 
         def epoch(params, pairs, key):
             shuffle_key, step_key = jax.random.split(key)
-            # one gather per epoch, contiguous slices per step (see train.py)
-            perm = epoch_permutation(shuffle_key, num_pairs, cfg.batch_pairs)
-            shuffled = pairs[perm.reshape(-1)]
+            shuffled = epoch_shuffle(
+                pairs, shuffle_key, num_pairs, num_batches, cfg.batch_pairs,
+                cfg.shuffle_mode, enabled=cfg.shuffle_each_iter,
+            )
+            if sharding is not None:
+                shuffled = sharding.constrain_batch(shuffled)
 
             def body(params, step):
                 batch = jax.lax.dynamic_slice_in_dim(
                     shuffled, step * cfg.batch_pairs, cfg.batch_pairs
                 )
+                if sharding is not None:
+                    batch = sharding.constrain_batch(batch)
                 frac = step.astype(compute_dtype) / max(num_batches, 1)
                 lr = cfg.lr * (1.0 - frac) + cfg.min_lr * frac
                 if self.hs:
@@ -206,6 +252,8 @@ class CBOWHSTrainer:
                         negative_mode=cfg.negative_mode,
                         shared_pool=cfg.shared_pool,
                     )
+                if sharding is not None:
+                    params = sharding.constrain_params(params)
                 return params, loss
 
             params, losses = jax.lax.scan(
@@ -218,18 +266,34 @@ class CBOWHSTrainer:
 
     # -- params ------------------------------------------------------------
 
-    def init(self, seed: Optional[int] = None) -> SGNSParams:
+    def _init_impl(self, key, dtype):
         cfg = self.config
-        key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
-        dtype = jnp.dtype(cfg.table_dtype)
         v = self.corpus.vocab_size
         emb = jax.random.uniform(
             key, (v, cfg.dim), dtype=dtype,
             minval=-0.5 / cfg.dim, maxval=0.5 / cfg.dim,
         )
-        out_rows = self.tree.num_nodes if self.hs else v
-        ctx = jnp.zeros((max(out_rows, 1), cfg.dim), dtype=dtype)
+        out_rows = max(self.tree.num_nodes if self.hs else v, 1)
+        if self.sharding is not None and self.sharding.vocab_sharded:
+            # row-sharding needs dimension 0 divisible by the model axis;
+            # the HS node table has V-1 rows, so pad — padded rows are
+            # never referenced by any Huffman path.
+            shards = self.sharding.mesh.shape[self.sharding.model_axis]
+            out_rows = -(-out_rows // shards) * shards
+        ctx = jnp.zeros((out_rows, cfg.dim), dtype=dtype)
         return SGNSParams(emb=emb, ctx=ctx)
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        cfg = self.config
+        key = jax.random.PRNGKey(cfg.seed if seed is None else seed)
+        dtype = jnp.dtype(cfg.table_dtype)
+        if self.sharding is not None:
+            init_fn = jax.jit(
+                functools.partial(self._init_impl, dtype=dtype),
+                out_shardings=self.sharding.params_sharding(),
+            )
+            return init_fn(key)
+        return self._init_impl(key, dtype)
 
     # -- training ----------------------------------------------------------
 
@@ -277,10 +341,14 @@ class CBOWHSTrainer:
         return params
 
 
-def make_trainer(corpus: PairCorpus, config: SGNSConfig):
+def make_trainer(
+    corpus: PairCorpus,
+    config: SGNSConfig,
+    sharding: Optional["SGNSSharding"] = None,
+):
     """Objective-dispatching factory: 'sgns' → SGNSTrainer, else CBOWHSTrainer."""
     if config.objective == "sgns":
         from gene2vec_tpu.sgns.train import SGNSTrainer
 
-        return SGNSTrainer(corpus, config)
-    return CBOWHSTrainer(corpus, config)
+        return SGNSTrainer(corpus, config, sharding=sharding)
+    return CBOWHSTrainer(corpus, config, sharding=sharding)

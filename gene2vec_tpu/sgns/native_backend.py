@@ -158,11 +158,16 @@ class HogwildSGNSTrainer:
             start_iter = 1
         from gene2vec_tpu.utils.metrics import MetricsLogger
 
-        rng = np.random.RandomState(cfg.seed)
         metrics = MetricsLogger(os.path.join(export_dir, "training_log.csv"))
         for it in range(start_iter, cfg.num_iters + 1):
             t0 = time.perf_counter()
-            params, loss = self.train_epoch(params, seed=cfg.seed + it, rng=rng)
+            # shuffle stream keyed by (seed, it) so a resumed run shuffles
+            # identically to an uninterrupted one (round-1 advisor finding)
+            params, loss = self.train_epoch(
+                params,
+                seed=cfg.seed + it,
+                rng=np.random.RandomState(cfg.seed + it),
+            )
             dt = time.perf_counter() - t0
             rate = self.corpus.num_pairs / dt if dt > 0 else float("inf")
             log(

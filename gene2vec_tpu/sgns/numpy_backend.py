@@ -121,11 +121,15 @@ class NumpySGNSTrainer:
         else:
             params = self.init()
             start_iter = 1
-        rng = np.random.RandomState(cfg.seed)
         pairs_per_epoch = (self.corpus.num_pairs // self.batch) * self.batch
         for it in range(start_iter, cfg.num_iters + 1):
             t0 = time.perf_counter()
-            params, loss = self.train_epoch(params, rng)
+            # per-iteration stream keyed by (seed, it): a resumed run draws
+            # the same shuffles/negatives as an uninterrupted one (round-1
+            # advisor finding; matches the hogwild kernel's seeding)
+            params, loss = self.train_epoch(
+                params, np.random.RandomState(cfg.seed + it)
+            )
             dt = time.perf_counter() - t0
             rate = pairs_per_epoch / dt if dt > 0 else float("inf")
             log(

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
-from gene2vec_tpu.data.pipeline import PairCorpus, epoch_permutation
+from gene2vec_tpu.data.pipeline import PairCorpus, epoch_shuffle, host_preshuffle
 from gene2vec_tpu.io import checkpoint as ckpt
 from gene2vec_tpu.sgns.model import SGNSParams, init_params
 from gene2vec_tpu.sgns.step import sgns_step
@@ -53,31 +53,16 @@ def make_train_epoch(
 
     def train_epoch(params, pairs, noise, key):
         shuffle_key, step_key = jax.random.split(key)
-        # Random row gathers are latency-bound on TPU (8-byte rows measured
-        # ~175 ns/row — more time than the training step itself, whether done
-        # per step or as one big epoch gather).  Default "offset" mode keeps
-        # the corpus host-shuffled once (trainer __init__) and decorrelates
-        # epochs with a random circular offset (one contiguous roll) plus a
-        # random batch visiting order — no gathers at all.  "full" restores
-        # the reference's exact per-epoch permutation semantics.
-        if not config.shuffle_each_iter:
-            shuffled, order = pairs, None
-        elif config.shuffle_mode == "full":
-            perm = epoch_permutation(shuffle_key, num_pairs, batch_pairs)
-            shuffled = pairs[perm.reshape(-1)]
-            order = None
-        else:
-            off_key, ord_key = jax.random.split(shuffle_key)
-            offset = jax.random.randint(off_key, (), 0, num_pairs)
-            shuffled = jnp.roll(pairs, offset, axis=0)
-            order = jax.random.permutation(ord_key, num_batches)
+        shuffled = epoch_shuffle(
+            pairs, shuffle_key, num_pairs, num_batches, batch_pairs,
+            config.shuffle_mode, enabled=config.shuffle_each_iter,
+        )
         if sharding is not None:
             shuffled = sharding.constrain_batch(shuffled)
 
         def body(params, step):
-            slot = step if order is None else order[step]
             batch = jax.lax.dynamic_slice_in_dim(
-                shuffled, slot * batch_pairs, batch_pairs
+                shuffled, step * batch_pairs, batch_pairs
             )
             if sharding is not None:
                 batch = sharding.constrain_batch(batch)
@@ -144,11 +129,8 @@ class SGNSTrainer:
         if config.shuffle_mode == "offset":
             # one-time host-side shuffle, unconditional like the reference's
             # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
-            # decorrelation then needs no device gathers
-            rng = np.random.RandomState(config.seed)
-            corpus = PairCorpus(
-                corpus.vocab, corpus.pairs[rng.permutation(corpus.num_pairs)]
-            )
+            # decorrelation then needs no per-row device gathers
+            corpus = host_preshuffle(corpus, config.seed)
         self.config = config
         self.corpus = corpus
         self.sharding = sharding

@@ -30,3 +30,26 @@ def test_numpy_and_jax_backends_recover_structure(
     # both must separate the planted clusters decisively
     assert seps["numpy"] > 0.3, seps
     assert seps["jax"] > 0.3, seps
+
+
+def test_numpy_backend_resume_matches_uninterrupted(
+    tmp_path, synthetic_corpus_dir
+):
+    """ADVICE r1: a resumed run must continue the per-iteration RNG streams,
+    not replay iteration 1's."""
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    corpus = PairCorpus(vocab, pairs)
+    cfg = SGNSConfig(dim=8, num_iters=3, batch_pairs=64, seed=2)
+
+    straight = make_backend_trainer(corpus, cfg, backend="numpy")
+    p_straight = straight.run(str(tmp_path / "a"), log=lambda s: None)
+
+    partial_cfg = SGNSConfig(dim=8, num_iters=2, batch_pairs=64, seed=2)
+    part = make_backend_trainer(corpus, partial_cfg, backend="numpy")
+    part.run(str(tmp_path / "b"), log=lambda s: None)
+    resumed = make_backend_trainer(corpus, cfg, backend="numpy")
+    p_resumed = resumed.run(str(tmp_path / "b"), log=lambda s: None)
+
+    np.testing.assert_allclose(
+        np.asarray(p_resumed.emb), np.asarray(p_straight.emb), atol=1e-6
+    )

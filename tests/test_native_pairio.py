@@ -106,3 +106,18 @@ def test_native_speed_sanity(tmp_path):
     _python_load(str(d))
     t_python = time.perf_counter() - t0
     assert t_native < t_python, (t_native, t_python)
+
+
+def test_native_reader_rejects_cp1252_undefined_bytes(tmp_path):
+    """ADVICE r1: strict-decode parity with the Python fallback — a file
+    containing a cp1252-undefined byte raises, even in skipped content."""
+    import pytest
+
+    from gene2vec_tpu.io import native_pairio
+
+    if not native_pairio.available():
+        pytest.skip("native pairio library unavailable")
+    bad = tmp_path / "bad.txt"
+    bad.write_bytes(b"GENE1 GENE2\nGEN\x81E3 GENE4\n")
+    with pytest.raises(UnicodeDecodeError):
+        native_pairio.load_corpus([str(bad)])

@@ -85,3 +85,24 @@ def test_embedding_quality_sanity(trained):
     keep = ra != rb
     rand_sims = np.einsum("nd,nd->n", unit[ra[keep]], unit[rb[keep]])
     assert pair_sims.mean() > rand_sims.mean()
+
+
+def test_epoch_shuffle_preserves_pair_multiset():
+    """Offset/block shuffle must reorder, never alter, the pair stream."""
+    import jax
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.data.pipeline import epoch_shuffle
+
+    rng = np.random.RandomState(0)
+    pairs = jnp.asarray(rng.randint(0, 50, (2048, 2)).astype(np.int32))
+    for mode in ("offset", "full"):
+        out = jax.jit(
+            lambda p, k: epoch_shuffle(p, k, 2048, 4, 512, mode)
+        )(pairs, jax.random.PRNGKey(3))
+        got = np.asarray(out)
+        assert got.shape == (2048, 2)
+        want = np.asarray(pairs)
+        key = lambda a: sorted(map(tuple, a.tolist()))
+        assert key(got) == key(want), mode
+        assert not np.array_equal(got, want)  # it actually shuffled
