@@ -39,15 +39,34 @@ class SGNSConfig:
     table_dtype: str = "float32"
     compute_dtype: str = "float32"
     both_directions: bool = True   # emit (a→b) and (b→a) per corpus pair
-    combiner: str = "capped"       # duplicate-row gradients: "capped" (sum, capped
-                                   # at C x mean for hot rows — stable at any batch
-                                   # size) | "mean" | "sum" (sequential-SGD-like,
+    combiner: str = "capped"       # duplicate-row gradients: "capped" (sum,
+                                   # capped at C x mean for overloaded rows —
+                                   # stable at any batch size; a row's
+                                   # positive and negative gradients shrink
+                                   # together, see sgns/step.py invariants)
+                                   # | "mean" | "sum" (sequential-SGD-like,
                                    # oracle parity at batch≈1)
     negative_mode: str = "shared"  # "shared": one noise pool per step (MXU
                                    # matmuls, pool-row scatter) | "per_example":
                                    # gensim's per-example draws (oracle parity)
-    shared_pool: int = 64          # shared-mode noise-pool size (importance-
-                                   # weighted down to `negatives` per example)
+    shared_pool: int = 1024        # shared-mode total noise-pool size floor
+                                   # (importance-weighted down to `negatives`
+                                   # per example)
+    shared_pool_auto: bool = True  # size the pool at 0.8*E*negatives total
+                                   # draws — the measured quality-parity
+                                   # point vs per-example draws; a small
+                                   # pool under a large batch (the round-2
+                                   # bench config: P=64, B=16384) diverges
+                                   # under "sum" and freezes the loss under
+                                   # "capped" (docs/QUALITY_NOTES.md)
+    shared_groups: int = 0         # sub-batches with independent pool slices
+                                   # (0 = auto: one group per 32 examples).
+                                   # At fixed total pool, quality is flat in
+                                   # group size while smaller groups cost
+                                   # less matmul — and one whole-batch pool
+                                   # repels ctx rows only along batch-mean
+                                   # directions and lets the geometry
+                                   # collapse — see sgns/step.py invariant 3
     shuffle_each_iter: bool = True # reference reshuffles every iteration
                                    # (src/gene2vec.py:80)
     shuffle_mode: str = "offset"   # per-epoch reshuffle: "offset" (host-shuffled

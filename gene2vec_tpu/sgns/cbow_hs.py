@@ -251,6 +251,8 @@ class CBOWHSTrainer:
                         combiner=cfg.combiner,
                         negative_mode=cfg.negative_mode,
                         shared_pool=cfg.shared_pool,
+                        shared_pool_auto=cfg.shared_pool_auto,
+                        shared_groups=cfg.shared_groups,
                     )
                 if sharding is not None:
                     params = sharding.constrain_params(params)

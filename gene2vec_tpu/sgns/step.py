@@ -20,19 +20,31 @@ TPU-first formulation:
   gensim never hits this because its Hogwild loop applies updates one pair
   at a time), so the per-row sum is capped at C x mean (see
   :func:`_row_divisor`, SURVEY §7 hard part 1).  ``combiner="sum"``
-  restores raw summing for small-batch oracle comparisons;
+  restores raw summing for small-batch oracle comparisons.  For the cap
+  to coexist with shared-mode noise, the pool is auto-sized so that one
+  slot aggregates only a few sequential draws' worth of gradient
+  (P = 0.8*E*K, ``shared_pool_auto``): a far smaller pool either
+  diverges under ``"sum"`` (each slot applies E*K/P stale sequential
+  updates to one row at once — measured at P=64, B=16384) or, under
+  ``"capped"``, has every slot's weight divided by ~E*K/(P*C), crushing
+  the negative term ~80x and freezing the loss at its init value — the
+  round-2 quality failure: a row's positive pulls and negative pushes
+  must shrink together or not at all for the SGNS objective to be
+  minimized (see the invariants in :func:`_step_shared`);
 * negatives that collide with the positive target are masked out of loss and
   update (gensim skips them; a resampling loop would be data-dependent
   control flow XLA can't tile);
-* by default negatives are **shared across the batch** (``negative_mode=
-  "shared"``): one pool of P = ``shared_pool`` noise draws per step (each
-  example's negative term is the pool mean importance-weighted by K/P, an
+* by default negatives are **shared within groups of ~32 examples**
+  (``negative_mode="shared"``): the batch splits into G sub-batches, each
+  drawing its own slice of a pool of P noise draws (each example's
+  negative term is its slice's mean importance-weighted by K/(P/G), an
   unbiased estimate of the K-negative SGNS objective), so the negative
-  logits are a single (E, D) x (D, P) MXU matmul and the negative update is
-  a (P, E) x (E, D) matmul scattered into just P rows — versus a
-  per-example (E, K, D) gather plus an E*K-row scatter, which profiling
-  showed dominated the step.  ``negative_mode="per_example"`` keeps
-  gensim's exact per-example draws for oracle comparisons.
+  logits are one batched (G, E/G, D) x (G, D, P/G) MXU matmul and the
+  negative update is a (G, P/G, E/G) x (G, E/G, D) matmul scattered into
+  just P rows — versus a per-example (E, K, D) gather plus an E*K-row
+  scatter, which profiling showed dominated the step.
+  ``negative_mode="per_example"`` keeps gensim's exact per-example draws
+  for oracle comparisons.
 
 Everything is shape-static and jit-safe; under a Mesh the same code runs
 data-parallel (sharded batch, replicated tables → XLA all-reduces the
@@ -42,6 +54,7 @@ gather/scatter into ICI collectives). See gene2vec_tpu/parallel/sharding.py.
 
 from __future__ import annotations
 
+import math
 from typing import Tuple
 
 import jax
@@ -100,6 +113,13 @@ def sgns_loss_and_grads(
 
 
 _CAP = 32.0  # "capped": sum up to this many duplicates, then scale as C x mean
+# Shared mode draws this fraction of per-example mode's E*K noise draws per
+# step (P = fraction * E * K).  Embedding quality tracks the TOTAL number of
+# independent noise draws per step and nothing else: sweeping sub-batch size
+# 32..256 at fixed P=4E left holdout AUC and planted-cluster separation
+# identical to 3 decimals, while P=0.2*E*K..0.8*E*K moved holdout AUC
+# 0.84 -> 0.879 (= per-example parity).  0.8 is the measured parity point.
+_SHARED_DRAW_FRACTION = 0.8
 
 
 def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
@@ -111,10 +131,18 @@ def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
       evaluated at the same stale parameter value);
     * ``"mean"``   — cnt (always stable, but under-trains hot rows: a row
       advances one averaged step per batch no matter how often it occurred);
-    * ``"capped"`` — max(cnt / C, 1): exact sum while a row has at most
-      C = 32 duplicates (bitwise-equal to "sum" on typical corpora), smoothly
-      capped at C x mean beyond, which keeps the hot-row step bounded at any
-      batch size.  The default (SURVEY §7 hard part 1).
+    * ``"capped"`` — max(cnt / C, 1): exact sum while a row carries at most
+      C = 32 example-units of gradient (bitwise-equal to "sum" on typical
+      corpora), smoothly capped at C x mean beyond, which keeps the hot-row
+      step bounded at any batch size.  The default (SURVEY §7 hard part 1).
+
+    The cap is measured in *example units* — one positive occurrence or one
+    per-example noise draw is 1; a shared-mode pool slot carries its
+    importance-weighted aggregate (scale·Σ masks ≈ E·K/P units).  For the
+    cap to track row load smoothly, one slot must carry only a few units —
+    the pool auto-sizing invariant (see :func:`_step_shared`).  Round 2
+    violated it (P=64 slots of ~2,560 units, divided ~80x), crushing the
+    negative term and freezing the loss.
     """
     cnt = jnp.maximum(cnt, 1.0)
     if combiner == "sum":
@@ -177,6 +205,11 @@ def _step_per_example(
         combiner,
         compute_dtype,
     )
+    # One fused scatter for positive contexts + noise draws: in per-example
+    # mode each noise draw carries weight ≤ 1 (its collision mask), the same
+    # scale as a positive occurrence, so the configured combiner's duplicate
+    # semantics apply uniformly (the cap binds only when a row is drawn
+    # > _CAP times per batch — the sequential-staleness bound).
     ctx = _apply_row_updates(
         params.ctx,
         jnp.concatenate([contexts, negs.reshape(-1)]),
@@ -195,35 +228,48 @@ def _step_shared(
     params: SGNSParams,
     centers: jax.Array,   # (E,)
     contexts: jax.Array,  # (E,)
-    negs: jax.Array,      # (P,) — one noise pool for the whole batch
+    negs: jax.Array,      # (P,) — noise pool, split into `groups` slices
     k_negatives: int,     # the objective's K (negative-term weight)
+    groups: int,          # sub-batches with independent pool slices
     lr: jax.Array,
     compute_dtype,
     combiner: str,
 ) -> Tuple[SGNSParams, jax.Array]:
     emb_t, ctx_t = params.emb, params.ctx
-    vocab_size = emb_t.shape[0]
+    e, p = centers.shape[0], negs.shape[0]
+    g = groups
     v = emb_t[centers].astype(compute_dtype)      # (E, D)
     u_pos = ctx_t[contexts].astype(compute_dtype) # (E, D)
     u_neg = ctx_t[negs].astype(compute_dtype)     # (P, D)
+    d = v.shape[-1]
 
     pos_logit = jnp.sum(v * u_pos, axis=-1)                     # (E,)
-    neg_logit = v @ u_neg.T                                     # (E, P) — MXU
-    neg_mask = (negs[None, :] != contexts[:, None]).astype(compute_dtype)
+    # Each of the G groups of E/G examples shares only its own P/G pool
+    # slice: one batched (G, E/G, D) x (G, D, P/G) MXU matmul.  G=1 is the
+    # classic single shared pool; the estimator-rank invariant (#3 below)
+    # wants E/G small enough that pool noise stays high-rank.
+    vg = v.reshape(g, e // g, d)
+    u_negg = u_neg.reshape(g, p // g, d)
+    neg_logit = jnp.einsum("ged,gpd->gep", vg, u_negg)          # MXU
+    neg_mask = (
+        negs.reshape(g, 1, p // g) != contexts.reshape(g, e // g, 1)
+    ).astype(compute_dtype)
 
-    # The pool holds P >= K draws for vocab coverage; weighting the mean of
-    # P noise terms by K keeps the SGNS objective's negative-term weight
-    # unchanged in expectation (a K/P importance weight per draw).
-    scale = jnp.asarray(k_negatives / negs.shape[0], compute_dtype)
+    # Each example sees P/G draws; weighting their mean by K keeps the SGNS
+    # objective's negative-term weight unchanged in expectation (a K/(P/G)
+    # importance weight per draw).
+    scale = jnp.asarray(k_negatives * g / p, compute_dtype)
     loss = jax.nn.softplus(-pos_logit) + scale * jnp.sum(
         neg_mask * jax.nn.softplus(neg_logit), axis=-1
-    )
+    ).reshape(e)
     g_pos = jax.nn.sigmoid(pos_logit) - 1.0                     # (E,)
-    g_neg = scale * jax.nn.sigmoid(neg_logit) * neg_mask        # (E, P)
+    g_neg = scale * jax.nn.sigmoid(neg_logit) * neg_mask        # (G, E/G, P/G)
 
-    d_center = g_pos[:, None] * u_pos + g_neg @ u_neg           # (E, D) — MXU
+    d_center = g_pos[:, None] * u_pos + jnp.einsum(
+        "gep,gpd->ged", g_neg, u_negg
+    ).reshape(e, d)                                             # MXU
     d_pos = g_pos[:, None] * v                                  # (E, D)
-    d_negrow = g_neg.T @ v                                      # (P, D) — MXU
+    d_negrow = jnp.einsum("gep,ged->gpd", g_neg, vg).reshape(p, d)  # MXU
 
     emb = _apply_row_updates(
         emb_t,
@@ -234,6 +280,31 @@ def _step_shared(
         combiner,
         compute_dtype,
     )
+    # One fused scatter for positive contexts + pool slots, weighted in
+    # example units (one positive occurrence = 1; one pool slot = its
+    # importance-weighted aggregate, scale·Σ masks ≈ E·K/P units).  Two
+    # measured invariants govern this design (docs/QUALITY_NOTES.md):
+    #
+    # 1. SYMMETRY — a row's positive and negative gradients must shrink by
+    #    the SAME divisor.  Weakening only the negatives — round 2 divided
+    #    pool slots ~80x via example-unit capping of ~2,560-unit slots; an
+    #    intermediate design pre-divided noise by its expected load —
+    #    freezes the loss at init or collapses all vectors onto one ray
+    #    (planted-cluster inter-cluster cosine 0.97 vs 0.40 healthy).
+    #    The fused accumulator applies one divisor per row to the sum of
+    #    both, exactly like the per-example path.
+    # 2. GRANULARITY — the divisor tracks example-unit load smoothly only
+    #    if one slot carries few units, so the pool is sized at
+    #    P = 0.8·E·K (~1.25-unit slots; see sgns_step).  Slots at ~_CAP
+    #    units make the divisor jump integer multiples of the cap per
+    #    draw, mean-ing every multi-slot row (measured −0.1 holdout AUC
+    #    on the real corpus vs per-example draws).
+    # 3. RANK — one pool shared by the whole batch repels ctx rows only
+    #    along the span of σ-weighted batch means; that low-rank repulsion
+    #    lets the bulk geometry contract (planted-cluster inter-cluster
+    #    cosine drifts 0.56 → 0.82 over 20 epochs at E=2048, G=1, while
+    #    per-example draws hold 0.41).  Grouped pools restore estimator
+    #    rank at MXU-friendly shapes.
     ctx = _apply_row_updates(
         ctx_t,
         jnp.concatenate([contexts, negs]),
@@ -244,7 +315,7 @@ def _step_shared(
                 # f32 reduction: a bf16 sum of ones saturates at 256, which
                 # would defeat the capped divisor for hot pool rows
                 scale.astype(jnp.float32)
-                * neg_mask.sum(axis=0, dtype=jnp.float32),
+                * neg_mask.sum(axis=1, dtype=jnp.float32).reshape(p),
             ]
         ),
         lr,
@@ -265,15 +336,59 @@ def sgns_step(
     compute_dtype=jnp.float32,
     combiner: str = "capped",
     negative_mode: str = "shared",
-    shared_pool: int = 64,
+    shared_pool: int = 1024,
+    shared_pool_auto: bool = True,
+    shared_groups: int = 0,
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
     centers, contexts = _examples_from_pairs(pairs, both_directions)
     if negative_mode == "shared":
-        pool = max(negatives, shared_pool)
-        negs = sample_negatives(noise, key, (pool,))
+        e = int(centers.shape[0])
+        # groups of ~32 examples, each with its own pool slice (estimator-
+        # rank invariant, _step_shared #3: sub-batch 32 measured at parity
+        # with per-example draws on holdout AUC and planted-cluster
+        # separation; larger groups trade quality for throughput — see
+        # docs/QUALITY_NOTES.md frontier table); G must divide E
+        if shared_groups > 0:
+            g = shared_groups
+            if e % g:
+                raise ValueError(
+                    f"shared_groups={g} does not divide the example count "
+                    f"{e} (= {'2x' if both_directions else ''}batch_pairs)"
+                )
+        else:
+            g = max(1, e // 32)
+            while e % g:
+                g -= 1
+            if e // g > 256 and e > 256:
+                import warnings
+
+                warnings.warn(
+                    f"batch example count {e} has no divisor near e/32; "
+                    f"falling back to {g} pool group(s) of {e // g} "
+                    "examples, which degrades embedding quality (see "
+                    "sgns/step.py invariant 3).  Use a batch_pairs "
+                    "divisible by 32.",
+                    stacklevel=2,
+                )
+        per_group = max(negatives, -(-max(shared_pool, 1) // g))
+        if shared_pool_auto:
+            # quality parity with per-example draws needs a total pool of
+            # P = _SHARED_DRAW_FRACTION * E * K independent draws (see the
+            # constant's measurement note); this also keeps one slot's
+            # aggregated gradient to ~K/fraction ≈ 6 example units, well
+            # under the capped combiner's granularity needs (invariant 2)
+            per_group = max(
+                per_group,
+                math.ceil(_SHARED_DRAW_FRACTION * (e // g) * negatives),
+            )
+        # round up to the f32 sublane width; memory traffic and scatter
+        # rows scale with the true pool size, so no 128-lane padding here
+        per_group = 8 * -(-per_group // 8)
+        negs = sample_negatives(noise, key, (g * per_group,))
         return _step_shared(
-            params, centers, contexts, negs, negatives, lr, compute_dtype, combiner
+            params, centers, contexts, negs, negatives, g, lr,
+            compute_dtype, combiner,
         )
     if negative_mode != "per_example":
         raise ValueError(f"unknown negative_mode {negative_mode!r}")

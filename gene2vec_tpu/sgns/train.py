@@ -80,6 +80,8 @@ def make_train_epoch(
                 combiner=config.combiner,
                 negative_mode=config.negative_mode,
                 shared_pool=config.shared_pool,
+                shared_pool_auto=config.shared_pool_auto,
+                shared_groups=config.shared_groups,
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)

@@ -186,9 +186,13 @@ def test_shared_pool_positive_updates_not_crushed():
     )
     noise = _uniform_noise(V)
     key = jax.random.PRNGKey(0)
+    # shared_pool_auto=False keeps the small explicit pools this test is
+    # about — auto sizing would override both to the same parity pool and
+    # make the comparison vacuous
     p1, _ = sgns_step(
         params, jnp.asarray(pairs), noise, key, 0.05,
         both_directions=False, negative_mode="shared", shared_pool=64,
+        shared_pool_auto=False, shared_groups=1,
     )
     # token 7 occurs B=512 times as positive context → capped divisor ≈ B/32;
     # the pool's extra weight is only ~ (5/64)·512·(64/V) ≈ tiny vs B. The
@@ -198,6 +202,7 @@ def test_shared_pool_positive_updates_not_crushed():
     p_ref, _ = sgns_step(
         params, jnp.asarray(pairs), noise, key, 0.05,
         both_directions=False, negative_mode="shared", shared_pool=5,
+        shared_pool_auto=False, shared_groups=1,
     )
     delta_ref = float(jnp.linalg.norm(p_ref.ctx[7] - params.ctx[7]))
     assert delta > 0.25 * delta_ref
