@@ -1,9 +1,18 @@
-"""Headline benchmark: SGNS training throughput (gene-pairs/sec).
+"""Headline benchmark: SGNS training throughput (gene-pairs/sec), gated on
+embedding quality.
 
 Prints exactly ONE JSON line on stdout:
     {"metric": "sgns_pairs_per_sec", "value": N, "unit": "pairs/s",
      "vs_baseline": N, "vs_32thread_equiv": N, "baseline_1core": N,
-     "secondary": {...}}
+     "quality": {...}, "secondary": {...}}
+
+Quality gate (VERDICT round-2 item 3): before any throughput is reported,
+the HEADLINE configuration must demonstrably learn — loss escapes its init
+plateau, planted clusters separate without collapse, and (when the
+reference predictionData is present) holdout link-prediction AUC reaches
+the sequential-oracle ballpark.  A failing gate withholds the headline
+(value 0.0, exit 1): round 2 posted 6.9M pairs/s from a configuration
+whose loss never moved, and that must be structurally impossible now.
 
 Baseline honesty (round-2, VERDICT item 3): ``vs_baseline`` divides by the
 *measured* native C++ Hogwild SGNS rate on this host's cores (the same
@@ -63,8 +72,10 @@ def synth_corpus(vocab_size: int, num_pairs: int, seed: int = 0):
 
 def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
     """Steady-state epoch throughput: warmup epochs excluded, each timed
-    epoch synced via a scalar transfer, best-of-timed returned (the device
-    is a shared queue; the best repetition is the least-contended one)."""
+    epoch synced via a scalar transfer, MEDIAN of the timed epochs returned
+    (round-2 advisor: best-of-N is the most flattering defensible statistic;
+    the median is the conventional honest headline — all repetitions are
+    logged to stderr)."""
     import jax
 
     params = trainer.init()
@@ -85,7 +96,7 @@ def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
         + ", ".join(f"{r:,.0f}" for r in rates)
         + f" pairs/s; final loss {float(loss):.4f}"
     )
-    return max(rates)
+    return float(np.median(rates))
 
 
 def measure_pairs_per_sec(
@@ -214,17 +225,108 @@ def _ggipnn_rate(n_pairs: int = 262144, batch: int = 1024) -> float:
     key = jax.random.PRNGKey(0)
     # epoch 1 compiles, epoch 2 pays donated-buffer relayout; time epoch 3
     for w in range(2):
-        params, opt_state, loss, _ = trainer._fit_epoch_scanned(
-            params, opt_state, xj, yj, num_batches, jax.random.fold_in(key, w)
+        params, opt_state, loss, _ = trainer.fit_epoch(
+            params, opt_state, xj, yj, jax.random.fold_in(key, w)
         )
         float(loss)
     t0 = time.perf_counter()
-    params, opt_state, loss, _ = trainer._fit_epoch_scanned(
-        params, opt_state, xj, yj, num_batches, jax.random.fold_in(key, 9)
+    params, opt_state, loss, _ = trainer.fit_epoch(
+        params, opt_state, xj, yj, jax.random.fold_in(key, 9)
     )
     float(loss)
     dt = time.perf_counter() - t0
     return num_batches * batch / dt
+
+
+def quality_gate(dim: int, batch_pairs: int, data_dir: str) -> dict:
+    """Verify the HEADLINE configuration learns before any throughput is
+    reported (VERDICT round-2 item 3: a flat-loss run must not produce a
+    headline number).
+
+    Checks, at the same ``--dim``/``--batch`` the throughput number uses:
+
+    * holdout link-prediction: SGNS at (dim, batch_pairs) on the canonical
+      seen-gene protocol (gene2vec_tpu/eval/holdout.py); in-vocab cosine
+      AUC >= GATE_MIN_AUC (frozen next to the oracle reference in that
+      module), and its loss escapes the init plateau ln2·(1+K) (freeze
+      guard).  This is the strongest check; when ``data_dir`` is missing
+      it is recorded as SKIPPED — visibly, never as a silent pass.
+    * planted clusters separate (collapse guard, thresholds frozen in
+      gene2vec_tpu/eval/planted.py — QUALITY_NOTES §2 lists designs that
+      pass any intra-only check while inter drifts to 0.97).  The planted
+      corpus is 20k pairs, so the trainer auto-shrinks large batches; this
+      check covers small-batch dynamics, the holdout check covers the
+      headline batch size.
+    """
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.eval.holdout import (
+        GATE_MIN_AUC,
+        ORACLE_COS_AUC,
+        holdout_cos_auc,
+        load_holdout,
+    )
+    from gene2vec_tpu.eval.planted import (
+        INTER_MAX,
+        INTRA_MIN,
+        cluster_cosines,
+        planted_corpus,
+    )
+    from gene2vec_tpu.sgns.train import train_epochs
+
+    out = {}
+    init_plateau = float(np.log(2.0) * (1 + SGNSConfig().negatives))
+
+    def _fin(x, places):
+        # round() propagates NaN, and json.dumps would then emit a literal
+        # NaN token — invalid JSON on the one stdout line the contract
+        # guarantees, on exactly the diverged run the gate exists to report
+        return round(float(x), places) if np.isfinite(x) else "diverged"
+
+    # -- strongest check: real-data holdout AUC at the HEADLINE config ----
+    if os.path.isdir(data_dir):
+        hcorpus, split = load_holdout(data_dir)
+        emb, losses = train_epochs(
+            hcorpus, SGNSConfig(dim=dim, batch_pairs=batch_pairs), 50
+        )
+        out["loss_first"] = _fin(losses[0], 4)
+        out["loss_last"] = _fin(losses[-1], 4)
+        out["loss_decreasing"] = bool(losses[-1] < init_plateau - 1.0)
+        auc = (
+            holdout_cos_auc(hcorpus.vocab, emb, split)
+            if np.isfinite(emb).all()
+            else float("nan")
+        )
+        out["holdout_cos_auc"] = _fin(auc, 4)
+        out["holdout_oracle"] = ORACLE_COS_AUC
+        auc_ok = bool(auc >= GATE_MIN_AUC)
+    else:
+        out["holdout_cos_auc"] = f"SKIPPED — {data_dir} not present"
+        auc_ok = True  # recorded as skipped above, never a silent pass
+
+    # -- collapse guard: planted clusters (small corpus, auto-shrunk batch)
+    vocab, corpus = planted_corpus()
+    emb, losses = train_epochs(
+        corpus, SGNSConfig(dim=64, batch_pairs=min(batch_pairs, 1024)), 15
+    )
+    if "loss_decreasing" not in out:  # holdout check skipped
+        out["loss_first"] = _fin(losses[0], 4)
+        out["loss_last"] = _fin(losses[-1], 4)
+        out["loss_decreasing"] = bool(losses[-1] < init_plateau - 1.0)
+
+    if np.isfinite(emb).all():
+        intra, inter = cluster_cosines(vocab, emb)
+    else:
+        intra = inter = float("nan")
+    out["planted_intra"] = _fin(intra, 3)
+    out["planted_inter"] = _fin(inter, 3)
+
+    out["passed"] = bool(
+        out["loss_decreasing"]
+        and intra > INTRA_MIN
+        and inter < INTER_MAX
+        and auc_ok
+    )
+    return out
 
 
 def main() -> None:
@@ -236,7 +338,31 @@ def main() -> None:
     ap.add_argument("--cpu-pairs", type=int, default=200_000)
     ap.add_argument("--secondary-pairs", type=int, default=1_000_000)
     ap.add_argument("--no-secondary", action="store_true")
+    ap.add_argument("--no-quality-gate", action="store_true",
+                    help="skip the quality gate (exploration only; the "
+                    "recorded headline must carry it)")
+    ap.add_argument("--data-dir", default="/root/reference/predictionData",
+                    help="reference predictionData for the gate's real-"
+                    "data AUC check (recorded as SKIPPED when absent)")
     args = ap.parse_args()
+
+    quality = {}
+    if not args.no_quality_gate:
+        log("=== quality gate (headline config must learn) ===")
+        quality = quality_gate(args.dim, args.batch, args.data_dir)
+        log(f"quality: {quality}")
+        if not quality["passed"]:
+            # No headline for a trainer that does not learn (round-2
+            # verdict: "fast and wrong is wrong").
+            print(json.dumps({
+                "metric": "sgns_pairs_per_sec",
+                "value": 0.0,
+                "unit": "pairs/s",
+                "vs_baseline": 0.0,
+                "quality": quality,
+                "error": "quality gate FAILED — throughput withheld",
+            }))
+            sys.exit(1)
 
     tpu_rate = measure_pairs_per_sec(args.dim, args.vocab, args.pairs, args.batch)
 
@@ -275,6 +401,8 @@ def main() -> None:
         "vs_32thread_equiv": round(vs32, 2) if vs32 else None,
         "baseline_1core": round(base1, 1) if base1 else None,
     }
+    if quality:
+        result["quality"] = quality
     if secondary:
         result["secondary"] = secondary
     print(json.dumps(result))

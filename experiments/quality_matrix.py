@@ -1,0 +1,227 @@
+"""Reproduce the measurements behind docs/QUALITY_NOTES.md.
+
+Three suites, each selectable with ``--suite``:
+
+* ``matrix``  — {negative_mode} x {combiner} x {batch, pool} on the real
+  corpus holdout protocol (QUALITY_NOTES §2's failed-repair table and §4's
+  P_total sweep).
+* ``groups``  — group-size sweep at fixed total pool (the "quality is flat
+  in group size" claim) plus the planted-cluster collapse metric
+  (invariant 3).
+* ``frontier`` — the quality/throughput frontier (§5) on an 8M-pair
+  Zipf-ish synthetic corpus, one real chip.
+
+Protocol (QUALITY_NOTES §1): hold out 20% of the reference train split's
+pairs, train SGNS on the remaining positives, and rank held-out *in-vocab*
+pairs by embedding cosine (the classifier-free, harder metric; the GGIPNN
+stage lives in scripts/run_real_auc.py).
+
+Usage::
+
+    python experiments/quality_matrix.py --suite matrix [--epochs 50]
+        [--data-dir /root/reference/predictionData] [--out -]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from gene2vec_tpu.config import SGNSConfig  # noqa: E402
+from gene2vec_tpu.data.pipeline import PairCorpus  # noqa: E402
+from gene2vec_tpu.eval.holdout import (  # noqa: E402
+    HoldoutSplit,
+    holdout_cos_auc,
+    load_holdout,
+)
+from gene2vec_tpu.eval.planted import (  # noqa: E402
+    cluster_cosines,
+    planted_corpus,
+)
+from gene2vec_tpu.io.vocab import Vocab  # noqa: E402
+from gene2vec_tpu.sgns.train import SGNSTrainer, train_epochs  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# -- protocol pieces ---------------------------------------------------------
+
+
+def holdout_auc(vocab: Vocab, emb: np.ndarray, split: HoldoutSplit):
+    """In-vocab holdout cosine AUC, or None when the embedding diverged
+    (round(nan) would otherwise leak literal NaN into the JSON output)."""
+    if not np.isfinite(emb).all():
+        return None
+    return round(holdout_cos_auc(vocab, emb, split), 4)
+
+
+def train(corpus: PairCorpus, cfg: SGNSConfig, epochs: int):
+    """Returns (emb, first loss, last loss) via the canonical shared loop
+    (gene2vec_tpu.sgns.train.train_epochs — same seeding as the bench gate
+    and the regression tests)."""
+    emb, losses = train_epochs(corpus, cfg, epochs)
+    return emb, losses[0], losses[-1]
+
+
+def train_timed(corpus: PairCorpus, cfg: SGNSConfig, epochs: int):
+    """Like train() but also measures post-compile wall time (the frontier
+    suite's throughput column needs interleaved blocking)."""
+    tr = SGNSTrainer(corpus, cfg)
+    params = tr.init()
+    losses = []
+    t0 = None
+    for it in range(1, epochs + 1):
+        params, loss = tr.train_epoch(
+            params, jax.random.fold_in(jax.random.PRNGKey(cfg.seed), it)
+        )
+        losses.append(float(loss))
+        if it == 1:
+            jax.block_until_ready(params.emb)
+            t0 = time.perf_counter()
+    jax.block_until_ready(params.emb)
+    dt = time.perf_counter() - t0 if epochs > 1 else float("nan")
+    return np.asarray(params.emb), losses[0], losses[-1], dt
+
+
+def synthetic_big(v=24000, n=8_000_000, seed=0):
+    rng = np.random.RandomState(seed)
+    p = np.arange(1, v + 1) ** -0.8
+    p /= p.sum()
+    pairs = rng.choice(v, size=(n, 2), p=p).astype(np.int32)
+    vocab = Vocab(
+        [f"G{i}" for i in range(v)], np.bincount(pairs.reshape(-1), minlength=v)
+    )
+    return PairCorpus(vocab, pairs)
+
+
+# -- suites ------------------------------------------------------------------
+
+
+def suite_matrix(args) -> list:
+    corpus, split = load_holdout(args.data_dir)
+    rows = []
+    configs = [
+        ("default shared+capped B=4096 auto", dict()),
+        ("shared+capped B=16384 auto", dict(batch_pairs=16384)),
+        ("per_example+capped B=4096", dict(negative_mode="per_example")),
+        ("per_example+sum B=1024", dict(negative_mode="per_example",
+                                        combiner="sum", batch_pairs=1024)),
+        ("shared+sum B=4096 auto", dict(combiner="sum")),
+        ("shared+mean B=4096 auto", dict(combiner="mean")),
+        # the round-2 failure shape: tiny pool, example-unit capping
+        ("round2: shared+capped B=16384 P=64",
+         dict(batch_pairs=16384, shared_pool=64, shared_pool_auto=False)),
+        # the P_total sweep (fractions of E*K at B=4096, E=8192)
+        ("P=0.2*E*K", dict(shared_pool=8192, shared_pool_auto=False,
+                           shared_groups=256)),
+        ("P=0.4*E*K", dict(shared_pool=16384, shared_pool_auto=False,
+                           shared_groups=256)),
+        ("P=0.8*E*K (auto point)", dict(shared_pool=32768,
+                                        shared_pool_auto=False,
+                                        shared_groups=256)),
+    ]
+    for name, kw in configs:
+        cfg = SGNSConfig(dim=200, num_iters=args.epochs, **kw)
+        emb, l0, l1 = train(corpus, cfg, args.epochs)
+        auc = holdout_auc(corpus.vocab, emb, split)
+        rows.append(
+            {"config": name, "loss_first": round(l0, 4),
+             "loss_last": round(l1, 4) if np.isfinite(l1) else "diverged",
+             "holdout_cos_auc": auc}
+        )
+        log(f"{name:42s} loss {l0:.3f}->{l1:.3f} AUC {auc}")
+    return rows
+
+
+def suite_groups(args) -> list:
+    corpus, split = load_holdout(args.data_dir)
+    vocab_p, corpus_p = planted_corpus()
+    rows = []
+    for sub in (32, 64, 128, 256):
+        # fixed total pool P = 4E on both corpora
+        cfg = SGNSConfig(dim=200, num_iters=args.epochs,
+                         shared_groups=8192 // sub, shared_pool=32768,
+                         shared_pool_auto=False)
+        emb, _, l1 = train(corpus, cfg, args.epochs)
+        auc = holdout_auc(corpus.vocab, emb, split)
+        cfg_p = SGNSConfig(dim=64, num_iters=20, batch_pairs=1024,
+                           shared_groups=2048 // sub, shared_pool=8192,
+                           shared_pool_auto=False)
+        emb_p, _, _ = train(corpus_p, cfg_p, 20)
+        intra, inter = cluster_cosines(vocab_p, emb_p)
+        rows.append({"sub_batch": sub, "holdout_cos_auc": auc,
+                     "planted_intra": round(intra, 3),
+                     "planted_inter": round(inter, 3)})
+        log(f"sub={sub}: AUC {auc} intra {intra:.3f} inter {inter:.3f}")
+    return rows
+
+
+def suite_frontier(args) -> list:
+    corpus = synthetic_big()
+    corpus_r, split = load_holdout(args.data_dir)
+    rows = []
+    configs = [
+        ("default (P=0.8*E*K)", dict()),
+        ("P=0.4*E*K", dict(shared_pool=65536, shared_pool_auto=False,
+                           shared_groups=1024)),
+        ("P=0.2*E*K", dict(shared_pool=32768, shared_pool_auto=False,
+                           shared_groups=1024)),
+        ("per_example", dict(negative_mode="per_example")),
+        ("round2 broken (P=64)", dict(shared_pool=64,
+                                      shared_pool_auto=False)),
+    ]
+    for name, kw in configs:
+        cfg = SGNSConfig(dim=200, num_iters=3, batch_pairs=16384, **kw)
+        _, _, _, dt = train_timed(corpus, cfg, 3)
+        rate = 2 * (corpus.num_pairs // 16384) * 16384 / dt
+        # quality on the real corpus at the same relative pool settings
+        cfg_r = SGNSConfig(dim=200, num_iters=args.epochs, **{
+            **kw,
+            **({"shared_pool": kw["shared_pool"] // 4,
+                "shared_groups": 256}
+               if "shared_pool" in kw and kw["shared_pool"] > 64 else {}),
+        })
+        emb, l0, l1 = train(corpus_r, cfg_r, args.epochs)
+        auc = holdout_auc(corpus_r.vocab, emb, split)
+        rows.append({"config": name, "pairs_per_sec_M": round(rate / 1e6, 2),
+                     "holdout_cos_auc": auc,
+                     "loss_last": round(l1, 4) if np.isfinite(l1) else "div"})
+        log(f"{name:24s} {rate/1e6:6.2f}M pairs/s AUC {auc}")
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--suite", choices=("matrix", "groups", "frontier"),
+                    default="matrix")
+    ap.add_argument("--epochs", type=int, default=50)
+    ap.add_argument("--data-dir", default="/root/reference/predictionData")
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args()
+
+    rows = {"matrix": suite_matrix, "groups": suite_groups,
+            "frontier": suite_frontier}[args.suite](args)
+    payload = json.dumps({"suite": args.suite, "epochs": args.epochs,
+                          "rows": rows}, indent=1)
+    if args.out == "-":
+        print(payload)
+    else:
+        with open(args.out, "w") as f:
+            f.write(payload)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,15 @@
-"""Evaluation suite: intrinsic target function, extrinsic AUC, parity harness."""
+"""Evaluation suite: intrinsic target function, extrinsic AUC, parity
+harness, and the canonical seen-gene holdout protocol."""
 
+from gene2vec_tpu.eval.holdout import (  # noqa: F401
+    GATE_MIN_AUC,
+    HOLDOUT_FRACTION,
+    HOLDOUT_SEED,
+    ORACLE_COS_AUC,
+    HoldoutSplit,
+    holdout_cos_auc,
+    holdout_split,
+    load_holdout,
+    read_split,
+)
 from gene2vec_tpu.eval.metrics import roc_auc_score  # noqa: F401

@@ -1,0 +1,70 @@
+"""THE planted-cluster collapse check — shared by ``bench.py``'s quality
+gate, ``experiments/quality_matrix.py``, and
+``tests/test_quality_regression.py`` so all three measure the same thing
+(the check exists because designs can pass any intra-only criterion while
+inter-cluster cosine drifts to ~1 — docs/QUALITY_NOTES.md §2-§3).
+
+A corpus of ``n_clusters`` disjoint gene cliques trained with the default
+config must yield intra-cluster cosine > INTRA_MIN while inter-cluster
+cosine stays < INTER_MAX.  Constants are frozen here; changing them
+re-calibrates the bench gate, the experiment tables, and the regression
+tests at once rather than silently forking them.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Tuple
+
+import numpy as np
+
+N_CLUSTERS = 10
+N_GENES = 20
+PAIRS_PER_CLUSTER = 2000
+INTRA_MIN = 0.95
+INTER_MAX = 0.6
+
+
+def planted_corpus(
+    n_clusters: int = N_CLUSTERS,
+    n_genes: int = N_GENES,
+    pairs_per: int = PAIRS_PER_CLUSTER,
+    seed: int = 0,
+):
+    """(vocab, PairCorpus) of ``n_clusters`` disjoint gene cliques."""
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+
+    rng = np.random.RandomState(seed)
+    lines = []
+    for c in range(n_clusters):
+        genes = [f"C{c}G{i}" for i in range(n_genes)]
+        for _ in range(pairs_per):
+            a, b = rng.choice(n_genes, 2, replace=False)
+            lines.append((genes[a], genes[b]))
+    vocab = Vocab.from_pairs(lines)
+    return vocab, PairCorpus(vocab, vocab.encode_pairs(lines))
+
+
+def cluster_cosines(
+    vocab,
+    emb: np.ndarray,
+    n_clusters: int = N_CLUSTERS,
+    n_genes: int = N_GENES,
+) -> Tuple[float, float]:
+    """(mean intra-cluster cosine, mean inter-cluster cosine)."""
+    m = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9)
+    idx = vocab.token_to_id
+    rng = np.random.RandomState(1)
+    intra, inter = [], []
+    for c in range(n_clusters):
+        rows = [idx[f"C{c}G{i}"] for i in range(8)]
+        for a, b in itertools.combinations(rows, 2):
+            intra.append(m[a] @ m[b])
+    for _ in range(500):
+        c1, c2 = rng.choice(n_clusters, 2, replace=False)
+        inter.append(
+            m[idx[f"C{c1}G{rng.randint(n_genes)}"]]
+            @ m[idx[f"C{c2}G{rng.randint(n_genes)}"]]
+        )
+    return float(np.mean(intra)), float(np.mean(inter))

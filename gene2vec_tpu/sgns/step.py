@@ -356,11 +356,31 @@ def sgns_step(
                     f"shared_groups={g} does not divide the example count "
                     f"{e} (= {'2x' if both_directions else ''}batch_pairs)"
                 )
+            if not shared_pool_auto and shared_pool < g * negatives:
+                # every group needs at least `negatives` draws; a pool
+                # below g*K cannot be honored even before sublane rounding,
+                # and silently inflating it would mislabel experiments (an
+                # 'explicit P=64' run must not measure a 128-draw pool).
+                # Pools >= g*K are realizable within sublane rounding and
+                # fall through to the warn-and-adjust path below.
+                raise ValueError(
+                    f"shared_pool={shared_pool} cannot be split across "
+                    f"shared_groups={g} groups of at least {negatives} "
+                    "draws each; lower shared_groups or raise shared_pool"
+                )
         else:
             g = max(1, e // 32)
+            if not shared_pool_auto:
+                # an explicit small pool (the documented degraded-throughput
+                # escape hatch) must be honored: cap the group count so the
+                # per-group floor (negatives rounded up to the 8-sublane
+                # width) cannot silently inflate the total pool past the
+                # request beyond that minimum slice
+                slice_min = 8 * -(-negatives // 8)
+                g = max(1, min(g, shared_pool // slice_min))
             while e % g:
                 g -= 1
-            if e // g > 256 and e > 256:
+            if shared_pool_auto and e // g > 256 and e > 256:
                 import warnings
 
                 warnings.warn(
@@ -377,14 +397,30 @@ def sgns_step(
             # P = _SHARED_DRAW_FRACTION * E * K independent draws (see the
             # constant's measurement note); this also keeps one slot's
             # aggregated gradient to ~K/fraction ≈ 6 example units, well
-            # under the capped combiner's granularity needs (invariant 2)
+            # under the capped combiner's granularity needs (invariant 2).
+            # shared_pool is a FLOOR here, so round up to the f32 sublane
+            # width (memory traffic and scatter rows scale with the true
+            # pool size — no 128-lane padding).
             per_group = max(
                 per_group,
                 math.ceil(_SHARED_DRAW_FRACTION * (e // g) * negatives),
             )
-        # round up to the f32 sublane width; memory traffic and scatter
-        # rows scale with the true pool size, so no 128-lane padding here
-        per_group = 8 * -(-per_group // 8)
+            per_group = 8 * -(-per_group // 8)
+        else:
+            # explicit pool: honor the request from above — round DOWN to
+            # the sublane width, never below the minimum slice
+            per_group = max(8 * -(-negatives // 8), 8 * (per_group // 8))
+            if g * per_group != shared_pool:
+                import warnings
+
+                warnings.warn(
+                    f"explicit shared_pool={shared_pool} adjusted to "
+                    f"{g * per_group} ({g} groups x {per_group}-draw "
+                    "slices; slices are sublane-rounded and at least "
+                    "`negatives` wide) — record the adjusted size when "
+                    "labeling experiments",
+                    stacklevel=2,
+                )
         negs = sample_negatives(noise, key, (g * per_group,))
         return _step_shared(
             params, centers, contexts, negs, negatives, g, lr,

@@ -96,6 +96,24 @@ def make_train_epoch(
     return jax.jit(train_epoch, donate_argnums=donate)
 
 
+def train_epochs(corpus: PairCorpus, config: SGNSConfig, epochs: int):
+    """Convenience loop shared by the quality tooling (bench gate,
+    experiments/quality_matrix.py, tests) so they all train identically:
+    fresh init, one epoch per iteration keyed by fold_in(seed, it).
+
+    Returns (final emb as numpy, per-epoch loss list).
+    """
+    trainer = SGNSTrainer(corpus, config)
+    params = trainer.init()
+    losses = []
+    for it in range(1, epochs + 1):
+        params, loss = trainer.train_epoch(
+            params, jax.random.fold_in(jax.random.PRNGKey(config.seed), it)
+        )
+        losses.append(float(loss))
+    return np.asarray(params.emb), losses
+
+
 class SGNSTrainer:
     """End-to-end trainer over an encoded :class:`PairCorpus`."""
 

@@ -1,30 +1,46 @@
-"""Produce the real GGIPNN ROC-AUC on the reference's predictionData splits
-(train 263,016 / valid 5,568 / test 21,448 gene pairs — the evaluation the
-reference scores at ``src/GGIPNN_Classification.py:246-254``).
+"""Real-data GGIPNN ROC-AUC on the reference's predictionData, under two
+protocols, writing ``REAL_AUC.json`` at the repo root.
 
-Two configurations are recorded (VERDICT round-1, item 2):
+**Why two protocols.** The reference's train/valid/test splits are
+*pairwise gene-disjoint* — zero genes are shared between any two splits
+(train 8,832 genes, valid 1,173, test 2,467; all intersections empty —
+verified by this script, recorded in the output).  The GGIPNN harness
+backfills unseen genes with random U(−0.25, 0.25) rows
+(``/root/reference/src/GGIPNN_util.py:6-14``), so an embedding trained on
+any in-repo corpus carries *no information* about test-split genes: the
+published test AUC ≈ 0.7+ is only reachable with the pretrained GEO
+co-expression embedding (24k-gene coverage) that the reference does not
+distribute (``.MISSING_LARGE_BLOBS``).  Scoring a train-split-trained
+embedding on that test split — round 2's protocol — measures nothing but
+chance, whatever the embedding's quality.
 
-1. **random-init embedding** — ``use_pre_trained_gene2vec=False`` path
-   (SURVEY §2.2 #13): the table keeps its random-uniform init and trains
-   frozen=False... the reference keeps the table *trainable* in that path
-   only implicitly; here we mirror the reference default (frozen table,
-   embed_train=False) with a random table, the honest lower bound.
-2. **self-trained embedding** — an SGNS embedding trained by this
-   framework on the positive train-split pairs (label==1), exported in
-   word2vec format and loaded frozen, mirroring the published-artifact
-   flow.  NOTE: the reference's published embedding was trained on a
-   984-dataset GEO co-expression corpus that is not distributed with the
-   repo (``.MISSING_LARGE_BLOBS``); the positive-pair corpus is the
-   closest in-repo reproducible stand-in.
+1. **reference protocol** (structural control): the reference's exact flow
+   (``src/GGIPNN_Classification.py:40-254``) with (a) a random-init frozen
+   table and (b) a self-trained frozen embedding.  Both are expected to
+   land at AUC ≈ 0.5 on the gene-disjoint test split; they are recorded to
+   document the structure, not to measure embedding quality.
 
-Writes REAL_AUC.json at the repo root and prints one JSON line.
+2. **holdout protocol** (the quality measurement): hold out 20% of the
+   train split's *pairs*, train SGNS on the remaining positives, train the
+   GGIPNN on the remaining pairs with the frozen self-trained embedding,
+   and score the held-out pairs — seen genes, unseen pairs: standard link
+   prediction.  Controls: the same GGIPNN over a random-init frozen table,
+   and a classifier-free cosine ranking.  The native sequential CPU oracle
+   reaches holdout cosine AUC ≈ 0.88 here; the TPU default config matches
+   it (docs/QUALITY_NOTES.md §1, §5).
 
-Usage: python scripts/run_real_auc.py [--data-dir DIR] [--epochs N]
+Usage::
+
+    python scripts/run_real_auc.py [--protocol both|holdout|reference]
+        [--emb-iters 50] [--batch-pairs 4096] [--negative-mode shared]
+        [--combiner capped] [--shared-pool 0] [--shared-groups 0]
+        [--epochs 1] [--data-dir DIR] [--out FILE]
 """
 
 from __future__ import annotations
 
 import argparse
+import csv
 import json
 import os
 import sys
@@ -36,83 +52,247 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from gene2vec_tpu.eval.holdout import (  # noqa: E402
+    HOLDOUT_FRACTION,
+    HOLDOUT_SEED,
+    load_holdout,
+    read_split,
+)
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def train_embedding(train_text: str, out_dir: str, num_iters: int) -> str:
-    """Train SGNS on the positive train pairs; return w2v-format emb path."""
+def _round4(x):
+    """round() that keeps a missing AUC as JSON null, not literal NaN."""
+    return round(x, 4) if x is not None else None
+
+
+def gene_disjointness(data_dir: str) -> dict:
+    """Document the split structure that makes the reference protocol a
+    structural control (all pairwise intersections are empty)."""
+    genes = {}
+    for split in ("train", "valid", "test"):
+        lines, _ = read_split(data_dir, split)
+        genes[split] = set(g for pair in lines for g in pair)
+    return {
+        "genes_per_split": {s: len(g) for s, g in genes.items()},
+        "shared_train_valid": len(genes["train"] & genes["valid"]),
+        "shared_train_test": len(genes["train"] & genes["test"]),
+        "shared_valid_test": len(genes["valid"] & genes["test"]),
+    }
+
+
+def sgns_config(args, dim=200):
     from gene2vec_tpu.config import SGNSConfig
-    from gene2vec_tpu.data.pipeline import PairCorpus
-    from gene2vec_tpu.io.vocab import Vocab
+
+    kw = dict(
+        dim=dim,
+        num_iters=args.emb_iters,
+        batch_pairs=args.batch_pairs,
+        negative_mode=args.negative_mode,
+        combiner=args.combiner,
+        shared_groups=args.shared_groups,
+    )
+    if args.shared_pool > 0:
+        kw.update(shared_pool=args.shared_pool, shared_pool_auto=False)
+    return SGNSConfig(**kw)
+
+
+def train_embedding(corpus, out_dir: str, args) -> str:
+    """Train SGNS on a positive-pair corpus; return the w2v-format export
+    path and record the loss trajectory."""
     from gene2vec_tpu.sgns.train import SGNSTrainer
 
-    labels_path = train_text.replace("_text", "_label")
-    with open(train_text) as f:
-        lines = [l.split() for l in f if l.strip()]
-    with open(labels_path) as f:
-        labels = [int(l) for l in f if l.strip()]
-    pos = [l for l, y in zip(lines, labels) if y == 1]
-    log(f"positive train pairs: {len(pos)} of {len(lines)}")
-
-    vocab = Vocab.from_pairs(pos)
-    corpus = PairCorpus(vocab, vocab.encode_pairs(pos))
-    cfg = SGNSConfig(dim=200, num_iters=num_iters, batch_pairs=16384)
-    trainer = SGNSTrainer(corpus, cfg)
+    cfg = sgns_config(args)
+    log(
+        f"SGNS: {corpus.num_pairs} positive pairs, vocab {corpus.vocab_size}, "
+        f"{cfg.num_iters} iters, B={cfg.batch_pairs}, {cfg.negative_mode}/"
+        f"{cfg.combiner}"
+    )
     t0 = time.perf_counter()
-    trainer.run(out_dir, log=log)
+    SGNSTrainer(corpus, cfg).run(out_dir, log=lambda m: None)
     log(f"SGNS training took {time.perf_counter() - t0:.1f}s")
-    w2v = os.path.join(out_dir, f"gene2vec_dim_200_iter_{num_iters}_w2v.txt")
+    w2v = os.path.join(out_dir, f"gene2vec_dim_{cfg.dim}_iter_{cfg.num_iters}_w2v.txt")
     assert os.path.exists(w2v), w2v
     return w2v
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument(
-        "--data-dir", default="/root/reference/predictionData",
-        help="directory with {train,valid,test}_{text,label}.txt",
-    )
-    ap.add_argument("--epochs", type=int, default=1)  # reference default
-    ap.add_argument("--emb-iters", type=int, default=10)
-    ap.add_argument("--out", default=os.path.join(REPO, "REAL_AUC.json"))
-    args = ap.parse_args()
+def loss_curve(export_dir: str) -> list:
+    with open(os.path.join(export_dir, "training_log.csv")) as f:
+        return [round(float(row["loss"]), 4) for row in csv.DictReader(f)]
 
+
+def cosine_auc(w2v_path: str, pairs, labels) -> dict:
+    """Classifier-free control: rank pairs by embedding cosine.
+
+    Reported twice: over all pairs (out-of-vocab genes score 0 — genes
+    absent from every positive fit pair are themselves a legitimate
+    negative signal) and over the harder in-vocab-only subset, where the
+    ranking must come entirely from learned geometry.
+    """
+    from gene2vec_tpu.eval.holdout import cosine_scores
+    from gene2vec_tpu.eval.metrics import roc_auc_score
+    from gene2vec_tpu.io.emb_io import read_word2vec_format
+
+    toks, mat = read_word2vec_format(w2v_path)
+    idx = {t: i for i, t in enumerate(toks)}
+    labels = np.asarray(labels)
+    scores, in_vocab = cosine_scores(idx, mat, pairs)
+    return {
+        "all_pairs": round(roc_auc_score(labels, scores), 4),
+        "in_vocab_pairs": round(
+            roc_auc_score(labels[in_vocab], scores[in_vocab]), 4
+        ),
+        "in_vocab_count": int(in_vocab.sum()),
+    }
+
+
+def write_splits(dir_, splits) -> None:
+    """Write {name: (lines, labels)} in the reference's directory format."""
+    for name, (lines, labels) in splits.items():
+        with open(os.path.join(dir_, f"{name}_text.txt"), "w") as f:
+            f.writelines(" ".join(p) + "\n" for p in lines)
+        with open(os.path.join(dir_, f"{name}_label.txt"), "w") as f:
+            f.writelines(f"{int(y)}\n" for y in labels)
+
+
+def run_holdout(args, results: dict) -> None:
     from gene2vec_tpu.config import GGIPNNConfig
     from gene2vec_tpu.models.ggipnn_train import run_classification
 
-    results = {}
+    emb_corpus, split = load_holdout(args.data_dir)
+    fit = (split.fit_pairs, split.fit_labels)
+    hold = (split.hold_pairs, split.hold_labels)
+    pos = split.fit_positives
+    # dev slice for GGIPNN training-loop monitoring only: a view of fit
+    # (never of holdout); per the canonical protocol it must NOT shrink
+    # the embedding corpus or the classifier's training set
+    dev_n = min(5000, len(fit[0]) // 10)
+    dev = (fit[0][:dev_n], fit[1][:dev_n])
+    log(
+        f"holdout protocol: fit {len(fit[0])} pairs ({len(pos)} positive), "
+        f"dev view {dev_n}, holdout {len(hold[0])}"
+    )
 
     cfg = GGIPNNConfig(num_epochs=args.epochs)
+    out = {
+        "protocol": {
+            "holdout_fraction": HOLDOUT_FRACTION,
+            "seed": HOLDOUT_SEED,
+            "fit_pairs": len(fit[0]),
+            "holdout_pairs": len(hold[0]),
+            "emb_corpus": "fit-split positive pairs only",
+        }
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        emb_dir = os.path.join(tmp, "emb")
+        os.makedirs(emb_dir)
+        w2v = train_embedding(emb_corpus, emb_dir, args)
+        curve = loss_curve(emb_dir)
+        out["sgns_loss_first"] = curve[0]
+        out["sgns_loss_last"] = curve[-1]
+        out["sgns_loss_decreasing"] = curve[-1] < curve[0] - 1.0
+        log(f"SGNS loss: {curve[0]} -> {curve[-1]}")
+
+        out["cosine_auc"] = cosine_auc(w2v, *hold)
+        log(f"holdout cosine AUC (classifier-free): {out['cosine_auc']}")
+
+        split_dir = os.path.join(tmp, "splits")
+        os.makedirs(split_dir)
+        write_splits(split_dir, {"train": fit, "valid": dev, "test": hold})
+
+        t0 = time.perf_counter()
+        log("=== GGIPNN on holdout, frozen self-trained embedding ===")
+        res = run_classification(split_dir, emb_path=w2v, config=cfg, log=log)
+        out["ggipnn_auc"] = _round4(res.get("auc"))
+        out["ggipnn_accuracy"] = round(res["accuracy"], 4)
+        out["ggipnn_seconds"] = round(time.perf_counter() - t0, 1)
+
+        log("=== GGIPNN on holdout, random-init control ===")
+        res = run_classification(split_dir, emb_path=None, config=cfg, log=log)
+        out["ggipnn_auc_random_init"] = _round4(res.get("auc"))
+    results["holdout"] = out
+
+
+def run_reference(args, results: dict) -> None:
+    """The reference's own gene-disjoint flow — structural controls."""
+    from gene2vec_tpu.config import GGIPNNConfig
+    from gene2vec_tpu.models.ggipnn_train import run_classification
+
+    cfg = GGIPNNConfig(num_epochs=args.epochs)
+    out = {}
     t0 = time.perf_counter()
-    log("=== GGIPNN with random-init table (quirk #13 path) ===")
+    log("=== reference split, random-init table (quirk #13 path) ===")
     res = run_classification(args.data_dir, emb_path=None, config=cfg, log=log)
-    results["random_init"] = {
-        "auc": res.get("auc"), "accuracy": res["accuracy"],
+    out["random_init"] = {
+        "auc": _round4(res.get("auc")),
+        "accuracy": round(res["accuracy"], 4),
         "seconds": round(time.perf_counter() - t0, 1),
     }
 
-    log("=== training SGNS embedding on positive train pairs ===")
+    log("=== reference split, self-trained frozen embedding ===")
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+
+    lines, labels = read_split(args.data_dir, "train")
+    pos = [p for p, y in zip(lines, labels) if y == 1]
+    vocab = Vocab.from_pairs(pos)
+    corpus = PairCorpus(vocab, vocab.encode_pairs(pos))
     with tempfile.TemporaryDirectory() as tmp:
-        w2v = train_embedding(
-            os.path.join(args.data_dir, "train_text.txt"), tmp, args.emb_iters
-        )
+        w2v = train_embedding(corpus, tmp, args)
         t0 = time.perf_counter()
-        log("=== GGIPNN with self-trained frozen embedding ===")
         res = run_classification(args.data_dir, emb_path=w2v, config=cfg, log=log)
-        results["self_trained_emb"] = {
-            "auc": res.get("auc"), "accuracy": res["accuracy"],
+        out["self_trained"] = {
+            "auc": _round4(res.get("auc")),
+            "accuracy": round(res["accuracy"], 4),
             "seconds": round(time.perf_counter() - t0, 1),
         }
+    out["note"] = (
+        "structural control: splits are gene-disjoint, unseen genes get "
+        "random rows, so ~0.5 is the expected ceiling for ANY in-repo-"
+        "trained embedding (see module docstring)"
+    )
+    results["reference_split"] = out
 
-    results["config"] = {
-        "splits": "reference predictionData (263016/5568/21448)",
-        "batch_size": cfg.batch_size,
-        "num_epochs": args.epochs,
-        "embed_train": cfg.embed_train,
-        "emb_corpus": "positive train pairs (GEO corpus not distributed)",
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data-dir", default="/root/reference/predictionData")
+    ap.add_argument(
+        "--protocol", choices=("both", "holdout", "reference"), default="both"
+    )
+    ap.add_argument("--epochs", type=int, default=1,
+                    help="GGIPNN epochs (reference default 1)")
+    ap.add_argument("--emb-iters", type=int, default=50)
+    ap.add_argument("--batch-pairs", type=int, default=4096)
+    ap.add_argument("--negative-mode", choices=("shared", "per_example"),
+                    default="shared")
+    ap.add_argument("--combiner", choices=("capped", "sum", "mean"),
+                    default="capped")
+    ap.add_argument("--shared-pool", type=int, default=0,
+                    help="explicit total pool size (disables auto sizing)")
+    ap.add_argument("--shared-groups", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "REAL_AUC.json"))
+    args = ap.parse_args()
+
+    results = {
+        "data": gene_disjointness(args.data_dir),
+        "sgns_config": {
+            "emb_iters": args.emb_iters,
+            "batch_pairs": args.batch_pairs,
+            "negative_mode": args.negative_mode,
+            "combiner": args.combiner,
+            "shared_pool": args.shared_pool or "auto",
+            "shared_groups": args.shared_groups or "auto",
+        },
     }
+    if args.protocol in ("both", "holdout"):
+        run_holdout(args, results)
+    if args.protocol in ("both", "reference"):
+        run_reference(args, results)
+
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results))

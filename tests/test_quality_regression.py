@@ -16,64 +16,32 @@ Both are pinned here with the *default* SGNSConfig on a planted-cluster
 corpus (the verify recipe's shape: 10 clusters × 20 genes).
 """
 
-import itertools
-
 import numpy as np
 import pytest
 
-import jax
-
 from gene2vec_tpu.config import SGNSConfig
-from gene2vec_tpu.data.pipeline import PairCorpus
-from gene2vec_tpu.io.vocab import Vocab
-from gene2vec_tpu.sgns.train import SGNSTrainer
+from gene2vec_tpu.eval.planted import (
+    INTER_MAX,
+    INTRA_MIN,
+    cluster_cosines,
+    planted_corpus,
+)
+from gene2vec_tpu.sgns.train import train_epochs
 
-N_CLUSTERS, N_GENES, N_PAIRS_PER = 10, 20, 1500
 EPOCHS = 12
 
 
 @pytest.fixture(scope="module")
 def planted():
-    rng = np.random.RandomState(0)
-    lines = []
-    for c in range(N_CLUSTERS):
-        genes = [f"C{c}G{i}" for i in range(N_GENES)]
-        for _ in range(N_PAIRS_PER):
-            a, b = rng.choice(N_GENES, 2, replace=False)
-            lines.append((genes[a], genes[b]))
-    vocab = Vocab.from_pairs(lines)
-    return vocab, PairCorpus(vocab, vocab.encode_pairs(lines))
+    # smaller than the bench gate's corpus (pairs_per) to keep the CPU-mesh
+    # test suite fast; same cliques, same metric, same thresholds
+    return planted_corpus(pairs_per=1500)
 
 
 def _train_default(corpus, epochs=EPOCHS, dim=32, batch_pairs=1024):
+    """The canonical shared loop — identical seeding to the bench gate."""
     cfg = SGNSConfig(dim=dim, num_iters=epochs, batch_pairs=batch_pairs)
-    tr = SGNSTrainer(corpus, cfg)
-    params = tr.init()
-    losses = []
-    for it in range(1, epochs + 1):
-        params, loss = tr.train_epoch(
-            params, jax.random.fold_in(jax.random.PRNGKey(cfg.seed), it)
-        )
-        losses.append(float(loss))
-    return np.asarray(params.emb), losses
-
-
-def _cluster_cosines(vocab, emb):
-    m = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9)
-    idx = vocab.token_to_id
-    rng = np.random.RandomState(1)
-    intra, inter = [], []
-    for c in range(N_CLUSTERS):
-        rows = [idx[f"C{c}G{i}"] for i in range(8)]
-        for a, b in itertools.combinations(rows, 2):
-            intra.append(m[a] @ m[b])
-    for _ in range(400):
-        c1, c2 = rng.choice(N_CLUSTERS, 2, replace=False)
-        inter.append(
-            m[idx[f"C{c1}G{rng.randint(N_GENES)}"]]
-            @ m[idx[f"C{c2}G{rng.randint(N_GENES)}"]]
-        )
-    return float(np.mean(intra)), float(np.mean(inter))
+    return train_epochs(corpus, cfg, epochs)
 
 
 def test_default_config_loss_decreases_not_frozen(planted):
@@ -91,7 +59,7 @@ def test_default_config_geometry_not_collapsed(planted):
     intra-only check while inter drifts to 0.97."""
     vocab, corpus = planted
     emb, _ = _train_default(corpus)
-    intra, inter = _cluster_cosines(vocab, emb)
-    assert intra > 0.95, (intra, inter)
-    assert inter < 0.6, (intra, inter)
+    intra, inter = cluster_cosines(vocab, emb)
+    assert intra > INTRA_MIN, (intra, inter)
+    assert inter < INTER_MAX, (intra, inter)
     assert intra - inter > 0.35, (intra, inter)
