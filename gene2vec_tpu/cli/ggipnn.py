@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--evaluate-every", type=int, default=d.evaluate_every)
     p.add_argument("--checkpoint-every", type=int, default=d.checkpoint_every)
     p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--run-dir", default=None,
+                   help="write a runs/<ts>-style artifact dir (train/dev "
+                   "TensorBoard summaries with grad histograms, keep-5 "
+                   "step checkpoints) at the reference cadence; slower "
+                   "than the default scanned fast path")
     return p
 
 
@@ -59,7 +64,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     from gene2vec_tpu.models.ggipnn_train import run_classification
 
-    run_classification(args.data_dir, args.emb, config)
+    run_classification(args.data_dir, args.emb, config, run_dir=args.run_dir)
     return 0
 
 

@@ -1,0 +1,113 @@
+"""GGIPNN run-directory observability — ``runs/<timestamp>/`` parity.
+
+The reference writes, per run (``src/GGIPNN_Classification.py:130-163``):
+
+* ``summaries/train``: loss + accuracy scalars and, for every variable
+  with a gradient, a gradient histogram and a gradient-sparsity
+  (zero-fraction) scalar, all merged per training step;
+* ``summaries/dev``: loss + accuracy scalars at the evaluation cadence;
+* ``checkpoints/``: a ``tf.train.Saver`` snapshot every
+  ``checkpoint_every`` steps keeping the ``max_to_keep=5`` most recent.
+
+:class:`GGIPNNRun` reproduces that layout.  Scalars/histograms go through
+tensorboardX when installed; a ``metrics.csv`` per writer is always
+written (the in-repo convention, ``utils/metrics.py``), so the artifacts
+exist — and tests can assert on them — without the optional dependency.
+Checkpoints are flat ``.npz`` files of the param pytree (loadable with
+:func:`load_checkpoint`), pruned to the most recent ``max_to_keep``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from gene2vec_tpu.utils.metrics import MetricsLogger
+
+
+def _flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in params.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, path + "/"))
+        else:
+            out[path] = np.asarray(v)
+    return out
+
+
+def load_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Flat ``{'dense1/kernel': array, ...}`` dict from a run checkpoint."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+class GGIPNNRun:
+    """One training run's artifact directory (reference ``runs/<ts>/``).
+
+    Parameters mirror the reference flags: ``max_to_keep`` is
+    ``num_checkpoints`` (default 5, ``src/GGIPNN_Classification.py:24``).
+    """
+
+    def __init__(self, out_dir: Optional[str] = None, max_to_keep: int = 5,
+                 base_dir: str = "runs"):
+        if out_dir is None:
+            out_dir = os.path.join(base_dir, str(int(time.time())))
+        self.out_dir = os.path.abspath(out_dir)
+        self.checkpoint_dir = os.path.join(self.out_dir, "checkpoints")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        train_dir = os.path.join(self.out_dir, "summaries", "train")
+        dev_dir = os.path.join(self.out_dir, "summaries", "dev")
+        self._train = MetricsLogger(
+            os.path.join(train_dir, "metrics.csv"), tensorboard_dir=train_dir
+        )
+        self._dev = MetricsLogger(
+            os.path.join(dev_dir, "metrics.csv"), tensorboard_dir=dev_dir
+        )
+        self.max_to_keep = max_to_keep
+
+    # -- summaries ---------------------------------------------------------
+
+    def log_train(self, step: int, loss: float, accuracy: float,
+                  grads: Optional[dict] = None) -> None:
+        """Train-writer scalars; with ``grads`` (a param-shaped pytree) also
+        the per-variable gradient histogram + sparsity the reference merges
+        into every train summary (``src/GGIPNN_Classification.py:129-137``)."""
+        metrics = {"loss": float(loss), "accuracy": float(accuracy)}
+        if grads is not None:
+            flat = _flatten_params(grads)
+            for name, g in flat.items():
+                metrics[f"{name}/grad/sparsity"] = float((g == 0).mean())
+                if self._train._tb is not None:
+                    self._train._tb.add_histogram(f"{name}/grad/hist", g, step)
+        self._train.log(step, metrics)
+
+    def log_dev(self, step: int, loss: float, accuracy: float) -> None:
+        self._dev.log(
+            step, {"loss": float(loss), "accuracy": float(accuracy)}
+        )
+
+    # -- checkpoints -------------------------------------------------------
+
+    def checkpoint(self, step: int, params: dict) -> str:
+        """``checkpoints/model-<step>.npz``, pruned to ``max_to_keep``."""
+        path = os.path.join(self.checkpoint_dir, f"model-{step}.npz")
+        np.savez(path, **_flatten_params(params))
+        kept = sorted(
+            (
+                int(m.group(1)), f
+            )
+            for f in os.listdir(self.checkpoint_dir)
+            if (m := re.fullmatch(r"model-(\d+)\.npz", f))
+        )
+        for _, f in kept[: max(0, len(kept) - self.max_to_keep)]:
+            os.remove(os.path.join(self.checkpoint_dir, f))
+        return path
+
+    def close(self) -> None:
+        self._train.close()
+        self._dev.close()

@@ -89,7 +89,10 @@ class GGIPNNTrainer:
 
     # -- jitted steps ------------------------------------------------------
 
-    def _train_step_impl(self, params, opt_state, batch_x, batch_y, dropout_key):
+    def _train_step_impl(
+        self, params, opt_state, batch_x, batch_y, dropout_key,
+        with_grads: bool = False,
+    ):
         """Forward/grad/optimizer sequence shared by the per-batch and
         scanned-epoch paths."""
         def loss_of(p):
@@ -101,12 +104,23 @@ class GGIPNNTrainer:
         (loss, acc), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = self.tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if with_grads:
+            return params, opt_state, loss, acc, grads
         return params, opt_state, loss, acc
 
     @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
     def train_step(self, params, opt_state, batch_x, batch_y, dropout_key):
         return self._train_step_impl(
             params, opt_state, batch_x, batch_y, dropout_key
+        )
+
+    @functools.partial(jax.jit, static_argnums=0, donate_argnums=(1, 2))
+    def train_step_grads(self, params, opt_state, batch_x, batch_y, dropout_key):
+        """train_step that also returns the gradient pytree — the
+        observability path (grad histograms/sparsity per step, reference
+        ``src/GGIPNN_Classification.py:129-137``)."""
+        return self._train_step_impl(
+            params, opt_state, batch_x, batch_y, dropout_key, with_grads=True
         )
 
     @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(1, 2))
@@ -160,12 +174,19 @@ class GGIPNNTrainer:
         y_valid: Optional[np.ndarray] = None,
         log: Callable[[str], None] = print,
         checkpoint_fn: Optional[Callable[[int, dict], None]] = None,
+        run=None,
     ) -> Tuple[dict, optax.OptState]:
+        """Train.  With ``run`` (a :class:`~gene2vec_tpu.models.ggipnn_obs.
+        GGIPNNRun`) the reference's observed step loop runs regardless of
+        ``scan_fit``: per-step train summaries with grad histograms/
+        sparsity, dev summaries every ``evaluate_every``, checkpoints every
+        ``checkpoint_every`` keeping 5 (``src/GGIPNN_Classification.py:
+        129-163,216-222``)."""
         cfg = self.config
         params, opt_state = getattr(self, "_state", (None, None))
         if params is None:
             params, opt_state = self.init_state()
-        if cfg.scan_fit and checkpoint_fn is None:
+        if cfg.scan_fit and checkpoint_fn is None and run is None:
             return self._fit_scanned(
                 params, opt_state, x_train, y_train, x_valid, y_valid, log
             )
@@ -176,10 +197,17 @@ class GGIPNNTrainer:
             bx = jnp.asarray(batch[:, :nx].astype(np.int32))
             by = jnp.asarray(batch[:, nx:].astype(np.float32))
             key, sub = jax.random.split(key)
-            params, opt_state, loss, acc = self.train_step(
-                params, opt_state, bx, by, sub
-            )
+            if run is not None:
+                params, opt_state, loss, acc, grads = self.train_step_grads(
+                    params, opt_state, bx, by, sub
+                )
+            else:
+                params, opt_state, loss, acc = self.train_step(
+                    params, opt_state, bx, by, sub
+                )
             self._step += 1
+            if run is not None:
+                run.log_train(self._step, float(loss), float(acc), grads)
             if self._step % cfg.evaluate_every == 0:
                 msg = f"step {self._step}: loss {float(loss):.4f} acc {float(acc):.4f}"
                 if x_valid is not None and y_valid is not None:
@@ -187,9 +215,14 @@ class GGIPNNTrainer:
                     msg += (
                         f" | dev loss {dev['loss']:.4f} acc {dev['accuracy']:.4f}"
                     )
+                    if run is not None:
+                        run.log_dev(self._step, dev["loss"], dev["accuracy"])
                 log(msg)
-            if checkpoint_fn is not None and self._step % cfg.checkpoint_every == 0:
-                checkpoint_fn(self._step, params)
+            if self._step % cfg.checkpoint_every == 0:
+                if checkpoint_fn is not None:
+                    checkpoint_fn(self._step, params)
+                if run is not None:
+                    run.checkpoint(self._step, params)
         self._state = (params, opt_state)
         return params, opt_state
 
@@ -299,10 +332,17 @@ def run_classification(
     emb_path: Optional[str],
     config: GGIPNNConfig = GGIPNNConfig(),
     log: Callable[[str], None] = print,
+    run_dir: Optional[str] = None,
 ) -> Dict[str, float]:
     """End-to-end: the reference's main flow
     (``src/GGIPNN_Classification.py:40-254``) over a ``predictionData/``-shaped
-    directory (train/valid/test ``_text.txt`` + ``_label.txt``)."""
+    directory (train/valid/test ``_text.txt`` + ``_label.txt``).
+
+    With ``run_dir`` the run is fully observed at the reference cadence —
+    the step loop replaces the scanned fast path, writing ``summaries/
+    {train,dev}`` (loss/accuracy scalars, grad histograms + sparsity) and
+    ``checkpoints/model-<step>.npz`` every ``checkpoint_every`` steps,
+    keeping 5 — the reference-comparison configuration."""
     splits = {}
     for split in ("train", "valid", "test"):
         splits[split] = (
@@ -319,7 +359,17 @@ def run_classification(
     trainer = GGIPNNTrainer(config, vocab)
     params, opt_state = trainer.init_state(pretrained_emb_path=emb_path)
     trainer._state = (params, opt_state)
-    params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log)
+    run = None
+    if run_dir is not None:
+        from gene2vec_tpu.models.ggipnn_obs import GGIPNNRun
+
+        run = GGIPNNRun(run_dir)
+        log(f"Writing to {run.out_dir}")
+    try:
+        params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log, run=run)
+    finally:
+        if run is not None:
+            run.close()
     result = trainer.evaluate(params, *enc["test"])
     log(f"test accuracy: {result['accuracy']:.4f}")
     if "auc" in result:

@@ -1,2 +1,7 @@
+from gene2vec_tpu.parallel.distributed import (  # noqa: F401
+    initialize as initialize_distributed,
+    process_count,
+    process_index,
+)
 from gene2vec_tpu.parallel.mesh import make_mesh  # noqa: F401
 from gene2vec_tpu.parallel.sharding import SGNSSharding  # noqa: F401

@@ -1,0 +1,105 @@
+"""Multi-host (pod-slice) entry point.
+
+The reference's only inter-process transport is Ray's object store during
+corpus construction (``src/generate_gene_pairs.py:173-188``); training is
+single-host.  The TPU-native multi-host story (SURVEY §5) is
+``jax.distributed`` + SPMD: every host runs the *same* program, calls
+:func:`initialize` once before any jax API touches devices, and from then
+on ``jax.devices()`` is the global device list — ``make_mesh`` lays all
+hosts' chips into one Mesh, pjit shards over it, and XLA routes
+collectives over ICI within a slice and DCN across slices.  No explicit
+communication code exists anywhere in the framework; sharding annotations
+are the communication layer.
+
+Launch recipe (documented in docs/DISTRIBUTED.md):
+
+* **TPU pod slice** (GKE/queued resources): run the same script on every
+  host calling ``initialize(auto=True)`` — jax auto-detects the
+  coordinator, process count, and process id from the TPU metadata
+  server.
+* **Anything else** (CPU fleet, GPU cluster): pass
+  ``coordinator_address="host0:1234"``, ``num_processes=N`` and
+  ``process_id=i`` (or set ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``
+  /``JAX_PROCESS_ID`` and call with no arguments).
+
+After ``initialize()``, per-host input pipelines feed each host's shard of
+the global batch (``process_index()``/``process_count()`` below give the
+shard coordinates), exactly like the single-host data-parallel path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[list] = None,
+    auto: bool = False,
+) -> bool:
+    """Idempotent ``jax.distributed.initialize`` wrapper.
+
+    Returns True when a multi-process runtime is active after the call,
+    False for the single-process no-op case (nothing configured — the
+    local run stays exactly as before).  Arguments default to the
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID`` environment variables.
+
+    On a TPU pod slice pass ``auto=True``: jax auto-detects coordinator,
+    process count and process id from the TPU metadata server.  Auto mode
+    is opt-in rather than sniffed from the environment because single-chip
+    TPU hosts can carry pod-looking variables (this development image
+    injects ``TPU_WORKER_HOSTNAMES=localhost`` into every process), and
+    must stay plain single-process runs.
+
+    Must be called before any other jax API touches the backend
+    (``jax.devices()`` etc. lock the runtime single-process).
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and not auto:
+        return False  # nothing configured: single process, no side effects
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (tests; end of program)."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    """This host's rank — selects its shard of the global pair stream."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

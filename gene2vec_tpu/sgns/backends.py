@@ -53,8 +53,22 @@ class GensimTrainer:
 
     Reproduces ``src/gene2vec.py:57-92``: dim/window/min_count/workers/sg
     parameters, one ``train()`` epoch per iteration with reshuffle, save +
-    txt export per iteration.
+    txt export per iteration.  Mid-run resume works the way the reference's
+    does (reload the previous iteration's saved model and keep training,
+    ``src/gene2vec.py:86-88``): every iteration also saves gensim's own
+    binary model next to the npz layout, and a restart loads the latest one
+    instead of retraining from iteration 1.
     """
+
+    @staticmethod
+    def model_path(export_dir: str, dim: int, iteration: int) -> str:
+        """gensim's own save file per iteration (the reference keeps one per
+        iteration too: ``gene2vec_dim_200_iter_N``)."""
+        import os
+
+        return os.path.join(
+            export_dir, f"gene2vec_dim_{dim}_iter_{iteration}.gensim"
+        )
 
     def __init__(
         self, corpus: PairCorpus, config: SGNSConfig, workers: int = 32
@@ -92,21 +106,29 @@ class GensimTrainer:
         if start_iter > cfg.num_iters:
             log(f"resuming from iteration {start_iter - 1}")
             return None
+        model = None
         if start_iter > 1:
-            # gensim's binary model is not part of our checkpoint layout, so
-            # a partial run restarts from scratch rather than resuming
-            # mid-stream (the reference reloads its own .save files,
-            # src/gene2vec.py:86-88; our layout keeps only the tables)
-            log(
-                f"gensim backend cannot resume mid-run from iteration "
-                f"{start_iter - 1}; retraining from iteration 1"
-            )
+            # the reference's resume: reload the previous iteration's saved
+            # model and continue training (src/gene2vec.py:86-88)
+            prev = self.model_path(export_dir, cfg.dim, start_iter - 1)
+            if os.path.exists(prev):
+                model = gensim.models.Word2Vec.load(prev)
+                log(
+                    f"resuming from iteration {start_iter - 1} "
+                    "(gensim model reloaded)"
+                )
+            else:
+                # older export dirs carry only the npz tables; without
+                # gensim's own save file the run restarts from scratch
+                log(
+                    f"no saved gensim model for iteration {start_iter - 1}; "
+                    "retraining from iteration 1"
+                )
+                start_iter = 1
         sentences = [
             [vocab.id_to_token[a], vocab.id_to_token[b]]
             for a, b in self.corpus.pairs
         ]
-        random.seed(cfg.seed)
-        model = None
         os.makedirs(export_dir, exist_ok=True)
         sg = 0 if cfg.objective.startswith("cbow") else 1
         hs = 1 if cfg.objective.endswith("_hs") else 0
@@ -116,8 +138,14 @@ class GensimTrainer:
         negative = 0 if hs else cfg.negatives
         import numpy as np
 
-        for it in range(1, cfg.num_iters + 1):
-            random.shuffle(sentences)
+        canonical = sentences
+        for it in range(start_iter, cfg.num_iters + 1):
+            # iteration N's order is shuffle_N(canonical) — derived from
+            # the canonical corpus order, not the previous iteration's, so
+            # a resumed run sees exactly the sequence an uninterrupted one
+            # would (cumulative in-place shuffles would diverge on resume)
+            sentences = list(canonical)
+            random.Random(cfg.seed * 1_000_003 + it).shuffle(sentences)
             if model is None:
                 kwargs = dict(
                     vector_size=cfg.dim, window=cfg.window,
@@ -155,5 +183,6 @@ class GensimTrainer:
                 export_dir, cfg.dim, it, params, vocab,
                 txt_output=cfg.txt_output, meta={"backend": "gensim"},
             )
+            model.save(self.model_path(export_dir, cfg.dim, it))
             log(f"gene2vec [gensim] dimension {cfg.dim} iteration {it} done")
         return model

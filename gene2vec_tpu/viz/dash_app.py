@@ -1,22 +1,30 @@
 """GeneView dashboard — ``src/gene2vec_dash_app.py`` parity.
 
 The reference's Dash app loads the plotly-JSON scatter exported by the plot
-generator, adds GO-term and Reactome-pathway dropdowns, and recolors member
-genes on selection (active yellow, inactive near-invisible,
-``src/gene2vec_dash_app.py:65,189-235``).
+generator, shows GO-term and Reactome-pathway dropdowns in a fixed dark
+sidebar (Darkly theme + ``src/assets/bootstrap.css`` dropdown overrides),
+recolors member genes on selection (active yellow, inactive
+near-invisible, ``src/gene2vec_dash_app.py:65,189-235``), and prints a
+description panel per selected term (``:237-281``).
 
-The data/logic layer here (annotation tables, marker restyling) is
-dependency-free and unit-tested; only ``serve()`` needs dash (gated), and
-GO-DAG/taxid enrichment needs goatools/ete3 (gated separately).
+Design here: the data/logic layer — GO-DAG parsing (``go-basic.obo``),
+``gene2go`` annotations, the Reactome table, marker restyling, and the
+description text — is dependency-free and unit-tested (the formats are
+plain text; goatools/ete3 are optional conveniences, not requirements).
+Only ``serve()`` needs dash (gated); the dark styling ships as our own
+``assets/geneview.css``.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-ACTIVE_COLOR = "#fcf803"          # the reference's highlight yellow
-INACTIVE_COLOR = "rgba(100, 100, 100, 0.12)"
+#: the reference's exact marker colors (``src/gene2vec_dash_app.py:65``)
+ACTIVE_COLOR = "rgba(226,255,0,1)"
+INACTIVE_COLOR = "rgba(10, 10, 10, 0.01)"
 BASE_COLOR = "#636efa"
 
 
@@ -91,78 +99,341 @@ def term_options(
     ]
 
 
+@dataclasses.dataclass
+class GOTerm:
+    """One ``[Term]`` of a GO DAG with the fields the description panel
+    shows (``src/gene2vec_dash_app.py:252-257``): level = shortest
+    distance to a root, depth = longest."""
+
+    id: str
+    name: str = ""
+    namespace: str = ""
+    parents: Tuple[str, ...] = ()
+    level: int = 0
+    depth: int = 0
+
+
+def parse_obo(path: str) -> Dict[str, GOTerm]:
+    """Dependency-free ``go-basic.obo`` parser: ``[Term]`` stanzas with
+    id/name/namespace/is_a, levels and depths computed over the ``is_a``
+    DAG.  goatools' GODag offers the same (and is used by the reference,
+    ``src/gene2vec_dash_app.py:30-44``); the format is 4 fields of plain
+    text, so the framework does not require the package.  Obsolete terms
+    are dropped, ``alt_id``s alias their term."""
+    terms: Dict[str, GOTerm] = {}
+    alt: Dict[str, str] = {}
+    cur: Optional[dict] = None
+
+    def flush(c):
+        if c is None or "id" not in c or c.get("obsolete"):
+            return
+        terms[c["id"]] = GOTerm(
+            id=c["id"],
+            name=c.get("name", ""),
+            namespace=c.get("namespace", ""),
+            parents=tuple(c.get("is_a", ())),
+        )
+        for a in c.get("alt_id", ()):
+            alt[a] = c["id"]
+
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line == "[Term]":
+                flush(cur)
+                cur = {}
+            elif line.startswith("[") and line.endswith("]"):  # [Typedef]…
+                flush(cur)
+                cur = None
+            elif cur is not None and ": " in line:
+                key, _, val = line.partition(": ")
+                if key == "id":
+                    cur["id"] = val
+                elif key == "name":
+                    cur["name"] = val
+                elif key == "namespace":
+                    cur["namespace"] = val
+                elif key == "is_a":
+                    cur.setdefault("is_a", []).append(val.split(" ! ")[0])
+                elif key == "alt_id":
+                    cur.setdefault("alt_id", []).append(val)
+                elif key == "is_obsolete" and val == "true":
+                    cur["obsolete"] = True
+    flush(cur)
+
+    level: Dict[str, int] = {}
+    depth: Dict[str, int] = {}
+
+    def walk(tid: str, acc: Dict[str, int], agg) -> int:
+        if tid in acc:
+            return acc[tid]
+        acc[tid] = 0  # cycle guard (GO is acyclic; malformed input isn't)
+        ps = [p for p in terms[tid].parents if p in terms]
+        acc[tid] = agg(walk(p, acc, agg) for p in ps) + 1 if ps else 0
+        return acc[tid]
+
+    for tid, term in terms.items():
+        terms[tid] = dataclasses.replace(
+            term, level=walk(tid, level, min), depth=walk(tid, depth, max)
+        )
+    for a, tid in alt.items():
+        terms.setdefault(a, terms[tid])
+    return terms
+
+
+def parse_gene2go(
+    path: str, taxids: Optional[Sequence[int]] = None
+) -> Dict[str, List[str]]:
+    """NCBI ``gene2go`` TSV → GO id → member gene (Entrez) ids, optionally
+    filtered to ``taxids`` (the reference filters to the figure's Tax ID
+    column via goatools, ``src/gene2vec_dash_app.py:38-41``)."""
+    keep = {str(t) for t in taxids} if taxids else None
+    # dict-as-ordered-set per term: broad GO terms collect >10k genes and
+    # real gene2go files are tens of millions of rows — list membership
+    # scans would be quadratic per term
+    members: Dict[str, dict] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            if line.startswith("#") or not line.strip():
+                continue
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 3:
+                continue
+            tax, gene, go_id = parts[0], parts[1], parts[2]
+            if keep is not None and tax not in keep:
+                continue
+            members.setdefault(go_id, {})[gene] = None
+    return {go_id: list(genes) for go_id, genes in members.items()}
+
+
+def load_reactome_table(
+    path: str, species: Optional[Sequence[str]] = None
+) -> Tuple[Dict[str, List[str]], Dict[str, dict]]:
+    """``NCBI2Reactome_All_Levels.txt`` (entrez, reactome id, url, name,
+    evidence, species) → (pathway → entrez members, pathway → info);
+    optional species filter (the reference translates the figure's taxids
+    via ete3 and filters, ``src/gene2vec_dash_app.py:84-96``)."""
+    keep = set(species) if species else None
+    members: Dict[str, dict] = {}  # dict-as-ordered-set (see parse_gene2go)
+    info: Dict[str, dict] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 6:
+                continue
+            entrez, rid, url, name, _, sp = parts[:6]
+            if keep is not None and sp not in keep:
+                continue
+            members.setdefault(rid, {})[entrez] = None
+            info.setdefault(
+                rid, {"name": name, "url": url, "species": sp}
+            )
+    return {rid: list(g) for rid, g in members.items()}, info
+
+
+def go_description(
+    term: GOTerm, member_genes: Sequence[str], gene_rep: str = "Gene Symbol"
+) -> str:
+    """The GO description panel text (``src/gene2vec_dash_app.py:252-257``)."""
+    return (
+        f"GO ID: {term.id}\nName: {term.name}\n"
+        f"Namespace: {term.namespace}\nLevel: {term.level}\n"
+        f"Depth: {term.depth}\n{gene_rep}: {', '.join(member_genes)}"
+    )
+
+
+def reactome_description(
+    rid: str, info: dict, member_genes: Sequence[str],
+    gene_rep: str = "Gene Symbol",
+) -> str:
+    """The Reactome description panel text (``:267-276``)."""
+    return (
+        f"Reactome ID: {rid}\nName: {info.get('name', '')}\n"
+        f"Species: {info.get('species', '')}\nurl: {info.get('url', '')}\n"
+        f"{gene_rep}: {', '.join(member_genes)}"
+    )
+
+
 def go_dag_descriptions(obo_path: str) -> Dict[str, str]:
-    """GO id → name via goatools (``src/gene2vec_dash_app.py:30-44``); gated."""
+    """GO id → name.  Uses goatools when installed (the reference's path,
+    ``src/gene2vec_dash_app.py:30-44``); otherwise the built-in parser."""
     try:
         from goatools.obo_parser import GODag
-    except ImportError as e:
-        raise ImportError(
-            "GO-DAG descriptions require the goatools package; provide a "
-            "TSV annotation table instead"
-        ) from e
-    dag = GODag(obo_path, prt=None)
-    return {go_id: term.name for go_id, term in dag.items()}
+
+        dag = GODag(obo_path, prt=None)
+        return {go_id: term.name for go_id, term in dag.items()}
+    except ImportError:
+        return {tid: t.name for tid, t in parse_obo(obo_path).items()}
+
+
+def build_app_state(
+    figure_json: str,
+    go_table: Optional[str] = None,
+    reactome_table: Optional[str] = None,
+    go_obo: Optional[str] = None,
+    gene2go: Optional[str] = None,
+    reactome_file: Optional[str] = None,
+    taxids: Optional[Sequence[int]] = None,
+    species: Optional[Sequence[str]] = None,
+) -> dict:
+    """Everything ``serve`` shows, assembled without dash: the figure, the
+    per-source term→members tables, term descriptions (rich GOTerm/Reactome
+    info when the obo/gene2go/reactome files are given, flat TSV tables
+    otherwise), and dropdown options.  Unit-testable."""
+    state = {
+        "figure": load_figure_json(figure_json),
+        "sources": {},  # kind -> {"members", "describe", "options"}
+    }
+
+    def add(kind, members, describe, label_desc):
+        state["sources"][kind] = {
+            "members": members,
+            "describe": describe,
+            "options": term_options(members, label_desc),
+        }
+
+    if go_obo and gene2go:
+        dag = parse_obo(go_obo)
+        members = parse_gene2go(gene2go, taxids)
+        members = {t: g for t, g in members.items() if t in dag}
+
+        def describe_go(term, genes, dag=dag):
+            return go_description(dag[term], genes)
+
+        add("GO", members, describe_go, {t: dag[t].name for t in members})
+    elif go_table:
+        members, desc = parse_annotation_table(go_table)
+        add("GO", members, lambda t, g, d=desc: d.get(t, ""), desc)
+    if reactome_file:
+        members, info = load_reactome_table(reactome_file, species)
+
+        def describe_r(term, genes, info=info):
+            return reactome_description(term, info.get(term, {}), genes)
+
+        add("Reactome", members, describe_r,
+            {t: info[t]["name"] for t in members})
+    elif reactome_table:
+        members, desc = parse_annotation_table(reactome_table)
+        add("Reactome", members, lambda t, g, d=desc: d.get(t, ""), desc)
+    return state
 
 
 def serve(
     figure_json: str,
     go_table: Optional[str] = None,
     reactome_table: Optional[str] = None,
+    go_obo: Optional[str] = None,
+    gene2go: Optional[str] = None,
+    reactome_file: Optional[str] = None,
+    taxids: Optional[Sequence[int]] = None,
+    species: Optional[Sequence[str]] = None,
     host: str = "127.0.0.1",
     port: int = 8050,
+    debug: bool = False,
+    run: bool = True,
 ):  # pragma: no cover - needs dash + a browser
-    """Launch the dashboard (requires the dash package)."""
+    """Launch the GeneView dashboard (requires the dash package).
+
+    Layout parity with the reference (``src/gene2vec_dash_app.py:100-186``):
+    a fixed dark sidebar — GeneView title, Gene Ontology dropdown, Reactome
+    dropdown, read-only description textarea — beside the scatter; dark
+    dropdown styling ships as the package's own ``assets/geneview.css``
+    (behavioral stand-in for the reference's Darkly overrides).  Pass
+    ``run=False`` to get the wired app back without serving (tests)."""
     try:
         import dash
         from dash import dcc, html
-        from dash.dependencies import Input, Output
+        from dash.dependencies import Input, Output, State
     except ImportError as e:
         raise ImportError(
             "the GeneView dashboard requires the dash package; the figure "
             "json/html exports from viz.plot work without it"
         ) from e
 
-    figure = load_figure_json(figure_json)
-    tables = {}
-    if go_table:
-        tables["GO"] = parse_annotation_table(go_table)
-    if reactome_table:
-        tables["Reactome"] = parse_annotation_table(reactome_table)
+    state = build_app_state(
+        figure_json, go_table, reactome_table, go_obo, gene2go,
+        reactome_file, taxids, species,
+    )
+    figure = state["figure"]
+    sources = state["sources"]
 
-    app = dash.Dash("GeneView")
-    dropdowns = []
-    for kind, (members, desc) in tables.items():
-        dropdowns.append(html.Label(kind))
-        dropdowns.append(
-            dcc.Dropdown(
-                id=f"dd-{kind.lower()}",
-                options=term_options(members, desc),
-                multi=False,
+    app = dash.Dash(
+        "GeneView",
+        assets_folder=os.path.join(os.path.dirname(__file__), "assets"),
+    )
+    sidebar_children = [html.H2("GeneView", className="display-8"), html.Hr()]
+    for kind, src in sources.items():
+        sidebar_children += [
+            html.Div(
+                [
+                    html.H4(
+                        "Gene Ontology" if kind == "GO" else f"{kind} ID",
+                        className="display-8",
+                    ),
+                    html.Hr(),
+                    dcc.Dropdown(
+                        id=f"dd-{kind.lower()}", options=src["options"]
+                    ),
+                ],
+                className="geneview-dropdown",
             )
+        ]
+    sidebar_children += [
+        html.Div(
+            [
+                html.H5("Description", className="display-8"),
+                html.Hr(),
+                dcc.Textarea(
+                    id="description", readOnly=True, value="",
+                    className="geneview-description",
+                ),
+            ]
         )
+    ]
     app.layout = html.Div(
         [
-            html.H2("GeneView — gene2vec embedding"),
-            *dropdowns,
-            dcc.Graph(id="scatter", figure=figure),
-            html.Pre(id="description"),
-        ]
+            html.Div(sidebar_children, className="geneview-sidebar"),
+            dcc.Graph(
+                id="scatter", figure=figure, className="geneview-graph"
+            ),
+        ],
+        className="dash-bootstrap",
     )
 
-    for kind, (members, desc) in tables.items():
-        @app.callback(
-            Output("scatter", "figure", allow_duplicate=True),
-            Output("description", "children", allow_duplicate=True),
-            Input(f"dd-{kind.lower()}", "value"),
-            prevent_initial_call=True,
-        )
-        def _update(term, members=members, desc=desc):
-            if not term:
-                return highlight_genes(figure, []), ""
-            return (
-                highlight_genes(figure, members.get(term, [])),
-                desc.get(term, ""),
-            )
+    inputs = [Input(f"dd-{k.lower()}", "value") for k in sources]
+    kinds = list(sources)
 
-    app.run(host=host, port=port)
+    def _selected(values):
+        """(kind, term) for the triggering dropdown; (None, None) when it
+        was CLEARED (value None) — callers must reset, not no_update, or
+        the near-invisible highlight state sticks forever."""
+        ctx = dash.callback_context
+        trigger = ctx.triggered[0]["prop_id"].split(".")[0]
+        for kind, value in zip(kinds, values):
+            if f"dd-{kind.lower()}" == trigger and value:
+                return kind, value
+        return None, None
+
+    if sources:  # a figure-only dashboard has no dropdowns or callbacks
+        @app.callback(
+            Output("scatter", "figure"), inputs, State("scatter", "figure")
+        )
+        def show_genes(*args):
+            values, fig = args[:-1], args[-1]
+            kind, term = _selected(values)
+            if kind is None:  # cleared: restore the base coloring
+                return highlight_genes(fig or figure, [])
+            genes = sources[kind]["members"].get(term, [])
+            return highlight_genes(fig or figure, genes)
+
+        @app.callback(Output("description", "value"), inputs)
+        def show_description(*values):
+            kind, term = _selected(values)
+            if kind is None:
+                return ""
+            genes = sources[kind]["members"].get(term, [])
+            return sources[kind]["describe"](term, genes)
+
+    if run:
+        app.run(host=host, port=port, debug=debug)
     return app

@@ -61,8 +61,34 @@ def reduce_embedding(
     raise ValueError(f"unknown reduction method {method!r}")
 
 
+def infer_gene_rep(x) -> str:
+    """Classify a gene identifier so annotation can pick the right query
+    scope (``src/plot_gene2vec.py:62-72``): ints are Entrez IDs, strings
+    containing ``ENS`` are Ensembl IDs, anything else is a gene symbol.
+    Numeric strings (Entrez IDs read from a text embedding file) are also
+    classified as Entrez."""
+    if isinstance(x, (int, np.integer)):
+        return "Entrez ID"
+    if isinstance(x, str):
+        if "ENS" in x:
+            return "Ensembl ID"
+        if x.isdigit():
+            return "Entrez ID"
+        return "Gene Symbol"
+    raise TypeError(f"cannot infer gene representation of {type(x).__name__}")
+
+
+#: mygene querymany scope per representation (``src/plot_gene2vec.py:84-96``)
+_REP_SCOPE = {
+    "Gene Symbol": "symbol",
+    "Entrez ID": "entrezgene",
+    "Ensembl ID": "ensembl.gene",
+}
+
+
 def query_gene_info(genes: Sequence[str]) -> Dict[str, dict]:
-    """NCBI annotation via mygene (``src/plot_gene2vec.py:74-96``); gated."""
+    """NCBI annotation via mygene (``src/plot_gene2vec.py:74-96``); the
+    query scope follows :func:`infer_gene_rep` of the first gene; gated."""
     try:
         import mygene
     except ImportError as e:
@@ -71,8 +97,10 @@ def query_gene_info(genes: Sequence[str]) -> Dict[str, dict]:
             "annotate=False to skip"
         ) from e
     mg = mygene.MyGeneInfo()
+    scope = _REP_SCOPE[infer_gene_rep(genes[0])] if genes else "symbol"
     res = mg.querymany(
-        list(genes), scopes="symbol", fields="name,summary", species="human"
+        list(genes), scopes=scope, fields="name,summary,symbol,entrezgene",
+        species="human",
     )
     return {r["query"]: r for r in res if not r.get("notfound")}
 

@@ -243,7 +243,10 @@ def run_reference(args, results: dict) -> None:
     with tempfile.TemporaryDirectory() as tmp:
         w2v = train_embedding(corpus, tmp, args)
         t0 = time.perf_counter()
-        res = run_classification(args.data_dir, emb_path=w2v, config=cfg, log=log)
+        res = run_classification(
+            args.data_dir, emb_path=w2v, config=cfg, log=log,
+            run_dir=args.run_dir,
+        )
         out["self_trained"] = {
             "auc": _round4(res.get("auc")),
             "accuracy": round(res["accuracy"], 4),
@@ -275,6 +278,10 @@ def main() -> None:
                     help="explicit total pool size (disables auto sizing)")
     ap.add_argument("--shared-groups", type=int, default=0)
     ap.add_argument("--out", default=os.path.join(REPO, "REAL_AUC.json"))
+    ap.add_argument("--run-dir", default=None,
+                    help="runs/<ts>-style artifact dir for the reference-"
+                    "protocol GGIPNN run (step-loop cadence: summaries + "
+                    "keep-5 checkpoints — the reference-comparison mode)")
     args = ap.parse_args()
 
     results = {

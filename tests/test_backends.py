@@ -53,3 +53,99 @@ def test_numpy_backend_resume_matches_uninterrupted(
     np.testing.assert_allclose(
         np.asarray(p_resumed.emb), np.asarray(p_straight.emb), atol=1e-6
     )
+
+
+class _FakeWv:
+    def __init__(self, tokens, dim, seed):
+        self.index_to_key = list(tokens)
+        self.vectors = np.random.RandomState(seed).randn(
+            len(tokens), dim
+        ).astype(np.float32)
+
+
+class _FakeWord2Vec:
+    """Minimal gensim.models.Word2Vec stand-in: records how many train()
+    calls it has absorbed and round-trips through save/load, so the
+    GensimTrainer resume logic is exercisable without the real package."""
+
+    def __init__(self, sentences, **kwargs):
+        dim = kwargs.get("vector_size") or kwargs.get("size")
+        toks = sorted({t for s in sentences for t in s})
+        self.wv = _FakeWv(toks, dim, seed=kwargs.get("seed", 0))
+        self.corpus_count = len(sentences)
+        self.trained_epochs = 1  # constructor trains once
+
+    def train(self, sentences, total_examples=None, epochs=1):
+        self.trained_epochs += epochs
+        self.wv.vectors += 0.01  # visible effect per epoch
+
+    def save(self, path):
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @classmethod
+    def load(cls, path):
+        import pickle
+
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+def test_gensim_backend_resumes_mid_run(
+    tmp_path, synthetic_corpus_dir, monkeypatch
+):
+    """The reference's resume semantics (src/gene2vec.py:86-88): a restarted
+    run reloads the previous iteration's saved gensim model and continues —
+    it must NOT retrain from iteration 1.  Runs against the real gensim when
+    installed, else a minimal fake (the wrapper logic is what's under test)."""
+    import sys
+    import types
+
+    try:
+        import gensim  # noqa: F401
+    except ImportError:
+        fake = types.ModuleType("gensim")
+        fake.models = types.SimpleNamespace(Word2Vec=_FakeWord2Vec)
+        monkeypatch.setitem(sys.modules, "gensim", fake)
+
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    corpus = PairCorpus(vocab, pairs)
+    cfg = SGNSConfig(dim=8, num_iters=4, seed=0)
+    out = str(tmp_path / "gensim_run")
+    logs = []
+
+    # interrupted run: iterations 1..2 only
+    trainer = make_backend_trainer(corpus, cfg, backend="gensim")
+    trainer.run(out, start_iter=None, log=logs.append)
+    # simulate the interruption by deleting iterations 3+ artifacts — train
+    # only up to 2 by running with num_iters=2 instead
+    import shutil
+
+    shutil.rmtree(out)
+    cfg2 = SGNSConfig(dim=8, num_iters=2, seed=0)
+    trainer = make_backend_trainer(corpus, cfg2, backend="gensim")
+    model2 = trainer.run(out, log=logs.append)
+
+    # restart with the full iteration budget: must resume from 3
+    logs.clear()
+    trainer = make_backend_trainer(corpus, cfg, backend="gensim")
+    model = trainer.run(out, log=logs.append)
+    assert any("resuming from iteration 2" in m for m in logs), logs
+    assert not any("retraining from iteration 1" in m for m in logs), logs
+    if model is not None and hasattr(model, "trained_epochs"):
+        # fake backend: 1 (ctor) + 1 (iter 2) from the first run persisted
+        # in the save file, + 2 more (iters 3, 4) after resume
+        assert model2.trained_epochs == 2
+        assert model.trained_epochs == 4
+    # all four iterations' npz + gensim model files exist
+    import os
+
+    for it in range(1, 5):
+        assert os.path.exists(
+            os.path.join(out, f"gene2vec_dim_8_iter_{it}.npz")
+        )
+        assert os.path.exists(
+            os.path.join(out, f"gene2vec_dim_8_iter_{it}.gensim")
+        )

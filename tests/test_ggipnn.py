@@ -127,3 +127,81 @@ def test_frozen_embedding_not_updated(tmp_path):
     enc = vocab.transform(lines)
     params_after, _ = trainer.fit(enc, one_hot_labels(y), log=lambda s: None)
     np.testing.assert_array_equal(np.asarray(params_after["embedding"]), table0)
+
+
+def _write_split_dir(tmp_path, n=300):
+    """predictionData/-shaped directory from the toy problem."""
+    x, y = _toy_problem(n=n)
+    d = tmp_path / "data"
+    d.mkdir()
+    cuts = {"train": slice(0, n - 100), "valid": slice(n - 100, n - 50),
+            "test": slice(n - 50, n)}
+    for split, sl in cuts.items():
+        with open(d / f"{split}_text.txt", "w") as f:
+            f.writelines(f"g{a} g{b}\n" for a, b in x[sl])
+        with open(d / f"{split}_label.txt", "w") as f:
+            f.writelines(f"{v}\n" for v in y[sl])
+    return str(d)
+
+
+def test_run_dir_summaries_and_checkpoints(tmp_path):
+    """Reference runs/<ts>/ parity (src/GGIPNN_Classification.py:129-163,
+    216-222): separate train/dev summary writers with grad sparsity, and
+    step checkpoints that appear on the checkpoint_every cadence."""
+    import glob
+    import os
+
+    from gene2vec_tpu.models.ggipnn_train import run_classification
+
+    data_dir = _write_split_dir(tmp_path)
+    run_dir = str(tmp_path / "run")
+    cfg = GGIPNNConfig(
+        embedding_dim=8, hidden_dims=(16, 16, 4), use_pretrained=False,
+        num_epochs=4, batch_size=16, evaluate_every=10, checkpoint_every=20,
+    )
+    run_classification(data_dir, None, cfg, log=lambda s: None, run_dir=run_dir)
+
+    # train writer: per-step rows with loss/accuracy + grad sparsity columns
+    train_csv = os.path.join(run_dir, "summaries", "train", "metrics.csv")
+    with open(train_csv) as f:
+        header = f.readline().strip().split(",")
+        rows = f.readlines()
+    assert "loss" in header and "accuracy" in header
+    assert any(c.endswith("/grad/sparsity") for c in header)
+    # 200 train pairs / batch 16 = 13 ragged batches x 4 epochs = 52 steps
+    assert len(rows) == 52
+    # dev writer: one row per evaluate_every steps
+    dev_csv = os.path.join(run_dir, "summaries", "dev", "metrics.csv")
+    with open(dev_csv) as f:
+        assert len(f.readlines()) == 1 + 52 // 10
+    # tensorboardX event files when the package is installed
+    try:
+        import tensorboardX  # noqa: F401
+
+        assert glob.glob(os.path.join(run_dir, "summaries", "train", "events.*"))
+        assert glob.glob(os.path.join(run_dir, "summaries", "dev", "events.*"))
+    except ImportError:
+        pass
+    # checkpoints on the every-20 cadence: steps 20 and 40
+    ckpts = sorted(os.listdir(os.path.join(run_dir, "checkpoints")))
+    assert ckpts == ["model-20.npz", "model-40.npz"]
+
+
+def test_run_checkpoints_keep_five(tmp_path):
+    """Saver max_to_keep=5 parity: older snapshots are pruned, and a saved
+    checkpoint round-trips the param pytree."""
+    import os
+
+    from gene2vec_tpu.models.ggipnn_obs import GGIPNNRun, load_checkpoint
+
+    run = GGIPNNRun(str(tmp_path / "run"))
+    params = {"dense1": {"kernel": np.ones((3, 2), np.float32)},
+              "embedding": np.zeros((4, 2), np.float32)}
+    for step in range(1000, 8000, 1000):
+        run.checkpoint(step, params)
+    run.close()
+    kept = sorted(os.listdir(run.checkpoint_dir))
+    assert kept == [f"model-{s}.npz" for s in range(3000, 8000, 1000)]
+    loaded = load_checkpoint(os.path.join(run.checkpoint_dir, "model-7000.npz"))
+    np.testing.assert_array_equal(loaded["dense1/kernel"], np.ones((3, 2)))
+    np.testing.assert_array_equal(loaded["embedding"], np.zeros((4, 2)))

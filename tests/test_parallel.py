@@ -121,3 +121,58 @@ def test_mesh_with_odd_device_count():
     params = tr.init()
     _, loss = tr.train_epoch(params, jax.random.PRNGKey(1))
     assert np.isfinite(float(loss))
+
+
+def test_distributed_single_process_noop():
+    """With nothing configured on a non-TPU backend, initialize() is a
+    no-op returning False and the local run is untouched."""
+    from gene2vec_tpu.parallel import distributed
+
+    assert distributed.initialize() is False
+    assert distributed.process_count() == 1
+    assert distributed.process_index() == 0
+
+
+def test_distributed_initialize_single_process_runtime():
+    """jax.distributed.initialize with an explicit 1-process coordinator:
+    the runtime comes up, the global mesh covers the forced-8 CPU devices,
+    and a collective executes.  Subprocess: the distributed runtime is
+    process-global and must not leak into other tests."""
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import jax
+from gene2vec_tpu.parallel import distributed
+from gene2vec_tpu.parallel.mesh import make_mesh
+from gene2vec_tpu.config import MeshConfig
+
+active = distributed.initialize(
+    coordinator_address="127.0.0.1:12955", num_processes=1, process_id=0
+)
+assert active is False, "1 process is not a multi-process runtime"
+assert jax.process_count() == 1
+# the distributed CPU client ignores xla_force_host_platform_device_count,
+# so build the mesh over however many devices the runtime exposes
+n = len(jax.devices())
+mesh = make_mesh(MeshConfig(data=n, model=1))
+assert mesh.devices.shape == (n, 1)
+from jax.sharding import NamedSharding, PartitionSpec as P
+import jax.numpy as jnp
+x = jax.device_put(np.arange(float(n)), NamedSharding(mesh, P("data")))
+s = float(jnp.sum(x * 2.0))
+assert s == float(n * (n - 1)), s
+distributed.shutdown()
+print("DISTRIBUTED_OK")
+"""
+    env = dict(
+        __import__("os").environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180, env=env,
+    )
+    assert "DISTRIBUTED_OK" in res.stdout, res.stderr[-2000:]

@@ -92,6 +92,19 @@ def test_plot_exports_json_and_figure(tmp_path):
     assert any(w.endswith((".html", ".png")) for w in written)
 
 
+def test_infer_gene_rep():
+    """src/plot_gene2vec.py:62-72 semantics: int -> Entrez, 'ENS' -> Ensembl,
+    other strings -> symbol; numeric strings (text files) also Entrez."""
+    from gene2vec_tpu.viz.plot import infer_gene_rep
+
+    assert infer_gene_rep(7157) == "Entrez ID"
+    assert infer_gene_rep("7157") == "Entrez ID"
+    assert infer_gene_rep("ENSG00000141510") == "Ensembl ID"
+    assert infer_gene_rep("TP53") == "Gene Symbol"
+    with pytest.raises(TypeError):
+        infer_gene_rep(3.14)
+
+
 def test_gtex_figure(tmp_path):
     from gene2vec_tpu.viz.gtex import run_gtex_figures
 
@@ -176,3 +189,113 @@ def test_umap_gated():
 
     with pytest.raises(ImportError, match="umap"):
         reduce_embedding(np.zeros((10, 4), np.float32), method="umap")
+
+
+_OBO = """format-version: 1.2
+
+[Term]
+id: GO:0000001
+name: root process
+namespace: biological_process
+
+[Term]
+id: GO:0000002
+name: child process
+namespace: biological_process
+alt_id: GO:0000099
+is_a: GO:0000001 ! root process
+
+[Term]
+id: GO:0000003
+name: grandchild
+namespace: biological_process
+is_a: GO:0000002 ! child process
+is_a: GO:0000001 ! root process
+
+[Term]
+id: GO:0000004
+name: gone
+is_obsolete: true
+
+[Typedef]
+id: part_of
+"""
+
+
+def test_parse_obo_levels_and_depths(tmp_path):
+    from gene2vec_tpu.viz.dash_app import parse_obo
+
+    obo = tmp_path / "go-basic.obo"
+    obo.write_text(_OBO)
+    dag = parse_obo(str(obo))
+    assert "GO:0000004" not in dag  # obsolete dropped
+    assert dag["GO:0000001"].level == 0 and dag["GO:0000001"].depth == 0
+    assert dag["GO:0000002"].parents == ("GO:0000001",)
+    # grandchild: shortest path 1 (direct is_a root), longest 2
+    assert dag["GO:0000003"].level == 1
+    assert dag["GO:0000003"].depth == 2
+    assert dag["GO:0000099"].name == "child process"  # alt_id alias
+
+
+def test_parse_gene2go_and_reactome(tmp_path):
+    from gene2vec_tpu.viz.dash_app import load_reactome_table, parse_gene2go
+
+    g2g = tmp_path / "gene2go"
+    g2g.write_text(
+        "#tax_id\tGeneID\tGO_ID\tEvidence\n"
+        "9606\t7157\tGO:0000002\tIEA\n"
+        "9606\t7158\tGO:0000002\tIDA\n"
+        "9606\t7157\tGO:0000002\tIDA\n"     # duplicate gene, second evidence
+        "10090\t999\tGO:0000002\tIEA\n"     # mouse, filtered out
+    )
+    members = parse_gene2go(str(g2g), taxids=[9606])
+    assert members == {"GO:0000002": ["7157", "7158"]}
+
+    rt = tmp_path / "reactome.txt"
+    rt.write_text(
+        "7157\tR-HSA-1\thttp://r/1\tApoptosis\tTAS\tHomo sapiens\n"
+        "7158\tR-HSA-1\thttp://r/1\tApoptosis\tTAS\tHomo sapiens\n"
+        "999\tR-MMU-9\thttp://r/9\tMouse thing\tTAS\tMus musculus\n"
+    )
+    m, info = load_reactome_table(str(rt), species=["Homo sapiens"])
+    assert m == {"R-HSA-1": ["7157", "7158"]}
+    assert info["R-HSA-1"]["name"] == "Apoptosis"
+
+
+def test_dash_descriptions_and_app_state(tmp_path):
+    """The description panel text (src/gene2vec_dash_app.py:252-276) and
+    the full serve()-side state assembled without dash."""
+    import json as _json
+
+    from gene2vec_tpu.viz.dash_app import build_app_state
+
+    obo = tmp_path / "go.obo"
+    obo.write_text(_OBO)
+    g2g = tmp_path / "gene2go"
+    g2g.write_text("9606\tA\tGO:0000002\tIEA\n9606\tB\tGO:0000002\tIEA\n")
+    rt = tmp_path / "reactome.txt"
+    rt.write_text("A\tR-HSA-1\thttp://r/1\tApoptosis\tTAS\tHomo sapiens\n")
+    fig = tmp_path / "fig.json"
+    fig.write_text(_json.dumps(
+        {"data": [{"customdata": ["A", "B"], "x": [0, 1]}], "layout": {}}
+    ))
+
+    state = build_app_state(
+        str(fig), go_obo=str(obo), gene2go=str(g2g), reactome_file=str(rt)
+    )
+    go = state["sources"]["GO"]
+    assert go["members"] == {"GO:0000002": ["A", "B"]}
+    desc = go["describe"]("GO:0000002", ["A", "B"])
+    assert "GO ID: GO:0000002" in desc
+    assert "Name: child process" in desc
+    assert "Namespace: biological_process" in desc
+    assert "Level: 1" in desc and "Depth: 1" in desc
+    assert "A, B" in desc
+    assert go["options"][0]["label"].startswith("GO:0000002")
+
+    r = state["sources"]["Reactome"]
+    rdesc = r["describe"]("R-HSA-1", ["A"])
+    assert "Reactome ID: R-HSA-1" in rdesc
+    assert "Name: Apoptosis" in rdesc
+    assert "Species: Homo sapiens" in rdesc
+    assert "url: http://r/1" in rdesc
