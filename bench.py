@@ -146,10 +146,28 @@ def hogwild_baseline(dim: int, vocab_size: int, num_pairs: int):
 
 
 def secondary_metrics(vocab_size: int, num_pairs: int, batch_pairs: int) -> dict:
-    """CBOW/HS, dim=512 vocab-sharded, and GGIPNN step rates."""
+    """CBOW/HS, dim=512 vocab-sharded, GGIPNN, and shared-mode SGNS rates."""
     import jax
 
     out = {}
+
+    # round-2 default (shared-pool negatives) for cross-round comparability
+    # against the round-3 stratified headline
+    try:
+        from gene2vec_tpu.config import SGNSConfig
+        from gene2vec_tpu.sgns.train import SGNSTrainer
+
+        corpus = synth_corpus(vocab_size, num_pairs)
+        trainer = SGNSTrainer(
+            corpus,
+            SGNSConfig(
+                dim=200, batch_pairs=batch_pairs, negative_mode="shared"
+            ),
+        )
+        out["shared_mode_pairs_per_sec"] = round(_steady_rate(trainer), 1)
+        log(f"shared mode: {out['shared_mode_pairs_per_sec']:,.0f} pairs/s")
+    except Exception as e:
+        log(f"shared-mode secondary failed: {e}")
 
     # BASELINE config 4: CBOW + hierarchical softmax.
     try:

@@ -113,25 +113,33 @@ def synthetic_big(v=24000, n=8_000_000, seed=0):
 def suite_matrix(args) -> list:
     corpus, split = load_holdout(args.data_dir)
     rows = []
+    shared = dict(negative_mode="shared")  # modes pinned explicitly: the
+    # SGNSConfig default moved to "stratified" in round 3 and these rows
+    # must keep measuring what their labels say
     configs = [
-        ("default shared+capped B=4096 auto", dict()),
-        ("shared+capped B=16384 auto", dict(batch_pairs=16384)),
+        ("stratified+capped B=4096 (default)",
+         dict(negative_mode="stratified")),
+        ("stratified+capped B=16384",
+         dict(negative_mode="stratified", batch_pairs=16384)),
+        ("shared+capped B=4096 auto", dict(**shared)),
+        ("shared+capped B=16384 auto", dict(batch_pairs=16384, **shared)),
         ("per_example+capped B=4096", dict(negative_mode="per_example")),
         ("per_example+sum B=1024", dict(negative_mode="per_example",
                                         combiner="sum", batch_pairs=1024)),
-        ("shared+sum B=4096 auto", dict(combiner="sum")),
-        ("shared+mean B=4096 auto", dict(combiner="mean")),
+        ("shared+sum B=4096 auto", dict(combiner="sum", **shared)),
+        ("shared+mean B=4096 auto", dict(combiner="mean", **shared)),
         # the round-2 failure shape: tiny pool, example-unit capping
         ("round2: shared+capped B=16384 P=64",
-         dict(batch_pairs=16384, shared_pool=64, shared_pool_auto=False)),
+         dict(batch_pairs=16384, shared_pool=64, shared_pool_auto=False,
+              **shared)),
         # the P_total sweep (fractions of E*K at B=4096, E=8192)
         ("P=0.2*E*K", dict(shared_pool=8192, shared_pool_auto=False,
-                           shared_groups=256)),
+                           shared_groups=256, **shared)),
         ("P=0.4*E*K", dict(shared_pool=16384, shared_pool_auto=False,
-                           shared_groups=256)),
+                           shared_groups=256, **shared)),
         ("P=0.8*E*K (auto point)", dict(shared_pool=32768,
                                         shared_pool_auto=False,
-                                        shared_groups=256)),
+                                        shared_groups=256, **shared)),
     ]
     for name, kw in configs:
         cfg = SGNSConfig(dim=200, num_iters=args.epochs, **kw)
@@ -153,11 +161,13 @@ def suite_groups(args) -> list:
     for sub in (32, 64, 128, 256):
         # fixed total pool P = 4E on both corpora
         cfg = SGNSConfig(dim=200, num_iters=args.epochs,
+                         negative_mode="shared",
                          shared_groups=8192 // sub, shared_pool=32768,
                          shared_pool_auto=False)
         emb, _, l1 = train(corpus, cfg, args.epochs)
         auc = holdout_auc(corpus.vocab, emb, split)
         cfg_p = SGNSConfig(dim=64, num_iters=20, batch_pairs=1024,
+                           negative_mode="shared",
                            shared_groups=2048 // sub, shared_pool=8192,
                            shared_pool_auto=False)
         emb_p, _, _ = train(corpus_p, cfg_p, 20)
@@ -174,13 +184,14 @@ def suite_frontier(args) -> list:
     corpus_r, split = load_holdout(args.data_dir)
     rows = []
     configs = [
-        ("default (P=0.8*E*K)", dict()),
-        ("P=0.4*E*K", dict(shared_pool=65536, shared_pool_auto=False,
-                           shared_groups=1024)),
-        ("P=0.2*E*K", dict(shared_pool=32768, shared_pool_auto=False,
-                           shared_groups=1024)),
+        ("stratified (default)", dict(negative_mode="stratified")),
+        ("shared auto (P=0.8*E*K)", dict(negative_mode="shared")),
+        ("P=0.4*E*K", dict(negative_mode="shared", shared_pool=65536,
+                           shared_pool_auto=False, shared_groups=1024)),
+        ("P=0.2*E*K", dict(negative_mode="shared", shared_pool=32768,
+                           shared_pool_auto=False, shared_groups=1024)),
         ("per_example", dict(negative_mode="per_example")),
-        ("round2 broken (P=64)", dict(shared_pool=64,
+        ("round2 broken (P=64)", dict(negative_mode="shared", shared_pool=64,
                                       shared_pool_auto=False)),
     ]
     for name, kw in configs:

@@ -83,3 +83,74 @@ def sample_negatives(table: NoiseTable, key: jax.Array, shape) -> jax.Array:
     j = jax.random.randint(kj, shape, 0, table.prob.shape[0], dtype=jnp.int32)
     coin = jax.random.uniform(kc, shape, dtype=jnp.float32)
     return jnp.where(coin < table.prob[j], j, table.alias[j]).astype(jnp.int32)
+
+
+class StratifiedSpec:
+    """Precomputed layout for ``negative_mode="stratified"`` (round-3 perf
+    design, docs/PERF_NOTES.md): the frequency-sorted vocab splits into an
+    exact HEAD — rows [0, head) contribute their noise-expectation term
+    K*q_j*softplus(v.u_j) densely, zero sampling variance, no scatter —
+    and a TAIL partitioned into ``nb`` contiguous blocks of ``block`` rows
+    (the last block clamps to the vocab end and may overlap its
+    predecessor).  Each example group draws one block uniformly;
+    ``tail_w[j] = q_j / p_j`` pre-divides each row's noise weight by its
+    draw probability p_j = (blocks containing j)/nb, so the estimator is
+    unbiased row-by-row including the overlap.
+
+    Registered as a pytree with the arrays as children and the geometry as
+    static aux data, so it can flow through jit boundaries while shapes
+    stay compile-time constants.
+    """
+
+    def __init__(self, q, tail_w, head: int, block: int, nb: int):
+        self.q = q
+        self.tail_w = tail_w
+        self.head = int(head)
+        self.block = int(block)
+        self.nb = int(nb)
+
+    def tree_flatten(self):
+        return (self.q, self.tail_w), (self.head, self.block, self.nb)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+
+jax.tree_util.register_pytree_node(
+    StratifiedSpec,
+    StratifiedSpec.tree_flatten,
+    StratifiedSpec.tree_unflatten,
+)
+
+
+def build_stratified_spec(
+    counts: np.ndarray,
+    head: int = 256,
+    block: int = 128,
+    ns_exponent: float = 0.75,
+) -> StratifiedSpec:
+    """Host-side construction; clamps geometry for small vocabs (head to
+    half the vocab, block to the tail size) so every vocab works — a tiny
+    vocab degenerates to near-exact negatives (head exact, one tail block
+    always drawn)."""
+    q = noise_distribution(counts, ns_exponent)
+    v = q.shape[0]
+    head = max(1, min(head, v // 2))
+    block = max(1, min(block, v - head))
+    t = v - head
+    nb = -(-t // block)  # ceil: last block start clamps to v - block
+    starts = np.minimum(head + np.arange(nb) * block, v - block)
+    coverage = np.zeros(v, np.int64)
+    for s in starts:
+        coverage[s : s + block] += 1
+    tail_w = np.zeros(v, np.float32)
+    tail = coverage > 0
+    tail_w[tail] = q[tail] * nb / coverage[tail]
+    return StratifiedSpec(
+        q=jnp.asarray(q),
+        tail_w=jnp.asarray(tail_w),
+        head=head,
+        block=block,
+        nb=nb,
+    )

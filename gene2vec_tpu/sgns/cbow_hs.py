@@ -195,6 +195,20 @@ class CBOWHSTrainer:
                 if sharding is not None
                 else self.sampler.table
             )
+            self.stratified = None
+            if config.negative_mode == "stratified":
+                from gene2vec_tpu.data.negative_sampling import (
+                    build_stratified_spec,
+                )
+
+                self.stratified = build_stratified_spec(
+                    corpus.vocab.counts, config.strat_head,
+                    config.strat_block, config.ns_exponent,
+                )
+                if sharding is not None:
+                    self.stratified = jax.device_put(
+                        self.stratified, sharding.replicated()
+                    )
         self.pairs = (
             corpus.device_pairs(sharding.corpus_sharding())
             if sharding is not None
@@ -253,6 +267,7 @@ class CBOWHSTrainer:
                         shared_pool=cfg.shared_pool,
                         shared_pool_auto=cfg.shared_pool_auto,
                         shared_groups=cfg.shared_groups,
+                        stratified=self.stratified,
                     )
                 if sharding is not None:
                     params = sharding.constrain_params(params)

@@ -34,15 +34,15 @@ TPU-first formulation:
 * negatives that collide with the positive target are masked out of loss and
   update (gensim skips them; a resampling loop would be data-dependent
   control flow XLA can't tile);
-* by default negatives are **shared within groups of ~32 examples**
-  (``negative_mode="shared"``): the batch splits into G sub-batches, each
-  drawing its own slice of a pool of P noise draws (each example's
-  negative term is its slice's mean importance-weighted by K/(P/G), an
-  unbiased estimate of the K-negative SGNS objective), so the negative
-  logits are one batched (G, E/G, D) x (G, D, P/G) MXU matmul and the
-  negative update is a (G, P/G, E/G) x (G, E/G, D) matmul scattered into
-  just P rows — versus a per-example (E, K, D) gather plus an E*K-row
-  scatter, which profiling showed dominated the step.
+* the default noise estimator is **stratified** (``negative_mode=
+  "stratified"``, :func:`_step_stratified`): an exact expectation term
+  over the frequency head plus importance-weighted random contiguous
+  tail blocks — the noise term becomes pure MXU matmuls and block-DMA
+  traffic with zero random noise row ops (round-3 redesign,
+  docs/PERF_NOTES.md).  ``negative_mode="shared"`` keeps the round-2
+  grouped noise pool (G sub-batches, each drawing its own slice of a
+  pool of P = 0.8*E*K draws, importance-weighted by K/(P/G)); it is the
+  estimator the P_total quality sweep was measured on.
   ``negative_mode="per_example"`` keeps gensim's exact per-example draws
   for oracle comparisons.
 
@@ -154,6 +154,39 @@ def _row_divisor(cnt: jax.Array, combiner: str) -> jax.Array:
     raise ValueError(f"unknown combiner {combiner!r}")
 
 
+def _acc_dtype_for(compute_dtype):
+    return jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
+
+
+def _scatter_accumulator(
+    v: int,
+    idx: jax.Array,          # (R,) row per gradient
+    grads: jax.Array,        # (R, D)
+    weights: jax.Array,      # (R,) occurrence weight per gradient row
+    acc_dtype,
+) -> jax.Array:
+    """(V, D+1) accumulator: gradients and occurrence weights scatter
+    together — one scatter instead of a count scatter + count gather + grad
+    scatter (profiling showed scatter count, not scatter payload,
+    dominates).  Callers may add further dense contributions before
+    :func:`_finalize_row_updates` applies the combiner divisor."""
+    d = grads.shape[-1]
+    payload = jnp.concatenate(
+        [grads.astype(acc_dtype), weights.astype(acc_dtype)[:, None]], axis=1
+    )
+    return jnp.zeros((v, d + 1), acc_dtype).at[idx].add(payload)
+
+
+def _finalize_row_updates(
+    table: jax.Array, acc: jax.Array, lr: jax.Array, combiner: str
+) -> jax.Array:
+    """table − lr · (accumulated grads / per-row combiner divisor)."""
+    d = table.shape[1]
+    update = acc[:, :d] / _row_divisor(acc[:, d], combiner)[:, None]
+    lr = jnp.asarray(lr, acc.dtype)
+    return (table.astype(acc.dtype) - lr * update).astype(table.dtype)
+
+
 def _apply_row_updates(
     table: jax.Array,        # (V, D)
     idx: jax.Array,          # (R,) row per gradient
@@ -163,24 +196,12 @@ def _apply_row_updates(
     combiner: str,
     compute_dtype,
 ) -> jax.Array:
-    """table − lr · combined row updates, via ONE fused scatter.
-
-    Gradients and occurrence weights scatter together into a (V, D+1)
-    accumulator — one scatter instead of a count scatter + count gather +
-    grad scatter (profiling showed scatter count, not scatter payload,
-    dominates) — and the combiner divisor is applied row-wise on the dense
-    accumulator afterwards.  Weights accumulate in f32 via the accumulator's
-    dtype; see :func:`_row_divisor` for the combiner semantics.
-    """
-    v, d = table.shape
-    acc_dtype = jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
-    payload = jnp.concatenate(
-        [grads.astype(acc_dtype), weights.astype(acc_dtype)[:, None]], axis=1
+    """table − lr · combined row updates, via ONE fused scatter; see
+    :func:`_scatter_accumulator` / :func:`_row_divisor` for semantics."""
+    acc = _scatter_accumulator(
+        table.shape[0], idx, grads, weights, _acc_dtype_for(compute_dtype)
     )
-    acc = jnp.zeros((v, d + 1), acc_dtype).at[idx].add(payload)
-    update = acc[:, :d] / _row_divisor(acc[:, d], combiner)[:, None]
-    lr = jnp.asarray(lr, acc_dtype)
-    return (table.astype(acc_dtype) - lr * update).astype(table.dtype)
+    return _finalize_row_updates(table, acc, lr, combiner)
 
 
 def _step_per_example(
@@ -325,6 +346,160 @@ def _step_shared(
     return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
 
 
+def _step_stratified(
+    params: SGNSParams,
+    centers: jax.Array,   # (E,)
+    contexts: jax.Array,  # (E,)
+    spec,                 # StratifiedSpec (data/negative_sampling)
+    key: jax.Array,
+    k_negatives: int,
+    group_size: int,
+    lr: jax.Array,
+    compute_dtype,
+    combiner: str,
+) -> Tuple[SGNSParams, jax.Array]:
+    """Stratified negatives: exact head + per-group random tail blocks.
+
+    The round-3 redesign of the noise term (docs/PERF_NOTES.md §round-3;
+    measured on the integrated path: 2.6-2.8M pairs/s vs 1.95M shared-auto
+    at B=16,384 on v5e, holdout AUC 0.896 vs the 0.878 sequential-oracle
+    parity target — the authoritative numbers, also in PERF_NOTES).  The
+    shared/per-example modes spend ~2/3 of their row ops gathering and
+    scattering P = 0.8*E*K random noise rows; noise rows have no example
+    coupling, so this mode restructures them into contiguous traffic:
+
+    * HEAD (rows [0, head) of the frequency-sorted vocab): the negative
+      term's expectation over the head mass is computed EXACTLY —
+      K * q_j * softplus(v.u_j) via one dense (E, D) x (D, H) MXU matmul
+      over a contiguous table slice.  Zero sampling variance where the
+      noise mass concentrates, and the ctx update is a dense slice add.
+    * TAIL: each group of ``group_size`` examples draws ONE contiguous
+      block of ``spec.block`` rows (uniform over ``spec.nb`` blocks;
+      ``spec.tail_w`` = q/p makes the estimator unbiased row-by-row, see
+      StratifiedSpec).  Gathers are vmapped dynamic slices and the
+      scatter is block-indexed — G block operations instead of G*S row
+      operations.
+
+    Cap symmetry (QUALITY_NOTES invariant 1) is preserved by adding the
+    noise gradients AND their example-unit weights densely into the same
+    (V, D+1) accumulator the positive scatter uses: each row still gets
+    one combiner divisor over the sum of positive and negative load.
+    Estimator rank (invariant 3) holds because each example sees
+    head + block >= hundreds of distinct repulsion directions per step.
+    """
+    emb_t, ctx_t = params.emb, params.ctx
+    v_size, d = ctx_t.shape
+    e = centers.shape[0]
+    g = max(1, e // group_size)
+    while e % g:
+        g -= 1
+    head, block, nb = spec.head, spec.block, spec.nb
+    k = jnp.asarray(float(k_negatives), compute_dtype)
+
+    v = emb_t[centers].astype(compute_dtype)          # (E, D)
+    u_pos = ctx_t[contexts].astype(compute_dtype)     # (E, D)
+    pos_logit = jnp.sum(v * u_pos, axis=-1)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+
+    # ---- head: exact expectation over rows [0, head) ---------------------
+    ctx_head = ctx_t[:head].astype(compute_dtype)     # contiguous slice
+    q_head = spec.q[:head].astype(compute_dtype)
+    head_logit = v @ ctx_head.T                       # (E, H) MXU
+    head_mask = (
+        jnp.arange(head)[None, :] != contexts[:, None]
+    ).astype(compute_dtype)                           # gensim skip parity
+    g_head = k * q_head[None, :] * jax.nn.sigmoid(head_logit) * head_mask
+    loss_head = k * jnp.sum(
+        q_head[None, :] * head_mask * jax.nn.softplus(head_logit), axis=-1
+    )
+
+    # ---- tail: one random block per group --------------------------------
+    blocks = jax.random.randint(key, (g,), 0, nb)
+    starts = jnp.minimum(head + blocks * block, v_size - block)
+
+    def slice_rows(tbl, s):
+        return jax.lax.dynamic_slice(tbl, (s, 0), (block, tbl.shape[1]))
+
+    ctx_blk = jax.vmap(slice_rows, in_axes=(None, 0))(
+        ctx_t, starts
+    ).astype(compute_dtype)                           # (G, S, D)
+    w_blk = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(spec.tail_w, (s,), (block,))
+    )(starts).astype(compute_dtype)                   # (G, S) q/p weights
+
+    vg = v.reshape(g, e // g, d)
+    cg = contexts.reshape(g, e // g)
+    tail_logit = jnp.einsum("ged,gsd->ges", vg, ctx_blk)      # MXU
+    row_ids = starts[:, None] + jnp.arange(block)[None, :]    # (G, S)
+    tail_mask = (
+        row_ids[:, None, :] != cg[:, :, None]
+    ).astype(compute_dtype)
+    w_tail = k * w_blk[:, None, :]
+    g_tail = w_tail * jax.nn.sigmoid(tail_logit) * tail_mask
+    loss_tail = jnp.sum(
+        w_tail * tail_mask * jax.nn.softplus(tail_logit), axis=-1
+    ).reshape(e)
+
+    loss = jnp.mean(jax.nn.softplus(-pos_logit) + loss_head + loss_tail)
+
+    # ---- center gradients: same per-example scatter path as other modes --
+    d_center = (
+        g_pos[:, None] * u_pos
+        + g_head @ ctx_head                                        # MXU
+        + jnp.einsum("ges,gsd->ged", g_tail, ctx_blk).reshape(e, d)
+    )
+    emb = _apply_row_updates(
+        emb_t, centers, d_center,
+        jnp.ones_like(centers, compute_dtype), lr, combiner, compute_dtype,
+    )
+
+    # ---- ctx: positive scatter + DENSE noise adds into ONE accumulator ---
+    acc_dtype = _acc_dtype_for(compute_dtype)
+    d_pos = g_pos[:, None] * v
+    acc = _scatter_accumulator(
+        v_size, contexts, d_pos, jnp.ones((e,), compute_dtype), acc_dtype
+    )
+
+    # Noise weight columns carry the rows' sigma-FREE example-unit loads —
+    # k*q_j*sum(mask) for head, k*w_j*sum(mask) for tail — matching the
+    # shared mode's scale*sum(mask) and per-example's mask<=1 exactly:
+    # the cap divisor must track how much sequential-equivalent gradient a
+    # row aggregated, not how much of it the current sigmoids pass (a
+    # sigma-modulated load would vanish as training polarizes, decoupling
+    # the divisor from row load — the asymmetric-cap failure class of
+    # QUALITY_NOTES invariant 1).
+    d_head_rows = g_head.T @ v                                     # MXU
+    u_head = k * q_head * jnp.sum(head_mask, axis=0, dtype=jnp.float32)
+    acc = acc.at[:head, :d].add(d_head_rows.astype(acc_dtype))
+    acc = acc.at[:head, d].add(u_head.astype(acc_dtype))
+
+    d_tail_rows = jnp.einsum("ges,ged->gsd", g_tail, vg)           # MXU
+    u_tail = w_tail[:, 0, :] * jnp.sum(tail_mask, axis=1, dtype=jnp.float32)
+    tail_payload = jnp.concatenate(
+        [
+            d_tail_rows.astype(acc_dtype),
+            u_tail[:, :, None].astype(acc_dtype),
+        ],
+        axis=2,
+    )
+    # block-indexed scatter-add: G indices with (S, D+1) payloads into a
+    # (NB, S, D+1) accumulator, then two STATIC slice adds into the row
+    # accumulator — blocks [0, nb-1) tile [head, head+(nb-1)*block)
+    # contiguously and the clamped last block sits at v - block (its
+    # overlap rows were pre-divided by their doubled coverage in tail_w)
+    acc_blocks = jnp.zeros((nb, block, d + 1), acc_dtype).at[blocks].add(
+        tail_payload
+    )
+    if nb > 1:
+        acc = acc.at[head : head + (nb - 1) * block].add(
+            acc_blocks[:-1].reshape((nb - 1) * block, d + 1)
+        )
+    acc = acc.at[v_size - block :].add(acc_blocks[-1])
+
+    ctx = _finalize_row_updates(ctx_t, acc, lr, combiner)
+    return SGNSParams(emb=emb, ctx=ctx), loss
+
+
 def sgns_step(
     params: SGNSParams,
     pairs: jax.Array,  # (B, 2) int32
@@ -339,9 +514,30 @@ def sgns_step(
     shared_pool: int = 1024,
     shared_pool_auto: bool = True,
     shared_groups: int = 0,
+    stratified=None,  # StratifiedSpec, required for negative_mode="stratified"
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
     centers, contexts = _examples_from_pairs(pairs, both_directions)
+    if negative_mode == "stratified":
+        if stratified is None:
+            raise ValueError(
+                "negative_mode='stratified' needs a StratifiedSpec (built "
+                "from vocab counts via build_stratified_spec); SGNSTrainer "
+                "wires this automatically"
+            )
+        # shared_groups keeps its shared-mode meaning (number of groups);
+        # unset -> the measured-flat ~32-example sub-batches
+        e = int(centers.shape[0])
+        if shared_groups > 0 and (shared_groups > e or e % shared_groups):
+            raise ValueError(
+                f"shared_groups={shared_groups} does not divide the example "
+                f"count {e} (= {'2x' if both_directions else ''}batch_pairs)"
+            )
+        group_size = e // shared_groups if shared_groups > 0 else 32
+        return _step_stratified(
+            params, centers, contexts, stratified, key, negatives,
+            group_size, lr, compute_dtype, combiner,
+        )
     if negative_mode == "shared":
         e = int(centers.shape[0])
         # groups of ~32 examples, each with its own pool slice (estimator-

@@ -42,11 +42,14 @@ def make_train_epoch(
     num_batches: int,
     config: SGNSConfig,
     sharding: Optional["SGNSSharding"] = None,
+    stratified=None,
 ) -> Callable:
     """Build the jitted epoch function.
 
     Signature: (params, pairs, noise, key) -> (params, mean_loss).
     All loop structure is static; only array contents are traced.
+    ``stratified`` (a StratifiedSpec) is captured in the closure — its
+    arrays are per-trainer constants derived from the vocab counts.
     """
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
@@ -82,6 +85,7 @@ def make_train_epoch(
                 shared_pool=config.shared_pool,
                 shared_pool_auto=config.shared_pool_auto,
                 shared_groups=config.shared_groups,
+                stratified=stratified,
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)
@@ -164,8 +168,24 @@ class SGNSTrainer:
             self.noise = self.sampler.table
             self.pairs = corpus.device_pairs()
 
+        self.stratified = None
+        if config.negative_mode == "stratified":
+            from gene2vec_tpu.data.negative_sampling import (
+                build_stratified_spec,
+            )
+
+            self.stratified = build_stratified_spec(
+                corpus.vocab.counts, config.strat_head, config.strat_block,
+                config.ns_exponent,
+            )
+            if sharding is not None:
+                self.stratified = jax.device_put(
+                    self.stratified, sharding.replicated()
+                )
+
         self._epoch_fn = make_train_epoch(
-            corpus.num_pairs, self.num_batches, self.config, sharding
+            corpus.num_pairs, self.num_batches, self.config, sharding,
+            stratified=self.stratified,
         )
         self.timer = StepTimer()
 

@@ -270,8 +270,9 @@ def main() -> None:
                     help="GGIPNN epochs (reference default 1)")
     ap.add_argument("--emb-iters", type=int, default=50)
     ap.add_argument("--batch-pairs", type=int, default=4096)
-    ap.add_argument("--negative-mode", choices=("shared", "per_example"),
-                    default="shared")
+    ap.add_argument("--negative-mode",
+                    choices=("stratified", "shared", "per_example"),
+                    default="stratified")
     ap.add_argument("--combiner", choices=("capped", "sum", "mean"),
                     default="capped")
     ap.add_argument("--shared-pool", type=int, default=0,

@@ -1,0 +1,107 @@
+"""negative_mode="stratified": spec geometry, estimator unbiasedness, and
+training sanity (the round-3 noise-term redesign, sgns/step.py
+_step_stratified)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import (
+    build_stratified_spec,
+    noise_distribution,
+)
+from gene2vec_tpu.sgns.model import SGNSParams
+from gene2vec_tpu.sgns.step import sgns_step
+
+
+@pytest.mark.parametrize("v", [7, 50, 200, 3711, 24447])
+def test_spec_geometry_and_unbiasedness(v):
+    counts = np.arange(v, 0, -1) ** 2  # skewed, frequency-sorted
+    spec = build_stratified_spec(counts)
+    q = np.asarray(spec.q)
+    tail_w = np.asarray(spec.tail_w)
+    assert 1 <= spec.head <= v // 2 or v < 2
+    assert spec.block <= v - spec.head
+    # every tail row is covered by at least one block
+    assert (tail_w[spec.head:] > 0).all()
+    # head rows are never tail-sampled
+    assert (tail_w[: spec.head] == 0).all()
+    # unbiasedness identity: averaging the per-block weighted sums over a
+    # uniform block draw recovers the tail q-mass exactly
+    starts = np.minimum(
+        spec.head + np.arange(spec.nb) * spec.block, v - spec.block
+    )
+    total = sum(tail_w[s : s + spec.block].sum() for s in starts) / spec.nb
+    np.testing.assert_allclose(total, q[spec.head :].sum(), rtol=1e-5)
+
+
+def test_stratified_loss_unbiased_vs_exact_expectation():
+    """The stratified loss, averaged over block draws, must equal the exact
+    SGNS objective (positives + K * E_q[masked softplus]) computed densely.
+    This pins both the head term's exactness and the tail importance
+    weights in one identity."""
+    v_size, d, b = 64, 16, 32
+    rng = np.random.RandomState(0)
+    counts = (np.arange(v_size, 0, -1) ** 1.5).astype(np.int64)
+    spec = build_stratified_spec(counts, head=8, block=8)
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+        ctx=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+    )
+    pairs = jnp.asarray(rng.randint(0, v_size, (b, 2)).astype(np.int32))
+
+    def loss_of(key):
+        _, loss = sgns_step(
+            params, pairs, None, key, 0.0,
+            negative_mode="stratified", stratified=spec, shared_groups=8,
+        )
+        return loss
+
+    losses = jax.vmap(loss_of)(
+        jax.random.split(jax.random.PRNGKey(1), 512)
+    )
+    est = float(jnp.mean(losses))
+
+    # exact objective, dense over the whole vocab
+    q = np.asarray(spec.q)
+    emb, ctx = np.asarray(params.emb), np.asarray(params.ctx)
+    centers = np.concatenate([pairs[:, 0], pairs[:, 1]])
+    contexts = np.concatenate([pairs[:, 1], pairs[:, 0]])
+    v = emb[centers]
+    pos = np.log1p(np.exp(-np.sum(v * ctx[contexts], axis=1)))
+    logits = v @ ctx.T                                   # (E, V)
+    mask = np.arange(v_size)[None, :] != contexts[:, None]
+    neg = 5.0 * np.sum(q[None, :] * mask * np.log1p(np.exp(logits)), axis=1)
+    exact = float(np.mean(pos + neg))
+    # 512 draws of the tail estimator: sampling error ~1%
+    assert est == pytest.approx(exact, rel=0.02), (est, exact)
+
+
+def test_stratified_trains_and_separates(synthetic_corpus_dir):
+    from conftest import cluster_separation
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.pair_reader import load_corpus
+    from gene2vec_tpu.sgns.train import train_epochs
+
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    emb, losses = train_epochs(
+        PairCorpus(vocab, pairs),
+        SGNSConfig(dim=16, batch_pairs=64, negative_mode="stratified"),
+        60,
+    )
+    assert losses[-1] < losses[0] - 1.0, losses[:3] + losses[-3:]
+    assert cluster_separation(emb, vocab.id_to_token) > 0.3
+
+
+def test_stratified_requires_spec():
+    params = SGNSParams(
+        emb=jnp.zeros((8, 4)), ctx=jnp.zeros((8, 4))
+    )
+    with pytest.raises(ValueError, match="StratifiedSpec"):
+        sgns_step(
+            params, jnp.zeros((4, 2), jnp.int32), None,
+            jax.random.PRNGKey(0), 0.1, negative_mode="stratified",
+        )
