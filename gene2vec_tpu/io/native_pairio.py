@@ -35,6 +35,9 @@ class _PairioResult(ctypes.Structure):
         ("counts", ctypes.POINTER(ctypes.c_int64)),
         ("tokens", ctypes.c_char_p),
         ("tokens_len", ctypes.c_int64),
+        ("err_file", ctypes.c_int32),
+        ("err_offset", ctypes.c_int64),
+        ("err_byte", ctypes.c_uint8),
     ]
 
 
@@ -58,8 +61,11 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
-        _try_build()
+    # always run make (a no-op when fresh): the Makefile's mtime dependency
+    # rebuilds a STALE libpairio.so left by an older checkout — loading one
+    # across an ABI change (e.g. the strict_cp1252 parameter) would call
+    # the old entry point with the new signature and segfault
+    _try_build()
     if not os.path.exists(_LIB_PATH):
         return None
     lib = ctypes.CDLL(_LIB_PATH)
@@ -67,6 +73,7 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_char_p),
         ctypes.c_int32,
         ctypes.c_int64,
+        ctypes.c_int32,
         ctypes.POINTER(_PairioResult),
     ]
     lib.pairio_load_files.restype = ctypes.c_int
@@ -80,49 +87,35 @@ def available() -> bool:
     return _load() is not None
 
 
-# cp1252 leaves these five bytes undefined; Python's strict decoder raises
-# on them anywhere in a file, while the native reader only decodes kept
-# tokens.  Pre-validating keeps the two paths behavior-identical (round-1
-# advisor finding).
-_CP1252_UNDEFINED = np.array([0x81, 0x8D, 0x8F, 0x90, 0x9D], dtype=np.uint8)
-
-
-def _validate_cp1252(path: str, chunk_bytes: int = 1 << 22) -> None:
-    offset = 0
-    with open(path, "rb") as f:
-        while True:
-            raw = f.read(chunk_bytes)
-            if not raw:
-                return
-            data = np.frombuffer(raw, dtype=np.uint8)
-            bad = np.isin(data, _CP1252_UNDEFINED)
-            if bad.any():
-                pos = int(np.argmax(bad))
-                raise UnicodeDecodeError(
-                    "charmap", bytes(data[max(0, pos - 8): pos + 8]),
-                    min(pos, 8), min(pos, 8) + 1,
-                    f"byte 0x{data[pos]:02X} undefined in cp1252 "
-                    f"({path} offset {offset + pos})",
-                )
-            offset += len(raw)
-
-
 def load_corpus(
     paths: Sequence[str], min_count: int = 1, encoding: str = "windows-1252"
 ) -> Tuple[Vocab, np.ndarray]:
-    """(Vocab, (N, 2) int32 pairs) — behavior-identical to the Python path."""
+    """(Vocab, (N, 2) int32 pairs) — behavior-identical to the Python path.
+
+    For the default windows-1252 encoding the reader rejects the five
+    bytes cp1252 leaves undefined *inside its single scan* (the Python
+    path's strict decoder raises on them anywhere in a file; a former
+    wrapper-side pre-pass cost a full extra read of every file — round-2
+    advisor finding)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native pairio library not available")
     paths = list(paths)
-    if encoding.replace("-", "").lower() in ("windows1252", "cp1252"):
-        for p in paths:
-            _validate_cp1252(p)
+    strict = encoding.replace("-", "").lower() in ("windows1252", "cp1252")
     c_paths = (ctypes.c_char_p * len(paths))(
         *[p.encode("utf-8") for p in paths]
     )
     res = _PairioResult()
-    rc = lib.pairio_load_files(c_paths, len(paths), min_count, ctypes.byref(res))
+    rc = lib.pairio_load_files(
+        c_paths, len(paths), min_count, int(strict), ctypes.byref(res)
+    )
+    if rc == -3:
+        path, off, byte = paths[res.err_file], res.err_offset, res.err_byte
+        lib.pairio_free(ctypes.byref(res))
+        raise UnicodeDecodeError(
+            "charmap", bytes([byte]), 0, 1,
+            f"byte 0x{byte:02X} undefined in cp1252 ({path} offset {off})",
+        )
     if rc != 0:
         lib.pairio_free(ctypes.byref(res))
         raise OSError(f"pairio_load_files failed with code {rc}")

@@ -162,11 +162,17 @@ class HogwildSGNSTrainer:
         for it in range(start_iter, cfg.num_iters + 1):
             t0 = time.perf_counter()
             # shuffle stream keyed by (seed, it) so a resumed run shuffles
-            # identically to an uninterrupted one (round-1 advisor finding)
+            # identically to an uninterrupted one (round-1 advisor finding);
+            # SeedSequence mixes non-additively so adjacent-seed runs don't
+            # share streams (seed=2 iter 1 vs seed=1 iter 2 — round-2
+            # advisor finding, same fix as numpy_backend)
+            mixed = int(
+                np.random.SeedSequence([cfg.seed, it]).generate_state(1)[0]
+            )
             params, loss = self.train_epoch(
                 params,
-                seed=cfg.seed + it,
-                rng=np.random.RandomState(cfg.seed + it),
+                seed=mixed,
+                rng=np.random.RandomState(mixed),
             )
             dt = time.perf_counter() - t0
             rate = self.corpus.num_pairs / dt if dt > 0 else float("inf")

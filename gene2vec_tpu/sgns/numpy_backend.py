@@ -126,9 +126,21 @@ class NumpySGNSTrainer:
             t0 = time.perf_counter()
             # per-iteration stream keyed by (seed, it): a resumed run draws
             # the same shuffles/negatives as an uninterrupted one (round-1
-            # advisor finding; matches the hogwild kernel's seeding)
+            # advisor finding).  SeedSequence mixes the key non-additively —
+            # seed+it would make adjacent-seed runs share streams (run
+            # seed=2 iter 1 == run seed=1 iter 2; round-2 advisor finding)
             params, loss = self.train_epoch(
-                params, np.random.RandomState(cfg.seed + it)
+                params,
+                np.random.RandomState(
+                    # int, not the 1-element array: RandomState seeds arrays
+                    # via init_by_array but scalars via init_genrand — the
+                    # scalar form keys identically to native_backend
+                    int(
+                        np.random.SeedSequence(
+                            [cfg.seed, it]
+                        ).generate_state(1)[0]
+                    )
+                ),
             )
             dt = time.perf_counter() - t0
             rate = pairs_per_epoch / dt if dt > 0 else float("inf")

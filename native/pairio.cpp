@@ -96,11 +96,17 @@ struct PairioResult {
   int64_t* counts = nullptr;     // vocab_size, id order
   char* tokens = nullptr;        // '\n'-joined token bytes, id order
   int64_t tokens_len = 0;
+  // set when strict_cp1252 rejects a byte (return code -3)
+  int32_t err_file = -1;         // index into `paths`
+  int64_t err_offset = -1;       // byte offset within that file
+  uint8_t err_byte = 0;
 };
 
-// Returns 0 on success, negative on error (-1 io, -2 alloc).
+// Returns 0 on success, negative on error (-1 io, -2 alloc, -3 a byte
+// undefined in cp1252 under strict_cp1252 — position in err_file/
+// err_offset/err_byte).
 int pairio_load_files(const char** paths, int32_t n_paths, int64_t min_count,
-                      PairioResult* out) {
+                      int32_t strict_cp1252, PairioResult* out) {
   std::unordered_map<std::string_view, TokenInfo> table;
   std::vector<std::string_view> by_first;           // first-appearance order
   std::vector<std::pair<int32_t, int32_t>> raw_pairs;  // first-appearance ids
@@ -122,7 +128,24 @@ int pairio_load_files(const char** paths, int32_t n_paths, int64_t min_count,
         while (q < line_end && is_space(static_cast<unsigned char>(*q))) ++q;
         if (q == line_end) break;
         const char* tok_start = q;
-        while (q < line_end && !is_space(static_cast<unsigned char>(*q))) ++q;
+        while (q < line_end && !is_space(static_cast<unsigned char>(*q))) {
+          // cp1252 leaves exactly these five bytes undefined; Python's
+          // strict decoder raises on them anywhere in a file.  Checking
+          // during the token scan keeps the native path behavior-identical
+          // without the wrapper's former extra full-file pre-pass (every
+          // non-whitespace byte lands in a token, and none of the five is
+          // whitespace, so token bytes cover them).
+          const unsigned char c = static_cast<unsigned char>(*q);
+          if (strict_cp1252 &&
+              (c == 0x81 || c == 0x8D || c == 0x8F || c == 0x90 ||
+               c == 0x9D)) {
+            out->err_file = f;
+            out->err_offset = static_cast<int64_t>(q - files[f].data);
+            out->err_byte = c;
+            return -3;
+          }
+          ++q;
+        }
         std::string_view tok(tok_start, static_cast<size_t>(q - tok_start));
         auto it = table.find(tok);
         if (it == table.end()) {
