@@ -62,6 +62,31 @@ class PairCorpus:
         extra = self.pairs[: multiple - rem]
         return PairCorpus(self.vocab, np.concatenate([self.pairs, extra], axis=0))
 
+    def process_shard(
+        self, index: Optional[int] = None, count: Optional[int] = None
+    ) -> "PairCorpus":
+        """This host's strided shard of the corpus for multi-host SPMD runs
+        (docs/DISTRIBUTED.md): every host reads the same files, keeps rows
+        ``index::count``, and feeds only its shard of the global batch.
+        Strided (not blocked) so hosts' shards interleave the corpus order
+        and the per-epoch shuffle stays well-mixed globally.  Defaults to
+        ``jax.process_index()``/``jax.process_count()``; identity on a
+        single-process run.  Vocab (built from the FULL corpus) is shared —
+        call before any per-host padding."""
+        if index is None:
+            index = jax.process_index()
+        if count is None:
+            count = jax.process_count()
+        if count < 1:
+            # a buggy launcher (unset env parsed as 0) must not silently
+            # feed every host the full corpus
+            raise ValueError(f"process count must be >= 1, got {count}")
+        if not 0 <= index < count:
+            raise ValueError(f"process index {index} not in [0, {count})")
+        if count == 1:
+            return self
+        return PairCorpus(self.vocab, self.pairs[index::count])
+
     def host_batches(
         self, batch_pairs: int, rng: np.random.Generator, shuffle: bool = True
     ) -> Iterator[np.ndarray]:

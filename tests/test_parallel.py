@@ -176,3 +176,31 @@ print("DISTRIBUTED_OK")
         timeout=180, env=env,
     )
     assert "DISTRIBUTED_OK" in res.stdout, res.stderr[-2000:]
+
+
+def test_process_shard_partitions_corpus():
+    """Multi-host feeding (docs/DISTRIBUTED.md): strided shards partition
+    the corpus exactly, share the full-corpus vocab, and the single-process
+    default is the identity."""
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 20, (101, 2)).astype(np.int32)
+    vocab = Vocab(
+        [f"g{i}" for i in range(20)],
+        np.bincount(pairs.reshape(-1), minlength=20),
+    )
+    corpus = PairCorpus(vocab, pairs)
+
+    shards = [corpus.process_shard(i, 4) for i in range(4)]
+    assert [s.num_pairs for s in shards] == [26, 25, 25, 25]
+    reassembled = np.concatenate([s.pairs for s in shards])
+    np.testing.assert_array_equal(
+        np.sort(reassembled.view("i4,i4"), axis=0),
+        np.sort(pairs.view("i4,i4"), axis=0),
+    )
+    for s in shards:
+        assert s.vocab is vocab  # full-corpus vocab, never re-derived
+    assert corpus.process_shard(0, 1) is corpus  # single-process identity
+    with pytest.raises(ValueError, match="process index"):
+        corpus.process_shard(4, 4)
+    with pytest.raises(ValueError, match="process count"):
+        corpus.process_shard(0, 0)
