@@ -12,5 +12,7 @@ system (SURVEY §5 "config/flag system"):
 * ``python -m gene2vec_tpu.cli.evaluate emb.txt msigdb.gmt``
   — ``src/evaluation_target_function.py`` parity;
 * ``python -m gene2vec_tpu.cli.tsne`` / ``...cli.plot``
-  — ``src/tsne_multi_core.py`` / ``src/plot_gene2vec.py`` parity.
+  — ``src/tsne_multi_core.py`` / ``src/plot_gene2vec.py`` parity;
+* ``python -m gene2vec_tpu.cli.dashboard --figure-json fig.json``
+  — ``src/gene2vec_dash_app.py:17-27`` parity (GeneView, needs dash).
 """
