@@ -110,9 +110,33 @@ def synthetic_big(v=24000, n=8_000_000, seed=0):
 # -- suites ------------------------------------------------------------------
 
 
+def degree_baseline(split: HoldoutSplit) -> float:
+    """No-embedding degree-product baseline on the in-vocab holdout —
+    the number frozen as eval.holdout.DEGREE_BASELINE_AUC (QUALITY_NOTES
+    §8: this metric has a strong co-occurrence floor)."""
+    from gene2vec_tpu.eval.metrics import roc_auc_score
+
+    deg: dict = {}
+    vocab_tokens = set()
+    for a, b in split.fit_positives:
+        deg[a] = deg.get(a, 0) + 1
+        deg[b] = deg.get(b, 0) + 1
+        vocab_tokens.update((a, b))
+    scores, labels = [], []
+    for (a, b), y in zip(split.hold_pairs, split.hold_labels):
+        if a in vocab_tokens and b in vocab_tokens:
+            scores.append(np.log1p(deg.get(a, 0)) + np.log1p(deg.get(b, 0)))
+            labels.append(y)
+    return roc_auc_score(np.asarray(labels), np.asarray(scores))
+
+
 def suite_matrix(args) -> list:
     corpus, split = load_holdout(args.data_dir)
-    rows = []
+    rows = [
+        {"config": "degree-product baseline (no embedding)",
+         "holdout_cos_auc": round(degree_baseline(split), 4)}
+    ]
+    log(f"degree baseline AUC {rows[0]['holdout_cos_auc']}")
     shared = dict(negative_mode="shared")  # modes pinned explicitly: the
     # SGNSConfig default moved to "stratified" in round 3 and these rows
     # must keep measuring what their labels say

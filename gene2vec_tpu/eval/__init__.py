@@ -2,6 +2,7 @@
 harness, and the canonical seen-gene holdout protocol."""
 
 from gene2vec_tpu.eval.holdout import (  # noqa: F401
+    DEGREE_BASELINE_AUC,
     GATE_MIN_AUC,
     HOLDOUT_FRACTION,
     HOLDOUT_SEED,
