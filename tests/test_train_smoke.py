@@ -106,3 +106,43 @@ def test_epoch_shuffle_preserves_pair_multiset():
         key = lambda a: sorted(map(tuple, a.tolist()))
         assert key(got) == key(want), mode
         assert not np.array_equal(got, want)  # it actually shuffled
+
+
+REFERENCE_SMOKE = "/root/reference/data"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REFERENCE_SMOKE}/test.txt"),
+    reason="reference smoke corpus not mounted",
+)
+def test_reference_smoke_corpus_end_to_end(tmp_path):
+    """BASELINE required config 1's data: the reference's own 39-pair
+    ``data/test.txt`` through the reference-shaped CLI invocation
+    (``python gene2vec.py data_dir out_dir txt``, src/gene2vec.py:8-15).
+    The trainer must shrink its batch to the tiny corpus, run all
+    iterations, and leave the per-iteration artifact set."""
+    import shutil
+
+    from gene2vec_tpu.cli.gene2vec import main as gene2vec_main
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    shutil.copy(f"{REFERENCE_SMOKE}/test.txt", data_dir / "test.txt")
+    out = tmp_path / "out"
+    rc = gene2vec_main(
+        [str(data_dir), str(out), "txt", "--dim", "16", "--iters", "2"]
+    )
+    assert rc == 0
+    # per-iteration artifact set: every iteration keeps all three formats
+    for it in (1, 2):
+        for suffix in (".npz", ".txt", "_w2v.txt"):
+            assert (out / f"gene2vec_dim_16_iter_{it}{suffix}").exists(), (
+                it, suffix,
+            )
+    toks, mat = read_word2vec_format(str(out / "gene2vec_dim_16_iter_2_w2v.txt"))
+    assert mat.shape == (len(toks), 16)
+    assert np.isfinite(mat).all()
+    # every gene of the 39-pair corpus is in vocab (min_count=1 parity)
+    with open(f"{REFERENCE_SMOKE}/test.txt", encoding="windows-1252") as f:
+        genes = {g for line in f for g in line.split()}
+    assert set(toks) == genes
