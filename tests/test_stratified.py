@@ -105,3 +105,28 @@ def test_stratified_requires_spec():
             params, jnp.zeros((4, 2), jnp.int32), None,
             jax.random.PRNGKey(0), 0.1, negative_mode="stratified",
         )
+
+
+@pytest.mark.parametrize("combiner", ["capped", "sum", "mean"])
+@pytest.mark.parametrize("both_directions", [True, False])
+def test_stratified_edge_configs(combiner, both_directions):
+    """Single-direction mode, every combiner, and sub-group/odd batch
+    sizes all produce finite losses and finite updated tables."""
+    rng = np.random.RandomState(0)
+    v_size, d = 64, 16
+    counts = (np.arange(v_size, 0, -1) ** 1.5).astype(np.int64)
+    spec = build_stratified_spec(counts, head=8, block=8)
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+        ctx=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+    )
+    for n_pairs in (20, 13):  # E < group size; E odd and indivisible
+        pairs = jnp.asarray(rng.randint(0, v_size, (n_pairs, 2), ).astype(np.int32))
+        p2, loss = sgns_step(
+            params, pairs, None, jax.random.PRNGKey(0), 0.05,
+            negative_mode="stratified", stratified=spec,
+            combiner=combiner, both_directions=both_directions,
+        )
+        assert np.isfinite(float(loss))
+        assert np.isfinite(np.asarray(p2.emb)).all()
+        assert np.isfinite(np.asarray(p2.ctx)).all()
