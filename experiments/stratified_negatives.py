@@ -1,36 +1,40 @@
-"""Prototype + measurement for negative_mode="stratified" (round-3 perf).
+"""Measurements behind negative_mode="stratified" (round-3 perf design;
+the estimator itself lives in gene2vec_tpu/sgns/step.py _step_stratified
+and is what these suites drive — no separate prototype implementation).
 
 Motivation (docs/PERF_NOTES.md round-3 section): at the quality-parity
 pool size P = 0.8*E*K the shared-negative step spends ~2/3 of its row ops
-on noise rows (gather + scatter of P random rows), capping the step at
-~2M pairs/s.  Noise rows have no example coupling, so they can be
-restructured into contiguous traffic:
+on noise rows; noise rows have no example coupling, so the stratified
+estimator restructures them into an exact frequency-head term plus
+importance-weighted contiguous tail blocks.
 
-* HEAD: the top-H vocab rows (frequency-sorted vocab) contribute their
-  EXACT expectation term K*q_j*softplus(v.u_j) — a dense (E,D)x(D,H)
-  matmul over a contiguous table slice; zero sampling variance for the
-  q-mass the head covers, and the ctx update is a dense slice add.
-* TAIL: the remaining vocab is partitioned into NB fixed blocks of S
-  contiguous rows; each group of ~32 examples draws ONE block uniformly
-  (importance weight T/S per row, T = tail size), an unbiased estimator
-  of the tail mass served by dynamic-slice gathers and block-indexed
-  scatter-adds — G block ops instead of G*S row ops.
+Suites::
 
-Cap symmetry (QUALITY_NOTES invariant 1) is preserved by adding the noise
-gradients AND their example-unit weights densely into the same (V, D+1)
-accumulator the positive scatter uses, so each row still gets one divisor
-over the sum of both.
+    python experiments/stratified_negatives.py --suite rate
+        # head/block sweep vs the shared baseline, 4M-pair Zipf corpus
+    python experiments/stratified_negatives.py --suite quality
+        # holdout AUC per (head, block, tail layout), real corpus
 
-Usage::
+Incident record (do not repeat): the first prototype of this estimator
+measured holdout AUC 0.75-0.76 — entirely an artifact of a hand-rolled
+training loop that skipped the trainer's per-epoch lr re-sweep
+(0.025 -> 1e-4, sgns/train.py:72-73) and per-epoch shuffle.  With the
+discipline matched the same estimator measured 0.886-0.895.  Estimator
+experiments must train through SGNSTrainer/train_epochs (these suites
+do); docs/QUALITY_NOTES.md §7 records the trap.
 
-    python experiments/stratified_negatives.py --suite rate     # throughput
-    python experiments/stratified_negatives.py --suite quality  # holdout AUC
+The quality suite also reproduces the tail-layout experiment: dealing
+tail rows round-robin into blocks (interleave_tail) makes every
+contiguous block a stratified systematic sample of the whole frequency
+range.  Against the pre-unit-fix step it measured +0.004-0.007 AUC;
+against the integrated sigma-free-units step it is neutral
+(0.8957 vs 0.8965 banded) — recorded here so the option stays
+reproducible, not integrated.
 """
 
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys
@@ -41,280 +45,40 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-import jax
-import jax.numpy as jnp
+import jax  # noqa: E402
 
-from gene2vec_tpu.config import SGNSConfig
-from gene2vec_tpu.data.negative_sampling import noise_distribution
-from gene2vec_tpu.data.pipeline import PairCorpus
-from gene2vec_tpu.sgns.model import SGNSParams
-from gene2vec_tpu.sgns.step import (
-    _apply_row_updates,
-    _examples_from_pairs,
-    _row_divisor,
-)
+from gene2vec_tpu.config import SGNSConfig  # noqa: E402
+from gene2vec_tpu.data.pipeline import PairCorpus  # noqa: E402
+from gene2vec_tpu.sgns.train import SGNSTrainer, train_epochs  # noqa: E402
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-# --------------------------------------------------------------------------
-# the stratified step (prototype; integrated form goes into sgns/step.py)
-# --------------------------------------------------------------------------
-
-
-def stratified_step(
-    params: SGNSParams,
-    pairs,                 # (B, 2)
-    q,                     # (V,) noise distribution
-    key,
-    lr,
-    negatives: int = 5,
-    head: int = 64,        # exact head rows
-    block: int = 128,      # tail block size (rows per group)
-    group: int = 32,       # examples per group
-    combiner: str = "capped",
-    compute_dtype=jnp.float32,
-):
-    emb_t, ctx_t = params.emb, params.ctx
-    v_size, d = ctx_t.shape
-    centers, contexts = _examples_from_pairs(pairs)
-    e = centers.shape[0]
-    g = e // group
-    t = v_size - head
-    nb = t // block                      # tail blocks (floor; tail rows
-    #                                      beyond nb*block are never drawn —
-    #                                      bias O(block/T), folded into head
-    #                                      coverage in the integrated version)
-    k = jnp.asarray(float(negatives), compute_dtype)
-
-    v = emb_t[centers].astype(compute_dtype)          # (E, D)
-    u_pos = ctx_t[contexts].astype(compute_dtype)     # (E, D)
-
-    pos_logit = jnp.sum(v * u_pos, axis=-1)
-    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
-
-    # ---- head: exact expectation over rows [0, H) ------------------------
-    ctx_head = ctx_t[:head].astype(compute_dtype)     # (H, D) contiguous
-    q_head = q[:head].astype(compute_dtype)           # (H,)
-    head_logit = v @ ctx_head.T                       # (E, H) MXU
-    head_mask = (
-        jnp.arange(head)[None, :] != contexts[:, None]
-    ).astype(compute_dtype)                           # gensim skip parity
-    g_head = k * q_head[None, :] * jax.nn.sigmoid(head_logit) * head_mask
-    loss_head = k * jnp.sum(
-        q_head[None, :] * head_mask * jax.nn.softplus(head_logit), axis=-1
-    )
-
-    # ---- tail: one random block per group --------------------------------
-    blocks = jax.random.randint(key, (g,), 0, nb)     # (G,)
-    starts = head + blocks * block
-
-    def slice_block(tbl, s):
-        return jax.lax.dynamic_slice(tbl, (s, 0), (block, tbl.shape[1]))
-
-    ctx_blk = jax.vmap(slice_block, in_axes=(None, 0))(
-        ctx_t, starts
-    ).astype(compute_dtype)                            # (G, S, D)
-    q_blk = jax.vmap(
-        lambda s: jax.lax.dynamic_slice(q, (s,), (block,))
-    )(starts).astype(compute_dtype)                    # (G, S)
-
-    vg = v.reshape(g, group, d)
-    cg = contexts.reshape(g, group)
-    tail_logit = jnp.einsum("ged,gsd->ges", vg, ctx_blk)  # (G, Eg, S) MXU
-    row_ids = starts[:, None] + jnp.arange(block)[None, :]  # (G, S)
-    tail_mask = (
-        row_ids[:, None, :] != cg[:, :, None]
-    ).astype(compute_dtype)
-    w_tail = k * (t / block) * q_blk[:, None, :]          # importance weight
-    g_tail = w_tail * jax.nn.sigmoid(tail_logit) * tail_mask
-    loss_tail = jnp.sum(
-        w_tail * tail_mask * jax.nn.softplus(tail_logit), axis=-1
-    ).reshape(e)
-
-    loss = jnp.mean(
-        jax.nn.softplus(-pos_logit) + loss_head + loss_tail
-    )
-
-    # ---- center gradients (per-example; same scatter path as today) -----
-    d_center = (
-        g_pos[:, None] * u_pos
-        + g_head @ ctx_head                                       # MXU
-        + jnp.einsum("ges,gsd->ged", g_tail, ctx_blk).reshape(e, d)
-    )
-    emb = _apply_row_updates(
-        emb_t, centers, d_center,
-        jnp.ones_like(centers, compute_dtype), lr, combiner, compute_dtype,
-    )
-
-    # ---- ctx updates: positives scatter + dense noise adds ---------------
-    acc_dtype = jnp.float32
-    d_pos = g_pos[:, None] * v
-    payload = jnp.concatenate(
-        [d_pos.astype(acc_dtype), jnp.ones((e, 1), acc_dtype)], axis=1
-    )
-    acc = jnp.zeros((v_size, d + 1), acc_dtype).at[contexts].add(payload)
-
-    # head noise: dense slice add (grads + example-unit weights)
-    d_head_rows = g_head.T @ v                                    # (H, D) MXU
-    u_head = jnp.sum(g_head, axis=0)                              # units ~ sigma-weighted
-    acc = acc.at[:head, :d].add(d_head_rows.astype(acc_dtype))
-    acc = acc.at[:head, d].add(u_head.astype(acc_dtype))
-
-    # tail noise: block-indexed scatter-add of (S, D+1) payloads
-    d_tail_rows = jnp.einsum("ges,ged->gsd", g_tail, vg)          # (G, S, D)
-    u_tail = jnp.sum(g_tail, axis=1)                              # (G, S)
-    tail_payload = jnp.concatenate(
-        [d_tail_rows.astype(acc_dtype), u_tail[:, :, None].astype(acc_dtype)],
-        axis=2,
-    )
-    tail_acc = jnp.zeros((nb, block, d + 1), acc_dtype).at[blocks].add(
-        tail_payload
-    )
-    acc = acc.at[head : head + nb * block].add(
-        tail_acc.reshape(nb * block, d + 1)
-    )
-
-    update = acc[:, :d] / _row_divisor(acc[:, d], combiner)[:, None]
-    ctx = (
-        ctx_t.astype(acc_dtype) - jnp.asarray(lr, acc_dtype) * update
-    ).astype(ctx_t.dtype)
-    return SGNSParams(emb=emb, ctx=ctx), loss
-
-
-# --------------------------------------------------------------------------
-# harness
-# --------------------------------------------------------------------------
-
-
 def synth_corpus(v=24447, n=4_000_000, seed=0):
+    from gene2vec_tpu.io.vocab import Vocab
+
     rng = np.random.RandomState(seed)
     p = 1.0 / np.arange(1, v + 1)
     p /= p.sum()
     pairs = rng.choice(v, size=(n, 2), p=p).astype(np.int32)
-    from gene2vec_tpu.io.vocab import Vocab
-
     counts = np.bincount(pairs.reshape(-1), minlength=v).astype(np.int64)
     order = np.argsort(-counts, kind="stable")
     remap = np.empty(v, np.int64)
     remap[order] = np.arange(v)
-    pairs = remap[pairs].astype(np.int32)
     return PairCorpus(
-        Vocab([f"G{i}" for i in range(v)], counts[order]), pairs
+        Vocab([f"G{i}" for i in range(v)], counts[order]),
+        remap[pairs].astype(np.int32),
     )
 
 
-def make_epoch_fn(
-    corpus, dim, batch_pairs, head, block, group,
-    lr0=0.025, min_lr=1e-4,
-):
-    """Jitted epoch matching SGNSTrainer's discipline: per-epoch pair
-    shuffle and the gensim-parity lr sweep lr0 -> min_lr across the epoch
-    (sgns/train.py:69-70) — the prototype must not diverge from the
-    baseline on anything but the negative estimator."""
-    q = jnp.asarray(noise_distribution(corpus.vocab.counts))
-    pairs = jnp.asarray(corpus.pairs)
-    num_batches = corpus.num_pairs // batch_pairs
-
-    @functools.partial(jax.jit, donate_argnums=0)
-    def epoch(params, key):
-        shuffle_key, step_key = jax.random.split(key)
-        shuffled = pairs[
-            jax.random.permutation(shuffle_key, pairs.shape[0])
-        ]
-
-        def body(carry, i):
-            params = carry
-            batch = jax.lax.dynamic_slice(
-                shuffled, (i * batch_pairs, 0), (batch_pairs, 2)
-            )
-            frac = i.astype(jnp.float32) / max(num_batches, 1)
-            lr = lr0 * (1.0 - frac) + min_lr * frac
-            params, loss = stratified_step(
-                params, batch, q, jax.random.fold_in(step_key, i), lr,
-                head=head, block=block, group=group,
-            )
-            return params, loss
-
-        params, losses = jax.lax.scan(
-            body, params, jnp.arange(num_batches)
-        )
-        return params, jnp.mean(losses)
-
-    return epoch, num_batches
-
-
-def init_params(vocab_size, dim, seed=0):
-    rng = np.random.RandomState(seed)
-    emb = ((rng.rand(vocab_size, dim) - 0.5) / dim).astype(np.float32)
-    ctx = np.zeros((vocab_size, dim), np.float32)
-    return SGNSParams(emb=jnp.asarray(emb), ctx=jnp.asarray(ctx))
-
-
-def suite_rate(args):
-    corpus = synth_corpus()
-    rows = []
-    for name, head, block in (
-        ("H=64 S=128", 64, 128),
-        ("H=256 S=128", 256, 128),
-        ("H=512 S=128", 512, 128),
-    ):
-        epoch, nbat = make_epoch_fn(
-            corpus, 200, args.batch_pairs, head, block, 32
-        )
-        params = init_params(corpus.vocab_size, 200)
-        key = jax.random.PRNGKey(0)
-        for w in range(2):  # compile + relayout warmup
-            params, loss = epoch(params, jax.random.fold_in(key, w))
-            float(loss)
-        rates = []
-        for r in range(3):
-            t0 = time.perf_counter()
-            params, loss = epoch(params, jax.random.fold_in(key, 10 + r))
-            float(loss)
-            rates.append(nbat * args.batch_pairs / (time.perf_counter() - t0))
-        rows.append(
-            {"config": name,
-             "pairs_per_sec_M": round(float(np.median(rates)) / 1e6, 2),
-             "loss": round(float(loss), 4)}
-        )
-        log(f"{name}: {np.median(rates)/1e6:.2f}M pairs/s loss {float(loss):.3f}")
-    # reference: current shared default
-    from gene2vec_tpu.sgns.train import SGNSTrainer
-
-    trainer = SGNSTrainer(corpus, SGNSConfig(dim=200, batch_pairs=args.batch_pairs))
-    p = trainer.init()
-    k = jax.random.PRNGKey(1)
-    for w in range(2):
-        p, loss = trainer.train_epoch(p, jax.random.fold_in(k, w))
-        float(loss)
-    rates = []
-    for r in range(3):
-        t0 = time.perf_counter()
-        p, loss = trainer.train_epoch(p, jax.random.fold_in(k, 10 + r))
-        float(loss)
-        rates.append(
-            trainer.num_batches * args.batch_pairs / (time.perf_counter() - t0)
-        )
-    rows.append(
-        {"config": "shared default (P=0.8EK)",
-         "pairs_per_sec_M": round(float(np.median(rates)) / 1e6, 2),
-         "loss": round(float(loss), 4)}
-    )
-    log(f"shared default: {np.median(rates)/1e6:.2f}M pairs/s")
-    return rows
-
-
-def interleave_tail(corpus: PairCorpus, head: int, block: int):
-    """Remap token ids so tail rows are dealt round-robin into blocks:
+def interleave_tail(corpus: PairCorpus, head: int, block: int) -> PairCorpus:
+    """Relabel token ids so tail rows are dealt round-robin into blocks:
     old tail index j (frequency order) -> head + (j % nb) * block + j // nb.
     Any contiguous tail block then holds a stratified systematic sample of
     the whole tail frequency range instead of one narrow band.  Ids are
-    arbitrary labels, so this is a free one-time relabeling; rows past
-    head + nb*block stay put (and are never drawn — their q-mass is the
-    same truncation the contiguous variant has)."""
+    arbitrary labels, so this is a free one-time relabeling."""
     from gene2vec_tpu.io.vocab import Vocab
 
     v = corpus.vocab_size
@@ -326,12 +90,46 @@ def interleave_tail(corpus: PairCorpus, head: int, block: int):
     inv = np.empty(v, np.int64)
     inv[remap] = np.arange(v)
     toks = [corpus.vocab.id_to_token[i] for i in inv]
-    counts = corpus.vocab.counts[inv]
-    vocab = Vocab.__new__(Vocab)
-    vocab.id_to_token = toks
-    vocab.token_to_id = {t_: i for i, t_ in enumerate(toks)}
-    vocab.counts = counts
+    vocab = Vocab(toks, corpus.vocab.counts[inv])
     return PairCorpus(vocab, remap[corpus.pairs].astype(np.int32))
+
+
+def measure_rate(corpus, cfg, reps=3):
+    tr = SGNSTrainer(corpus, cfg)
+    p = tr.init()
+    k = jax.random.PRNGKey(0)
+    for w in range(2):
+        p, loss = tr.train_epoch(p, jax.random.fold_in(k, w))
+        float(loss)
+    rates = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        p, loss = tr.train_epoch(p, jax.random.fold_in(k, 10 + r))
+        float(loss)
+        rates.append(
+            tr.num_batches * tr.config.batch_pairs
+            / (time.perf_counter() - t0)
+        )
+    return float(np.median(rates)), float(loss)
+
+
+def suite_rate(args):
+    corpus = synth_corpus()
+    rows = []
+    configs = [
+        ("stratified H=64 S=128", dict(strat_head=64)),
+        ("stratified H=256 S=128 (default)", dict()),
+        ("stratified H=512 S=128", dict(strat_head=512)),
+        ("shared auto (P=0.8EK)", dict(negative_mode="shared")),
+    ]
+    for name, kw in configs:
+        cfg = SGNSConfig(dim=200, batch_pairs=args.batch_pairs, **kw)
+        rate, loss = measure_rate(corpus, cfg)
+        rows.append({"config": name,
+                     "pairs_per_sec_M": round(rate / 1e6, 2),
+                     "loss": round(loss, 4)})
+        log(f"{name:36s} {rate/1e6:5.2f}M pairs/s loss {loss:.3f}")
+    return rows
 
 
 def suite_quality(args):
@@ -339,27 +137,25 @@ def suite_quality(args):
 
     base, split = load_holdout(args.data_dir)
     rows = []
-    for name, head, block, il in (
-        ("H=256 S=128 banded", 256, 128, False),
-        ("H=512 S=128 banded", 512, 128, False),
-        ("H=256 S=256 banded", 256, 256, False),
-    ):
-        corpus = interleave_tail(base, head, block) if il else base
-        epoch, _ = make_epoch_fn(corpus, 200, args.batch_pairs, head, block, 32)
-        params = init_params(corpus.vocab_size, 200)
-        losses = []
-        for it in range(1, args.epochs + 1):
-            params, loss = epoch(
-                params, jax.random.fold_in(jax.random.PRNGKey(0), it)
-            )
-            losses.append(float(loss))
-        auc = holdout_cos_auc(corpus.vocab, np.asarray(params.emb), split)
-        rows.append(
-            {"config": name, "loss_first": round(losses[0], 4),
-             "loss_last": round(losses[-1], 4),
-             "holdout_cos_auc": round(auc, 4)}
+    configs = [
+        ("H=64 S=128 banded", dict(strat_head=64), False),
+        ("H=256 S=128 banded (default)", dict(), False),
+        ("H=512 S=128 banded", dict(strat_head=512), False),
+        ("H=256 S=128 interleaved", dict(), True),
+    ]
+    for name, kw, il in configs:
+        cfg = SGNSConfig(dim=200, batch_pairs=args.batch_pairs, **kw)
+        corpus = (
+            interleave_tail(base, cfg.strat_head, cfg.strat_block)
+            if il else base
         )
-        log(f"{name}: loss {losses[0]:.3f}->{losses[-1]:.3f} AUC {auc:.4f}")
+        emb, losses = train_epochs(corpus, cfg, args.epochs)
+        auc = holdout_cos_auc(corpus.vocab, emb, split)
+        rows.append({"config": name,
+                     "loss_first": round(losses[0], 4),
+                     "loss_last": round(losses[-1], 4),
+                     "holdout_cos_auc": round(auc, 4)})
+        log(f"{name:32s} loss {losses[0]:.3f}->{losses[-1]:.3f} AUC {auc:.4f}")
     return rows
 
 
