@@ -35,9 +35,12 @@ ORACLE_COS_AUC = 0.878
 #: therefore ANDs loss escape + planted separation with the AUC check;
 #: docs/QUALITY_NOTES.md §8).
 DEGREE_BASELINE_AUC = 0.859
-#: the gate threshold derived from the oracle (small slack for config/
-#: seed noise); bench.py withholds its headline below this.
-GATE_MIN_AUC = 0.85
+#: the gate threshold: above the no-embedding degree floor (a gate that
+#: accepts less than "no embedding at all" would be vacuous on this
+#: axis) while leaving ~0.015 slack under the oracle's 0.878 for
+#: config/seed noise; bench.py withholds its headline below this.
+#: Converged runs measure 0.886-0.898.
+GATE_MIN_AUC = 0.862
 
 
 def read_split(data_dir: str, split: str) -> Tuple[List[List[str]], np.ndarray]:
