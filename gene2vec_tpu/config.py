@@ -51,7 +51,7 @@ class SGNSConfig:
                                    # random tail blocks — contiguous noise
                                    # traffic, ~1.4x shared-auto throughput
                                    # at measured quality parity (holdout
-                                   # AUC 0.892 vs 0.878 oracle; sgns/step.py
+                                   # AUC 0.896 vs 0.878 oracle; sgns/step.py
                                    # _step_stratified, PERF_NOTES round 3)
                                    # | "shared": one noise pool per step
                                    # (MXU matmuls, pool-row scatter)

@@ -72,7 +72,14 @@ class PairCorpus:
         and the per-epoch shuffle stays well-mixed globally.  Defaults to
         ``jax.process_index()``/``jax.process_count()``; identity on a
         single-process run.  Vocab (built from the FULL corpus) is shared —
-        call before any per-host padding."""
+        call before any per-host padding.
+
+        Every shard is trimmed to exactly ``num_pairs // count`` rows: the
+        trainer derives ``num_batches`` (and the small-corpus batch shrink)
+        from its *local* shard, so hosts whose shards differed by one row
+        could compile different epoch step counts and deadlock the SPMD
+        collectives.  Dropping < count tail rows is harmless — the per-epoch
+        reshuffle already drops ragged batch tails by design."""
         if index is None:
             index = jax.process_index()
         if count is None:
@@ -85,7 +92,8 @@ class PairCorpus:
             raise ValueError(f"process index {index} not in [0, {count})")
         if count == 1:
             return self
-        return PairCorpus(self.vocab, self.pairs[index::count])
+        per_host = self.num_pairs // count
+        return PairCorpus(self.vocab, self.pairs[index::count][:per_host])
 
     def host_batches(
         self, batch_pairs: int, rng: np.random.Generator, shuffle: bool = True

@@ -23,6 +23,9 @@ _NATIVE_DIR = os.path.join(
 )
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpairio.so")
 
+# must match PAIRIO_ABI_VERSION in native/pairio.cpp
+_ABI_VERSION = 2
+
 _lib: Optional[ctypes.CDLL] = None
 _build_attempted = False
 
@@ -69,6 +72,19 @@ def _load() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH):
         return None
     lib = ctypes.CDLL(_LIB_PATH)
+    # make can fail (missing toolchain, GENE2VEC_TPU_NO_NATIVE_BUILD set);
+    # verify the loaded library speaks the ABI this wrapper was written for
+    # rather than trusting mtimes — a stale .so with the old 4-arg
+    # pairio_load_files called through the new 5-arg prototype is undefined
+    # behavior, not a clean error.
+    try:
+        abi = lib.pairio_abi_version
+    except AttributeError:
+        return None  # pre-versioning build: fall back to the Python reader
+    abi.argtypes = []
+    abi.restype = ctypes.c_int64
+    if abi() != _ABI_VERSION:
+        return None
     lib.pairio_load_files.argtypes = [
         ctypes.POINTER(ctypes.c_char_p),
         ctypes.c_int32,

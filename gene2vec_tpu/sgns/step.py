@@ -393,6 +393,20 @@ def _step_stratified(
     g = max(1, e // group_size)
     while e % g:
         g -= 1
+    if e // g > 8 * group_size:
+        # mirror the shared-mode fallback warning: awkward example counts
+        # (e.g. e = 2*8191) can collapse the divisor search to very few
+        # groups, so thousands of examples share one tail-block draw per
+        # step — higher estimator variance with no other signal.
+        import warnings
+
+        warnings.warn(
+            f"batch example count {e} has no divisor near "
+            f"e/{group_size}; falling back to {g} tail-block group(s) of "
+            f"{e // g} examples, which raises stratified-estimator "
+            f"variance.  Use a batch_pairs divisible by {group_size}.",
+            stacklevel=2,
+        )
     head, block, nb = spec.head, spec.block, spec.nb
     k = jnp.asarray(float(k_negatives), compute_dtype)
 

@@ -89,6 +89,14 @@ struct MappedFile {
 
 extern "C" {
 
+// Bump on ANY change to PairioResult's layout or pairio_load_files'
+// signature.  The Python wrapper refuses to call a library reporting a
+// different version (a stale .so dlopened across an ABI change would
+// misread arguments — e.g. a flag landing where a pointer used to be).
+enum { PAIRIO_ABI_VERSION = 2 };
+
+int64_t pairio_abi_version(void) { return PAIRIO_ABI_VERSION; }
+
 struct PairioResult {
   int64_t num_pairs = 0;
   int32_t* pairs = nullptr;      // 2 * num_pairs, row-major

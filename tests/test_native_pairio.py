@@ -108,6 +108,21 @@ def test_native_speed_sanity(tmp_path):
     assert t_native < t_python, (t_native, t_python)
 
 
+def test_abi_version_mismatch_falls_back(monkeypatch):
+    """ADVICE r3: a library reporting the wrong ABI version (stale .so that
+    `make` could not rebuild) must make the wrapper fall back to the Python
+    reader instead of calling mismatched entry points."""
+    from gene2vec_tpu.io import native_pairio as np_mod
+
+    assert np_mod.available()  # fresh build reports the expected version
+    monkeypatch.setattr(np_mod, "_lib", None)
+    monkeypatch.setattr(np_mod, "_ABI_VERSION", -1)
+    assert not np_mod.available()
+    monkeypatch.undo()
+    np_mod._lib = None
+    assert np_mod.available()  # cache restored for later tests
+
+
 def test_native_reader_rejects_cp1252_undefined_bytes(tmp_path):
     """ADVICE r1: strict-decode parity with the Python fallback — a file
     containing a cp1252-undefined byte raises, even in skipped content."""

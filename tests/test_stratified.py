@@ -107,6 +107,38 @@ def test_stratified_requires_spec():
         )
 
 
+def test_stratified_warns_on_degenerate_grouping():
+    """ADVICE r3: awkward example counts that collapse the divisor search
+    (e.g. E = 2*supergroup) must warn about the raised estimator variance,
+    like the shared-mode fallback does."""
+    import warnings
+
+    rng = np.random.RandomState(0)
+    v_size, d = 64, 16
+    counts = (np.arange(v_size, 0, -1) ** 1.5).astype(np.int64)
+    spec = build_stratified_spec(counts, head=8, block=8)
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+        ctx=jnp.asarray(rng.randn(v_size, d).astype(np.float32) * 0.3),
+    )
+    # E = 2*307 (307 prime, default group size 32): the divisor search
+    # collapses to g=2 -> groups of 307 examples >> 8*32 -> warn
+    pairs = jnp.asarray(rng.randint(0, v_size, (307, 2)).astype(np.int32))
+    with pytest.warns(UserWarning, match="tail-block group"):
+        sgns_step(
+            params, pairs, None, jax.random.PRNGKey(0), 0.05,
+            negative_mode="stratified", stratified=spec,
+        )
+    # a well-shaped batch must not warn
+    pairs = jnp.asarray(rng.randint(0, v_size, (32, 2)).astype(np.int32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        sgns_step(
+            params, pairs, None, jax.random.PRNGKey(0), 0.05,
+            negative_mode="stratified", stratified=spec,
+        )
+
+
 @pytest.mark.parametrize("combiner", ["capped", "sum", "mean"])
 @pytest.mark.parametrize("both_directions", [True, False])
 def test_stratified_edge_configs(combiner, both_directions):
