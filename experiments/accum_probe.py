@@ -1,0 +1,183 @@
+"""Round-4 probe: primitive costs behind the (V, D+1) accumulator slice.
+
+The round-3 step decomposition (docs/PERF_NOTES.md "Remaining account")
+attributes ~1.6 ms of the 6.0 ms step to accumulator traffic.  Before
+redesigning, measure the candidate primitives in isolation on the real
+chip:
+
+  a. (E,) scalar scatter-add into (V,) and (E,) scalar gather from (V,)
+     — if these are ~free vs 800 B row ops, a two-pass "scale at scatter
+     time" design (count pass -> inv-div gather -> direct table scatter)
+     beats the accumulator; if they cost the same ~16 ns/row, it loses.
+  b. windowed slab scatter-add (G slabs of (S, D+1) rows at dynamic row
+     starts, lax.scatter_add with update_window_dims) directly into the
+     (V, D+1) accumulator vs the current acc_blocks detour
+     (zeros (NB,S,D+1) + block scatter + two static slice adds).
+  c. full dense accumulator pass (zeros + finalize read/update) in f32
+     vs bf16 payload — the dense side is bandwidth-bound, so bf16 should
+     halve it (unlike the row-op side, where round 2 measured dtype
+     independence).
+
+Each timing: one jitted lax.scan of ITERS identical bodies, scalar
+forced out, median of 3 — per docs/PERF_NOTES.md measurement discipline
+(block_until_ready does not block on the axon tunnel).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+V, D, E = 24447, 200, 32768
+BLOCK = 128
+HEAD = 256
+G = 1024          # tail groups per step at E=32768, group 32
+ITERS = 100
+REPS = 3
+
+
+def bench(fn, *args):
+    out = jax.jit(fn)(*args)
+    jax.tree_util.tree_map(lambda x: np.asarray(x.ravel()[0]), out)  # compile+force
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = jax.jit(fn)(*args)
+        jax.tree_util.tree_map(lambda x: np.asarray(x.ravel()[0]), out)
+        times.append((time.perf_counter() - t0) / ITERS)
+    return sorted(times)[len(times) // 2]
+
+
+def scanned(body):
+    """Run `body` ITERS times with varying fold so XLA can't CSE it away."""
+
+    @functools.wraps(body)
+    def run(*args):
+        def it(carry, i):
+            return body(carry, i, *args[1:])[0], ()
+
+        carry, _ = lax.scan(it, args[0], jnp.arange(ITERS))
+        return carry
+
+    return run
+
+
+def main():
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    # Zipf-ish indices, like real batch rows
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    idx = jnp.asarray(rng.choice(V, size=(E,), p=p).astype(np.int32))
+    rows = jnp.asarray(rng.randn(E, D).astype(np.float32))
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    acc0 = jnp.zeros((V, D + 1), jnp.float32)
+    starts = jnp.asarray(
+        (HEAD + rng.randint(0, (V - HEAD - BLOCK) // BLOCK, G) * BLOCK).astype(
+            np.int32
+        )
+    )
+    slabs = jnp.asarray(rng.randn(G, BLOCK, D + 1).astype(np.float32))
+
+    # --- a. scalar scatter / gather --------------------------------------
+    @scanned
+    def scalar_scatter(carry, i, idx):
+        return carry.at[idx + (i % 2)].add(1.0), None
+
+    t = bench(lambda c, ix: scalar_scatter(c, ix), jnp.zeros(V), idx)
+    print(f"a1 scalar scatter-add E={E} -> (V,): {t*1e3:.3f} ms "
+          f"({t/E*1e9:.2f} ns/el)")
+
+    @scanned
+    def scalar_gather(carry, i, tbl):
+        return carry + tbl[idx + (i % 2)].sum(), None
+
+    t = bench(lambda c, tbl: scalar_gather(c, tbl), jnp.zeros(()), jnp.ones(V))
+    print(f"a2 scalar gather   E={E} <- (V,): {t*1e3:.3f} ms "
+          f"({t/E*1e9:.2f} ns/el)")
+
+    # row scatter reference (the known ~16 ns/row-op)
+    @scanned
+    def row_scatter(carry, i, idx, rows):
+        return carry.at[idx + (i % 2)].add(rows), None
+
+    t = bench(lambda c, ix, r: row_scatter(c, ix, r),
+              jnp.zeros((V, D)), idx, rows)
+    print(f"a3 row scatter-add E={E} x {D}f32:  {t*1e3:.3f} ms "
+          f"({t/E*1e9:.2f} ns/row)")
+
+    # --- b. slab scatter vs acc_blocks detour ----------------------------
+    nb = (V - HEAD) // BLOCK + 1
+
+    @scanned
+    def via_blocks(acc, i, blocks_idx, slabs):
+        blk = jnp.zeros((nb, BLOCK, D + 1), jnp.float32).at[
+            (blocks_idx + i) % nb
+        ].add(slabs)
+        acc = acc.at[HEAD : HEAD + (nb - 1) * BLOCK].add(
+            blk[:-1].reshape((nb - 1) * BLOCK, D + 1)
+        )
+        return acc.at[V - BLOCK :].add(blk[-1]), None
+
+    blocks_idx = (starts - HEAD) // BLOCK
+    t = bench(lambda a, b, s: via_blocks(a, b, s), acc0, blocks_idx, slabs)
+    print(f"b1 acc_blocks detour G={G}: {t*1e3:.3f} ms")
+
+    @scanned
+    def via_slab_scatter(acc, i, starts, slabs):
+        dn = lax.ScatterDimensionNumbers(
+            update_window_dims=(1, 2),
+            inserted_window_dims=(),
+            scatter_dims_to_operand_dims=(0,),
+        )
+        return lax.scatter_add(
+            acc, ((starts + i * BLOCK) % (V - BLOCK))[:, None], slabs, dn
+        ), None
+
+    t = bench(lambda a, s, sl: via_slab_scatter(a, s, sl), acc0, starts, slabs)
+    print(f"b2 windowed slab scatter G={G}x({BLOCK},{D+1}): {t*1e3:.3f} ms")
+
+    # --- c. dense accumulator pass, f32 vs bf16 --------------------------
+    @scanned
+    def dense_pass(tbl, i, acc):
+        upd = acc[:, :D] / jnp.maximum(acc[:, D] / 32.0, 1.0)[:, None]
+        return (tbl - 0.01 * upd.astype(tbl.dtype)), None
+
+    accf = jnp.abs(jnp.asarray(rng.randn(V, D + 1).astype(np.float32)))
+    t = bench(lambda tb, a: dense_pass(tb, a), table, accf)
+    print(f"c1 finalize pass f32 acc: {t*1e3:.3f} ms")
+    t = bench(lambda tb, a: dense_pass(tb, a), table, accf.astype(jnp.bfloat16))
+    print(f"c2 finalize pass bf16 acc: {t*1e3:.3f} ms")
+
+    @scanned
+    def zeros_scatter(carry, i, idx, rows):
+        acc = jnp.zeros((V, D + 1), jnp.float32).at[idx + (i % 2)].add(
+            jnp.concatenate([rows, jnp.ones((E, 1), jnp.float32)], axis=1)
+        )
+        return carry + acc[0, 0], None
+
+    t = bench(lambda c, ix, r: zeros_scatter(c, ix, r), jnp.zeros(()), idx, rows)
+    print(f"c3 zeros+fused scatter f32 (V,D+1): {t*1e3:.3f} ms")
+
+    @scanned
+    def zeros_scatter_bf16(carry, i, idx, rows):
+        acc = jnp.zeros((V, D + 1), jnp.bfloat16).at[idx + (i % 2)].add(
+            jnp.concatenate(
+                [rows, jnp.ones((E, 1), jnp.float32)], axis=1
+            ).astype(jnp.bfloat16)
+        )
+        return carry + acc[0, 0].astype(jnp.float32), None
+
+    t = bench(lambda c, ix, r: zeros_scatter_bf16(c, ix, r),
+              jnp.zeros(()), idx, rows)
+    print(f"c4 zeros+fused scatter bf16 (V,D+1): {t*1e3:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

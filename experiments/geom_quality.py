@@ -1,0 +1,84 @@
+"""Round-4 stratified-geometry quality check on the real corpus.
+
+experiments/step_ablate.py found throughput scales strongly with the
+stratified tail GROUP SIZE (fewer vmapped dynamic slices per step):
+group 32 -> 2.7 M pairs/s, group 128 -> 4.7 M.  This script answers the
+only question that matters before changing the default: does the holdout
+cosine AUC (gate metric, oracle 0.878, round-3 default 0.8965) survive
+larger groups, and does growing the block size alongside (keeping
+per-example repulsion rank) compensate the variance of shared draws?
+
+Protocol: the canonical eval.holdout split (same seed/fraction as
+bench.py's gate and REAL_AUC.json), embedding trained through
+``train_epochs`` (per-epoch lr sweep included — hand loops read ~0.13
+low, docs/PERF_NOTES.md round-3 caveat), 50 epochs, B=4096.
+
+Usage: python experiments/geom_quality.py [group:head:block[:batch] ...]
+(batch defaults to the run_real_auc protocol's 4096; pass 16384 to
+reproduce the bench gate's configuration)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.eval.holdout import ORACLE_COS_AUC, holdout_cos_auc, load_holdout
+from gene2vec_tpu.sgns.train import train_epochs
+
+DATA_DIR = "/root/reference/predictionData"
+EPOCHS = 50
+
+
+def main():
+    specs = sys.argv[1:] or [
+        "32:256:128",    # round-3 default (control; expect ~0.8965)
+        "64:256:256",
+        "128:256:256",
+        "128:512:128",
+        "128:256:512",
+        "256:256:512",
+    ]
+    corpus, split = load_holdout(DATA_DIR)
+    print(
+        f"corpus: {corpus.num_pairs} pairs, vocab {corpus.vocab_size}; "
+        f"holdout {len(split.hold_pairs)} pairs; oracle {ORACLE_COS_AUC}",
+        flush=True,
+    )
+    results = {}
+    for s in specs:
+        parts = [int(x) for x in s.split(":")]
+        group, head, block = parts[:3]
+        batch = parts[3] if len(parts) > 3 else 4096
+        cfg = SGNSConfig(
+            dim=200, batch_pairs=batch, negative_mode="stratified",
+            strat_group=group, strat_head=head, strat_block=block,
+        )
+        t0 = time.perf_counter()
+        emb, losses = train_epochs(corpus, cfg, EPOCHS)
+        auc = holdout_cos_auc(corpus.vocab, emb, split)
+        dt = time.perf_counter() - t0
+        results[s] = {
+            "auc": round(auc, 4),
+            "loss_first": round(losses[0], 4),
+            "loss_last": round(losses[-1], 4),
+            "seconds": round(dt, 1),
+        }
+        print(f"g{group} h{head} s{block}: AUC {auc:.4f} "
+              f"loss {losses[0]:.3f}->{losses[-1]:.3f} ({dt:.0f}s)", flush=True)
+    out = os.path.join(os.path.dirname(__file__), "results",
+                       "geom_quality_r4.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,370 @@
+"""Round-4 ablation: where the stratified step's 6.0 ms actually goes,
+and which restructurings move it.
+
+VERDICT r3 item 1 names the (V, D+1) accumulator slice (~1.6 ms) as the
+squeezable cost.  experiments/accum_probe.py killed the two named
+micro-fixes (bf16 accumulator: no change — issue-bound, like round 2's
+table-dtype result; windowed slab scatter: 3x worse than the acc_blocks
+detour).  This ablation measures step-level restructurings instead, each
+a local variant of _step_stratified run through the same whole-epoch
+scan harness as experiments/epoch_sweep.py (steady-state, 3 reps):
+
+  base      — gene2vec_tpu.sgns.step._step_stratified as shipped
+  onehot    — tail-block aggregation as one-hot MXU matmul instead of the
+              (NB, S, D+1) block-scatter detour: the detour writes ~105 MB
+              of slab scatter-adds per step; a (NB, G) one-hot times the
+              (G, S*(D+1)) payload is ~5e9 MACs (~free on MXU) and turns
+              all of it into streaming matmul traffic
+  bf16noise — head/tail logit+mask+sigmoid chains in bf16 (f32 accumulate
+              via preferred_element_type): halves the (E, H) and
+              (G, E/G, S) elementwise intermediates' bytes
+  maskfree  — drop the (E, H) head mask materialization; correct the
+              self-collision exactly per example using q[contexts]
+              (the positive row's logit IS pos_logit, so the correction
+              needs no extra row gathers if q[contexts] is cheap)
+  merged    — one (2V, D+1) accumulator for emb+ctx: a single 2E-row
+              scatter and one finalize pass instead of two of each
+  sum       — scatter straight into the tables (combiner="sum"
+              semantics, no accumulator/finalize at all): an UPPER BOUND
+              on what any accumulator redesign could recover, not a
+              candidate (capped combiner is a quality invariant)
+
+Usage: python experiments/step_ablate.py [variant ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.data.negative_sampling import build_stratified_spec
+from gene2vec_tpu.data.pipeline import PairCorpus, epoch_shuffle
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns.model import SGNSParams, init_params
+from gene2vec_tpu.sgns.step import (
+    _acc_dtype_for,
+    _apply_row_updates,
+    _examples_from_pairs,
+    _finalize_row_updates,
+    _row_divisor,
+    _scatter_accumulator,
+    _step_stratified,
+)
+
+V, D = 24447, 200
+N = 4_000_000
+B = 16384
+REPS = 3
+K = 5.0
+GROUP = 32
+
+
+def stratified_variant(params, centers, contexts, spec, key, lr, variant):
+    """_step_stratified with the ablation knobs; mirrors sgns/step.py."""
+    onehot = variant in ("onehot", "all")
+    bf16noise = variant in ("bf16noise", "all")
+    maskfree = variant in ("maskfree", "all")
+    merged = variant in ("merged",)
+    direct_sum = variant in ("sum",)
+
+    emb_t, ctx_t = params.emb, params.ctx
+    v_size, d = ctx_t.shape
+    e = centers.shape[0]
+    g = e // GROUP
+    head, block, nb = spec.head, spec.block, spec.nb
+    noise_dtype = jnp.bfloat16 if bf16noise else jnp.float32
+    k = jnp.asarray(K, jnp.float32)
+
+    v = emb_t[centers]
+    u_pos = ctx_t[contexts]
+    pos_logit = jnp.sum(v * u_pos, axis=-1)
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+
+    # ---- head ----
+    ctx_head = ctx_t[:head].astype(noise_dtype)
+    q_head = spec.q[:head].astype(noise_dtype)
+    head_logit = jax.lax.dot(
+        v.astype(noise_dtype), ctx_head.T,
+        preferred_element_type=jnp.float32,
+    ).astype(noise_dtype)
+    if maskfree:
+        sig = jax.nn.sigmoid(head_logit)
+        g_head = k.astype(noise_dtype) * q_head[None, :] * sig
+        loss_head_raw = k * jnp.sum(
+            (q_head[None, :] * jax.nn.softplus(head_logit)).astype(
+                jnp.float32
+            ),
+            axis=-1,
+        )
+        # exact self-collision correction: head_logit[e, c_e] == pos_logit[e]
+        q_ctx = spec.q[contexts]  # (E,) scalar gather
+        in_head = (contexts < head).astype(jnp.float32)
+        corr = k * q_ctx * in_head
+        loss_head = loss_head_raw - corr * jax.nn.softplus(pos_logit)
+        g_self = corr * jax.nn.sigmoid(pos_logit)  # (E,) to subtract
+    else:
+        head_mask = (
+            jnp.arange(head)[None, :] != contexts[:, None]
+        ).astype(noise_dtype)
+        g_head = (
+            k.astype(noise_dtype)
+            * q_head[None, :]
+            * jax.nn.sigmoid(head_logit)
+            * head_mask
+        )
+        loss_head = k * jnp.sum(
+            (q_head[None, :] * head_mask * jax.nn.softplus(head_logit)).astype(
+                jnp.float32
+            ),
+            axis=-1,
+        )
+        g_self = None
+
+    # ---- tail ----
+    blocks = jax.random.randint(key, (g,), 0, nb)
+    starts = jnp.minimum(head + blocks * block, v_size - block)
+
+    def slice_rows(tbl, s):
+        return jax.lax.dynamic_slice(tbl, (s, 0), (block, tbl.shape[1]))
+
+    ctx_blk = jax.vmap(slice_rows, in_axes=(None, 0))(ctx_t, starts).astype(
+        noise_dtype
+    )
+    w_blk = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(spec.tail_w, (s,), (block,))
+    )(starts).astype(noise_dtype)
+
+    vg = v.reshape(g, e // g, d)
+    cg = contexts.reshape(g, e // g)
+    tail_logit = jax.lax.dot_general(
+        vg.astype(noise_dtype), ctx_blk,
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ).astype(noise_dtype)  # (G, E/G, S)
+    row_ids = starts[:, None] + jnp.arange(block)[None, :]
+    tail_mask = (row_ids[:, None, :] != cg[:, :, None]).astype(noise_dtype)
+    w_tail = k.astype(noise_dtype) * w_blk[:, None, :]
+    g_tail = w_tail * jax.nn.sigmoid(tail_logit) * tail_mask
+    loss_tail = jnp.sum(
+        (w_tail * tail_mask * jax.nn.softplus(tail_logit)).astype(jnp.float32),
+        axis=-1,
+    ).reshape(e)
+
+    loss = jnp.mean(jax.nn.softplus(-pos_logit) + loss_head + loss_tail)
+
+    # ---- center gradients ----
+    d_center = (
+        g_pos[:, None] * u_pos
+        + jax.lax.dot(
+            g_head, ctx_head, preferred_element_type=jnp.float32
+        )
+        + jax.lax.dot_general(
+            g_tail, ctx_blk, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(e, d)
+    )
+    if g_self is not None:
+        d_center = d_center - g_self[:, None] * u_pos
+
+    # ---- ctx/emb updates ----
+    d_pos = g_pos[:, None] * v
+    if g_self is not None:
+        d_pos = d_pos - g_self[:, None] * v
+
+    if direct_sum:
+        emb = emb_t.at[centers].add(-lr * d_center)
+        ctx = ctx_t.at[contexts].add(-lr * d_pos)
+        d_head_rows = jax.lax.dot(
+            g_head.T, v.astype(noise_dtype), preferred_element_type=jnp.float32
+        )
+        ctx = ctx.at[:head].add(-lr * d_head_rows)
+        d_tail_rows = jax.lax.dot_general(
+            g_tail, vg.astype(noise_dtype), (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (G, S, D)
+        blk = jnp.zeros((nb, block, d), jnp.float32).at[blocks].add(d_tail_rows)
+        if nb > 1:
+            ctx = ctx.at[head : head + (nb - 1) * block].add(
+                -lr * blk[:-1].reshape((nb - 1) * block, d)
+            )
+        ctx = ctx.at[v_size - block :].add(-lr * blk[-1])
+        return SGNSParams(emb=emb, ctx=ctx), loss
+
+    acc_dtype = jnp.float32
+    if merged:
+        idx2 = jnp.concatenate([centers, contexts + v_size])
+        grads2 = jnp.concatenate([d_center, d_pos])
+        acc = _scatter_accumulator(
+            2 * v_size, idx2, grads2, jnp.ones((2 * e,), jnp.float32), acc_dtype
+        )
+    else:
+        emb = _apply_row_updates(
+            emb_t, centers, d_center, jnp.ones((e,), jnp.float32), lr,
+            "capped", jnp.float32,
+        )
+        acc = _scatter_accumulator(
+            v_size, contexts, d_pos, jnp.ones((e,), jnp.float32), acc_dtype
+        )
+    coff = v_size if merged else 0
+
+    if maskfree:
+        # unmasked dense units; the exact per-row correction folds into the
+        # positive scatter (weight 1 - corr_e at row c_e) in a real impl —
+        # cost-identical to the ones used here, so the ablation timing holds
+        u_head = k * q_head.astype(jnp.float32) * e
+    else:
+        u_head = k * q_head.astype(jnp.float32) * jnp.sum(
+            head_mask.astype(jnp.float32), axis=0
+        )
+    d_head_rows = jax.lax.dot(
+        g_head.T, v.astype(noise_dtype), preferred_element_type=jnp.float32
+    )
+    acc = acc.at[coff : coff + head, :d].add(d_head_rows)
+    acc = acc.at[coff : coff + head, d].add(u_head)
+
+    d_tail_rows = jax.lax.dot_general(
+        g_tail, vg.astype(noise_dtype), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # (G, S, D)
+    u_tail = (w_tail[:, 0, :] * jnp.sum(tail_mask, axis=1)).astype(jnp.float32)
+    tail_payload = jnp.concatenate(
+        [d_tail_rows, u_tail[:, :, None]], axis=2
+    )  # (G, S, D+1)
+
+    if onehot:
+        oh = (
+            blocks[None, :] == jnp.arange(nb)[:, None]
+        ).astype(jnp.bfloat16)  # (NB, G)
+        agg = jax.lax.dot(
+            oh,
+            tail_payload.astype(jnp.bfloat16).reshape(g, block * (d + 1)),
+            preferred_element_type=jnp.float32,
+        ).reshape(nb, block, d + 1)
+    else:
+        agg = jnp.zeros((nb, block, d + 1), jnp.float32).at[blocks].add(
+            tail_payload
+        )
+    if nb > 1:
+        acc = acc.at[coff + head : coff + head + (nb - 1) * block].add(
+            agg[:-1].reshape((nb - 1) * block, d + 1)
+        )
+    acc = acc.at[coff + v_size - block : coff + v_size].add(agg[-1])
+
+    if merged:
+        both = jnp.concatenate([emb_t, ctx_t], axis=0)
+        both = _finalize_row_updates(both, acc, lr, "capped")
+        return SGNSParams(emb=both[:v_size], ctx=both[v_size:]), loss
+    ctx = _finalize_row_updates(ctx_t, acc, lr, "capped")
+    return SGNSParams(emb=emb, ctx=ctx), loss
+
+
+def make_epoch(variant, spec, num_batches):
+    def epoch(params, pairs, key):
+        shuffle_key, step_key = jax.random.split(key)
+        shuffled = epoch_shuffle(pairs, shuffle_key, N, num_batches, B, "offset")
+
+        def body(params, step):
+            batch = jax.lax.dynamic_slice_in_dim(shuffled, step * B, B)
+            centers, contexts = _examples_from_pairs(batch)
+            lr = 0.025 * (1.0 - step.astype(jnp.float32) / num_batches)
+            if variant in ("base", "g64", "g128"):
+                gs = {"base": GROUP, "g64": 64, "g128": 128}[variant]
+                return _step_stratified(
+                    params, centers, contexts, spec,
+                    jax.random.fold_in(step_key, step), 5, gs, lr,
+                    jnp.float32, "capped",
+                )
+            return stratified_variant(
+                params, centers, contexts, spec,
+                jax.random.fold_in(step_key, step), lr, variant,
+            )
+
+        params, losses = jax.lax.scan(
+            body, params, jnp.arange(num_batches, dtype=jnp.int32)
+        )
+        return params, jnp.mean(losses)
+
+    return jax.jit(epoch, donate_argnums=(0,))
+
+
+def make_geom_epoch(group, spec, num_batches):
+    def epoch(params, pairs, key):
+        shuffle_key, step_key = jax.random.split(key)
+        shuffled = epoch_shuffle(pairs, shuffle_key, N, num_batches, B, "offset")
+
+        def body(params, step):
+            batch = jax.lax.dynamic_slice_in_dim(shuffled, step * B, B)
+            centers, contexts = _examples_from_pairs(batch)
+            lr = 0.025 * (1.0 - step.astype(jnp.float32) / num_batches)
+            return _step_stratified(
+                params, centers, contexts, spec,
+                jax.random.fold_in(step_key, step), 5, group, lr,
+                jnp.float32, "capped",
+            )
+
+        params, losses = jax.lax.scan(
+            body, params, jnp.arange(num_batches, dtype=jnp.int32)
+        )
+        return params, jnp.mean(losses)
+
+    return jax.jit(epoch, donate_argnums=(0,))
+
+
+def main():
+    variants = sys.argv[1:] or [
+        "base", "onehot", "bf16noise", "maskfree", "merged", "all", "sum",
+        "g64", "g128",
+    ]
+    print("device:", jax.devices()[0])
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, V + 1)
+    p /= p.sum()
+    pairs_np = rng.choice(V, size=(N, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs_np.reshape(-1), minlength=V).astype(np.int64)
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(V)], counts), pairs_np)
+    num_batches = N // B
+    pairs = corpus.device_pairs()
+
+    for variant in variants:
+        # geometry variants: gG[.sS[.hH]] -> group G, block S, head H
+        # through the shipped _step_stratified (e.g. g64.s256, g128.s512.h512)
+        if variant.startswith("g") and "." in variant:
+            parts = dict(
+                (p[0], int(p[1:])) for p in variant.split(".")
+            )
+            spec_v = build_stratified_spec(
+                counts, parts.get("h", 256), parts.get("s", 128)
+            )
+            gs = parts["g"]
+            epoch = make_geom_epoch(gs, spec_v, num_batches)
+        else:
+            spec = build_stratified_spec(counts, 256, 128)
+            epoch = make_epoch(variant, spec, num_batches)
+        params = init_params(jax.random.PRNGKey(0), V, D, jnp.float32)
+        key = jax.random.PRNGKey(1)
+        params, loss = epoch(params, pairs, key)  # compile
+        float(loss)
+        rates, losses = [], []
+        for r in range(REPS):
+            t0 = time.perf_counter()
+            params, loss = epoch(params, pairs, jax.random.fold_in(key, r))
+            losses.append(float(loss))
+            dt = time.perf_counter() - t0
+            rates.append(num_batches * B / dt)
+        rs = ", ".join(f"{r/1e6:5.2f}" for r in rates)
+        print(
+            f"{variant:10s} [{rs}] M pairs/s  (best {max(rates)/1e6:.2f})"
+            f"  loss {losses[-1]:.4f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

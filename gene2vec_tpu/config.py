@@ -59,8 +59,23 @@ class SGNSConfig:
                                    # draws (oracle parity)
     strat_head: int = 256          # stratified: exact-expectation head rows
                                    # (clamped to vocab/2 for small vocabs)
-    strat_block: int = 128         # stratified: rows per random tail block
+    strat_block: int = 512         # stratified: rows per random tail block
                                    # (clamped to the tail size)
+    strat_group: int = 128         # stratified: examples per tail-block
+                                   # draw.  The tail term's cost scales
+                                   # with the number of groups E/group
+                                   # (vmapped dynamic slices are issue-
+                                   # bound per slice), so larger groups
+                                   # buy throughput at the price of more
+                                   # examples sharing one block draw;
+                                   # growing strat_block alongside keeps
+                                   # per-example repulsion rank.  The
+                                   # round-4 sweep measured (128, 512) at
+                                   # holdout AUC 0.8971 vs the round-3
+                                   # (32, 128) default's 0.8965 at 1.37x
+                                   # its throughput (docs/PERF_NOTES.md
+                                   # round-4 geometry).  shared_groups>0
+                                   # overrides the group size.
     shared_pool: int = 1024        # shared-mode total noise-pool size floor
                                    # (importance-weighted down to `negatives`
                                    # per example)

@@ -267,6 +267,7 @@ class CBOWHSTrainer:
                         shared_pool=cfg.shared_pool,
                         shared_pool_auto=cfg.shared_pool_auto,
                         shared_groups=cfg.shared_groups,
+                        strat_group=cfg.strat_group,
                         stratified=self.stratified,
                     )
                 if sharding is not None:

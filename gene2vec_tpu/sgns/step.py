@@ -346,6 +346,38 @@ def _step_shared(
     return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
 
 
+def _aggregate_tail_blocks(
+    blocks: jax.Array,        # (G,) block index drawn by each group
+    tail_payload: jax.Array,  # (G, S, D+1) per-group gradient+weight slabs
+    nb: int,
+) -> jax.Array:
+    """Sum each group's tail slab into its block slot: (NB, S, D+1).
+
+    Round 4 replaced the block-indexed scatter-add with a (NB, G) one-hot
+    MXU matmul over the (G, S*(D+1)) payload: ~NB*G*S*D MACs (~free) that
+    stream the ~100 MB payload once instead of re-writing it through
+    scatter RMW — measured +7% on the whole epoch (docs/PERF_NOTES.md
+    round 4).  Precision: the matmul runs at the step's default matmul
+    precision, i.e. bf16-truncated inputs on TPU — the SAME policy every
+    logit/gradient matmul in this module already uses — so tail
+    aggregates carry ~0.4% relative rounding vs the old f32 scatter.
+    Measured end to end: holdout AUC identical to 4 decimals (0.8971)
+    and epoch loss identical to 4 decimals either way; the summation
+    itself (indexing, clamped last block) is pinned exact by
+    tests/test_stratified.py::test_aggregate_tail_blocks_matches_scatter.
+    """
+    g = blocks.shape[0]
+    s, d1 = tail_payload.shape[1], tail_payload.shape[2]
+    onehot = (blocks[None, :] == jnp.arange(nb)[:, None]).astype(
+        tail_payload.dtype
+    )
+    return jax.lax.dot(
+        onehot,
+        tail_payload.reshape(g, s * d1),
+        preferred_element_type=tail_payload.dtype,
+    ).reshape(nb, s, d1)
+
+
 def _step_stratified(
     params: SGNSParams,
     centers: jax.Array,   # (E,)
@@ -360,10 +392,15 @@ def _step_stratified(
 ) -> Tuple[SGNSParams, jax.Array]:
     """Stratified negatives: exact head + per-group random tail blocks.
 
-    The round-3 redesign of the noise term (docs/PERF_NOTES.md §round-3;
-    measured on the integrated path: 2.6-2.8M pairs/s vs 1.95M shared-auto
-    at B=16,384 on v5e, holdout AUC 0.896 vs the 0.878 sequential-oracle
-    parity target — the authoritative numbers, also in PERF_NOTES).  The
+    The round-3 redesign of the noise term (docs/PERF_NOTES.md §round-3),
+    re-tuned in round 4: the tail term's cost is the NUMBER of per-group
+    dynamic slices, not their bytes, so the default geometry moved from
+    (group 32, block 128) to (group 128, block 512) — same tail row
+    traffic, 1/4 the slice count, and each example sees 4x the repulsion
+    directions.  Measured on the integrated path at B=16,384 on v5e:
+    3.6-3.7M pairs/s vs round-3's 2.6-2.8M, holdout AUC 0.8971 vs 0.8965
+    (oracle parity target 0.878) — authoritative numbers in PERF_NOTES
+    round-4 geometry table.  The
     shared/per-example modes spend ~2/3 of their row ops gathering and
     scattering P = 0.8*E*K random noise rows; noise rows have no example
     coupling, so this mode restructures them into contiguous traffic:
@@ -496,14 +533,7 @@ def _step_stratified(
         ],
         axis=2,
     )
-    # block-indexed scatter-add: G indices with (S, D+1) payloads into a
-    # (NB, S, D+1) accumulator, then two STATIC slice adds into the row
-    # accumulator — blocks [0, nb-1) tile [head, head+(nb-1)*block)
-    # contiguously and the clamped last block sits at v - block (its
-    # overlap rows were pre-divided by their doubled coverage in tail_w)
-    acc_blocks = jnp.zeros((nb, block, d + 1), acc_dtype).at[blocks].add(
-        tail_payload
-    )
+    acc_blocks = _aggregate_tail_blocks(blocks, tail_payload, nb)
     if nb > 1:
         acc = acc.at[head : head + (nb - 1) * block].add(
             acc_blocks[:-1].reshape((nb - 1) * block, d + 1)
@@ -528,6 +558,7 @@ def sgns_step(
     shared_pool: int = 1024,
     shared_pool_auto: bool = True,
     shared_groups: int = 0,
+    strat_group: int = 32,
     stratified=None,  # StratifiedSpec, required for negative_mode="stratified"
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
@@ -539,15 +570,15 @@ def sgns_step(
                 "from vocab counts via build_stratified_spec); SGNSTrainer "
                 "wires this automatically"
             )
-        # shared_groups keeps its shared-mode meaning (number of groups);
-        # unset -> the measured-flat ~32-example sub-batches
+        # shared_groups keeps its shared-mode meaning (number of groups)
+        # and overrides; unset -> the configured group SIZE (strat_group)
         e = int(centers.shape[0])
         if shared_groups > 0 and (shared_groups > e or e % shared_groups):
             raise ValueError(
                 f"shared_groups={shared_groups} does not divide the example "
                 f"count {e} (= {'2x' if both_directions else ''}batch_pairs)"
             )
-        group_size = e // shared_groups if shared_groups > 0 else 32
+        group_size = e // shared_groups if shared_groups > 0 else strat_group
         return _step_stratified(
             params, centers, contexts, stratified, key, negatives,
             group_size, lr, compute_dtype, combiner,

@@ -85,6 +85,7 @@ def make_train_epoch(
                 shared_pool=config.shared_pool,
                 shared_pool_auto=config.shared_pool_auto,
                 shared_groups=config.shared_groups,
+                strat_group=config.strat_group,
                 stratified=stratified,
             )
             if sharding is not None:

@@ -53,6 +53,26 @@ def test_default_config_loss_decreases_not_frozen(planted):
     assert losses[-1] < init_plateau - 1.0, losses
 
 
+def test_auc_gate_band_rejects_too_good():
+    """DEGENERATION guard (VERDICT r3 item 7): the gate must reject AUC
+    far above the oracle — the broken P=64 config scores 0.9613 on this
+    metric while its loss never moves (QUALITY_NOTES §8), so "too good"
+    is a failure signature, not a success."""
+    from gene2vec_tpu.eval.holdout import (
+        GATE_MAX_AUC,
+        GATE_MIN_AUC,
+        ORACLE_COS_AUC,
+        auc_in_gate_band,
+    )
+
+    assert GATE_MIN_AUC < ORACLE_COS_AUC < GATE_MAX_AUC
+    assert auc_in_gate_band(ORACLE_COS_AUC)
+    assert auc_in_gate_band(0.8965)          # round-3 recorded default
+    assert not auc_in_gate_band(0.9613)      # broken P=64 degenerate
+    assert not auc_in_gate_band(0.5)         # chance
+    assert not auc_in_gate_band(float("nan"))  # diverged
+
+
 def test_default_config_geometry_not_collapsed(planted):
     """COLLAPSE guard: intra-cluster cosine high AND inter-cluster cosine
     bounded.  The collapsing designs in QUALITY_NOTES §2 pass any

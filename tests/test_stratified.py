@@ -107,6 +107,36 @@ def test_stratified_requires_spec():
         )
 
 
+def test_aggregate_tail_blocks_matches_scatter():
+    """The one-hot MXU aggregation (round 4) must compute the same sums
+    as the block-indexed scatter-add it replaced — duplicate draws add,
+    undrawn blocks are zero, and the (clamped) last block slot works.
+    On CPU (this suite) matmuls are exact f32, so equality is tight."""
+    from gene2vec_tpu.sgns.step import _aggregate_tail_blocks
+
+    rng = np.random.RandomState(0)
+    g, s, d1, nb = 64, 8, 5, 7
+    blocks = jnp.asarray(rng.randint(0, nb, (g,)).astype(np.int32))
+    payload = jnp.asarray(rng.randn(g, s, d1).astype(np.float32))
+
+    got = _aggregate_tail_blocks(blocks, payload, nb)
+    want = jnp.zeros((nb, s, d1), jnp.float32).at[blocks].add(payload)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+    # a block nobody drew stays exactly zero
+    blocks1 = jnp.full((g,), 3, jnp.int32)
+    got1 = _aggregate_tail_blocks(blocks1, payload, nb)
+    assert np.all(np.asarray(got1[0]) == 0) and np.all(np.asarray(got1[6]) == 0)
+    # f32 reduction order differs (matmul tree vs sequential), so compare
+    # with an absolute floor for near-cancelling sums
+    np.testing.assert_allclose(
+        np.asarray(got1[3]), np.asarray(payload.sum(axis=0)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_stratified_warns_on_degenerate_grouping():
     """ADVICE r3: awkward example counts that collapse the divisor search
     (e.g. E = 2*supergroup) must warn about the raised estimator variance,
