@@ -100,8 +100,17 @@ def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
 
 
 def measure_pairs_per_sec(
-    dim: int, vocab_size: int, num_pairs: int, batch_pairs: int
-) -> float:
+    dim: int, vocab_size: int, num_pairs: int, batch_pairs: int,
+    mesh_data: int = 0,
+) -> tuple:
+    """Headline rate; ``mesh_data > 0`` runs the SAME config data-parallel
+    over the first N attached devices (sharded corpus + batch, replicated
+    tables — XLA's scatter-into-replicated psum IS the gradient
+    all-reduce, parallel/sharding.py).  Loss parity of the mesh path vs
+    single-device is pinned by tests/test_parallel.py and the committed
+    MESH_SANITY artifact (8-way CPU mesh); this flag makes the multi-chip
+    headline one command when hardware is attached:
+    ``python bench.py --mesh-data 8``.  Returns (rate, mesh_info)."""
     import jax
 
     from gene2vec_tpu.config import SGNSConfig
@@ -109,13 +118,35 @@ def measure_pairs_per_sec(
 
     corpus = synth_corpus(vocab_size, num_pairs)
     config = SGNSConfig(dim=dim, batch_pairs=batch_pairs)
-    trainer = SGNSTrainer(corpus, config)
+    sharding = None
+    mesh_info = {
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+        "mesh": None,
+    }
+    if mesh_data > 0:
+        from gene2vec_tpu.config import MeshConfig
+        from gene2vec_tpu.parallel.mesh import make_mesh
+        from gene2vec_tpu.parallel.sharding import SGNSSharding
+
+        devs = jax.devices()
+        mesh = make_mesh(
+            MeshConfig(data=mesh_data, model=1), devices=devs[:mesh_data]
+        )
+        sharding = SGNSSharding(mesh, vocab_sharded=False)
+        mesh_info = {
+            "devices": mesh_data,
+            "platform": devs[0].platform,
+            "mesh": {"data": mesh_data, "model": 1},
+        }
+    trainer = SGNSTrainer(corpus, config, sharding=sharding)
     rate = _steady_rate(trainer)
     log(
-        f"platform={jax.devices()[0].platform} dim={dim} V={vocab_size} "
+        f"platform={mesh_info['platform']} devices={mesh_info['devices']} "
+        f"dim={dim} V={vocab_size} "
         f"N={num_pairs} batch={batch_pairs}: {rate:,.0f} pairs/s steady-state"
     )
-    return rate
+    return rate, mesh_info
 
 
 def hogwild_baseline(dim: int, vocab_size: int, num_pairs: int):
@@ -390,6 +421,10 @@ def main() -> None:
     ap.add_argument("--cpu-pairs", type=int, default=200_000)
     ap.add_argument("--secondary-pairs", type=int, default=1_000_000)
     ap.add_argument("--no-secondary", action="store_true")
+    ap.add_argument("--mesh-data", type=int, default=0,
+                    help="run the headline data-parallel over the first N "
+                    "attached devices (0 = single device); the result JSON "
+                    "records the mesh shape and device count")
     ap.add_argument("--no-quality-gate", action="store_true",
                     help="skip the quality gate (exploration only; the "
                     "recorded headline must carry it)")
@@ -397,6 +432,16 @@ def main() -> None:
                     help="reference predictionData for the gate's real-"
                     "data AUC check (recorded as SKIPPED when absent)")
     args = ap.parse_args()
+
+    if args.mesh_data > 0:
+        # fail in seconds, not after the multi-minute quality gate
+        import jax
+
+        n = len(jax.devices())
+        if args.mesh_data > n:
+            raise SystemExit(
+                f"--mesh-data {args.mesh_data}: only {n} device(s) attached"
+            )
 
     quality = {}
     if not args.no_quality_gate:
@@ -416,7 +461,9 @@ def main() -> None:
             }))
             sys.exit(1)
 
-    tpu_rate = measure_pairs_per_sec(args.dim, args.vocab, args.pairs, args.batch)
+    tpu_rate, mesh_info = measure_pairs_per_sec(
+        args.dim, args.vocab, args.pairs, args.batch, args.mesh_data
+    )
 
     vs = vs32 = base1 = None
     extrapolated = None
@@ -460,6 +507,9 @@ def main() -> None:
         "vs_32thread_equiv": round(vs32, 2) if vs32 else None,
         "vs_32thread_equiv_extrapolated": extrapolated,
         "baseline_1core": round(base1, 1) if base1 else None,
+        "platform": mesh_info["platform"],
+        "devices": mesh_info["devices"],
+        "mesh": mesh_info["mesh"],
     }
     if quality:
         result["quality"] = quality

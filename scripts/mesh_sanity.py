@@ -1,0 +1,112 @@
+"""8-way CPU-mesh sanity table for the data-parallel bench path
+(VERDICT r3 item 2): runs the bench headline configuration (scaled
+down) on 1/2/4/8-device meshes of the FORCED-CPU backend, pinning
+
+* loss parity — the sharded epoch must reproduce the single-device
+  epoch's loss to float tolerance (the collectives XLA inserts for the
+  scatter-into-replicated-table updates are exact), and
+* bounded per-device overhead — the mesh path's single-device-equivalent
+  rate must stay within a sane factor of the unsharded rate (on CPU the
+  collectives are memcpys; this is a plumbing check, not a perf claim —
+  the perf number comes from ``bench.py --mesh-data N`` on real chips).
+
+Corpus and timing discipline are imported from bench.py itself
+(``synth_corpus``, ``_steady_rate``) so the table cannot desynchronize
+from the headline recipe.  Writes MESH_SANITY_r04.json at the repo
+root.  Forced-CPU because the bench host has one TPU chip; the same
+``bench.py --mesh-data 8`` command produces the real multi-chip number
+when hardware is attached.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+# sitecustomize imports jax before us, so env vars are latched — re-pin
+# through the config API (docs/DISTRIBUTED.md; round-3 lesson)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+from bench import _steady_rate, synth_corpus  # noqa: E402
+from gene2vec_tpu.config import MeshConfig, SGNSConfig  # noqa: E402
+from gene2vec_tpu.parallel.mesh import make_mesh  # noqa: E402
+from gene2vec_tpu.parallel.sharding import SGNSSharding  # noqa: E402
+from gene2vec_tpu.sgns.train import SGNSTrainer  # noqa: E402
+
+V, D, N, B = 4096, 64, 262_144, 4096
+
+
+def run(n_devices: int) -> dict:
+    corpus = synth_corpus(V, N)
+    cfg = SGNSConfig(dim=D, batch_pairs=B)
+    sharding = None
+    if n_devices > 1:
+        mesh = make_mesh(
+            MeshConfig(data=n_devices, model=1),
+            devices=jax.devices()[:n_devices],
+        )
+        sharding = SGNSSharding(mesh, vocab_sharded=False)
+    trainer = SGNSTrainer(corpus, cfg, sharding=sharding)
+
+    # loss parity probe: one epoch from the same fresh init/key as every
+    # other mesh size (before _steady_rate's own init/warmup)
+    params = trainer.init()
+    key = jax.random.PRNGKey(42)
+    params, loss = trainer.train_epoch(params, key)
+    loss = float(loss)
+    params, loss2 = trainer.train_epoch(params, jax.random.fold_in(key, 1))
+    loss2 = float(loss2)
+
+    # steady-state rate with the bench's own discipline (2 warmup epochs —
+    # compile + donated-buffer relayout — then the median of 3 timed)
+    rate = _steady_rate(trainer)
+    return {
+        "devices": n_devices,
+        "loss_epoch1": round(loss, 6),
+        "loss_epoch2": round(loss2, 6),
+        "pairs_per_sec": round(rate, 1),
+    }
+
+
+def main():
+    assert jax.device_count() == 8, jax.devices()
+    rows = [run(n) for n in (1, 2, 4, 8)]
+    ref = rows[0]
+    for r in rows[1:]:
+        # loss parity: identical seed/config => the mesh changes only the
+        # physical layout; any drift means a collective is wrong
+        for k in ("loss_epoch1", "loss_epoch2"):
+            assert abs(r[k] - ref[k]) < 1e-3, (k, r, ref)
+        r["loss_parity"] = True
+        # per-device overhead bound: N CPU "devices" share the same host
+        # cores, so aggregate throughput CANNOT scale — we bound the
+        # mesh-plumbing SLOWDOWN instead (collectives + sharded shuffle)
+        r["overhead_factor"] = round(ref["pairs_per_sec"] / r["pairs_per_sec"], 2)
+        assert r["overhead_factor"] < 4.0, r
+    out = {
+        "note": (
+            "forced-CPU 8-device mesh (one real chip on the bench host); "
+            "loss parity proves the data-parallel collectives exact; "
+            "overhead_factor is single-device rate / mesh rate on SHARED "
+            "host cores (mesh plumbing cost, not a scaling measurement). "
+            "Real multi-chip: bench.py --mesh-data N."
+        ),
+        "config": {"V": V, "dim": D, "pairs": N, "batch": B},
+        "rows": rows,
+    }
+    with open(os.path.join(REPO, "MESH_SANITY_r04.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
