@@ -1,0 +1,180 @@
+"""Real-data intrinsic score via the reference's target function
+(VERDICT r3 item 4): pathway-ratio for the real-corpus-trained embedding
+vs a random table, written to INTRINSIC_r04.json.
+
+**Pathway source & limitation (documented, not hidden).**  The canonical
+input is MSigDB v6.1 (``src/evaluation_target_function.py:54-60``), which
+the reference does not bundle and which is unobtainable here (zero
+package/data egress — see docs/QUALITY_NOTES.md §5 for the recorded
+attempt).  The best independent gene-set source in this environment is
+the reference's own predictionData: we build sets from HELD-OUT positive
+pairs (the canonical eval.holdout split — the same 20%/seed-7 holdout the
+AUC protocol scores; the embedding never trains on them).  Each set is a
+gene's held-out positive neighborhood (its partners across held-out
+pairs), sizes 2..50 to match the reference's ≤50-gene pathway filter.
+Genes sharing interaction partners are functionally related, so a real
+embedding must score intra-set cosine ≫ random-pair cosine — exactly the
+target function's contract.  The sets are written as a genuine ``.gmt``
+file and scored through the UNCHANGED ``target_function`` entry point
+(gmt parsing, ≤50-gene filter, seed-35 shuffled denominator all
+exercised).
+
+Controls, and why the headline is reported as raw numerator/denominator
+pairs and not only the reference's ratio: for a RANDOM table both the
+intra-set mean and the seed-35 random-pair mean are ≈ 0, so their ratio
+is noise amplification (a first run measured 2.15 for a random table —
+meaningless, both terms ~5e-3).  The informative comparisons are
+
+* trained, real sets vs trained, SIZE-MATCHED random sets — same
+  geometry, same set-size distribution, only the biology removed; the
+  gap is what the embedding knows about held-out interactions;
+* trained vs random-table raw intra-set cosine — geometry vs none;
+* the reference-exact ratio (``targetFunc``) for the trained embedding,
+  which is the number comparable to reference-pipeline outputs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gene2vec_tpu.config import SGNSConfig  # noqa: E402
+from gene2vec_tpu.eval.holdout import load_holdout  # noqa: E402
+from gene2vec_tpu.eval.target_function import (  # noqa: E402
+    pathway_similarities,
+    random_pair_similarity,
+    target_function,
+)
+from gene2vec_tpu.io.emb_io import write_word2vec_format  # noqa: E402
+from gene2vec_tpu.sgns.train import train_epochs  # noqa: E402
+
+DATA_DIR = "/root/reference/predictionData"
+MAX_SET = 50
+MIN_SET = 2
+
+
+def neighborhood_sets(hold_pairs, hold_labels, vocab):
+    """gene -> sorted list of its held-out positive partners (in-vocab),
+    capped at MAX_SET."""
+    labels = np.asarray(hold_labels)
+    nbrs = defaultdict(set)
+    for (a, b), y in zip(hold_pairs, labels):
+        if y != 1:
+            continue
+        if a in vocab.token_to_id and b in vocab.token_to_id:
+            nbrs[a].add(b)
+            nbrs[b].add(a)
+    sets = {}
+    for g, partners in nbrs.items():
+        partners = sorted(partners)[:MAX_SET]
+        if len(partners) >= MIN_SET:
+            sets[f"HOLDOUT_NBR_{g}"] = partners
+    return sets
+
+
+def write_gmt(path, sets):
+    with open(path, "w") as f:
+        for name, genes in sets.items():
+            f.write("\t".join([name, "holdout://predictionData"] + genes) + "\n")
+
+
+def main():
+    corpus, split = load_holdout(DATA_DIR)
+    vocab = corpus.vocab
+    print(
+        f"corpus {corpus.num_pairs} pairs, vocab {len(vocab)}; "
+        f"holdout {len(split.hold_pairs)} pairs",
+        file=sys.stderr, flush=True,
+    )
+
+    t0 = time.perf_counter()
+    emb, losses = train_epochs(
+        corpus, SGNSConfig(dim=200, batch_pairs=4096), 50
+    )
+    train_s = time.perf_counter() - t0
+
+    sets = neighborhood_sets(split.hold_pairs, split.hold_labels, vocab)
+    # size-matched random sets: same size multiset, genes drawn uniformly
+    # from the vocab — removes the biology, keeps every set-size artifact
+    rng = np.random.RandomState(0)
+    all_tokens = np.asarray(vocab.id_to_token)
+    matched = {
+        f"MATCHED_{i}": list(
+            all_tokens[rng.choice(len(vocab), size=len(g), replace=False)]
+        )
+        for i, g in enumerate(sets.values())
+    }
+    rng = np.random.RandomState(1)
+    random_table = rng.uniform(-0.25, 0.25, emb.shape).astype(np.float32)
+
+    out = {
+        "protocol": {
+            "pathway_source": (
+                "held-out positive-pair neighborhoods from the canonical "
+                "eval.holdout split (MSigDB v6.1 unobtainable: zero "
+                "egress, attempt recorded in docs/QUALITY_NOTES.md §5); "
+                "sets never seen by the embedding"
+            ),
+            "n_sets": len(sets),
+            "set_size_filter": [MIN_SET, MAX_SET],
+            "embedding": "SGNS default config, dim 200, 50 epochs, B=4096",
+            "sgns_loss": [round(losses[0], 4), round(losses[-1], 4)],
+            "train_seconds": round(train_s, 1),
+        }
+    }
+    tokens = list(vocab.id_to_token)
+    with tempfile.TemporaryDirectory() as tmp:
+        # the reference-exact entry point (gmt parse, <=50 filter,
+        # seed-35 denominator) for the number comparable to the
+        # reference pipeline's targetFunc output
+        gmt = os.path.join(tmp, "holdout_sets.gmt")
+        write_gmt(gmt, sets)
+        trained_w2v = os.path.join(tmp, "trained_w2v.txt")
+        write_word2vec_format(trained_w2v, tokens, emb)
+        out["trained_target_func_ratio"] = round(
+            target_function(trained_w2v, gmt), 4
+        )
+
+    num_real, _ = pathway_similarities(tokens, emb, sets)
+    num_matched, _ = pathway_similarities(tokens, emb, matched)
+    denom = random_pair_similarity(tokens, emb)
+    rnum_real, _ = pathway_similarities(tokens, random_table, sets)
+    rnum_matched, _ = pathway_similarities(tokens, random_table, matched)
+    rdenom = random_pair_similarity(tokens, random_table)
+    out["trained"] = {
+        "intra_set_cos_real_sets": round(num_real, 4),
+        "intra_set_cos_size_matched_random_sets": round(num_matched, 4),
+        "random_pair_cos": round(denom, 4),
+    }
+    out["random_table"] = {
+        "intra_set_cos_real_sets": round(rnum_real, 4),
+        "intra_set_cos_size_matched_random_sets": round(rnum_matched, 4),
+        "random_pair_cos": round(rdenom, 4),
+        "note": "all ~0: no geometry — the targetFunc RATIO of two "
+                "near-zero terms is undefined noise for a random table, "
+                "which is why raw terms are recorded",
+    }
+    out["interpretation"] = (
+        "the embedding knows held-out biology iff "
+        "trained.intra_set_cos_real_sets >> "
+        "trained.intra_set_cos_size_matched_random_sets (same geometry, "
+        "same set sizes, biology removed) and >> "
+        "random_table.intra_set_cos_real_sets (no geometry at all); "
+        "trained_target_func_ratio is the reference-comparable number."
+    )
+    with open(os.path.join(REPO, "INTRINSIC_r04.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
