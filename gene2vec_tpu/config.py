@@ -76,6 +76,18 @@ class SGNSConfig:
                                    # its throughput (docs/PERF_NOTES.md
                                    # round-4 geometry).  shared_groups>0
                                    # overrides the group size.
+    hs_dense_depth: int = 10       # hierarchical softmax: tree levels
+                                   # scored densely against the contiguous
+                                   # shallow-node prefix (huffman.py
+                                   # split_shallow; <= 2^depth - 1 slab
+                                   # rows).  Hot tokens' whole paths live
+                                   # in the prefix, so only rare tokens'
+                                   # deep levels pay per-row gathers.
+                                   # 0 = classic all-sparse path (also
+                                   # the layout older node-table
+                                   # checkpoints were saved in — resuming
+                                   # one across a depth change scrambles
+                                   # node vectors, not the exported emb).
     shared_pool: int = 1024        # shared-mode total noise-pool size floor
                                    # (importance-weighted down to `negatives`
                                    # per example)

@@ -38,11 +38,19 @@ from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
 from gene2vec_tpu.data.pipeline import PairCorpus, epoch_shuffle, host_preshuffle
 from gene2vec_tpu.io import checkpoint as ckpt
-from gene2vec_tpu.sgns.huffman import HuffmanTree, build_huffman_tree
+from gene2vec_tpu.sgns.huffman import (
+    HuffmanTree,
+    ShallowSplit,
+    build_huffman_tree,
+    split_shallow,
+)
 from gene2vec_tpu.sgns.model import SGNSParams
 from gene2vec_tpu.sgns.step import (
+    _acc_dtype_for,
     _apply_row_updates,
     _examples_from_pairs,
+    _finalize_row_updates,
+    _scatter_accumulator,
     sgns_step,
 )
 from gene2vec_tpu.utils.profiling import StepTimer
@@ -62,13 +70,18 @@ def hs_loss_and_grads(
     codes: jax.Array,      # (V, L) branch bits
     lengths: jax.Array,    # (V,) path lengths
     compute_dtype=jnp.float32,
+    precomputed_v: Optional[jax.Array] = None,  # reuse the caller's gather
 ):
     """Masked per-path-node logistic loss and closed-form gradients.
 
     word2vec HS: loss = -Σ_l log σ((1 − 2·code_l) · v·w_l) over the target
     token's path; dL/dlogit_l = σ(logit_l) − (1 − code_l).
     """
-    v = emb[inputs].astype(compute_dtype)              # (E, D)
+    v = (
+        emb[inputs].astype(compute_dtype)
+        if precomputed_v is None
+        else precomputed_v
+    )                                                  # (E, D)
     pts = points[targets]                              # (E, L)
     cds = codes[targets].astype(compute_dtype)         # (E, L)
     max_len = points.shape[1]
@@ -99,25 +112,76 @@ def hs_step(
     both_directions: bool = True,
     compute_dtype=jnp.float32,
     combiner: str = "capped",
+    shallow_sign: Optional[jax.Array] = None,  # (V, Ns) int8, split layout
+    n_shallow: int = 0,
 ) -> Tuple[SGNSParams, jax.Array]:
-    """One hierarchical-softmax SGD step over a batch of corpus pairs."""
+    """One hierarchical-softmax SGD step over a batch of corpus pairs.
+
+    With ``shallow_sign``/``n_shallow`` set (the :func:`split_shallow`
+    layout; ``tree_*`` must then be the DEEP remainders), the first tree
+    levels are scored densely against the contiguous node-table prefix:
+    per example, one (Ns,)-row sign gather plus MXU matmuls replace up to
+    ``depth`` node gathers AND scatters — and a hot token's whole path
+    lives in the prefix, so only rare tokens' deep levels pay per-row
+    ops (docs/PERF_NOTES.md round-4 CBOW/HS section).  The objective is
+    unchanged: the split is an exact re-grouping of the same per-node
+    logistic terms (pinned by tests/test_cbow_hs.py).
+    """
     centers, contexts = _examples_from_pairs(pairs, both_directions)
     # sg_hs: input center, path of context. cbow_hs: input context, path of
     # center (the 1-token-context CBOW degeneration).
     inputs, targets = (contexts, centers) if cbow else (centers, contexts)
 
+    v_in = (
+        params.emb[inputs].astype(compute_dtype)
+        if shallow_sign is not None
+        else None
+    )
     loss, d_input, d_node, pts, mask = hs_loss_and_grads(
         params.emb, params.ctx, inputs, targets,
         tree_points, tree_codes, tree_lengths, compute_dtype,
+        precomputed_v=v_in,
     )
-
-    # Same fused (rows, D+1) accumulator scatter + dense divisor/axpy as the
-    # SGNS step (step.py:_apply_row_updates) — one scatter per table instead
-    # of two count scatters, a count gather, and raw in-place adds, which
-    # roughly halves the per-row op count of the hot loop (round-1 VERDICT
-    # item 5).  Padded path entries carry weight 0 (mask), so they combine
-    # into row 0 with zero payload.
     d = d_input.shape[-1]
+
+    if shallow_sign is None:
+        emb = _apply_row_updates(
+            params.emb,
+            inputs,
+            d_input,
+            jnp.ones_like(inputs, compute_dtype),
+            lr,
+            combiner,
+            compute_dtype,
+        )
+        # Same fused (rows, D+1) accumulator scatter + dense divisor/axpy
+        # as the SGNS step (step.py:_apply_row_updates).  Padded path
+        # entries carry weight 0 (mask), so they combine into row 0 with
+        # zero payload.
+        node = _apply_row_updates(
+            params.ctx,
+            pts.reshape(-1),
+            d_node.reshape(-1, d),
+            mask.reshape(-1),
+            lr,
+            combiner,
+            compute_dtype,
+        )
+        return SGNSParams(emb=emb, ctx=node), loss
+
+    # ---- dense shallow levels over the contiguous node prefix -----------
+    w_s = params.ctx[:n_shallow].astype(compute_dtype)     # contiguous slab
+    s = shallow_sign[targets].astype(compute_dtype)        # (E, Ns) ±1/0
+    abs_s = jnp.abs(s)
+    logit_s = v_in @ w_s.T                                 # (E, Ns) MXU
+    # word2vec HS per node: loss = softplus(−sign·logit), dL/dlogit =
+    # σ(logit) − (1 − code) with (1 − code) = (1 + sign)/2
+    loss_s = jnp.sum(abs_s * jax.nn.softplus(-s * logit_s), axis=-1)
+    g_s = abs_s * (jax.nn.sigmoid(logit_s) - (1.0 + s) / 2.0)  # (E, Ns)
+
+    loss = loss + jnp.mean(loss_s)
+    d_input = d_input + g_s @ w_s                          # (E, D) MXU
+
     emb = _apply_row_updates(
         params.emb,
         inputs,
@@ -127,15 +191,24 @@ def hs_step(
         combiner,
         compute_dtype,
     )
-    node = _apply_row_updates(
-        params.ctx,
+
+    # node table: deep rows via the fused scatter, shallow rows via dense
+    # adds into the same (rows, D+1) accumulator — one divisor per node
+    # over the sum of shallow and deep load (cap-symmetry invariant,
+    # exactly the stratified head's pattern in step.py)
+    acc_dtype = _acc_dtype_for(compute_dtype)
+    acc = _scatter_accumulator(
+        params.ctx.shape[0],
         pts.reshape(-1),
         d_node.reshape(-1, d),
         mask.reshape(-1),
-        lr,
-        combiner,
-        compute_dtype,
+        acc_dtype,
     )
+    d_shallow = (g_s.T @ v_in).astype(acc_dtype)           # (Ns, D) MXU
+    u_shallow = jnp.sum(abs_s, axis=0, dtype=acc_dtype)    # σ-free units
+    acc = acc.at[:n_shallow, :d].add(d_shallow)
+    acc = acc.at[:n_shallow, d].add(u_shallow)
+    node = _finalize_row_updates(params.ctx, acc, lr, combiner)
     return SGNSParams(emb=emb, ctx=node), loss
 
 
@@ -178,15 +251,27 @@ class CBOWHSTrainer:
         self.hs = config.objective.endswith("_hs")
         if self.hs:
             self.tree: Optional[HuffmanTree] = build_huffman_tree(corpus.vocab.counts)
-            points = jnp.asarray(self.tree.points)
-            codes = jnp.asarray(self.tree.codes)
-            lengths = jnp.asarray(self.tree.lengths)
+            self.split: Optional[ShallowSplit] = None
+            if config.hs_dense_depth > 0 and self.tree.num_nodes > 1:
+                self.split = split_shallow(self.tree, config.hs_dense_depth)
+                points = jnp.asarray(self.split.points_deep)
+                codes = jnp.asarray(self.split.codes_deep)
+                lengths = jnp.asarray(self.split.lengths_deep)
+                sign = jnp.asarray(self.split.sign)
+            else:
+                points = jnp.asarray(self.tree.points)
+                codes = jnp.asarray(self.tree.codes)
+                lengths = jnp.asarray(self.tree.lengths)
+                sign = None
             if sharding is not None:
                 rep = sharding.replicated()
                 points = jax.device_put(points, rep)
                 codes = jax.device_put(codes, rep)
                 lengths = jax.device_put(lengths, rep)
+                if sign is not None:
+                    sign = jax.device_put(sign, rep)
             self._points, self._codes, self._lengths = points, codes, lengths
+            self._sign = sign
         else:
             self.tree = None
             self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
@@ -249,6 +334,10 @@ class CBOWHSTrainer:
                         both_directions=cfg.both_directions,
                         compute_dtype=compute_dtype,
                         combiner=cfg.combiner,
+                        shallow_sign=self._sign,
+                        n_shallow=(
+                            self.split.n_shallow if self.split else 0
+                        ),
                     )
                 else:
                     # cbow + negative sampling: swap roles so the *input*
@@ -328,7 +417,23 @@ class CBOWHSTrainer:
         if start_iter is None:
             start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
         if start_iter > 1:
-            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            params, _, meta = ckpt.load_iteration(
+                export_dir, cfg.dim, start_iter - 1
+            )
+            if self.hs:
+                # node-table row ids depend on the shallow-split layout;
+                # resuming a checkpoint saved under a different
+                # hs_dense_depth would silently feed permuted node
+                # vectors into the step (absent = pre-round-4 = depth 0)
+                saved_depth = int(meta.get("hs_dense_depth", 0))
+                if saved_depth != cfg.hs_dense_depth:
+                    raise ValueError(
+                        f"checkpoint in {export_dir} was saved with "
+                        f"hs_dense_depth={saved_depth}, config has "
+                        f"{cfg.hs_dense_depth}: node-table layouts differ "
+                        "— resume with the saved depth or start a fresh "
+                        "export dir"
+                    )
             log(f"resuming from iteration {start_iter - 1}")
         else:
             params = self.init()
@@ -354,6 +459,8 @@ class CBOWHSTrainer:
                     "loss": loss,
                     "pairs_per_sec": rate,
                     "objective": cfg.objective,
+                    # node-table layout tag: resume refuses a mismatch
+                    "hs_dense_depth": cfg.hs_dense_depth if self.hs else 0,
                 },
             )
         return params

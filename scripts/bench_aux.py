@@ -1,0 +1,166 @@
+"""Viz- and corpus-layer benchmarks (VERDICT r3 item 5): the
+"matching-or-beating on perf" claim measured for L5 (t-SNE) and L1
+(corpus correlation), not just the SGNS hot loop.  Writes
+AUX_BENCH_r04.json at the repo root.
+
+(a) t-SNE — the reference's single heaviest native dependency is
+MulticoreTSNE (C++/OpenMP Barnes-Hut, ``src/tsne_multi_core.py:42-52``:
+perplexity 30, lr 200, n_jobs=32, runs up to 100k iterations on ~24k
+genes x 200d after PCA-50).  MulticoreTSNE is not installed here, so the
+CPU denominator is sklearn's Barnes-Hut TSNE (same algorithm family) on
+this host, with a LINEAR 32-thread extrapolation recorded as
+``extrapolated: true`` (generous to the CPU: BH-tSNE's tree build does
+not parallelize linearly).  The TPU number is the repo's exact O(N²)
+jitted t-SNE (`viz/tsne.py`) at the reference's headline 5,000
+iterations — exact, not approximate: at N=24k the N² kernels are dense
+MXU/VPU work, which is the TPU-first trade.
+
+(b) corpus correlation — the reference's C1 scale story is a Ray
+cluster running pandas ``data.corr()`` per study
+(``src/generate_gene_pairs.py:49,167-191``).  Measured here per GEO-like
+study (5,000 genes x 100 samples) and for a 50-study corpus build:
+pandas ``DataFrame.corr`` on this host vs the repo's standardized-matmul
+``abs_correlation`` (numpy BLAS and jax/TPU backends,
+`corpus/builder.py:113`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_GENES_TSNE = 24447
+DIM = 200
+TSNE_ITERS = 5000
+STUDY_GENES, STUDY_SAMPLES, N_STUDIES = 5000, 100, 50
+
+
+def bench_tsne(out: dict) -> None:
+    from gene2vec_tpu.viz.tsne import TSNE, TSNEConfig, pca_reduce
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(N_GENES_TSNE, DIM).astype(np.float32)
+
+    cfg = TSNEConfig(perplexity=30.0, learning_rate=200.0, n_iter=TSNE_ITERS)
+    t = TSNE(config=cfg)
+    # _segment is jitted with `steps` static, so only an IDENTICAL run
+    # warms the cache — time the second of two full runs (the first pays
+    # compile for both the calibration and the 5000-iter segment)
+    t.fit(x, snapshot_iters=[TSNE_ITERS], log=lambda m: None)
+    t0 = time.perf_counter()
+    t.fit(x, snapshot_iters=[TSNE_ITERS], log=lambda m: None)
+    tpu_s = time.perf_counter() - t0
+    out["tsne"] = {
+        "n": N_GENES_TSNE,
+        "dim_in": DIM,
+        "pca_dims": cfg.pca_dims,
+        "iters": TSNE_ITERS,
+        "tpu_exact_seconds": round(tpu_s, 1),
+        "tpu_iters_per_sec": round(TSNE_ITERS / tpu_s, 1),
+    }
+
+    # CPU denominator: sklearn Barnes-Hut at its minimum 250 iterations,
+    # same PCA-50 input, then linear projections (both flagged).
+    try:
+        from sklearn.manifold import TSNE as SkTSNE
+
+        xp = pca_reduce(x, cfg.pca_dims)
+        cpu_iters = 250
+        sk = SkTSNE(
+            n_components=2, perplexity=30, learning_rate=200,
+            max_iter=cpu_iters, init="random", method="barnes_hut",
+            random_state=0,
+        )
+        t0 = time.perf_counter()
+        sk.fit_transform(xp)
+        cpu_s = time.perf_counter() - t0
+        per_iter = cpu_s / cpu_iters
+        proj_5000_32t = per_iter * TSNE_ITERS / 32.0
+        out["tsne"].update({
+            "cpu_sklearn_bh_iters": cpu_iters,
+            "cpu_sklearn_bh_seconds": round(cpu_s, 1),
+            "cpu_5000iter_32thread_seconds_extrapolated": round(
+                proj_5000_32t, 1
+            ),
+            "extrapolated": True,
+            "vs_cpu_32thread_equiv": round(proj_5000_32t / tpu_s, 2),
+            "note": (
+                "CPU rate measured on 1 core at 250 BH iters "
+                "(neighbor-build amortized in, favoring CPU per-iter), "
+                "scaled linearly to 5000 iters / 32 threads — an upper "
+                "bound for BH scaling.  TPU path is EXACT t-SNE "
+                "(no BH approximation) at the same perplexity/lr."
+            ),
+        })
+    except Exception as e:  # pragma: no cover - recorded, not hidden
+        out["tsne"]["cpu_error"] = repr(e)
+
+
+def bench_corr(out: dict) -> None:
+    import pandas as pd
+
+    from gene2vec_tpu.corpus.builder import abs_correlation
+
+    rng = np.random.RandomState(0)
+    study = rng.randn(STUDY_SAMPLES, STUDY_GENES).astype(np.float64)
+    df = pd.DataFrame(study)
+
+    t0 = time.perf_counter()
+    c_pd = df.corr().to_numpy()
+    pandas_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    c_np = abs_correlation(study, backend="numpy")
+    numpy_s = time.perf_counter() - t0
+
+    # jax backend: first call compiles; time the steady-state call and
+    # a full 50-study serial build
+    abs_correlation(study, backend="jax")
+    t0 = time.perf_counter()
+    c_jx = abs_correlation(study, backend="jax")
+    jax_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for s in range(N_STUDIES):
+        abs_correlation(study, backend="jax")
+    jax_50_s = time.perf_counter() - t0
+
+    err_np = float(np.max(np.abs(np.abs(c_pd) - c_np)))
+    err_jx = float(np.max(np.abs(np.abs(c_pd) - c_jx)))
+    out["corpus_corr"] = {
+        "genes": STUDY_GENES,
+        "samples": STUDY_SAMPLES,
+        "pandas_corr_seconds_per_study": round(pandas_s, 3),
+        "numpy_matmul_seconds_per_study": round(numpy_s, 3),
+        "jax_tpu_seconds_per_study": round(jax_s, 3),
+        "jax_tpu_seconds_50_studies": round(jax_50_s, 2),
+        "pandas_50_studies_seconds_projected": round(pandas_s * N_STUDIES, 1),
+        "vs_pandas_per_study": round(pandas_s / jax_s, 1),
+        "max_abs_err_numpy_vs_pandas": err_np,
+        "max_abs_err_jax_vs_pandas": err_jx,
+        "note": (
+            "reference scales C1 with a Ray cluster running pandas "
+            ".corr() per study; one chip's serial matmul covers the "
+            "50-study GEO-like corpus in jax_tpu_seconds_50_studies"
+        ),
+    }
+
+
+def main() -> None:
+    out: dict = {}
+    bench_corr(out)
+    print(json.dumps(out.get("corpus_corr", {})), file=sys.stderr, flush=True)
+    bench_tsne(out)
+    with open(os.path.join(REPO, "AUX_BENCH_r04.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

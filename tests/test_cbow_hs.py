@@ -12,6 +12,7 @@ from gene2vec_tpu.io.pair_reader import load_corpus
 from gene2vec_tpu.sgns.cbow_hs import (
     CBOWHSTrainer,
     hs_loss_and_grads,
+    hs_step,
     make_trainer,
 )
 from gene2vec_tpu.sgns.huffman import build_huffman_tree
@@ -119,6 +120,97 @@ def test_hs_loss_matches_oracle():
         np.asarray(d_nd).reshape(-1, D) * np.asarray(mask).reshape(-1, 1),
     )
     np.testing.assert_allclose(got_dnode, exp_dnode, atol=1e-5)
+
+
+def test_split_shallow_layout():
+    """split_shallow: shallow nodes (depth < d) are renumbered into a
+    contiguous prefix; the sign row re-encodes exactly the first d path
+    levels; deep remainders carry the rest under the permutation."""
+    from gene2vec_tpu.sgns.huffman import split_shallow
+
+    rng = np.random.RandomState(1)
+    counts = (rng.zipf(1.5, 200) + 1).astype(np.int64)
+    tree = build_huffman_tree(counts)
+    d = 4
+    split = split_shallow(tree, d)
+    assert 1 <= split.n_shallow < 2 ** d
+    inv = np.argsort(split.perm)  # new id -> old id
+    for t in range(len(counts)):
+        ln = int(tree.lengths[t])
+        # shallow levels encoded in the sign row
+        row = split.sign[t]
+        on = np.flatnonzero(row)
+        assert len(on) == min(ln, d)
+        for l in range(min(ln, d)):
+            new_id = split.perm[tree.points[t, l]]
+            assert new_id < split.n_shallow
+            assert row[new_id] == 1 - 2 * tree.codes[t, l]
+        # deep levels preserved under the permutation
+        assert int(split.lengths_deep[t]) == max(ln - d, 0)
+        for l in range(d, ln):
+            assert inv[split.points_deep[t, l - d]] == tree.points[t, l]
+            assert split.codes_deep[t, l - d] == tree.codes[t, l]
+
+
+@pytest.mark.parametrize("cbow", [False, True])
+def test_hs_step_split_matches_classic(cbow):
+    """The dense-shallow split (round 4) is an exact re-grouping of the
+    same per-node logistic objective: one step from identical params must
+    give the same loss and the same updated tables (modulo the node
+    permutation and f32 matmul-vs-scatter reorder)."""
+    from gene2vec_tpu.sgns.huffman import split_shallow
+
+    rng = np.random.RandomState(0)
+    V, D, B = 60, 8, 32
+    counts = (rng.zipf(1.5, V) + 1).astype(np.int64)
+    tree = build_huffman_tree(counts)
+    split = split_shallow(tree, 4)
+    emb = rng.randn(V, D).astype(np.float32) * 0.2
+    node = rng.randn(tree.num_nodes, D).astype(np.float32) * 0.2
+    pairs = jnp.asarray(rng.randint(0, V, (B, 2)).astype(np.int32))
+    lr = jnp.float32(0.05)
+
+    p_ref, loss_ref = hs_step(
+        SGNSParams(emb=jnp.asarray(emb), ctx=jnp.asarray(node)), pairs,
+        jnp.asarray(tree.points), jnp.asarray(tree.codes),
+        jnp.asarray(tree.lengths), lr, cbow=cbow,
+    )
+    node_perm = node[np.argsort(split.perm)]  # new id -> old row
+    p_new, loss_new = hs_step(
+        SGNSParams(emb=jnp.asarray(emb), ctx=jnp.asarray(node_perm)), pairs,
+        jnp.asarray(split.points_deep), jnp.asarray(split.codes_deep),
+        jnp.asarray(split.lengths_deep), lr, cbow=cbow,
+        shallow_sign=jnp.asarray(split.sign), n_shallow=split.n_shallow,
+    )
+    np.testing.assert_allclose(float(loss_new), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p_new.emb), np.asarray(p_ref.emb), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_new.ctx)[split.perm], np.asarray(p_ref.ctx), atol=1e-5
+    )
+
+
+def test_hs_resume_refuses_layout_mismatch(tmp_path, synthetic_corpus_dir):
+    """A checkpoint saved under one hs_dense_depth must not silently
+    resume under another — node-table row ids are permuted between
+    layouts (round-4 split)."""
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    corpus = PairCorpus(vocab, pairs)
+    cfg = SGNSConfig(
+        dim=8, num_iters=1, batch_pairs=64, objective="sg_hs",
+        hs_dense_depth=4,
+    )
+    CBOWHSTrainer(corpus, cfg).run(str(tmp_path), log=lambda m: None)
+
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, num_iters=2, hs_dense_depth=0)
+    with pytest.raises(ValueError, match="hs_dense_depth=4"):
+        CBOWHSTrainer(corpus, cfg2).run(str(tmp_path), log=lambda m: None)
+    # same depth resumes fine
+    cfg3 = dataclasses.replace(cfg, num_iters=2)
+    CBOWHSTrainer(corpus, cfg3).run(str(tmp_path), log=lambda m: None)
 
 
 # -- training smoke -------------------------------------------------------
