@@ -76,6 +76,26 @@ class SGNSConfig:
                                    # its throughput (docs/PERF_NOTES.md
                                    # round-4 geometry).  shared_groups>0
                                    # overrides the group size.
+    positive_head: int = 512       # dense-head positives (stratified mode,
+                                   # single-device): batches arrive class-
+                                   # segmented [HH|HT|TT] by head membership
+                                   # (token row < positive_head of the
+                                   # frequency-sorted vocab), and head-token
+                                   # emb/ctx rows are gathered/scattered as
+                                   # one-hot MXU matmuls over the contiguous
+                                   # table[:positive_head] slab — only
+                                   # tail-token examples pay dynamic row
+                                   # ops.  0 disables (plain gathers).  The
+                                   # trainer falls back to 0 under sharding
+                                   # or non-stratified/one-direction
+                                   # configs.  Measured (v5e, V=24,447
+                                   # Zipf, B=16,384): 3.69M -> ~4.5M
+                                   # pairs/s at H=512, epoch loss equal to
+                                   # 4 decimals, holdout AUC 0.8960 vs the
+                                   # plain path's 0.8971 (same run-to-run
+                                   # band; oracle 0.878) — sweep in
+                                   # experiments/results/positive_head_r4*,
+                                   # PERF_NOTES round 4.
     hs_dense_depth: int = 10       # hierarchical softmax: tree levels
                                    # scored densely against the contiguous
                                    # shallow-node prefix (huffman.py

@@ -153,6 +153,98 @@ def epoch_shuffle(
     return blocks[jax.random.permutation(blk_key, nblocks)].reshape(span, 2)
 
 
+def segment_corpus_by_head(
+    pairs: np.ndarray, head: int, batch_pairs: int
+) -> Tuple[Tuple[np.ndarray, np.ndarray, np.ndarray], Tuple[int, int, int]]:
+    """Host-side class segmentation backing the dense-head positive path
+    (``sgns/step.py`` round 4): split the corpus into three pools by
+    whether each token falls in the frequency head (row < ``head`` of the
+    frequency-sorted vocab) — HH (both), HT (exactly one; canonicalized
+    head-token-first, a no-op under both-direction example emission), TT
+    (neither) — and compute static per-batch quotas (q1, q2, q3) summing
+    to ``batch_pairs`` so every batch carries the corpus's class mix at
+    fixed segment offsets.  The step can then gather/scatter head-token
+    rows as one-hot MXU matmuls over the contiguous ``table[:head]`` slab.
+
+    Quotas are floors of each pool's share of ``num_batches`` batches;
+    rounding leftovers are settled deterministically (largest-pool
+    decrement / largest-leftover increment, the latter wrap-padding its
+    pool by < num_batches rows — the same wrap device ``pad_to_multiple``
+    uses).  Each pool keeps ALL its rows (>= quota * num_batches): the
+    per-epoch roll in :func:`segmented_epoch_shuffle` cycles which rows
+    fall into the epoch's span, so no pair is dropped permanently.
+    """
+    if batch_pairs <= 0 or pairs.shape[0] < batch_pairs:
+        raise ValueError(
+            f"cannot segment {pairs.shape[0]} pairs into "
+            f"batches of {batch_pairs}"
+        )
+    num_batches = pairs.shape[0] // batch_pairs
+    a_head = pairs[:, 0] < head
+    b_head = pairs[:, 1] < head
+    hh = pairs[a_head & b_head]
+    tt = pairs[~a_head & ~b_head]
+    ht = pairs[a_head ^ b_head].copy()
+    swap = ht[:, 0] >= head
+    ht[swap] = ht[swap][:, ::-1]
+    pools = [hh, ht, tt]
+
+    # every non-empty class gets quota >= 1: a pool smaller than one row
+    # per batch would otherwise round to 0 and its pairs would NEVER train
+    # (the roll cycles within a pool, not across pools)
+    floors = [1 if len(p) else 0 for p in pools]
+    if sum(floors) > batch_pairs:
+        raise ValueError(
+            f"batch_pairs={batch_pairs} is smaller than the number of "
+            f"non-empty head classes ({sum(floors)})"
+        )
+    quotas = [
+        max(len(p) // num_batches, f) for p, f in zip(pools, floors)
+    ]
+    while sum(quotas) > batch_pairs:
+        # decrement the largest quota that stays above its floor
+        c = int(
+            np.argmax([q if q > f else -1 for q, f in zip(quotas, floors)])
+        )
+        quotas[c] -= 1
+    while sum(quotas) < batch_pairs:
+        leftover = [
+            len(p) - q * num_batches for p, q in zip(pools, quotas)
+        ]
+        quotas[int(np.argmax(leftover))] += 1
+    for c, (pool, q) in enumerate(zip(pools, quotas)):
+        need = q * num_batches
+        if 0 < len(pool) < need:
+            # wrap-pad: tile the pool to the quota (a pool under one row
+            # per batch repeats; mild oversampling of a tiny class beats
+            # dropping it)
+            reps = -(-need // len(pool))
+            pools[c] = np.concatenate([pool] * reps, axis=0)[:need]
+    return tuple(pools), tuple(quotas)
+
+
+def segmented_epoch_shuffle(
+    pools, key: jax.Array, quotas, num_batches: int, mode: str,
+    enabled: bool = True,
+):
+    """Per-epoch shuffle for class-segmented corpora: each pool shuffles
+    independently (same roll + block-permutation machinery as
+    :func:`epoch_shuffle`), then batch ``b`` is the concatenation of row
+    range ``[b*q_c, (b+1)*q_c)`` from each pool — static [HH|HT|TT]
+    segment layout every batch."""
+    keys = jax.random.split(key, len(pools))
+    return tuple(
+        # zero-quota pools contribute no rows to any batch; epoch_shuffle
+        # ("full" mode) would divide by batch_pairs=0
+        pool[:0]
+        if q == 0
+        else epoch_shuffle(
+            pool, k, pool.shape[0], num_batches, q, mode, enabled=enabled
+        )
+        for pool, k, q in zip(pools, keys, quotas)
+    )
+
+
 def host_preshuffle(corpus: "PairCorpus", seed: int) -> "PairCorpus":
     """One-time host-side shuffle backing ``epoch_shuffle``'s offset mode —
     the analogue of the reference's pre-training ``random.shuffle``

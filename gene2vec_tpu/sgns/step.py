@@ -346,6 +346,118 @@ def _step_shared(
     return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
 
 
+#: Matmul precision for the dense-head positive path's one-hot gathers and
+#: scatters.  ``None`` = the step's default policy (bf16-truncated inputs on
+#: TPU, f32 accumulation) — a one-hot gather then returns the table value
+#: truncated to bf16 and a one-hot scatter sums bf16-truncated payload rows
+#: in f32, the same rounding class as :func:`_aggregate_tail_blocks`.
+#: Tests pin exactness against the scatter path by setting this to
+#: ``jax.lax.Precision.HIGHEST``.
+_DENSE_HEAD_PRECISION = None
+
+
+def _dense_head_segments(q1: int, q2: int, b: int):
+    """Static (start, length) example segments for the [HH|HT|TT] batch
+    layout (``data/pipeline.segment_corpus_by_head``): q1 HH pairs, q2 HT
+    pairs (head token first), q3 = b - q1 - q2 TT pairs, emitted in both
+    directions so example i and i + b are the two directions of pair i.
+
+    Returns (center_head, center_tail, context_head, context_tail), each a
+    tuple of segments in ascending position order.
+    """
+    q3 = b - q1 - q2
+    center_head = ((0, q1 + q2), (b, q1))
+    center_tail = ((q1 + q2, q3), (b + q1, q2 + q3))
+    context_head = ((0, q1), (b, q1 + q2))
+    context_tail = ((q1, q2 + q3), (b + q1 + q2, q3))
+    return center_head, center_tail, context_head, context_tail
+
+
+def _segment_split(x: jax.Array, head_segs, tail_segs):
+    """Split rows of ``x`` (example-major) into head/tail parts, returning
+    (x_head, x_tail) with each part's segments concatenated in order."""
+    xh = jnp.concatenate([x[s : s + l] for s, l in head_segs], axis=0)
+    xt = jnp.concatenate([x[s : s + l] for s, l in tail_segs], axis=0)
+    return xh, xt
+
+
+def _segment_join(head_part, tail_part, head_segs, tail_segs):
+    """Inverse of :func:`_segment_split`: reassemble rows in original
+    example order.  Segments alternate head/tail by construction."""
+    pieces = []
+    oh = ot = 0
+    for (hs, hl), (ts, tl) in zip(head_segs, tail_segs):
+        pieces.append(head_part[oh : oh + hl])
+        pieces.append(tail_part[ot : ot + tl])
+        oh += hl
+        ot += tl
+    return jnp.concatenate(pieces, axis=0)
+
+
+def _dense_head_gather(
+    table: jax.Array,   # (V, D)
+    idx: jax.Array,     # (E,) — head segments guaranteed < head
+    head: int,
+    head_segs,
+    tail_segs,
+    compute_dtype,
+):
+    """Gather ``table[idx]`` with head-segment rows produced by a one-hot
+    MXU matmul against the contiguous ``table[:head]`` slab — zero dynamic
+    row ops for head examples (the positive-side analogue of the stratified
+    noise head; docs/PERF_NOTES.md round 4).  Returns (rows (E, D),
+    onehot (Eh, head), idx_tail (Et,)) — the one-hot is reused by
+    :func:`_dense_head_scatter` for the update direction.
+    """
+    idx_h, idx_t = _segment_split(idx, head_segs, tail_segs)
+    onehot = (idx_h[:, None] == jnp.arange(head)[None, :]).astype(
+        compute_dtype
+    )
+    rows_h = jax.lax.dot(
+        onehot,
+        table[:head].astype(compute_dtype),
+        precision=_DENSE_HEAD_PRECISION,
+        preferred_element_type=compute_dtype,
+    )
+    rows_t = table[idx_t].astype(compute_dtype)
+    return (
+        _segment_join(rows_h, rows_t, head_segs, tail_segs),
+        onehot,
+        idx_t,
+    )
+
+
+def _dense_head_scatter_acc(
+    v_size: int,
+    grads: jax.Array,     # (E, D) per-example gradients
+    weights: jax.Array,   # (E,) example-unit weights
+    onehot: jax.Array,    # (Eh, head) from _dense_head_gather
+    idx_tail: jax.Array,  # (Et,)
+    head_segs,
+    tail_segs,
+    acc_dtype,
+) -> jax.Array:
+    """(V, D+1) accumulator for the dense-head path: tail rows scatter as
+    usual; head rows land as ONE (head, Eh) x (Eh, D+1) MXU matmul added
+    densely to the accumulator's head slab (exact f32 accumulation of
+    bf16-truncated payload rows under the default policy)."""
+    d = grads.shape[-1]
+    payload = jnp.concatenate(
+        [grads, weights.astype(grads.dtype)[:, None]], axis=1
+    )
+    pay_h, pay_t = _segment_split(payload, head_segs, tail_segs)
+    acc = jnp.zeros((v_size, d + 1), acc_dtype).at[idx_tail].add(
+        pay_t.astype(acc_dtype)
+    )
+    head_rows = jax.lax.dot(
+        onehot.T,
+        pay_h,
+        precision=_DENSE_HEAD_PRECISION,
+        preferred_element_type=acc_dtype,
+    )
+    return acc.at[: onehot.shape[1]].add(head_rows.astype(acc_dtype))
+
+
 def _aggregate_tail_blocks(
     blocks: jax.Array,        # (G,) block index drawn by each group
     tail_payload: jax.Array,  # (G, S, D+1) per-group gradient+weight slabs
@@ -389,6 +501,8 @@ def _step_stratified(
     lr: jax.Array,
     compute_dtype,
     combiner: str,
+    pos_head: int = 0,
+    pos_quotas=None,  # (q1, q2) static HH/HT pair counts of the batch layout
 ) -> Tuple[SGNSParams, jax.Array]:
     """Stratified negatives: exact head + per-group random tail blocks.
 
@@ -447,8 +561,27 @@ def _step_stratified(
     head, block, nb = spec.head, spec.block, spec.nb
     k = jnp.asarray(float(k_negatives), compute_dtype)
 
-    v = emb_t[centers].astype(compute_dtype)          # (E, D)
-    u_pos = ctx_t[contexts].astype(compute_dtype)     # (E, D)
+    # Positive-side row ops: plain gathers, or the dense-head split when the
+    # trainer feeds class-segmented [HH|HT|TT] batches (positive_head > 0):
+    # head-token rows come from one-hot MXU matmuls over the contiguous
+    # table[:pos_head] slab, and only tail-token examples pay dynamic row
+    # ops (docs/PERF_NOTES.md round 4 — the positive-side analogue of the
+    # stratified noise head).
+    dense_pos = pos_head > 0 and pos_quotas is not None
+    if dense_pos:
+        q1, q2 = pos_quotas
+        c_head, c_tail, x_head, x_tail = _dense_head_segments(
+            q1, q2, e // 2
+        )
+        v, oh_c, idx_ct = _dense_head_gather(
+            emb_t, centers, pos_head, c_head, c_tail, compute_dtype
+        )
+        u_pos, oh_x, idx_xt = _dense_head_gather(
+            ctx_t, contexts, pos_head, x_head, x_tail, compute_dtype
+        )
+    else:
+        v = emb_t[centers].astype(compute_dtype)      # (E, D)
+        u_pos = ctx_t[contexts].astype(compute_dtype) # (E, D)
     pos_logit = jnp.sum(v * u_pos, axis=-1)
     g_pos = jax.nn.sigmoid(pos_logit) - 1.0
 
@@ -499,17 +632,31 @@ def _step_stratified(
         + g_head @ ctx_head                                        # MXU
         + jnp.einsum("ges,gsd->ged", g_tail, ctx_blk).reshape(e, d)
     )
-    emb = _apply_row_updates(
-        emb_t, centers, d_center,
-        jnp.ones_like(centers, compute_dtype), lr, combiner, compute_dtype,
-    )
+    acc_dtype = _acc_dtype_for(compute_dtype)
+    if dense_pos:
+        acc_emb = _dense_head_scatter_acc(
+            v_size, d_center, jnp.ones((e,), compute_dtype),
+            oh_c, idx_ct, c_head, c_tail, acc_dtype,
+        )
+        emb = _finalize_row_updates(emb_t, acc_emb, lr, combiner)
+    else:
+        emb = _apply_row_updates(
+            emb_t, centers, d_center,
+            jnp.ones_like(centers, compute_dtype), lr, combiner,
+            compute_dtype,
+        )
 
     # ---- ctx: positive scatter + DENSE noise adds into ONE accumulator ---
-    acc_dtype = _acc_dtype_for(compute_dtype)
     d_pos = g_pos[:, None] * v
-    acc = _scatter_accumulator(
-        v_size, contexts, d_pos, jnp.ones((e,), compute_dtype), acc_dtype
-    )
+    if dense_pos:
+        acc = _dense_head_scatter_acc(
+            v_size, d_pos, jnp.ones((e,), compute_dtype),
+            oh_x, idx_xt, x_head, x_tail, acc_dtype,
+        )
+    else:
+        acc = _scatter_accumulator(
+            v_size, contexts, d_pos, jnp.ones((e,), compute_dtype), acc_dtype
+        )
 
     # Noise weight columns carry the rows' sigma-FREE example-unit loads —
     # k*q_j*sum(mask) for head, k*w_j*sum(mask) for tail — matching the
@@ -560,9 +707,22 @@ def sgns_step(
     shared_groups: int = 0,
     strat_group: int = 32,
     stratified=None,  # StratifiedSpec, required for negative_mode="stratified"
+    positive_head: int = 0,
+    pos_quotas=None,  # static (q1, q2): HH/HT pair counts of the batch layout
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
     centers, contexts = _examples_from_pairs(pairs, both_directions)
+    if positive_head > 0 and pos_quotas is not None:
+        if negative_mode != "stratified":
+            raise ValueError(
+                "positive_head (dense-head positives) is implemented for "
+                "negative_mode='stratified' only"
+            )
+        if not both_directions:
+            raise ValueError(
+                "positive_head requires both_directions=True (the [HH|HT|TT]"
+                " batch layout emits both directions of each pair)"
+            )
     if negative_mode == "stratified":
         if stratified is None:
             raise ValueError(
@@ -582,6 +742,7 @@ def sgns_step(
         return _step_stratified(
             params, centers, contexts, stratified, key, negatives,
             group_size, lr, compute_dtype, combiner,
+            pos_head=positive_head, pos_quotas=pos_quotas,
         )
     if negative_mode == "shared":
         e = int(centers.shape[0])

@@ -27,7 +27,13 @@ import jax.numpy as jnp
 
 from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
-from gene2vec_tpu.data.pipeline import PairCorpus, epoch_shuffle, host_preshuffle
+from gene2vec_tpu.data.pipeline import (
+    PairCorpus,
+    epoch_shuffle,
+    host_preshuffle,
+    segment_corpus_by_head,
+    segmented_epoch_shuffle,
+)
 from gene2vec_tpu.io import checkpoint as ckpt
 from gene2vec_tpu.sgns.model import SGNSParams, init_params
 from gene2vec_tpu.sgns.step import sgns_step
@@ -43,6 +49,7 @@ def make_train_epoch(
     config: SGNSConfig,
     sharding: Optional["SGNSSharding"] = None,
     stratified=None,
+    pos_quotas: Optional[Tuple[int, int, int]] = None,
 ) -> Callable:
     """Build the jitted epoch function.
 
@@ -50,23 +57,43 @@ def make_train_epoch(
     All loop structure is static; only array contents are traced.
     ``stratified`` (a StratifiedSpec) is captured in the closure — its
     arrays are per-trainer constants derived from the vocab counts.
+    With ``pos_quotas`` (dense-head positives), ``pairs`` is the
+    3-tuple of class pools from ``segment_corpus_by_head`` and each
+    batch is assembled [HH|HT|TT] at static quota offsets.
     """
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
+    positive_head = config.positive_head if pos_quotas is not None else 0
 
     def train_epoch(params, pairs, noise, key):
         shuffle_key, step_key = jax.random.split(key)
-        shuffled = epoch_shuffle(
-            pairs, shuffle_key, num_pairs, num_batches, batch_pairs,
-            config.shuffle_mode, enabled=config.shuffle_each_iter,
-        )
-        if sharding is not None:
-            shuffled = sharding.constrain_batch(shuffled)
+        if pos_quotas is not None:
+            pools = segmented_epoch_shuffle(
+                pairs, shuffle_key, pos_quotas, num_batches,
+                config.shuffle_mode, enabled=config.shuffle_each_iter,
+            )
+        else:
+            shuffled = epoch_shuffle(
+                pairs, shuffle_key, num_pairs, num_batches, batch_pairs,
+                config.shuffle_mode, enabled=config.shuffle_each_iter,
+            )
+            if sharding is not None:
+                shuffled = sharding.constrain_batch(shuffled)
 
         def body(params, step):
-            batch = jax.lax.dynamic_slice_in_dim(
-                shuffled, step * batch_pairs, batch_pairs
-            )
+            if pos_quotas is not None:
+                batch = jnp.concatenate(
+                    [
+                        jax.lax.dynamic_slice_in_dim(pool, step * q, q)
+                        for pool, q in zip(pools, pos_quotas)
+                        if q
+                    ],
+                    axis=0,
+                )
+            else:
+                batch = jax.lax.dynamic_slice_in_dim(
+                    shuffled, step * batch_pairs, batch_pairs
+                )
             if sharding is not None:
                 batch = sharding.constrain_batch(batch)
             frac = step.astype(compute_dtype) / max(num_batches, 1)
@@ -87,6 +114,10 @@ def make_train_epoch(
                 shared_groups=config.shared_groups,
                 strat_group=config.strat_group,
                 stratified=stratified,
+                positive_head=positive_head,
+                pos_quotas=(
+                    pos_quotas[:2] if pos_quotas is not None else None
+                ),
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)
@@ -156,6 +187,32 @@ class SGNSTrainer:
             # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
             # decorrelation then needs no per-row device gathers
             corpus = host_preshuffle(corpus, config.seed)
+        # dense-head positives need the class-segmented batch layout, which
+        # is single-device stratified both-directions only (the segment
+        # offsets don't align with a sharded batch axis) — fall back to
+        # plain gathers otherwise
+        self.pos_quotas = None
+        if config.positive_head > 0 and (
+            sharding is not None
+            or config.negative_mode != "stratified"
+            or not config.both_directions
+        ):
+            if sharding is not None and config.negative_mode == "stratified":
+                import warnings
+
+                warnings.warn(
+                    "positive_head (dense-head positives) is single-device "
+                    "only and was disabled for this sharded run — expect "
+                    "the plain-gather per-chip rate (PERF_NOTES round 4)",
+                    stacklevel=2,
+                )
+            config = dataclasses.replace(config, positive_head=0)
+        elif config.positive_head > 0:
+            config = dataclasses.replace(
+                config,
+                positive_head=min(config.positive_head, corpus.vocab_size),
+            )
+
         self.config = config
         self.corpus = corpus
         self.sharding = sharding
@@ -165,6 +222,12 @@ class SGNSTrainer:
         if sharding is not None:
             self.noise = jax.device_put(self.sampler.table, sharding.replicated())
             self.pairs = corpus.device_pairs(sharding.corpus_sharding())
+        elif config.positive_head > 0:
+            self.noise = self.sampler.table
+            pools, self.pos_quotas = segment_corpus_by_head(
+                corpus.pairs, config.positive_head, config.batch_pairs
+            )
+            self.pairs = tuple(jnp.asarray(p) for p in pools)
         else:
             self.noise = self.sampler.table
             self.pairs = corpus.device_pairs()
@@ -186,7 +249,7 @@ class SGNSTrainer:
 
         self._epoch_fn = make_train_epoch(
             corpus.num_pairs, self.num_batches, self.config, sharding,
-            stratified=self.stratified,
+            stratified=self.stratified, pos_quotas=self.pos_quotas,
         )
         self.timer = StepTimer()
 

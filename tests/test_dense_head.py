@@ -1,0 +1,232 @@
+"""Dense-head positive path (round 4): head-token emb/ctx rows move via
+one-hot MXU matmuls over the contiguous table[:H] slab; tail rows keep the
+per-row gather/scatter.  The split must be an exact re-grouping of the same
+per-example updates — pinned here against the plain-scatter stratified step
+on identical batches — and the segmented corpus machinery must preserve the
+corpus (same multiset of pairs per class, quotas summing to the batch).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.negative_sampling import NoiseTable, build_stratified_spec
+from gene2vec_tpu.data.pipeline import (
+    PairCorpus,
+    segment_corpus_by_head,
+    segmented_epoch_shuffle,
+)
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns import step as step_mod
+from gene2vec_tpu.sgns.model import init_params
+from gene2vec_tpu.sgns.step import sgns_step
+from gene2vec_tpu.sgns.train import SGNSTrainer, train_epochs
+
+
+def _zipf_corpus(v, n, seed=0):
+    rng = np.random.RandomState(seed)
+    p = 1.0 / np.arange(1, v + 1)
+    p /= p.sum()
+    pairs = rng.choice(v, size=(n, 2), p=p).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=v).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(v)], counts), pairs)
+
+
+def _segmented_batch(v, b, head, seed=0):
+    """One [HH|HT|TT]-ordered batch + its (q1, q2) quotas."""
+    corpus = _zipf_corpus(v, b, seed)
+    pools, quotas = segment_corpus_by_head(corpus.pairs, head, b)
+    batch = np.concatenate([p[:q] for p, q in zip(pools, quotas)], axis=0)
+    return corpus, jnp.asarray(batch), quotas
+
+
+@pytest.mark.parametrize("head", [8, 64])
+def test_dense_head_step_matches_scatter(head, monkeypatch):
+    """positive_head>0 on a segmented batch must equal the plain path on
+    the same batch (HIGHEST matmul precision isolates the re-grouping
+    from bf16 input truncation)."""
+    monkeypatch.setattr(
+        step_mod, "_DENSE_HEAD_PRECISION", jax.lax.Precision.HIGHEST
+    )
+    v, d, b = 257, 16, 128
+    corpus, batch, quotas = _segmented_batch(v, b, head)
+    spec = build_stratified_spec(corpus.vocab.counts, 32, 64, 0.75)
+    noise = NoiseTable(
+        prob=jnp.ones((v,)) / v,
+        alias=jnp.arange(v, dtype=jnp.int32),
+    )
+    params = init_params(jax.random.PRNGKey(0), v, d, jnp.float32)
+    key = jax.random.PRNGKey(7)
+    lr = jnp.asarray(0.05, jnp.float32)
+
+    kw = dict(
+        negatives=5, combiner="capped", negative_mode="stratified",
+        strat_group=32, stratified=spec,
+    )
+    p_ref, loss_ref = sgns_step(params, batch, noise, key, lr, **kw)
+    p_dense, loss_dense = sgns_step(
+        params, batch, noise, key, lr,
+        positive_head=head, pos_quotas=quotas[:2], **kw,
+    )
+    np.testing.assert_allclose(
+        float(loss_dense), float(loss_ref), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_dense.emb), np.asarray(p_ref.emb), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_dense.ctx), np.asarray(p_ref.ctx), atol=2e-6
+    )
+
+
+def test_dense_head_default_precision_close():
+    """Under the default (bf16-input) matmul policy the dense path is the
+    same update within bf16 rounding — no precision override."""
+    v, d, b, head = 257, 16, 128, 64
+    corpus, batch, quotas = _segmented_batch(v, b, head)
+    spec = build_stratified_spec(corpus.vocab.counts, 32, 64, 0.75)
+    noise = NoiseTable(
+        prob=jnp.ones((v,)) / v, alias=jnp.arange(v, dtype=jnp.int32)
+    )
+    params = init_params(jax.random.PRNGKey(0), v, d, jnp.float32)
+    key = jax.random.PRNGKey(7)
+    kw = dict(
+        negatives=5, combiner="capped", negative_mode="stratified",
+        strat_group=32, stratified=spec,
+    )
+    p_ref, loss_ref = sgns_step(
+        params, batch, noise, key, jnp.float32(0.05), **kw
+    )
+    p_dense, loss_dense = sgns_step(
+        params, batch, noise, key, jnp.float32(0.05),
+        positive_head=head, pos_quotas=quotas[:2], **kw,
+    )
+    assert abs(float(loss_dense) - float(loss_ref)) < 2e-2
+    np.testing.assert_allclose(
+        np.asarray(p_dense.ctx), np.asarray(p_ref.ctx), atol=2e-3
+    )
+
+
+def test_segment_corpus_by_head_partitions_exactly():
+    v, n, b, head = 500, 4096 + 37, 512, 32
+    corpus = _zipf_corpus(v, n)
+    pools, quotas = segment_corpus_by_head(corpus.pairs, head, b)
+    assert sum(quotas) == b
+    nb = n // b
+    hh, ht, tt = pools
+    assert np.all((hh < head).all(axis=1))
+    assert np.all((tt >= head).all(axis=1))
+    assert np.all(ht[:, 0] < head) and np.all(ht[:, 1] >= head)
+    for pool, q in zip(pools, quotas):
+        assert len(pool) >= q * nb
+    # the pools together are the corpus (up to HT direction canonicalization
+    # and the deterministic < nb wrap-padding rows)
+    canon = np.sort(corpus.pairs, axis=1)
+    got = np.concatenate(
+        [np.sort(p, axis=1) for p in pools], axis=0
+    )
+    base = {tuple(r) for r in canon.tolist()}
+    assert base == {tuple(r) for r in got.tolist()}
+    assert len(got) - len(canon) < nb * 3
+
+
+def test_segmented_epoch_shuffle_preserves_classes():
+    v, n, b, head = 300, 2048, 256, 16
+    corpus = _zipf_corpus(v, n)
+    pools, quotas = segment_corpus_by_head(corpus.pairs, head, b)
+    nb = n // b
+    out = segmented_epoch_shuffle(
+        tuple(jnp.asarray(p) for p in pools),
+        jax.random.PRNGKey(3), quotas, nb, "offset",
+    )
+    for arr, q, pool in zip(out, quotas, pools):
+        arr = np.asarray(arr)
+        assert arr.shape == (q * nb, 2)
+        pool_set = {tuple(r) for r in pool.tolist()}
+        assert {tuple(r) for r in arr.tolist()} <= pool_set
+
+
+def test_segment_tiny_pool_tiles_to_quota():
+    """A class pool far smaller than its forced quota must wrap-pad by
+    tiling (one concatenation pass is not enough when the pool has fewer
+    than half the needed rows)."""
+    rng = np.random.RandomState(0)
+    head, b = 4, 8
+    # 4000 pairs -> 500 batches; make TT almost empty but non-zero
+    hh = rng.randint(0, head, size=(3000, 2))
+    ht = np.stack(
+        [rng.randint(0, head, 2995), rng.randint(head, 50, 2995)], axis=1
+    )
+    tt = rng.randint(head, 50, size=(5, 2))
+    pairs = np.concatenate([hh, ht, tt]).astype(np.int32)
+    rng.shuffle(pairs)
+    pools, quotas = segment_corpus_by_head(pairs, head, b)
+    nb = len(pairs) // b
+    assert sum(quotas) == b
+    for pool, q in zip(pools, quotas):
+        assert len(pool) >= q * nb
+        # non-empty classes must never round to quota 0 (a permanent
+        # training-set drop); the 5-row TT pool gets q=1 and is tiled
+        assert q >= 1
+    out = segmented_epoch_shuffle(
+        tuple(jnp.asarray(p) for p in pools),
+        jax.random.PRNGKey(0), quotas, nb, "full",
+    )
+    for arr, q in zip(out, quotas):
+        assert np.asarray(arr).shape[0] >= q * nb
+
+
+def test_all_head_vocab_trains():
+    """positive_head >= vocab_size: every pair is HH, HT/TT quotas are 0,
+    'full' shuffle mode must not divide by zero."""
+    corpus = _zipf_corpus(40, 2048)
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=256, positive_head=4096, strat_head=8,
+        strat_block=8, shuffle_mode="full",
+    )
+    tr = SGNSTrainer(corpus, cfg)
+    assert tr.config.positive_head == 40
+    params, loss = tr.train_epoch(tr.init(), jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+def test_trainer_dense_head_learns_planted_clusters():
+    """Integrated trainer with positive_head: loss decreases and the
+    planted two-cluster structure is recovered (same check the plain
+    path's quality tests use)."""
+    rng = np.random.RandomState(0)
+    v, n = 64, 8192
+    half = v // 2
+    pairs = np.concatenate(
+        [
+            rng.randint(0, half, size=(n // 2, 2)),
+            rng.randint(half, v, size=(n // 2, 2)),
+        ]
+    ).astype(np.int32)
+    rng.shuffle(pairs)
+    counts = np.bincount(pairs.reshape(-1), minlength=v).astype(np.int64)
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(v)], counts), pairs)
+    cfg = SGNSConfig(
+        dim=16, batch_pairs=512, positive_head=16, strat_head=8,
+        strat_block=16, strat_group=32, lr=0.05,
+    )
+    emb, losses = train_epochs(corpus, cfg, epochs=8)
+    assert losses[-1] < losses[0] - 0.5
+    unit = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    intra = np.mean(unit[:half] @ unit[:half].T)
+    inter = np.mean(unit[:half] @ unit[half:].T)
+    assert intra > inter + 0.3
+
+
+def test_trainer_falls_back_without_stratified():
+    corpus = _zipf_corpus(100, 2048)
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=256, positive_head=16, negative_mode="shared"
+    )
+    tr = SGNSTrainer(corpus, cfg)
+    assert tr.pos_quotas is None and tr.config.positive_head == 0
+    params, loss = tr.train_epoch(tr.init(), jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
