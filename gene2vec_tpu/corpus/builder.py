@@ -110,18 +110,21 @@ def gene_annotated_data(
     return normed.loc[:, vc.index[vc == 1]]
 
 
-def abs_correlation(matrix: np.ndarray, backend: str = "numpy") -> np.ndarray:
-    """|Pearson correlation| between columns, as a standardized matmul.
-
-    Zero-variance columns get 0 everywhere (they can never pass a positive
-    threshold — matching pandas' NaN-never-compares behavior).
-    """
+def _standardized_columns(matrix: np.ndarray):
+    """(z, n): columns centered and scaled to unit sample-variance; zero-
+    variance columns become all-zero (they can never pass a positive
+    threshold — matching pandas' NaN-never-compares behavior)."""
     x = np.asarray(matrix, dtype=np.float64)
     n = x.shape[0]
     mean = x.mean(axis=0)
     std = x.std(axis=0, ddof=1)
     ok = std > 0
-    z = np.where(ok, (x - mean) / np.where(ok, std, 1.0), 0.0)
+    return np.where(ok, (x - mean) / np.where(ok, std, 1.0), 0.0), n
+
+
+def abs_correlation(matrix: np.ndarray, backend: str = "numpy") -> np.ndarray:
+    """|Pearson correlation| between columns, as a standardized matmul."""
+    z, n = _standardized_columns(matrix)
     if backend == "jax":
         import jax
         import jax.numpy as jnp
@@ -139,14 +142,45 @@ def abs_correlation(matrix: np.ndarray, backend: str = "numpy") -> np.ndarray:
     return np.clip(corr, 0.0, 1.0)
 
 
+def abs_correlation_mask(
+    matrix: np.ndarray, threshold: float, backend: str = "numpy"
+) -> np.ndarray:
+    """(genes, genes) bool mask of ``|corr| > threshold``.
+
+    The corpus builder only ever consumes the thresholded mask, so the
+    jax backend compares ON DEVICE and downloads packed bits — genes²/8
+    bytes, 32x less host-link traffic than the f32 matrix.  At GEO-study
+    shapes the matmul is trivial for the MXU and the device→host link is
+    the whole cost of the TPU path (measured: the full-matrix download
+    made backend="jax" *slower* than numpy end to end; see
+    docs/PERF_NOTES.md round 4, viz/corpus benchmarks).
+    """
+    if backend != "jax":
+        return abs_correlation(matrix, backend=backend) > threshold
+    import jax
+    import jax.numpy as jnp
+
+    z, n = _standardized_columns(matrix)
+    g = z.shape[1]
+    zj = jnp.asarray(z, dtype=jnp.float32)
+    prod = jnp.matmul(zj.T, zj, precision=jax.lax.Precision.HIGHEST)
+    # same clip as abs_correlation so the backends agree even at
+    # threshold >= 1.0 (fp error can push |corr| past 1)
+    corr = jnp.clip(jnp.abs(prod) / (n - 1), 0.0, 1.0)
+    bits = np.asarray(jnp.packbits((corr > threshold).reshape(-1)))
+    return np.unpackbits(bits, count=g * g).astype(bool).reshape(g, g)
+
+
 def coexpression_pairs(
     normed, *, corr_threshold: float = 0.9, backend: str = "numpy"
 ) -> List[str]:
     """'g1 g2' lines for every |corr| > threshold column pair — both
     directions, no self-pairs."""
     genes = list(normed.columns)
-    corr = abs_correlation(normed.values, backend=backend)
-    rows, cols = (corr > corr_threshold).nonzero()
+    mask = abs_correlation_mask(
+        normed.values, corr_threshold, backend=backend
+    )
+    rows, cols = mask.nonzero()
     return [f"{genes[r]} {genes[c]}" for r, c in zip(rows, cols) if r != c]
 
 

@@ -144,16 +144,30 @@ def hs_step(
     )
     d = d_input.shape[-1]
 
+    if shallow_sign is not None:
+        # ---- dense shallow levels over the contiguous node prefix -------
+        w_s = params.ctx[:n_shallow].astype(compute_dtype) # contiguous slab
+        s = shallow_sign[targets].astype(compute_dtype)    # (E, Ns) ±1/0
+        abs_s = jnp.abs(s)
+        logit_s = v_in @ w_s.T                             # (E, Ns) MXU
+        # word2vec HS per node: loss = softplus(−sign·logit), dL/dlogit =
+        # σ(logit) − (1 − code) with (1 − code) = (1 + sign)/2
+        loss_s = jnp.sum(abs_s * jax.nn.softplus(-s * logit_s), axis=-1)
+        g_s = abs_s * (jax.nn.sigmoid(logit_s) - (1.0 + s) / 2.0)  # (E, Ns)
+        loss = loss + jnp.mean(loss_s)
+        d_input = d_input + g_s @ w_s                      # (E, D) MXU
+
+    emb = _apply_row_updates(
+        params.emb,
+        inputs,
+        d_input,
+        jnp.ones_like(inputs, compute_dtype),
+        lr,
+        combiner,
+        compute_dtype,
+    )
+
     if shallow_sign is None:
-        emb = _apply_row_updates(
-            params.emb,
-            inputs,
-            d_input,
-            jnp.ones_like(inputs, compute_dtype),
-            lr,
-            combiner,
-            compute_dtype,
-        )
         # Same fused (rows, D+1) accumulator scatter + dense divisor/axpy
         # as the SGNS step (step.py:_apply_row_updates).  Padded path
         # entries carry weight 0 (mask), so they combine into row 0 with
@@ -168,29 +182,6 @@ def hs_step(
             compute_dtype,
         )
         return SGNSParams(emb=emb, ctx=node), loss
-
-    # ---- dense shallow levels over the contiguous node prefix -----------
-    w_s = params.ctx[:n_shallow].astype(compute_dtype)     # contiguous slab
-    s = shallow_sign[targets].astype(compute_dtype)        # (E, Ns) ±1/0
-    abs_s = jnp.abs(s)
-    logit_s = v_in @ w_s.T                                 # (E, Ns) MXU
-    # word2vec HS per node: loss = softplus(−sign·logit), dL/dlogit =
-    # σ(logit) − (1 − code) with (1 − code) = (1 + sign)/2
-    loss_s = jnp.sum(abs_s * jax.nn.softplus(-s * logit_s), axis=-1)
-    g_s = abs_s * (jax.nn.sigmoid(logit_s) - (1.0 + s) / 2.0)  # (E, Ns)
-
-    loss = loss + jnp.mean(loss_s)
-    d_input = d_input + g_s @ w_s                          # (E, D) MXU
-
-    emb = _apply_row_updates(
-        params.emb,
-        inputs,
-        d_input,
-        jnp.ones_like(inputs, compute_dtype),
-        lr,
-        combiner,
-        compute_dtype,
-    )
 
     # node table: deep rows via the fused scatter, shallow rows via dense
     # adds into the same (rows, D+1) accumulator — one divisor per node

@@ -42,6 +42,26 @@ def test_abs_correlation_jax_backend():
     )
 
 
+def test_abs_correlation_mask_jax_packbits_roundtrip():
+    """The device-side threshold + packbits path coexpression_pairs routes
+    through must agree bit-for-bit with the host mask — including at a
+    non-multiple-of-8 gene count (unpackbits count/reshape) and a planted
+    above-threshold pair."""
+    from gene2vec_tpu.corpus.builder import abs_correlation_mask
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(40, 13)
+    x[:, 7] = x[:, 2] + 0.01 * rng.randn(40)        # corr ~ 0.999
+    for thr in (0.9, 1.0):
+        m_np = abs_correlation_mask(x, thr, backend="numpy")
+        m_jax = abs_correlation_mask(x, thr, backend="jax")
+        assert m_np.shape == m_jax.shape == (13, 13)
+        # at 1.0 both backends must agree (clip parity); at 0.9 the
+        # planted pair must be present
+        np.testing.assert_array_equal(m_np, m_jax)
+    assert abs_correlation_mask(x, 0.9, backend="numpy")[2, 7]
+
+
 def _toy_query(tmp_path, n_samples=25, seed=0):
     """Synthetic query dir: 2 studies, gene_id 'ENSG|SYM' with one dup
     symbol, one low-count gene, one planted correlated gene pair."""
