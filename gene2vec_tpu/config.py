@@ -96,6 +96,15 @@ class SGNSConfig:
                                    # band; oracle 0.878) — sweep in
                                    # experiments/results/positive_head_r4*,
                                    # PERF_NOTES round 4.
+    pos_layout_shards: int = 0     # dense-head batch layout: number of
+                                   # per-device [HH|HT|TT] blocks per
+                                   # batch.  0 = auto (the mesh's data-
+                                   # axis size under sharding, else 1).
+                                   # An explicit value reproduces a mesh
+                                   # layout on one device — used by the
+                                   # sharded-vs-unsharded parity tests,
+                                   # since the block layout changes the
+                                   # example order (not the example set).
     hs_dense_depth: int = 10       # hierarchical softmax: tree levels
                                    # scored densely against the contiguous
                                    # shallow-node prefix (huffman.py
@@ -207,3 +216,9 @@ class TSNEConfig:
     momentum_final: float = 0.8
     momentum_switch_iter: int = 250
     seed: int = 0
+    compute_dtype: str = "float32" # (N, N) kernel arrays; "bfloat16"
+                                   # halves HBM traffic of the exact
+                                   # O(N²) iteration (~0.4% relative
+                                   # rounding on P/num — layouts agree
+                                   # with f32 to visualization accuracy;
+                                   # reductions always accumulate f32)

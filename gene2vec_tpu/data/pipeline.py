@@ -154,7 +154,7 @@ def epoch_shuffle(
 
 
 def segment_corpus_by_head(
-    pairs: np.ndarray, head: int, batch_pairs: int
+    pairs: np.ndarray, head: int, batch_pairs: int, multiple: int = 1
 ) -> Tuple[Tuple[np.ndarray, np.ndarray, np.ndarray], Tuple[int, int, int]]:
     """Host-side class segmentation backing the dense-head positive path
     (``sgns/step.py`` round 4): split the corpus into three pools by
@@ -173,11 +173,20 @@ def segment_corpus_by_head(
     uses).  Each pool keeps ALL its rows (>= quota * num_batches): the
     per-epoch roll in :func:`segmented_epoch_shuffle` cycles which rows
     fall into the epoch's span, so no pair is dropped permanently.
+
+    ``multiple`` forces every quota to a multiple of it (the data-parallel
+    device count: each device block of a batch carries quota/multiple rows
+    of each class, so the per-device segment layout is uniform).
     """
     if batch_pairs <= 0 or pairs.shape[0] < batch_pairs:
         raise ValueError(
             f"cannot segment {pairs.shape[0]} pairs into "
             f"batches of {batch_pairs}"
+        )
+    if multiple < 1 or batch_pairs % multiple:
+        raise ValueError(
+            f"batch_pairs={batch_pairs} must be a positive multiple of "
+            f"multiple={multiple}"
         )
     num_batches = pairs.shape[0] // batch_pairs
     a_head = pairs[:, 0] < head
@@ -189,29 +198,31 @@ def segment_corpus_by_head(
     ht[swap] = ht[swap][:, ::-1]
     pools = [hh, ht, tt]
 
-    # every non-empty class gets quota >= 1: a pool smaller than one row
-    # per batch would otherwise round to 0 and its pairs would NEVER train
-    # (the roll cycles within a pool, not across pools)
-    floors = [1 if len(p) else 0 for p in pools]
+    # every non-empty class gets quota >= multiple: a pool smaller than
+    # one row per batch(-block) would otherwise round to 0 and its pairs
+    # would NEVER train (the roll cycles within a pool, not across pools)
+    m = multiple
+    floors = [m if len(p) else 0 for p in pools]
     if sum(floors) > batch_pairs:
         raise ValueError(
-            f"batch_pairs={batch_pairs} is smaller than the number of "
+            f"batch_pairs={batch_pairs} is smaller than m x the number of "
             f"non-empty head classes ({sum(floors)})"
         )
     quotas = [
-        max(len(p) // num_batches, f) for p, f in zip(pools, floors)
+        max(len(p) // num_batches // m * m, f)
+        for p, f in zip(pools, floors)
     ]
     while sum(quotas) > batch_pairs:
         # decrement the largest quota that stays above its floor
         c = int(
             np.argmax([q if q > f else -1 for q, f in zip(quotas, floors)])
         )
-        quotas[c] -= 1
+        quotas[c] -= m
     while sum(quotas) < batch_pairs:
         leftover = [
             len(p) - q * num_batches for p, q in zip(pools, quotas)
         ]
-        quotas[int(np.argmax(leftover))] += 1
+        quotas[int(np.argmax(leftover))] += m
     for c, (pool, q) in enumerate(zip(pools, quotas)):
         need = q * num_batches
         if 0 < len(pool) < need:
@@ -219,7 +230,15 @@ def segment_corpus_by_head(
             # per batch repeats; mild oversampling of a tiny class beats
             # dropping it)
             reps = -(-need // len(pool))
-            pools[c] = np.concatenate([pool] * reps, axis=0)[:need]
+            pool = np.concatenate([pool] * reps, axis=0)[:need]
+        rem = len(pool) % m
+        if rem:
+            # row counts also wrap-pad to the multiple HERE — not at
+            # device_put — so a pos_layout_shards-pinned single-device
+            # reference shuffles the exact same pool (same num_pairs,
+            # same roll range) as the sharded run it is compared against
+            pool = np.concatenate([pool, pool[: m - rem]], axis=0)
+        pools[c] = pool
     return tuple(pools), tuple(quotas)
 
 

@@ -65,14 +65,24 @@ from gene2vec_tpu.sgns.model import SGNSParams
 
 
 def _examples_from_pairs(
-    pairs: jax.Array, both_directions: bool = True
+    pairs: jax.Array, both_directions: bool = True, shards: int = 1
 ) -> Tuple[jax.Array, jax.Array]:
-    """(B, 2) pairs → (E,) centers, (E,) contexts with E = 2B (or B)."""
+    """(B, 2) pairs → (E,) centers, (E,) contexts with E = 2B (or B).
+
+    ``shards > 1`` (dense-head positives under data parallelism) emits the
+    two directions per DEVICE block instead of globally — pairs are viewed
+    as (shards, B/shards, 2) and each block's examples are [its forward
+    directions | its reverse directions], so a device's examples stay on
+    its shard and the per-block [HH|HT|TT] segment layout survives into
+    the example axis.  shards=1 reduces to the global concat.
+    """
     if both_directions:
-        centers = jnp.concatenate([pairs[:, 0], pairs[:, 1]])
-        contexts = jnp.concatenate([pairs[:, 1], pairs[:, 0]])
-    else:
-        centers, contexts = pairs[:, 0], pairs[:, 1]
+        b = pairs.shape[0]
+        p3 = pairs.reshape(shards, b // shards, 2)
+        centers = jnp.concatenate([p3[:, :, 0], p3[:, :, 1]], axis=1)
+        contexts = jnp.concatenate([p3[:, :, 1], p3[:, :, 0]], axis=1)
+        return centers.reshape(-1), contexts.reshape(-1)
+    centers, contexts = pairs[:, 0], pairs[:, 1]
     return centers, contexts
 
 
@@ -362,6 +372,11 @@ def _dense_head_segments(q1: int, q2: int, b: int):
     pairs (head token first), q3 = b - q1 - q2 TT pairs, emitted in both
     directions so example i and i + b are the two directions of pair i.
 
+    Segments index the LOCAL example axis of the (shards, 2b) view — under
+    data parallelism each device block carries its own [HH|HT|TT] layout
+    with per-device quotas, so every slice below stays device-local and
+    the head matmuls reduce over the shard axis (XLA's psum over ICI).
+
     Returns (center_head, center_tail, context_head, context_tail), each a
     tuple of segments in ascending position order.
     """
@@ -374,29 +389,29 @@ def _dense_head_segments(q1: int, q2: int, b: int):
 
 
 def _segment_split(x: jax.Array, head_segs, tail_segs):
-    """Split rows of ``x`` (example-major) into head/tail parts, returning
-    (x_head, x_tail) with each part's segments concatenated in order."""
-    xh = jnp.concatenate([x[s : s + l] for s, l in head_segs], axis=0)
-    xt = jnp.concatenate([x[s : s + l] for s, l in tail_segs], axis=0)
+    """Split the local example axis (axis 1) of ``x`` (shards, local, ...)
+    into head/tail parts, each part's segments concatenated in order."""
+    xh = jnp.concatenate([x[:, s : s + l] for s, l in head_segs], axis=1)
+    xt = jnp.concatenate([x[:, s : s + l] for s, l in tail_segs], axis=1)
     return xh, xt
 
 
 def _segment_join(head_part, tail_part, head_segs, tail_segs):
-    """Inverse of :func:`_segment_split`: reassemble rows in original
-    example order.  Segments alternate head/tail by construction."""
+    """Inverse of :func:`_segment_split`: reassemble local example order.
+    Segments alternate head/tail by construction."""
     pieces = []
     oh = ot = 0
     for (hs, hl), (ts, tl) in zip(head_segs, tail_segs):
-        pieces.append(head_part[oh : oh + hl])
-        pieces.append(tail_part[ot : ot + tl])
+        pieces.append(head_part[:, oh : oh + hl])
+        pieces.append(tail_part[:, ot : ot + tl])
         oh += hl
         ot += tl
-    return jnp.concatenate(pieces, axis=0)
+    return jnp.concatenate(pieces, axis=1)
 
 
 def _dense_head_gather(
     table: jax.Array,   # (V, D)
-    idx: jax.Array,     # (E,) — head segments guaranteed < head
+    idx: jax.Array,     # (S, L) — head segments guaranteed < head
     head: int,
     head_segs,
     tail_segs,
@@ -405,21 +420,22 @@ def _dense_head_gather(
     """Gather ``table[idx]`` with head-segment rows produced by a one-hot
     MXU matmul against the contiguous ``table[:head]`` slab — zero dynamic
     row ops for head examples (the positive-side analogue of the stratified
-    noise head; docs/PERF_NOTES.md round 4).  Returns (rows (E, D),
-    onehot (Eh, head), idx_tail (Et,)) — the one-hot is reused by
-    :func:`_dense_head_scatter` for the update direction.
+    noise head; docs/PERF_NOTES.md round 4).  Returns (rows (S, L, D),
+    onehot (S, Lh, head), idx_tail (S, Lt)) — the one-hot is reused by
+    :func:`_dense_head_scatter_acc` for the update direction.
     """
     idx_h, idx_t = _segment_split(idx, head_segs, tail_segs)
-    onehot = (idx_h[:, None] == jnp.arange(head)[None, :]).astype(
+    onehot = (idx_h[:, :, None] == jnp.arange(head)[None, None, :]).astype(
         compute_dtype
     )
-    rows_h = jax.lax.dot(
+    rows_h = jax.lax.dot_general(
         onehot,
         table[:head].astype(compute_dtype),
+        (((2,), (0,)), ((), ())),
         precision=_DENSE_HEAD_PRECISION,
         preferred_element_type=compute_dtype,
-    )
-    rows_t = table[idx_t].astype(compute_dtype)
+    )                                                   # (S, Lh, D)
+    rows_t = table[idx_t].astype(compute_dtype)         # (S, Lt, D)
     return (
         _segment_join(rows_h, rows_t, head_segs, tail_segs),
         onehot,
@@ -429,33 +445,37 @@ def _dense_head_gather(
 
 def _dense_head_scatter_acc(
     v_size: int,
-    grads: jax.Array,     # (E, D) per-example gradients
-    weights: jax.Array,   # (E,) example-unit weights
-    onehot: jax.Array,    # (Eh, head) from _dense_head_gather
-    idx_tail: jax.Array,  # (Et,)
+    grads: jax.Array,     # (S, L, D) per-example gradients
+    weights: jax.Array,   # (S, L) example-unit weights
+    onehot: jax.Array,    # (S, Lh, head) from _dense_head_gather
+    idx_tail: jax.Array,  # (S, Lt)
     head_segs,
     tail_segs,
     acc_dtype,
 ) -> jax.Array:
     """(V, D+1) accumulator for the dense-head path: tail rows scatter as
-    usual; head rows land as ONE (head, Eh) x (Eh, D+1) MXU matmul added
-    densely to the accumulator's head slab (exact f32 accumulation of
-    bf16-truncated payload rows under the default policy)."""
+    usual; head rows land as ONE (head, S·Lh) x (S·Lh, D+1) MXU
+    contraction added densely to the accumulator's head slab (exact f32
+    accumulation of bf16-truncated payload rows under the default
+    policy).  Both the tail scatter and the shard-axis contraction reduce
+    over ``S`` — under data parallelism XLA emits that reduction as the
+    gradient psum."""
     d = grads.shape[-1]
     payload = jnp.concatenate(
-        [grads, weights.astype(grads.dtype)[:, None]], axis=1
+        [grads, weights.astype(grads.dtype)[:, :, None]], axis=2
     )
     pay_h, pay_t = _segment_split(payload, head_segs, tail_segs)
-    acc = jnp.zeros((v_size, d + 1), acc_dtype).at[idx_tail].add(
-        pay_t.astype(acc_dtype)
-    )
-    head_rows = jax.lax.dot(
-        onehot.T,
+    acc = jnp.zeros((v_size, d + 1), acc_dtype).at[
+        idx_tail.reshape(-1)
+    ].add(pay_t.reshape(-1, d + 1).astype(acc_dtype))
+    head_rows = jax.lax.dot_general(
+        onehot,
         pay_h,
+        (((0, 1), (0, 1)), ((), ())),                   # contract S, Lh
         precision=_DENSE_HEAD_PRECISION,
         preferred_element_type=acc_dtype,
-    )
-    return acc.at[: onehot.shape[1]].add(head_rows.astype(acc_dtype))
+    )                                                   # (head, D+1)
+    return acc.at[: onehot.shape[2]].add(head_rows.astype(acc_dtype))
 
 
 def _aggregate_tail_blocks(
@@ -503,6 +523,7 @@ def _step_stratified(
     combiner: str,
     pos_head: int = 0,
     pos_quotas=None,  # (q1, q2) static HH/HT pair counts of the batch layout
+    pos_shards: int = 1,  # data-parallel device blocks in the batch layout
 ) -> Tuple[SGNSParams, jax.Array]:
     """Stratified negatives: exact head + per-group random tail blocks.
 
@@ -570,15 +591,20 @@ def _step_stratified(
     dense_pos = pos_head > 0 and pos_quotas is not None
     if dense_pos:
         q1, q2 = pos_quotas
+        s = pos_shards
         c_head, c_tail, x_head, x_tail = _dense_head_segments(
-            q1, q2, e // 2
+            q1 // s, q2 // s, e // (2 * s)
         )
-        v, oh_c, idx_ct = _dense_head_gather(
-            emb_t, centers, pos_head, c_head, c_tail, compute_dtype
+        centers2 = centers.reshape(s, e // s)
+        contexts2 = contexts.reshape(s, e // s)
+        v2, oh_c, idx_ct = _dense_head_gather(
+            emb_t, centers2, pos_head, c_head, c_tail, compute_dtype
         )
-        u_pos, oh_x, idx_xt = _dense_head_gather(
-            ctx_t, contexts, pos_head, x_head, x_tail, compute_dtype
+        u2, oh_x, idx_xt = _dense_head_gather(
+            ctx_t, contexts2, pos_head, x_head, x_tail, compute_dtype
         )
+        v = v2.reshape(e, d)
+        u_pos = u2.reshape(e, d)
     else:
         v = emb_t[centers].astype(compute_dtype)      # (E, D)
         u_pos = ctx_t[contexts].astype(compute_dtype) # (E, D)
@@ -635,7 +661,8 @@ def _step_stratified(
     acc_dtype = _acc_dtype_for(compute_dtype)
     if dense_pos:
         acc_emb = _dense_head_scatter_acc(
-            v_size, d_center, jnp.ones((e,), compute_dtype),
+            v_size, d_center.reshape(s, e // s, d),
+            jnp.ones((s, e // s), compute_dtype),
             oh_c, idx_ct, c_head, c_tail, acc_dtype,
         )
         emb = _finalize_row_updates(emb_t, acc_emb, lr, combiner)
@@ -650,7 +677,8 @@ def _step_stratified(
     d_pos = g_pos[:, None] * v
     if dense_pos:
         acc = _dense_head_scatter_acc(
-            v_size, d_pos, jnp.ones((e,), compute_dtype),
+            v_size, d_pos.reshape(s, e // s, d),
+            jnp.ones((s, e // s), compute_dtype),
             oh_x, idx_xt, x_head, x_tail, acc_dtype,
         )
     else:
@@ -709,10 +737,11 @@ def sgns_step(
     stratified=None,  # StratifiedSpec, required for negative_mode="stratified"
     positive_head: int = 0,
     pos_quotas=None,  # static (q1, q2): HH/HT pair counts of the batch layout
+    pos_shards: int = 1,  # per-device [HH|HT|TT] blocks (data parallelism)
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
-    centers, contexts = _examples_from_pairs(pairs, both_directions)
-    if positive_head > 0 and pos_quotas is not None:
+    dense_pos = positive_head > 0 and pos_quotas is not None
+    if dense_pos:
         if negative_mode != "stratified":
             raise ValueError(
                 "positive_head (dense-head positives) is implemented for "
@@ -723,6 +752,16 @@ def sgns_step(
                 "positive_head requires both_directions=True (the [HH|HT|TT]"
                 " batch layout emits both directions of each pair)"
             )
+        b = int(pairs.shape[0])
+        q1, q2 = pos_quotas
+        if any(q % pos_shards for q in (q1, q2, b)):
+            raise ValueError(
+                f"pos_quotas {pos_quotas} / batch {b} must be divisible by "
+                f"pos_shards={pos_shards} (per-device segment layout)"
+            )
+    centers, contexts = _examples_from_pairs(
+        pairs, both_directions, shards=pos_shards if dense_pos else 1
+    )
     if negative_mode == "stratified":
         if stratified is None:
             raise ValueError(
@@ -743,6 +782,7 @@ def sgns_step(
             params, centers, contexts, stratified, key, negatives,
             group_size, lr, compute_dtype, combiner,
             pos_head=positive_head, pos_quotas=pos_quotas,
+            pos_shards=pos_shards,
         )
     if negative_mode == "shared":
         e = int(centers.shape[0])

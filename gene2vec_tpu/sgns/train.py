@@ -50,6 +50,7 @@ def make_train_epoch(
     sharding: Optional["SGNSSharding"] = None,
     stratified=None,
     pos_quotas: Optional[Tuple[int, int, int]] = None,
+    pos_shards: int = 1,
 ) -> Callable:
     """Build the jitted epoch function.
 
@@ -59,7 +60,8 @@ def make_train_epoch(
     arrays are per-trainer constants derived from the vocab counts.
     With ``pos_quotas`` (dense-head positives), ``pairs`` is the
     3-tuple of class pools from ``segment_corpus_by_head`` and each
-    batch is assembled [HH|HT|TT] at static quota offsets.
+    batch is assembled as ``pos_shards`` device blocks, each
+    [HH|HT|TT] at static per-block quota offsets.
     """
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
@@ -82,14 +84,19 @@ def make_train_epoch(
 
         def body(params, step):
             if pos_quotas is not None:
+                # [dev0: hh|ht|tt][dev1: hh|ht|tt]… — each data-parallel
+                # block carries the same per-class quota slice, so the
+                # step's per-block segment offsets hold on every device
                 batch = jnp.concatenate(
                     [
-                        jax.lax.dynamic_slice_in_dim(pool, step * q, q)
+                        jax.lax.dynamic_slice_in_dim(
+                            pool, step * q, q
+                        ).reshape(pos_shards, q // pos_shards, 2)
                         for pool, q in zip(pools, pos_quotas)
                         if q
                     ],
-                    axis=0,
-                )
+                    axis=1,
+                ).reshape(batch_pairs, 2)
             else:
                 batch = jax.lax.dynamic_slice_in_dim(
                     shuffled, step * batch_pairs, batch_pairs
@@ -118,6 +125,7 @@ def make_train_epoch(
                 pos_quotas=(
                     pos_quotas[:2] if pos_quotas is not None else None
                 ),
+                pos_shards=pos_shards,
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)
@@ -187,13 +195,15 @@ class SGNSTrainer:
             # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
             # decorrelation then needs no per-row device gathers
             corpus = host_preshuffle(corpus, config.seed)
-        # dense-head positives need the class-segmented batch layout, which
-        # is single-device stratified both-directions only (the segment
-        # offsets don't align with a sharded batch axis) — fall back to
-        # plain gathers otherwise
+        # dense-head positives need the class-segmented batch layout:
+        # stratified + both-directions, with replicated tables (under a
+        # mesh, each data-parallel device block carries its own [HH|HT|TT]
+        # segment layout; vocab-sharded tables would split the head slab
+        # across the model axis) — fall back to plain gathers otherwise
         self.pos_quotas = None
+        self.pos_shards = 1
         if config.positive_head > 0 and (
-            sharding is not None
+            (sharding is not None and sharding.vocab_sharded)
             or config.negative_mode != "stratified"
             or not config.both_directions
         ):
@@ -201,9 +211,10 @@ class SGNSTrainer:
                 import warnings
 
                 warnings.warn(
-                    "positive_head (dense-head positives) is single-device "
-                    "only and was disabled for this sharded run — expect "
-                    "the plain-gather per-chip rate (PERF_NOTES round 4)",
+                    "positive_head (dense-head positives) does not support "
+                    "vocab-sharded tables and was disabled for this run — "
+                    "expect the plain-gather per-chip rate (PERF_NOTES "
+                    "round 4)",
                     stacklevel=2,
                 )
             config = dataclasses.replace(config, positive_head=0)
@@ -212,6 +223,32 @@ class SGNSTrainer:
                 config,
                 positive_head=min(config.positive_head, corpus.vocab_size),
             )
+            if sharding is not None:
+                self.pos_shards = int(
+                    sharding.mesh.shape[sharding.data_axis]
+                )
+            if config.pos_layout_shards > 0:
+                # explicit layout override (sharded-vs-unsharded parity
+                # tests reproduce a mesh layout on one device)
+                self.pos_shards = config.pos_layout_shards
+            if (
+                config.batch_pairs % self.pos_shards
+                or config.batch_pairs < 3 * self.pos_shards
+            ):
+                # a batch that can't be cut into uniform per-device
+                # [HH|HT|TT] blocks falls back gracefully, like the
+                # vocab-sharded case — never a constructor crash
+                import warnings
+
+                warnings.warn(
+                    f"positive_head disabled: batch_pairs="
+                    f"{config.batch_pairs} cannot form {self.pos_shards} "
+                    "uniform [HH|HT|TT] device blocks (needs a multiple "
+                    f"of {self.pos_shards}, at least {3 * self.pos_shards})",
+                    stacklevel=2,
+                )
+                config = dataclasses.replace(config, positive_head=0)
+                self.pos_shards = 1
 
         self.config = config
         self.corpus = corpus
@@ -219,18 +256,33 @@ class SGNSTrainer:
         self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
         self.num_batches = corpus.num_batches(config.batch_pairs)
 
+        if config.positive_head > 0:
+            pools, self.pos_quotas = segment_corpus_by_head(
+                corpus.pairs, config.positive_head, config.batch_pairs,
+                multiple=self.pos_shards,
+            )
+            if sharding is not None:
+                # pools live row-sharded over data like the plain corpus
+                # path (replicating the corpus would cost pairs-bytes per
+                # device at 100M+ pair scale); the per-step batch slice is
+                # re-sharded into per-device blocks by constrain_batch.
+                # Pool lengths are already multiples of pos_shards
+                # (segment_corpus_by_head pads them, so a layout-pinned
+                # single-device reference shuffles identical pools).
+                self.pairs = tuple(
+                    jax.device_put(p, sharding.corpus_sharding())
+                    for p in pools
+                )
+            else:
+                self.pairs = tuple(jnp.asarray(p) for p in pools)
+        elif sharding is not None:
+            self.pairs = corpus.device_pairs(sharding.corpus_sharding())
+        else:
+            self.pairs = corpus.device_pairs()
         if sharding is not None:
             self.noise = jax.device_put(self.sampler.table, sharding.replicated())
-            self.pairs = corpus.device_pairs(sharding.corpus_sharding())
-        elif config.positive_head > 0:
-            self.noise = self.sampler.table
-            pools, self.pos_quotas = segment_corpus_by_head(
-                corpus.pairs, config.positive_head, config.batch_pairs
-            )
-            self.pairs = tuple(jnp.asarray(p) for p in pools)
         else:
             self.noise = self.sampler.table
-            self.pairs = corpus.device_pairs()
 
         self.stratified = None
         if config.negative_mode == "stratified":
@@ -250,6 +302,7 @@ class SGNSTrainer:
         self._epoch_fn = make_train_epoch(
             corpus.num_pairs, self.num_batches, self.config, sharding,
             stratified=self.stratified, pos_quotas=self.pos_quotas,
+            pos_shards=self.pos_shards,
         )
         self.timer = StepTimer()
 

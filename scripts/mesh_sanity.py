@@ -47,7 +47,11 @@ V, D, N, B = 4096, 64, 262_144, 4096
 
 def run(n_devices: int) -> dict:
     corpus = synth_corpus(V, N)
-    cfg = SGNSConfig(dim=D, batch_pairs=B)
+    # pin the dense-head batch layout to 8 blocks at EVERY device count:
+    # the per-device [HH|HT|TT] block layout changes example order (not
+    # the example set), so loss parity across mesh sizes needs all rows
+    # on the same layout (config.pos_layout_shards docs)
+    cfg = SGNSConfig(dim=D, batch_pairs=B, pos_layout_shards=8)
     sharding = None
     if n_devices > 1:
         mesh = make_mesh(

@@ -42,22 +42,41 @@ def test_make_mesh_shapes():
 
 @pytest.mark.parametrize("vocab_sharded", [False, True])
 def test_sharded_matches_unsharded(vocab_sharded):
-    """Same seed, same corpus → sharded epoch ≈ single-device epoch."""
+    """Same seed, same corpus → sharded epoch ≈ single-device epoch.
+
+    Data-parallel runs use the dense-head positive path, whose per-device
+    block layout changes example ORDER (not the example set), so the
+    unsharded reference pins the same layout via pos_layout_shards.
+    Vocab-sharded runs fall back to plain gathers (the head slab would be
+    split over the model axis), so the reference disables positive_head.
+    """
     corpus = _corpus()
-    cfg = SGNSConfig(dim=16, num_iters=1, batch_pairs=64, seed=3)
+    mesh = make_mesh(MeshConfig(data=-1, model=2))
+    data = mesh.shape["data"]
+    if vocab_sharded:
+        cfg = SGNSConfig(
+            dim=16, num_iters=1, batch_pairs=64, seed=3, positive_head=0
+        )
+    else:
+        cfg = SGNSConfig(
+            dim=16, num_iters=1, batch_pairs=64, seed=3,
+            pos_layout_shards=data,
+        )
 
     ref_trainer = SGNSTrainer(corpus, cfg)
     ref_params = ref_trainer.init()
     key = jax.random.PRNGKey(11)
     ref_params, ref_loss = ref_trainer.train_epoch(ref_params, key)
+    if not vocab_sharded:
+        assert ref_trainer.pos_quotas is not None  # dense path exercised
 
-    mesh = make_mesh(MeshConfig(data=-1, model=2))
     sharding = SGNSSharding(mesh, vocab_sharded=vocab_sharded)
     tr = SGNSTrainer(corpus, cfg, sharding=sharding)
+    assert (tr.pos_quotas is None) == vocab_sharded
     params = tr.init()
     params, loss = tr.train_epoch(params, key)
 
-    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
     np.testing.assert_allclose(
         np.asarray(params.emb), np.asarray(ref_params.emb), atol=1e-5
     )
@@ -97,15 +116,25 @@ def test_dim512_vocab_sharded_trains():
 
 
 def test_data_sharded_corpus_upload():
-    """The corpus array itself is sharded over the data axis in HBM."""
+    """The corpus array itself is sharded over the data axis in HBM — for
+    the plain path (one array) and the dense-head path (class pools)."""
     corpus = _corpus(num_pairs=512)
     mesh = make_mesh(MeshConfig(data=-1, model=2))
     sharding = SGNSSharding(mesh)
     tr = SGNSTrainer(
+        corpus, SGNSConfig(dim=8, batch_pairs=64, positive_head=0),
+        sharding=sharding,
+    )
+    assert tr.pairs.sharding.spec[0] == "data"
+
+    tr = SGNSTrainer(
         corpus, SGNSConfig(dim=8, batch_pairs=64), sharding=sharding
     )
-    spec = tr.pairs.sharding.spec
-    assert spec[0] == "data"
+    assert tr.pos_quotas is not None
+    for pool, q in zip(tr.pairs, tr.pos_quotas):
+        if q:
+            assert pool.sharding.spec[0] == "data"
+            assert pool.shape[0] % tr.pos_shards == 0
 
 
 def test_mesh_with_odd_device_count():
