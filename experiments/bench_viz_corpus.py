@@ -36,11 +36,8 @@ REF_SWEEP = [100, 5000, 10000, 20000, 50000, 100000]
 
 
 def bench_tsne(n: int, dim: int, seg: int, cpu_iters: int) -> dict:
-    import jax
-
     from gene2vec_tpu.config import TSNEConfig
-    from gene2vec_tpu.viz.tsne import TSNE, pca_reduce, _calibrate_p, \
-        _squared_distances
+    from gene2vec_tpu.viz.tsne import TSNE, pca_reduce
 
     rng = np.random.RandomState(0)
     # clustered data so the BH tree in the CPU baseline sees realistic

@@ -299,3 +299,20 @@ def test_dash_descriptions_and_app_state(tmp_path):
     assert "Name: Apoptosis" in rdesc
     assert "Species: Homo sapiens" in rdesc
     assert "url: http://r/1" in rdesc
+
+
+def test_tsne_bfloat16_separates_blobs():
+    """The halved-traffic bf16 kernel path must reach the same qualitative
+    layout (cluster separation) as f32 — reductions accumulate f32, so
+    only the (N, N) kernel values carry bf16 rounding."""
+    x, labels = _blobs()
+    cfg = TSNEConfig(
+        pca_dims=10, n_iter=500, seed=0, compute_dtype="bfloat16"
+    )
+    y = TSNE(config=cfg).fit(x, log=lambda s: None)[500]
+    dists = np.linalg.norm(y[:, None] - y[None, :], axis=-1)
+    same = labels[:, None] == labels[None, :]
+    np.fill_diagonal(same, False)
+    intra = dists[same].mean()
+    inter = dists[~same & ~np.eye(len(y), dtype=bool)].mean()
+    assert inter > 2.0 * intra, (intra, inter)
