@@ -15,7 +15,7 @@
     reference's per-study ``data.corr()``
     (``/root/reference/src/generate_gene_pairs.py:49``).
 
-Writes BENCH_EXTRA.json at the repo root.  Run from the repo root:
+Writes BENCH_VIZ_CORPUS_r04.json at the repo root (NOT BENCH_EXTRA.json — bench.py owns that name for its per-run secondary metrics).  Run from the repo root:
 
     python experiments/bench_viz_corpus.py [--quick]
 """
@@ -173,7 +173,7 @@ def bench_corr(studies: int, samples: int, genes: int) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes")
-    ap.add_argument("--out", default="BENCH_EXTRA.json")
+    ap.add_argument("--out", default="BENCH_VIZ_CORPUS_r04.json")
     args = ap.parse_args()
 
     if args.quick:
