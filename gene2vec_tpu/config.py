@@ -61,21 +61,26 @@ class SGNSConfig:
                                    # (clamped to vocab/2 for small vocabs)
     strat_block: int = 512         # stratified: rows per random tail block
                                    # (clamped to the tail size)
-    strat_group: int = 128         # stratified: examples per tail-block
+    strat_group: int = 256         # stratified: examples per tail-block
                                    # draw.  The tail term's cost scales
                                    # with the number of groups E/group
                                    # (vmapped dynamic slices are issue-
-                                   # bound per slice), so larger groups
-                                   # buy throughput at the price of more
-                                   # examples sharing one block draw;
-                                   # growing strat_block alongside keeps
-                                   # per-example repulsion rank.  The
-                                   # round-4 sweep measured (128, 512) at
-                                   # holdout AUC 0.8971 vs the round-3
-                                   # (32, 128) default's 0.8965 at 1.37x
-                                   # its throughput (docs/PERF_NOTES.md
-                                   # round-4 geometry).  shared_groups>0
-                                   # overrides the group size.
+                                   # bound per slice) AND with the total
+                                   # tail row traffic G x S, so larger
+                                   # groups buy throughput at the price
+                                   # of more examples sharing one block
+                                   # draw; growing strat_block alongside
+                                   # keeps per-example repulsion rank.
+                                   # Post-dense-head frontier (PERF_NOTES
+                                   # round-4 geometry II): (256, 512) =
+                                   # 5.5-5.8M pairs/s at holdout AUC
+                                   # 0.8896 (oracle 0.878) — the chosen
+                                   # default; (128, 512) = 4.4M at
+                                   # 0.8960 for maximum-quality runs;
+                                   # (768, 768) = 6.35M falls BELOW
+                                   # oracle parity (0.8751) and is not
+                                   # offered as a default.
+                                   # shared_groups>0 overrides the size.
     positive_head: int = 512       # dense-head positives (stratified mode,
                                    # single-device): batches arrive class-
                                    # segmented [HH|HT|TT] by head membership
