@@ -528,14 +528,14 @@ def _step_stratified(
     """Stratified negatives: exact head + per-group random tail blocks.
 
     The round-3 redesign of the noise term (docs/PERF_NOTES.md §round-3),
-    re-tuned in round 4: the tail term's cost is the NUMBER of per-group
-    dynamic slices, not their bytes, so the default geometry moved from
-    (group 32, block 128) to (group 128, block 512) — same tail row
-    traffic, 1/4 the slice count, and each example sees 4x the repulsion
-    directions.  Measured on the integrated path at B=16,384 on v5e:
-    3.6-3.7M pairs/s vs round-3's 2.6-2.8M, holdout AUC 0.8971 vs 0.8965
-    (oracle parity target 0.878) — authoritative numbers in PERF_NOTES
-    round-4 geometry table.  The
+    re-tuned twice in round 4: the tail term's cost tracks the NUMBER of
+    per-group dynamic slices and, once the dense-head positive split
+    landed, the total tail row traffic G x S, so the default geometry
+    moved (32, 128) → (128, 512) → (256, 512).  The shipped default
+    measures 5.5-5.8M pairs/s at holdout AUC 0.8896 (oracle parity
+    target 0.878; ``strat_group=128`` is the maximum-quality knob at
+    0.8960) — authoritative numbers in the PERF_NOTES round-4 geometry
+    tables (I and II).  The
     shared/per-example modes spend ~2/3 of their row ops gathering and
     scattering P = 0.8*E*K random noise rows; noise rows have no example
     coupling, so this mode restructures them into contiguous traffic:

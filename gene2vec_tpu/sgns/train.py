@@ -202,6 +202,23 @@ class SGNSTrainer:
         # across the model axis) — fall back to plain gathers otherwise
         self.pos_quotas = None
         self.pos_shards = 1
+        if config.positive_head > 0 and jax.process_count() > 1:
+            # multi-host SPMD: every host derives the static segment
+            # quotas from its LOCAL corpus shard (process_shard strides
+            # differ by a few pairs per class), so hosts would compile
+            # different batch layouts and deadlock the collectives —
+            # the exact failure class ADVICE r3 item 1 fixed for
+            # num_batches.  Fall back to plain gathers until quotas are
+            # derived from global metadata (docs/DISTRIBUTED.md).
+            import warnings
+
+            warnings.warn(
+                "positive_head (dense-head positives) is disabled on "
+                "multi-host runs: per-host corpus shards would derive "
+                "mismatched segment quotas (docs/DISTRIBUTED.md)",
+                stacklevel=2,
+            )
+            config = dataclasses.replace(config, positive_head=0)
         if config.positive_head > 0 and (
             (sharding is not None and sharding.vocab_sharded)
             or config.negative_mode != "stratified"

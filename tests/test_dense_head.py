@@ -221,6 +221,21 @@ def test_trainer_dense_head_learns_planted_clusters():
     assert intra > inter + 0.3
 
 
+def test_trainer_falls_back_on_multihost(monkeypatch):
+    """Multi-host runs must not use dense-head positives: per-host corpus
+    shards derive mismatched static quotas, so hosts would compile
+    different batch layouts and deadlock the collectives.  The trainer
+    warns and falls back to plain gathers."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    corpus = _zipf_corpus(100, 2048)
+    cfg = SGNSConfig(dim=8, batch_pairs=256, positive_head=16)
+    with pytest.warns(UserWarning, match="multi-host"):
+        tr = SGNSTrainer(corpus, cfg)
+    assert tr.pos_quotas is None and tr.config.positive_head == 0
+    params, loss = tr.train_epoch(tr.init(), jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
 def test_trainer_falls_back_without_stratified():
     corpus = _zipf_corpus(100, 2048)
     cfg = SGNSConfig(
