@@ -221,6 +221,64 @@ def test_trainer_dense_head_learns_planted_clusters():
     assert intra > inter + 0.3
 
 
+try:
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        n_hh=st.integers(0, 3000),
+        n_ht=st.integers(0, 3000),
+        n_tt=st.integers(0, 3000),
+        batch=st.sampled_from([8, 16, 64, 128]),
+        multiple=st.sampled_from([1, 2, 4, 8]),
+        seed=st.integers(0, 10),
+    )
+    def test_segment_quota_invariants_fuzz(
+        n_hh, n_ht, n_tt, batch, multiple, seed
+    ):
+        """Property test over random class mixes: quotas sum to the batch,
+        are multiples of `multiple`, non-empty classes never drop to 0,
+        and every pool covers quota*num_batches rows at a length divisible
+        by `multiple`."""
+        head = 8
+        rng = np.random.RandomState(seed)
+        parts = []
+        if n_hh:
+            parts.append(rng.randint(0, head, size=(n_hh, 2)))
+        if n_ht:
+            parts.append(
+                np.stack(
+                    [
+                        rng.randint(0, head, n_ht),
+                        rng.randint(head, 60, n_ht),
+                    ],
+                    axis=1,
+                )
+            )
+        if n_tt:
+            parts.append(rng.randint(head, 60, size=(n_tt, 2)))
+        if not parts:
+            return
+        pairs = np.concatenate(parts).astype(np.int32)
+        rng.shuffle(pairs)
+        if len(pairs) < batch or batch % multiple or batch < 3 * multiple:
+            return
+        pools, quotas = segment_corpus_by_head(
+            pairs, head, batch, multiple=multiple
+        )
+        nb = len(pairs) // batch
+        assert sum(quotas) == batch
+        for pool, q, n_orig in zip(pools, quotas, (n_hh, n_ht, n_tt)):
+            assert q % multiple == 0
+            assert len(pool) >= q * nb
+            assert len(pool) % multiple == 0 or len(pool) == 0
+            if n_orig:
+                assert q >= multiple  # non-empty class always trains
+
+except ImportError:  # pragma: no cover - hypothesis is in the base image
+    pass
+
+
 def test_trainer_falls_back_on_multihost(monkeypatch):
     """Multi-host runs must not use dense-head positives: per-host corpus
     shards derive mismatched static quotas, so hosts would compile
