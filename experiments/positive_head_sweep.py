@@ -24,22 +24,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
+from bench import synth_corpus  # the bench's own corpus recipe
 from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.sgns.train import SGNSTrainer
-
-
-def synth_corpus(vocab_size, num_pairs, seed=0):
-    from gene2vec_tpu.data.pipeline import PairCorpus
-    from gene2vec_tpu.io.vocab import Vocab
-
-    rng = np.random.RandomState(seed)
-    p = 1.0 / np.arange(1, vocab_size + 1)
-    p /= p.sum()
-    pairs = rng.choice(vocab_size, size=(num_pairs, 2), p=p).astype(np.int32)
-    counts = np.bincount(pairs.reshape(-1), minlength=vocab_size).astype(
-        np.int64
-    )
-    return PairCorpus(Vocab([f"G{i}" for i in range(vocab_size)], counts), pairs)
 
 
 def measure(head: int, v: int, n: int, b: int, dim: int, epochs: int = 3):

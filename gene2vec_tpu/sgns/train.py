@@ -195,78 +195,10 @@ class SGNSTrainer:
             # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
             # decorrelation then needs no per-row device gathers
             corpus = host_preshuffle(corpus, config.seed)
-        # dense-head positives need the class-segmented batch layout:
-        # stratified + both-directions, with replicated tables (under a
-        # mesh, each data-parallel device block carries its own [HH|HT|TT]
-        # segment layout; vocab-sharded tables would split the head slab
-        # across the model axis) — fall back to plain gathers otherwise
+        config, self.pos_shards = self._resolve_positive_head(
+            config, corpus, sharding
+        )
         self.pos_quotas = None
-        self.pos_shards = 1
-        if config.positive_head > 0 and jax.process_count() > 1:
-            # multi-host SPMD: every host derives the static segment
-            # quotas from its LOCAL corpus shard (process_shard strides
-            # differ by a few pairs per class), so hosts would compile
-            # different batch layouts and deadlock the collectives —
-            # the exact failure class ADVICE r3 item 1 fixed for
-            # num_batches.  Fall back to plain gathers until quotas are
-            # derived from global metadata (docs/DISTRIBUTED.md).
-            import warnings
-
-            warnings.warn(
-                "positive_head (dense-head positives) is disabled on "
-                "multi-host runs: per-host corpus shards would derive "
-                "mismatched segment quotas (docs/DISTRIBUTED.md)",
-                stacklevel=2,
-            )
-            config = dataclasses.replace(config, positive_head=0)
-        if config.positive_head > 0 and (
-            (sharding is not None and sharding.vocab_sharded)
-            or config.negative_mode != "stratified"
-            or not config.both_directions
-        ):
-            if sharding is not None and config.negative_mode == "stratified":
-                import warnings
-
-                warnings.warn(
-                    "positive_head (dense-head positives) does not support "
-                    "vocab-sharded tables and was disabled for this run — "
-                    "expect the plain-gather per-chip rate (PERF_NOTES "
-                    "round 4)",
-                    stacklevel=2,
-                )
-            config = dataclasses.replace(config, positive_head=0)
-        elif config.positive_head > 0:
-            config = dataclasses.replace(
-                config,
-                positive_head=min(config.positive_head, corpus.vocab_size),
-            )
-            if sharding is not None:
-                self.pos_shards = int(
-                    sharding.mesh.shape[sharding.data_axis]
-                )
-            if config.pos_layout_shards > 0:
-                # explicit layout override (sharded-vs-unsharded parity
-                # tests reproduce a mesh layout on one device)
-                self.pos_shards = config.pos_layout_shards
-            if (
-                config.batch_pairs % self.pos_shards
-                or config.batch_pairs < 3 * self.pos_shards
-            ):
-                # a batch that can't be cut into uniform per-device
-                # [HH|HT|TT] blocks falls back gracefully, like the
-                # vocab-sharded case — never a constructor crash
-                import warnings
-
-                warnings.warn(
-                    f"positive_head disabled: batch_pairs="
-                    f"{config.batch_pairs} cannot form {self.pos_shards} "
-                    "uniform [HH|HT|TT] device blocks (needs a multiple "
-                    f"of {self.pos_shards}, at least {3 * self.pos_shards})",
-                    stacklevel=2,
-                )
-                config = dataclasses.replace(config, positive_head=0)
-                self.pos_shards = 1
-
         self.config = config
         self.corpus = corpus
         self.sharding = sharding
@@ -322,6 +254,63 @@ class SGNSTrainer:
             pos_shards=self.pos_shards,
         )
         self.timer = StepTimer()
+
+    @staticmethod
+    def _resolve_positive_head(config, corpus, sharding):
+        """Gate the dense-head positive path: returns (config, pos_shards)
+        with ``positive_head`` clamped to the vocab, or set to 0 (with a
+        warning) when the class-segmented batch layout cannot apply.  The
+        layout needs stratified + both-direction training with replicated
+        tables; a batch cuttable into uniform per-device [HH|HT|TT]
+        blocks; and a single host (per-host corpus shards would derive
+        mismatched static quotas and deadlock the collectives — the
+        failure class process_shard's equal-length trim prevents for
+        num_batches; docs/DISTRIBUTED.md)."""
+        import warnings
+
+        def disabled(msg):
+            warnings.warn(
+                f"positive_head (dense-head positives) disabled: {msg}",
+                stacklevel=3,
+            )
+            return dataclasses.replace(config, positive_head=0), 1
+
+        if config.positive_head <= 0:
+            return config, 1
+        if config.negative_mode != "stratified" or not config.both_directions:
+            # silent: these configs never supported the dense path
+            return dataclasses.replace(config, positive_head=0), 1
+        if jax.process_count() > 1:
+            return disabled(
+                "multi-host run — per-host corpus shards would derive "
+                "mismatched segment quotas (docs/DISTRIBUTED.md)"
+            )
+        if sharding is not None and sharding.vocab_sharded:
+            return disabled(
+                "vocab-sharded tables split the head slab over the model "
+                "axis — expect the plain-gather per-chip rate "
+                "(PERF_NOTES round 4)"
+            )
+        shards = 1
+        if sharding is not None:
+            shards = int(sharding.mesh.shape[sharding.data_axis])
+        if config.pos_layout_shards > 0:
+            # explicit layout override (sharded-vs-unsharded parity tests
+            # reproduce a mesh layout on one device)
+            shards = config.pos_layout_shards
+        if config.batch_pairs % shards or config.batch_pairs < 3 * shards:
+            return disabled(
+                f"batch_pairs={config.batch_pairs} cannot form {shards} "
+                "uniform [HH|HT|TT] device blocks (needs a multiple of "
+                f"{shards}, at least {3 * shards})"
+            )
+        return (
+            dataclasses.replace(
+                config,
+                positive_head=min(config.positive_head, corpus.vocab_size),
+            ),
+            shards,
+        )
 
     # -- params ------------------------------------------------------------
 
