@@ -56,9 +56,19 @@ def save_iteration(
     else:
         vocab.save(vocab_path)
     prefix = ckpt_prefix(export_dir, dim, iteration)
-    emb = np.asarray(params.emb)
-    ctx = np.asarray(params.ctx)
-    meta = dict(meta or {}, dim=dim, iteration=iteration, vocab_size=len(vocab))
+    # npz has no bfloat16 dtype: store f32 (a lossless upcast of bf16
+    # tables — every bf16 value is exactly representable) and record the
+    # training width so load_iteration can restore it
+    table_dtype = str(params.emb.dtype)
+    emb = np.asarray(params.emb, dtype=np.float32)
+    ctx = np.asarray(params.ctx, dtype=np.float32)
+    meta = dict(
+        meta or {},
+        dim=dim,
+        iteration=iteration,
+        vocab_size=len(vocab),
+        table_dtype=table_dtype,
+    )
     np.savez(prefix + ".npz", emb=emb, ctx=ctx, meta=json.dumps(meta))
     if txt_output:
         write_matrix_txt(prefix + ".txt", vocab.id_to_token, emb)
@@ -73,9 +83,12 @@ def load_iteration(
 
     prefix = ckpt_prefix(export_dir, dim, iteration)
     with np.load(prefix + ".npz") as z:
-        emb = jnp.asarray(z["emb"])
-        ctx = jnp.asarray(z["ctx"])
         meta = json.loads(str(z["meta"]))
+        # stored f32; restore the recorded training width (bf16 tables
+        # round-trip losslessly through the f32 file)
+        dtype = jnp.dtype(meta.get("table_dtype", "float32"))
+        emb = jnp.asarray(z["emb"], dtype=dtype)
+        ctx = jnp.asarray(z["ctx"], dtype=dtype)
     vocab = Vocab.load(os.path.join(export_dir, "vocab.tsv"))
     return SGNSParams(emb=emb, ctx=ctx), vocab, meta
 

@@ -146,3 +146,57 @@ def test_reference_smoke_corpus_end_to_end(tmp_path):
     with open(f"{REFERENCE_SMOKE}/test.txt", encoding="windows-1252") as f:
         genes = {g for line in f for g in line.split()}
     assert set(toks) == genes
+
+
+def test_bfloat16_tables_checkpoint_and_export(tmp_path):
+    """table_dtype="bfloat16" (the measured +7% opt-in) must checkpoint,
+    export, and resume: npz has no bf16 dtype, so the file stores f32 (a
+    lossless upcast) and load restores the recorded training width."""
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 50, (2048, 2)).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=50).astype(np.int64)
+    from gene2vec_tpu.io.vocab import Vocab
+
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(50)], counts), pairs)
+    cfg = SGNSConfig(
+        dim=8, num_iters=2, batch_pairs=256, table_dtype="bfloat16"
+    )
+    tr = SGNSTrainer(corpus, cfg)
+    tr.run(str(tmp_path), log=lambda m: None)
+
+    params, vocab, meta = ckpt.load_iteration(str(tmp_path), 8, 2)
+    assert str(params.emb.dtype) == "bfloat16"
+    assert meta["table_dtype"] == "bfloat16"
+    toks, mat = read_word2vec_format(
+        str(tmp_path / "gene2vec_dim_8_iter_2_w2v.txt")
+    )
+    assert mat.shape == (50, 8) and np.isfinite(mat).all()
+    # resume picks up from the saved iteration without retraining
+    tr2 = SGNSTrainer(corpus, cfg)
+    msgs = []
+    tr2.run(str(tmp_path), log=msgs.append)
+    assert any("resuming from iteration 2" in m for m in msgs)
+
+
+def test_resume_honors_configured_table_dtype(tmp_path):
+    """Resuming a bf16 checkpoint with table_dtype=float32 configured must
+    warn and continue at the CONFIGURED width (and vice versa) — not
+    silently undo the config change."""
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 50, (2048, 2)).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=50).astype(np.int64)
+    from gene2vec_tpu.io.vocab import Vocab
+
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(50)], counts), pairs)
+    cfg16 = SGNSConfig(
+        dim=8, num_iters=1, batch_pairs=256, table_dtype="bfloat16"
+    )
+    SGNSTrainer(corpus, cfg16).run(str(tmp_path), log=lambda m: None)
+
+    cfg32 = SGNSConfig(
+        dim=8, num_iters=2, batch_pairs=256, table_dtype="float32"
+    )
+    tr = SGNSTrainer(corpus, cfg32)
+    with pytest.warns(UserWarning, match="resuming at the configured"):
+        params = tr.run(str(tmp_path), log=lambda m: None)
+    assert str(params.emb.dtype) == "float32"
