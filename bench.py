@@ -200,6 +200,28 @@ def secondary_metrics(vocab_size: int, num_pairs: int, batch_pairs: int) -> dict
     except Exception as e:
         log(f"shared-mode secondary failed: {e}")
 
+    # measured opt-in: bf16 table storage (+7% at real-scale quality
+    # parity; NOT the gated headline config — the f32 default is, since
+    # bf16 absorbs small-scale updates.  PERF_NOTES geometry II note).
+    try:
+        from gene2vec_tpu.config import SGNSConfig
+        from gene2vec_tpu.sgns.train import SGNSTrainer
+
+        corpus = synth_corpus(vocab_size, num_pairs)
+        trainer = SGNSTrainer(
+            corpus,
+            SGNSConfig(
+                dim=200, batch_pairs=batch_pairs, table_dtype="bfloat16"
+            ),
+        )
+        out["table_bf16_pairs_per_sec"] = round(_steady_rate(trainer), 1)
+        log(
+            f"bf16 tables (opt-in): "
+            f"{out['table_bf16_pairs_per_sec']:,.0f} pairs/s"
+        )
+    except Exception as e:
+        log(f"bf16-table secondary failed: {e}")
+
     # BASELINE config 4: CBOW + hierarchical softmax.
     try:
         from gene2vec_tpu.config import SGNSConfig

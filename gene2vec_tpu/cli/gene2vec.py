@@ -49,8 +49,40 @@ def build_parser() -> argparse.ArgumentParser:
         "--combiner", choices=("capped", "mean", "sum"), default=d.combiner
     )
     p.add_argument(
-        "--negative-mode", choices=("shared", "per_example"),
+        "--negative-mode",
+        choices=("stratified", "shared", "per_example"),
         default=d.negative_mode,
+    )
+    p.add_argument(
+        "--strat-head", type=int, default=d.strat_head,
+        help="stratified: exact-expectation noise head rows",
+    )
+    p.add_argument(
+        "--strat-group", type=int, default=d.strat_group,
+        help="stratified: examples per tail-block draw (128 = the "
+             "maximum-quality point, 256 = the default throughput point; "
+             "docs/PERF_NOTES.md geometry II)",
+    )
+    p.add_argument(
+        "--strat-block", type=int, default=d.strat_block,
+        help="stratified: rows per random tail block",
+    )
+    p.add_argument(
+        "--positive-head", type=int, default=d.positive_head,
+        help="dense-head positives: head rows moved via one-hot MXU "
+             "matmuls (0 disables; single-host stratified runs only)",
+    )
+    p.add_argument(
+        "--table-dtype", choices=("float32", "bfloat16"),
+        default=d.table_dtype,
+        help="emb/ctx storage width; bfloat16 = measured +7%% at "
+             "real-scale quality parity, NOT safe for tiny corpora "
+             "(see config.py)",
+    )
+    p.add_argument(
+        "--hs-dense-depth", type=int, default=d.hs_dense_depth,
+        help="cbow_hs/sg_hs: Huffman-tree levels scored densely against "
+             "the contiguous shallow-node prefix (0 = classic)",
     )
     p.add_argument(
         "--vocab-sharded", action="store_true",
@@ -85,6 +117,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed,
         combiner=args.combiner,
         negative_mode=args.negative_mode,
+        strat_head=args.strat_head,
+        strat_group=args.strat_group,
+        strat_block=args.strat_block,
+        positive_head=args.positive_head,
+        table_dtype=args.table_dtype,
+        hs_dense_depth=args.hs_dense_depth,
         vocab_sharded=args.vocab_sharded,
         txt_output=not args.no_txt_output,
     )

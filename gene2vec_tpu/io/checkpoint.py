@@ -77,16 +77,35 @@ def save_iteration(
 
 
 def load_iteration(
-    export_dir: str, dim: int, iteration: int
+    export_dir: str, dim: int, iteration: int,
+    table_dtype: Optional[str] = None,
 ) -> Tuple[SGNSParams, Vocab, dict]:
+    """Load one iteration's tables (+vocab, meta).
+
+    ``table_dtype`` is the CALLER'S configured training width: on a
+    mismatch with the checkpoint's recorded width the tables are cast to
+    the configured one, with a warning — silently resuming at the
+    checkpoint's width would undo exactly the config retreat the bf16
+    small-scale-absorption caveat recommends (config.py table_dtype).
+    ``None`` restores the recorded width as-is (inspection tools).  The
+    file itself always stores f32 — a lossless upcast of bf16 tables.
+    """
     import jax.numpy as jnp
 
     prefix = ckpt_prefix(export_dir, dim, iteration)
     with np.load(prefix + ".npz") as z:
         meta = json.loads(str(z["meta"]))
-        # stored f32; restore the recorded training width (bf16 tables
-        # round-trip losslessly through the f32 file)
-        dtype = jnp.dtype(meta.get("table_dtype", "float32"))
+        saved = meta.get("table_dtype", "float32")
+        if table_dtype is not None and table_dtype != saved:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint iteration {iteration} was saved with "
+                f"table_dtype={saved}; resuming at the configured "
+                f"{table_dtype}",
+                stacklevel=2,
+            )
+        dtype = jnp.dtype(table_dtype if table_dtype is not None else saved)
         emb = jnp.asarray(z["emb"], dtype=dtype)
         ctx = jnp.asarray(z["ctx"], dtype=dtype)
     vocab = Vocab.load(os.path.join(export_dir, "vocab.tsv"))

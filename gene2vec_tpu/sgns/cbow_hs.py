@@ -409,7 +409,8 @@ class CBOWHSTrainer:
             start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
         if start_iter > 1:
             params, _, meta = ckpt.load_iteration(
-                export_dir, cfg.dim, start_iter - 1
+                export_dir, cfg.dim, start_iter - 1,
+                table_dtype=cfg.table_dtype,
             )
             if self.hs:
                 # node-table row ids depend on the shallow-split layout;

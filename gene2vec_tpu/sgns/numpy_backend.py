@@ -113,7 +113,10 @@ class NumpySGNSTrainer:
         if start_iter is None:
             start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
         if start_iter > 1:
-            params, _, _ = ckpt.load_iteration(export_dir, cfg.dim, start_iter - 1)
+            params, _, _ = ckpt.load_iteration(
+                export_dir, cfg.dim, start_iter - 1,
+                table_dtype="float32",  # this backend computes in f32
+            )
             params = SGNSParams(
                 emb=np.asarray(params.emb), ctx=np.asarray(params.ctx)
             )

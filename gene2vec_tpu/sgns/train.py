@@ -364,28 +364,10 @@ class SGNSTrainer:
         if start_iter is None:
             start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
         if start_iter > 1:
-            params, _, meta = ckpt.load_iteration(
-                export_dir, cfg.dim, start_iter - 1
+            params, _, _ = ckpt.load_iteration(
+                export_dir, cfg.dim, start_iter - 1,
+                table_dtype=cfg.table_dtype,
             )
-            saved_dtype = meta.get("table_dtype", "float32")
-            if saved_dtype != cfg.table_dtype:
-                # honor the CONFIGURED width (e.g. a user retreating from
-                # the bf16 opt-in after the small-scale absorption
-                # failure), visibly — silently resuming at the
-                # checkpoint's width would undo the config change
-                import warnings
-
-                warnings.warn(
-                    f"checkpoint iteration {start_iter - 1} was saved with "
-                    f"table_dtype={saved_dtype}; resuming at the configured "
-                    f"{cfg.table_dtype}",
-                    stacklevel=2,
-                )
-                dtype = jnp.dtype(cfg.table_dtype)
-                params = SGNSParams(
-                    emb=params.emb.astype(dtype),
-                    ctx=params.ctx.astype(dtype),
-                )
             log(f"resuming from iteration {start_iter - 1}")
         else:
             params = self.init()
