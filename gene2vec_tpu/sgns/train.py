@@ -182,11 +182,17 @@ class SGNSTrainer:
             # even row count per data shard is required to device_put the
             # corpus with a sharded axis
             corpus = corpus.pad_to_multiple(sharding.mesh.shape[sharding.data_axis])
-        if corpus.num_pairs < config.batch_pairs:
+        # multi-host SPMD: `corpus` is this host's equal-length shard
+        # (docs/DISTRIBUTED.md) but the jitted epoch runs against the
+        # GLOBAL pair array, so pair/batch counts derive from the global
+        # row count — identical on every host because process_shard trims
+        # shards to equal length
+        self._procs = jax.process_count() if sharding is not None else 1
+        if corpus.num_pairs * self._procs < config.batch_pairs:
             # shrink the batch rather than failing on tiny corpora
             # (the reference smoke corpus data/test.txt has 39 pairs)
             config = dataclasses.replace(
-                config, batch_pairs=max(1, corpus.num_pairs)
+                config, batch_pairs=max(1, corpus.num_pairs * self._procs)
             )
         if config.shuffle_mode not in ("offset", "full"):
             raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
@@ -203,7 +209,8 @@ class SGNSTrainer:
         self.corpus = corpus
         self.sharding = sharding
         self.sampler = NegativeSampler(corpus.vocab.counts, config.ns_exponent)
-        self.num_batches = corpus.num_batches(config.batch_pairs)
+        self.global_num_pairs = corpus.num_pairs * self._procs
+        self.num_batches = self.global_num_pairs // config.batch_pairs
 
         if config.positive_head > 0:
             pools, self.pos_quotas = segment_corpus_by_head(
@@ -224,6 +231,12 @@ class SGNSTrainer:
                 )
             else:
                 self.pairs = tuple(jnp.asarray(p) for p in pools)
+        elif sharding is not None and self._procs > 1:
+            # per-host shards assemble into ONE global row-sharded array;
+            # device_put would require identical values on every host
+            self.pairs = jax.make_array_from_process_local_data(
+                sharding.corpus_sharding(), corpus.pairs
+            )
         elif sharding is not None:
             self.pairs = corpus.device_pairs(sharding.corpus_sharding())
         else:
@@ -249,7 +262,7 @@ class SGNSTrainer:
                 )
 
         self._epoch_fn = make_train_epoch(
-            corpus.num_pairs, self.num_batches, self.config, sharding,
+            self.global_num_pairs, self.num_batches, self.config, sharding,
             stratified=self.stratified, pos_quotas=self.pos_quotas,
             pos_shards=self.pos_shards,
         )

@@ -244,3 +244,94 @@ def test_process_shard_partitions_corpus():
         corpus.process_shard(4, 4)
     with pytest.raises(ValueError, match="process count"):
         corpus.process_shard(0, 0)
+
+
+def test_two_process_distributed_training(tmp_path):
+    """REAL multi-host SPMD: two OS processes, each with 4 forced-CPU
+    devices, form one 8-device jax.distributed runtime; each feeds its
+    process_shard of the same corpus; the global-mesh epoch runs over
+    Gloo collectives.  Both processes must compute identical, decreasing
+    losses — the strongest executable evidence for docs/DISTRIBUTED.md
+    (per-host shards assembled via make_array_from_process_local_data,
+    global num_batches derived from the global row count, dense-head
+    auto-disabled)."""
+    import subprocess
+    import sys
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        """
+import sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+pid = int(sys.argv[1])
+from gene2vec_tpu.parallel import distributed
+from gene2vec_tpu.config import MeshConfig, SGNSConfig
+from gene2vec_tpu.parallel.mesh import make_mesh
+from gene2vec_tpu.parallel.sharding import SGNSSharding
+from gene2vec_tpu.sgns.train import SGNSTrainer
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.vocab import Vocab
+
+distributed.initialize(
+    coordinator_address="127.0.0.1:12983", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8
+
+rng = np.random.RandomState(0)  # same full corpus on every host
+pairs = rng.randint(0, 64, (4096, 2)).astype(np.int32)
+counts = np.bincount(pairs.reshape(-1), minlength=64).astype(np.int64)
+corpus = PairCorpus(Vocab([f"G{i}" for i in range(64)], counts), pairs)
+local = corpus.process_shard()
+assert local.num_pairs == 2048
+
+mesh = make_mesh(MeshConfig(data=8, model=1))
+tr = SGNSTrainer(
+    local,
+    SGNSConfig(dim=16, num_iters=1, batch_pairs=256, seed=3),
+    sharding=SGNSSharding(mesh, vocab_sharded=False),
+)
+assert tr.global_num_pairs == 4096 and tr.num_batches == 16
+params = tr.init()
+params, l1 = tr.train_epoch(params, jax.random.PRNGKey(7))
+params, l2 = tr.train_epoch(params, jax.random.PRNGKey(8))
+print(f"RESULT {float(l1):.6f} {float(l2):.6f}", flush=True)
+distributed.shutdown()
+"""
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo,
+        )
+        for i in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(out)
+    finally:
+        # a failed/timed-out worker must not leave its peer blocked in
+        # the distributed rendezvous with the coordinator port bound
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT")
+    ]
+    assert len(results) == 2
+    assert results[0] == results[1], results  # identical across processes
+    l1, l2 = map(float, results[0].split()[1:])
+    assert l2 < l1  # and the model actually learns
