@@ -152,9 +152,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         print(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))} "
               f"over {len(jax.devices())} devices")
+        # multi-process runtime: every host read the full corpus above;
+        # feed this host's shard and keep the full corpus for the
+        # dense-head global quotas (docs/DISTRIBUTED.md data feeding —
+        # passing the full corpus as the shard would train every pair
+        # process_count times per epoch)
+        local, full = corpus, None
+        if jax.process_count() > 1:
+            local, full = corpus.process_shard(), corpus
+            print(
+                f"process {jax.process_index()}/{jax.process_count()}: "
+                f"feeding {local.num_pairs:,} of {corpus.num_pairs:,} pairs"
+            )
         trainer = SGNSTrainer(
-            corpus, config,
+            local, config,
             sharding=SGNSSharding(mesh, vocab_sharded=args.vocab_sharded),
+            full_corpus=full,
         )
     else:
         from gene2vec_tpu.sgns.backends import make_backend_trainer

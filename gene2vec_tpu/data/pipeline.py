@@ -242,6 +242,66 @@ def segment_corpus_by_head(
     return tuple(pools), tuple(quotas)
 
 
+def segment_corpus_by_head_multihost(
+    pairs_full: np.ndarray,
+    head: int,
+    batch_pairs: int,
+    multiple: int,
+    index: int,
+    count: int,
+):
+    """Multi-host dense-head segmentation: every host calls this with the
+    SAME full corpus (the documented flow — each host reads all pair
+    files before :meth:`PairCorpus.process_shard`) and receives its LOCAL
+    shard of each class pool plus the GLOBAL quotas.
+
+    Everything is a deterministic function of the full corpus, so all
+    hosts compute identical quotas and identical per-host pool lengths —
+    the property that makes the static batch layout safe under SPMD
+    (mismatched quotas would compile different programs and deadlock the
+    collectives; docs/DISTRIBUTED.md).
+
+    Construction: classify + quota on the full corpus exactly as the
+    single-host :func:`segment_corpus_by_head` (``multiple`` = the global
+    data-axis size), then give each host the strided shard
+    ``pool[index::count]`` adjusted to the agreed length ``L_c`` =
+    max(floor-share, coverage need), rounded to the per-host device
+    multiple — trimming or wrap-padding the local shard as needed.
+    Returns (local_pools, quotas, num_batches).
+    """
+    if count < 1 or not 0 <= index < count:
+        raise ValueError(f"bad process coordinates {index}/{count}")
+    if multiple % count:
+        raise ValueError(
+            f"device-block count {multiple} must be divisible by the "
+            f"process count {count} (equal devices per host)"
+        )
+    pools, quotas = segment_corpus_by_head(
+        pairs_full, head, batch_pairs, multiple=multiple
+    )
+    num_batches = pairs_full.shape[0] // batch_pairs
+    lm = max(multiple // count, 1)  # per-host device multiple
+    local_pools = []
+    for pool, q in zip(pools, quotas):
+        if len(pool) == 0:
+            local_pools.append(pool)
+            continue
+        share = len(pool) // count // lm * lm
+        need = -(-q * num_batches // count)  # ceil coverage per host
+        target = max(share, -(-need // lm) * lm)
+        local = pool[index::count]
+        if len(local) == 0:
+            # a tiny pool whose strided rows all landed on other hosts:
+            # borrow from the (globally known) pool — host LENGTHS must
+            # agree, host contents need not
+            local = pool
+        if len(local) < target:
+            reps = -(-target // len(local))
+            local = np.concatenate([local] * reps, axis=0)
+        local_pools.append(local[:target])
+    return tuple(local_pools), quotas, num_batches
+
+
 def segmented_epoch_shuffle(
     pools, key: jax.Array, quotas, num_batches: int, mode: str,
     enabled: bool = True,

@@ -32,6 +32,7 @@ from gene2vec_tpu.data.pipeline import (
     epoch_shuffle,
     host_preshuffle,
     segment_corpus_by_head,
+    segment_corpus_by_head_multihost,
     segmented_epoch_shuffle,
 )
 from gene2vec_tpu.io import checkpoint as ckpt
@@ -166,7 +167,15 @@ class SGNSTrainer:
         corpus: PairCorpus,
         config: SGNSConfig = SGNSConfig(),
         sharding: Optional["SGNSSharding"] = None,
+        full_corpus: Optional[PairCorpus] = None,
     ):
+        """``corpus`` is this host's (possibly process-sharded) pair set.
+        On multi-host runs, passing ``full_corpus`` (the UN-sharded
+        corpus every host already read — docs/DISTRIBUTED.md data
+        feeding) additionally enables dense-head positives: the static
+        segment quotas derive from the full corpus, so every host
+        compiles the same batch layout.  Ignored on single-host runs.
+        """
         if corpus.num_pairs == 0 or corpus.vocab_size == 0:
             raise ValueError(
                 "corpus is empty — no pair lines matched the source "
@@ -196,14 +205,18 @@ class SGNSTrainer:
             )
         if config.shuffle_mode not in ("offset", "full"):
             raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
-        if config.shuffle_mode == "offset":
+        config, self.pos_shards = self._resolve_positive_head(
+            config, corpus, sharding,
+            have_full_corpus=full_corpus is not None,
+        )
+        dense_multihost = config.positive_head > 0 and self._procs > 1
+        if config.shuffle_mode == "offset" and not dense_multihost:
             # one-time host-side shuffle, unconditional like the reference's
             # pre-training random.shuffle (src/gene2vec.py:52); per-epoch
-            # decorrelation then needs no per-row device gathers
+            # decorrelation then needs no per-row device gathers.  The
+            # dense multi-host path preshuffles full_corpus instead — its
+            # device arrays derive from that, never from the local shard.
             corpus = host_preshuffle(corpus, config.seed)
-        config, self.pos_shards = self._resolve_positive_head(
-            config, corpus, sharding
-        )
         self.pos_quotas = None
         self.config = config
         self.corpus = corpus
@@ -212,7 +225,31 @@ class SGNSTrainer:
         self.global_num_pairs = corpus.num_pairs * self._procs
         self.num_batches = self.global_num_pairs // config.batch_pairs
 
-        if config.positive_head > 0:
+        if dense_multihost:
+            # multi-host dense head: quotas and num_batches derive from
+            # the FULL corpus (identical on every host), each host keeps
+            # deterministic-length local pool shards, and the pools
+            # assemble into global row-sharded arrays
+            assert full_corpus is not None  # gated in _resolve_positive_head
+            fc = full_corpus
+            if config.shuffle_mode == "offset":
+                fc = host_preshuffle(fc, config.seed)
+            local_pools, self.pos_quotas, self.num_batches = (
+                segment_corpus_by_head_multihost(
+                    fc.pairs, config.positive_head, config.batch_pairs,
+                    self.pos_shards, jax.process_index(), self._procs,
+                )
+            )
+            self.global_num_pairs = self.num_batches * config.batch_pairs
+            self.pairs = tuple(
+                jax.make_array_from_process_local_data(
+                    sharding.corpus_sharding(), p
+                )
+                if len(p)
+                else jnp.asarray(p)
+                for p in local_pools
+            )
+        elif config.positive_head > 0:
             pools, self.pos_quotas = segment_corpus_by_head(
                 corpus.pairs, config.positive_head, config.batch_pairs,
                 multiple=self.pos_shards,
@@ -269,16 +306,19 @@ class SGNSTrainer:
         self.timer = StepTimer()
 
     @staticmethod
-    def _resolve_positive_head(config, corpus, sharding):
+    def _resolve_positive_head(
+        config, corpus, sharding, have_full_corpus=False
+    ):
         """Gate the dense-head positive path: returns (config, pos_shards)
         with ``positive_head`` clamped to the vocab, or set to 0 (with a
         warning) when the class-segmented batch layout cannot apply.  The
         layout needs stratified + both-direction training with replicated
-        tables; a batch cuttable into uniform per-device [HH|HT|TT]
-        blocks; and a single host (per-host corpus shards would derive
-        mismatched static quotas and deadlock the collectives — the
+        tables, and a batch cuttable into uniform per-device [HH|HT|TT]
+        blocks.  Multi-host runs additionally need ``full_corpus`` so the
+        static quotas derive from global data — per-host shards would
+        derive mismatched quotas and deadlock the collectives, the
         failure class process_shard's equal-length trim prevents for
-        num_batches; docs/DISTRIBUTED.md)."""
+        num_batches (docs/DISTRIBUTED.md)."""
         import warnings
 
         def disabled(msg):
@@ -293,10 +333,12 @@ class SGNSTrainer:
         if config.negative_mode != "stratified" or not config.both_directions:
             # silent: these configs never supported the dense path
             return dataclasses.replace(config, positive_head=0), 1
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not have_full_corpus:
             return disabled(
-                "multi-host run — per-host corpus shards would derive "
-                "mismatched segment quotas (docs/DISTRIBUTED.md)"
+                "multi-host run without full_corpus — per-host corpus "
+                "shards would derive mismatched segment quotas; pass the "
+                "un-sharded corpus as SGNSTrainer(..., full_corpus=...) "
+                "to enable (docs/DISTRIBUTED.md)"
             )
         if sharding is not None and sharding.vocab_sharded:
             return disabled(

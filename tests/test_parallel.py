@@ -276,8 +276,9 @@ from gene2vec_tpu.sgns.train import SGNSTrainer
 from gene2vec_tpu.data.pipeline import PairCorpus
 from gene2vec_tpu.io.vocab import Vocab
 
+port = sys.argv[2]
 distributed.initialize(
-    coordinator_address="127.0.0.1:12983", num_processes=2, process_id=pid
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
 )
 assert jax.process_count() == 2
 assert len(jax.devices()) == 8
@@ -299,7 +300,29 @@ assert tr.global_num_pairs == 4096 and tr.num_batches == 16
 params = tr.init()
 params, l1 = tr.train_epoch(params, jax.random.PRNGKey(7))
 params, l2 = tr.train_epoch(params, jax.random.PRNGKey(8))
-print(f"RESULT {float(l1):.6f} {float(l2):.6f}", flush=True)
+
+# dense-head positives on multi-host: quotas derive from the FULL
+# corpus (identical on every host), pools assemble from per-host shards
+tr2 = SGNSTrainer(
+    local,
+    SGNSConfig(
+        dim=16, num_iters=1, batch_pairs=256, seed=3, positive_head=16,
+        strat_head=8, strat_block=16,
+    ),
+    sharding=SGNSSharding(mesh, vocab_sharded=False),
+    full_corpus=corpus,
+)
+assert tr2.pos_quotas is not None and tr2.config.positive_head == 16
+p2 = tr2.init()
+dlosses = []
+for ep in range(5):  # tiny-scale epoch losses are noisy; look at the trend
+    p2, dl = tr2.train_epoch(p2, jax.random.fold_in(jax.random.PRNGKey(9), ep))
+    dlosses.append(float(dl))
+print(
+    f"RESULT {float(l1):.6f} {float(l2):.6f} "
+    f"{tr2.pos_quotas} {dlosses[0]:.6f} {min(dlosses):.6f}",
+    flush=True,
+)
 distributed.shutdown()
 """
     )
@@ -307,9 +330,16 @@ distributed.shutdown()
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    # a free port per run: concurrent sessions (or a stale listener) on a
+    # fixed port would hang both workers in the rendezvous until timeout
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i)],
+            [sys.executable, str(worker), str(i), str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=repo,
         )
@@ -333,5 +363,8 @@ distributed.shutdown()
     ]
     assert len(results) == 2
     assert results[0] == results[1], results  # identical across processes
-    l1, l2 = map(float, results[0].split()[1:])
+    parts = results[0].split()
+    l1, l2 = float(parts[1]), float(parts[2])
     assert l2 < l1  # and the model actually learns
+    d_first, d_best = float(parts[-2]), float(parts[-1])
+    assert d_best < d_first - 0.5  # dense-head multi-host path learns too
