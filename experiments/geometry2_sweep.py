@@ -1,0 +1,104 @@
+"""Round 4 geometry II: stratified tail (group, block) frontier AFTER the
+dense-head positive split landed (docs/PERF_NOTES.md "Geometry II").
+
+With positive row ops shrunk, the tail term's cost tracks BOTH the slice
+count (E/group) and the total tail row traffic (E/group) x block.  This
+sweep measures integrated-trainer throughput at the bench headline shape
+per (group, block); quality (holdout AUC per the frozen gate protocol) is
+measured separately — rates alone do NOT pick a default (two measured
+points faster than the shipped default fall below oracle parity and were
+rejected; QUALITY_NOTES §5).
+
+Run: python experiments/geometry2_sweep.py \
+        [--geometries 128:512,256:512,...] [--quality]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from bench import synth_corpus
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.sgns.train import SGNSTrainer, train_epochs
+
+DEFAULT_GEOMS = "128:512,256:512,384:768,512:512,512:1024,768:768,768:1536"
+
+
+def rate(group: int, block: int, v: int, n: int, b: int) -> dict:
+    corpus = synth_corpus(v, n)
+    cfg = SGNSConfig(
+        dim=200, batch_pairs=b, strat_group=group, strat_block=block
+    )
+    tr = SGNSTrainer(corpus, cfg)
+    params = tr.init()
+    key = jax.random.PRNGKey(0)
+    n_pairs = tr.num_batches * cfg.batch_pairs
+    rates, loss = [], None
+    for ep in range(4):
+        t0 = time.perf_counter()
+        params, loss = tr.train_epoch(params, jax.random.fold_in(key, ep))
+        loss = float(loss)
+        if ep:
+            rates.append(n_pairs / (time.perf_counter() - t0))
+    return {
+        "group": group,
+        "block": block,
+        "pairs_per_sec": round(float(np.median(rates)), 1),
+        "final_loss": round(loss, 4),
+    }
+
+
+def quality(group: int, block: int) -> float:
+    from gene2vec_tpu.eval.holdout import holdout_cos_auc, load_holdout
+
+    hcorpus, split = load_holdout("/root/reference/predictionData")
+    emb, _ = train_epochs(
+        hcorpus,
+        SGNSConfig(
+            dim=200, batch_pairs=16384, strat_group=group, strat_block=block
+        ),
+        50,
+    )
+    return round(float(holdout_cos_auc(hcorpus.vocab, emb, split)), 4)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--geometries", default=DEFAULT_GEOMS)
+    ap.add_argument("--vocab", type=int, default=24447)
+    ap.add_argument("--pairs", type=int, default=4_000_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument(
+        "--quality", action="store_true",
+        help="also run the (slow) holdout-AUC protocol per geometry",
+    )
+    ap.add_argument(
+        "--out", default="experiments/results/geometry2_r4.json"
+    )
+    args = ap.parse_args()
+
+    rows = []
+    for spec in args.geometries.split(","):
+        g, b = (int(x) for x in spec.split(":"))
+        row = rate(g, b, args.vocab, args.pairs, args.batch)
+        if args.quality:
+            row["holdout_auc"] = quality(g, b)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(rows, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()
