@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
              "matmuls (0 disables; single-host stratified runs only)",
     )
     p.add_argument(
+        "--positive-mid", type=int, default=d.positive_mid,
+        help="second dense positive slab: rows [positive_head, "
+             "positive_head + positive_mid) also move via one-hot MXU "
+             "matmuls (6-class batch layout; 0 disables)",
+    )
+    p.add_argument(
         "--table-dtype", choices=("float32", "bfloat16"),
         default=d.table_dtype,
         help="emb/ctx storage width; bfloat16 = measured +7%% at "
@@ -121,6 +127,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         strat_group=args.strat_group,
         strat_block=args.strat_block,
         positive_head=args.positive_head,
+        positive_mid=args.positive_mid,
         table_dtype=args.table_dtype,
         hs_dense_depth=args.hs_dense_depth,
         vocab_sharded=args.vocab_sharded,

@@ -112,6 +112,18 @@ class SGNSConfig:
                                    # band; oracle 0.878) — sweep in
                                    # experiments/results/positive_head_r4*,
                                    # PERF_NOTES round 4.
+    positive_mid: int = 0          # second dense positive slab (round 5):
+                                   # rows [positive_head, positive_head +
+                                   # positive_mid) form a MID frequency
+                                   # band whose examples also move via
+                                   # one-hot MXU matmuls — batches become
+                                   # 6-class [HH|HM|HT|MM|MT|TT].  Each
+                                   # level's one-hot FLOPs scale with ITS
+                                   # example count x ITS slab width, so
+                                   # the mid band covers rows the single-
+                                   # level head could not afford (sweep:
+                                   # PERF_NOTES round 5).  0 disables
+                                   # (round-4 two-class layout).
     pos_layout_shards: int = 0     # dense-head batch layout: number of
                                    # per-device [HH|HT|TT] blocks per
                                    # batch.  0 = auto (the mesh's data-

@@ -153,18 +153,27 @@ def epoch_shuffle(
     return blocks[jax.random.permutation(blk_key, nblocks)].reshape(span, 2)
 
 
+def pool_class_pairs(n_classes: int):
+    """Canonical (class_a, class_b) per pool, a <= b, lexicographic — the
+    pool order :func:`segment_corpus_by_head` emits and
+    ``sgns/step.py:_pool_class_pairs`` consumes."""
+    return [(a, b) for a in range(n_classes) for b in range(a, n_classes)]
+
+
 def segment_corpus_by_head(
-    pairs: np.ndarray, head: int, batch_pairs: int, multiple: int = 1
-) -> Tuple[Tuple[np.ndarray, np.ndarray, np.ndarray], Tuple[int, int, int]]:
-    """Host-side class segmentation backing the dense-head positive path
-    (``sgns/step.py`` round 4): split the corpus into three pools by
-    whether each token falls in the frequency head (row < ``head`` of the
-    frequency-sorted vocab) — HH (both), HT (exactly one; canonicalized
-    head-token-first, a no-op under both-direction example emission), TT
-    (neither) — and compute static per-batch quotas (q1, q2, q3) summing
-    to ``batch_pairs`` so every batch carries the corpus's class mix at
-    fixed segment offsets.  The step can then gather/scatter head-token
-    rows as one-hot MXU matmuls over the contiguous ``table[:head]`` slab.
+    pairs: np.ndarray, head, batch_pairs: int, multiple: int = 1
+) -> Tuple[Tuple[np.ndarray, ...], Tuple[int, ...]]:
+    """Host-side class segmentation backing the dense-slab positive path
+    (``sgns/step.py`` rounds 4-5): classify each token by which frequency
+    band it falls in (``head`` is one boundary — classes head/tail — or an
+    ascending boundary sequence, e.g. ``(512, 4608)`` for
+    head/mid/tail), split the corpus into one pool per unordered class
+    pair (pairs canonicalized lower-class-token-first, a no-op under
+    both-direction example emission; :func:`pool_class_pairs` order), and
+    compute static per-batch quotas summing to ``batch_pairs`` so every
+    batch carries the corpus's class mix at fixed segment offsets.  The
+    step can then gather/scatter slab-class rows as one-hot MXU matmuls
+    over the contiguous ``table[lo:hi]`` slabs.
 
     Quotas are floors of each pool's share of ``num_batches`` batches;
     rounding leftovers are settled deterministically (largest-pool
@@ -188,15 +197,21 @@ def segment_corpus_by_head(
             f"batch_pairs={batch_pairs} must be a positive multiple of "
             f"multiple={multiple}"
         )
+    boundaries = np.atleast_1d(np.asarray(head, dtype=np.int64))
+    if boundaries.ndim != 1 or np.any(np.diff(boundaries) <= 0):
+        raise ValueError(f"head boundaries must be ascending, got {head}")
+    n_classes = len(boundaries) + 1
     num_batches = pairs.shape[0] // batch_pairs
-    a_head = pairs[:, 0] < head
-    b_head = pairs[:, 1] < head
-    hh = pairs[a_head & b_head]
-    tt = pairs[~a_head & ~b_head]
-    ht = pairs[a_head ^ b_head].copy()
-    swap = ht[:, 0] >= head
-    ht[swap] = ht[swap][:, ::-1]
-    pools = [hh, ht, tt]
+    # token class = number of boundaries <= token (0 = hottest band)
+    cls = np.searchsorted(boundaries, pairs, side="right")
+    swap = cls[:, 0] > cls[:, 1]
+    canon = pairs.copy()
+    canon[swap] = canon[swap][:, ::-1]
+    cls.sort(axis=1)
+    pools = [
+        canon[(cls[:, 0] == a) & (cls[:, 1] == b)]
+        for a, b in pool_class_pairs(n_classes)
+    ]
 
     # every non-empty class gets quota >= multiple: a pool smaller than
     # one row per batch(-block) would otherwise round to 0 and its pairs
@@ -244,7 +259,7 @@ def segment_corpus_by_head(
 
 def segment_corpus_by_head_multihost(
     pairs_full: np.ndarray,
-    head: int,
+    head,
     batch_pairs: int,
     multiple: int,
     index: int,
@@ -268,6 +283,15 @@ def segment_corpus_by_head_multihost(
     max(floor-share, coverage need), rounded to the per-host device
     multiple — trimming or wrap-padding the local shard as needed.
     Returns (local_pools, quotas, num_batches).
+
+    Trimming note: unlike the single-host path (whose per-epoch roll
+    eventually reaches every pool row), the ``local[:target]`` trim drops
+    up to ~one device-multiple of rows per pool per host PERMANENTLY —
+    the epoch roll cycles within the trimmed shard.  This is the same
+    order of loss as :meth:`PairCorpus.process_shard`'s documented
+    ``num_pairs // count`` trim (< count + multiple rows out of millions)
+    and is accepted for the same reason: equal per-host lengths are what
+    keep every host compiling the same program (docs/DISTRIBUTED.md).
     """
     if count < 1 or not 0 <= index < count:
         raise ValueError(f"bad process coordinates {index}/{count}")

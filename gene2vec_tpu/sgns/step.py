@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 
 from gene2vec_tpu.data.negative_sampling import NoiseTable, sample_negatives
+from gene2vec_tpu.data.pipeline import pool_class_pairs as _pool_class_pairs
 from gene2vec_tpu.sgns.model import SGNSParams
 
 
@@ -366,116 +367,147 @@ def _step_shared(
 _DENSE_HEAD_PRECISION = None
 
 
-def _dense_head_segments(q1: int, q2: int, b: int):
-    """Static (start, length) example segments for the [HH|HT|TT] batch
-    layout (``data/pipeline.segment_corpus_by_head``): q1 HH pairs, q2 HT
-    pairs (head token first), q3 = b - q1 - q2 TT pairs, emitted in both
-    directions so example i and i + b are the two directions of pair i.
+def _dense_segments(quotas, b: int, n_classes: int):
+    """Static per-CLASS (start, length) example segments for the
+    class-segmented batch layout (``data/pipeline.segment_corpus_by_head``):
+    ``quotas[p]`` pairs of pool p (pools in :func:`_pool_class_pairs`
+    order), emitted in both directions so example i and i + b are the two
+    directions of pair i.
 
     Segments index the LOCAL example axis of the (shards, 2b) view — under
-    data parallelism each device block carries its own [HH|HT|TT] layout
-    with per-device quotas, so every slice below stays device-local and
-    the head matmuls reduce over the shard axis (XLA's psum over ICI).
+    data parallelism each device block carries its own class layout with
+    per-device quotas, so every slice below stays device-local and the
+    slab matmuls reduce over the shard axis (XLA's psum over ICI).
 
-    Returns (center_head, center_tail, context_head, context_tail), each a
-    tuple of segments in ascending position order.
+    Returns (center_segs, context_segs): each a tuple of n_classes tuples
+    of (start, length) segments in ascending position order (adjacent
+    same-class segments merged).  The last class is the tail (plain
+    gathers); the rest are dense slabs.
     """
-    q3 = b - q1 - q2
-    center_head = ((0, q1 + q2), (b, q1))
-    center_tail = ((q1 + q2, q3), (b + q1, q2 + q3))
-    context_head = ((0, q1), (b, q1 + q2))
-    context_tail = ((q1, q2 + q3), (b + q1 + q2, q3))
-    return center_head, center_tail, context_head, context_tail
+    pcs = _pool_class_pairs(n_classes)
+    assert len(quotas) == len(pcs), (quotas, pcs)
+    center = [[] for _ in range(n_classes)]
+    context = [[] for _ in range(n_classes)]
+    off = 0
+    for (ca, cb), q in zip(pcs, quotas):
+        if q:
+            center[ca].append((off, q))       # forward: centers = first
+            context[cb].append((off, q))
+            center[cb].append((b + off, q))   # reverse direction
+            context[ca].append((b + off, q))
+        off += q
+    assert off == b, (quotas, b)
+
+    def merge(segs):
+        out = []
+        for s, l in sorted(segs):
+            if out and out[-1][0] + out[-1][1] == s:
+                out[-1] = (out[-1][0], out[-1][1] + l)
+            else:
+                out.append((s, l))
+        return tuple((s, l) for s, l in out)
+
+    return tuple(merge(c) for c in center), tuple(merge(x) for x in context)
 
 
-def _segment_split(x: jax.Array, head_segs, tail_segs):
+def _split_classes(x: jax.Array, seg_lists):
     """Split the local example axis (axis 1) of ``x`` (shards, local, ...)
-    into head/tail parts, each part's segments concatenated in order."""
-    xh = jnp.concatenate([x[:, s : s + l] for s, l in head_segs], axis=1)
-    xt = jnp.concatenate([x[:, s : s + l] for s, l in tail_segs], axis=1)
-    return xh, xt
+    into per-class parts, each part's segments concatenated in order."""
+    return [
+        jnp.concatenate([x[:, s : s + l] for s, l in segs], axis=1)
+        if segs
+        else x[:, :0]
+        for segs in seg_lists
+    ]
 
 
-def _segment_join(head_part, tail_part, head_segs, tail_segs):
-    """Inverse of :func:`_segment_split`: reassemble local example order.
-    Segments alternate head/tail by construction."""
+def _join_classes(parts, seg_lists):
+    """Inverse of :func:`_split_classes`: reassemble local example order."""
+    tagged = sorted(
+        (s, l, c) for c, segs in enumerate(seg_lists) for s, l in segs
+    )
     pieces = []
-    oh = ot = 0
-    for (hs, hl), (ts, tl) in zip(head_segs, tail_segs):
-        pieces.append(head_part[:, oh : oh + hl])
-        pieces.append(tail_part[:, ot : ot + tl])
-        oh += hl
-        ot += tl
+    offs = [0] * len(seg_lists)
+    for s, l, c in tagged:
+        pieces.append(parts[c][:, offs[c] : offs[c] + l])
+        offs[c] += l
     return jnp.concatenate(pieces, axis=1)
 
 
-def _dense_head_gather(
+def _dense_slab_gather(
     table: jax.Array,   # (V, D)
-    idx: jax.Array,     # (S, L) — head segments guaranteed < head
-    head: int,
-    head_segs,
-    tail_segs,
+    idx: jax.Array,     # (S, L) — slab-class segments guaranteed in-slab
+    slabs,              # tuple of (lo, hi) row ranges, one per dense class
+    seg_lists,          # per-class segments from _dense_segments
     compute_dtype,
 ):
-    """Gather ``table[idx]`` with head-segment rows produced by a one-hot
-    MXU matmul against the contiguous ``table[:head]`` slab — zero dynamic
-    row ops for head examples (the positive-side analogue of the stratified
-    noise head; docs/PERF_NOTES.md round 4).  Returns (rows (S, L, D),
-    onehot (S, Lh, head), idx_tail (S, Lt)) — the one-hot is reused by
-    :func:`_dense_head_scatter_acc` for the update direction.
+    """Gather ``table[idx]`` with slab-class rows produced by one-hot MXU
+    matmuls against the contiguous ``table[lo:hi]`` slabs — zero dynamic
+    row ops for slab examples (the positive-side analogue of the
+    stratified noise head; docs/PERF_NOTES.md rounds 4-5).  Each level's
+    one-hot FLOPs scale with ITS example count x ITS slab width, which is
+    what lets a second mid slab cover rows the single-level head could
+    not afford (coverage grows logarithmically but single-level FLOPs grow
+    with all head examples).  Returns (rows (S, L, D), onehots per slab,
+    idx_tail (S, Lt)) — the one-hots are reused by
+    :func:`_dense_slab_scatter_acc` for the update direction.
     """
-    idx_h, idx_t = _segment_split(idx, head_segs, tail_segs)
-    onehot = (idx_h[:, :, None] == jnp.arange(head)[None, None, :]).astype(
-        compute_dtype
-    )
-    rows_h = jax.lax.dot_general(
-        onehot,
-        table[:head].astype(compute_dtype),
-        (((2,), (0,)), ((), ())),
-        precision=_DENSE_HEAD_PRECISION,
-        preferred_element_type=compute_dtype,
-    )                                                   # (S, Lh, D)
-    rows_t = table[idx_t].astype(compute_dtype)         # (S, Lt, D)
-    return (
-        _segment_join(rows_h, rows_t, head_segs, tail_segs),
-        onehot,
-        idx_t,
-    )
+    parts = _split_classes(idx, seg_lists)
+    onehots = []
+    row_parts = []
+    for (lo, hi), idx_c in zip(slabs, parts[:-1]):
+        onehot = (
+            idx_c[:, :, None] == jnp.arange(lo, hi)[None, None, :]
+        ).astype(compute_dtype)
+        rows = jax.lax.dot_general(
+            onehot,
+            table[lo:hi].astype(compute_dtype),
+            (((2,), (0,)), ((), ())),
+            precision=_DENSE_HEAD_PRECISION,
+            preferred_element_type=compute_dtype,
+        )                                               # (S, Lc, D)
+        onehots.append(onehot)
+        row_parts.append(rows)
+    idx_t = parts[-1]
+    row_parts.append(table[idx_t].astype(compute_dtype))  # (S, Lt, D)
+    return _join_classes(row_parts, seg_lists), onehots, idx_t
 
 
-def _dense_head_scatter_acc(
+def _dense_slab_scatter_acc(
     v_size: int,
     grads: jax.Array,     # (S, L, D) per-example gradients
     weights: jax.Array,   # (S, L) example-unit weights
-    onehot: jax.Array,    # (S, Lh, head) from _dense_head_gather
+    onehots,              # per-slab one-hots from _dense_slab_gather
     idx_tail: jax.Array,  # (S, Lt)
-    head_segs,
-    tail_segs,
+    slabs,                # tuple of (lo, hi) row ranges, one per dense class
+    seg_lists,
     acc_dtype,
 ) -> jax.Array:
-    """(V, D+1) accumulator for the dense-head path: tail rows scatter as
-    usual; head rows land as ONE (head, S·Lh) x (S·Lh, D+1) MXU
-    contraction added densely to the accumulator's head slab (exact f32
-    accumulation of bf16-truncated payload rows under the default
-    policy).  Both the tail scatter and the shard-axis contraction reduce
+    """(V, D+1) accumulator for the dense-slab path: tail rows scatter as
+    usual; each slab's rows land as ONE (W, S·Lc) x (S·Lc, D+1) MXU
+    contraction added densely to the accumulator's [lo, hi) slab (exact
+    f32 accumulation of bf16-truncated payload rows under the default
+    policy).  Both the tail scatter and the shard-axis contractions reduce
     over ``S`` — under data parallelism XLA emits that reduction as the
     gradient psum."""
     d = grads.shape[-1]
     payload = jnp.concatenate(
         [grads, weights.astype(grads.dtype)[:, :, None]], axis=2
     )
-    pay_h, pay_t = _segment_split(payload, head_segs, tail_segs)
+    parts = _split_classes(payload, seg_lists)
     acc = jnp.zeros((v_size, d + 1), acc_dtype).at[
         idx_tail.reshape(-1)
-    ].add(pay_t.reshape(-1, d + 1).astype(acc_dtype))
-    head_rows = jax.lax.dot_general(
-        onehot,
-        pay_h,
-        (((0, 1), (0, 1)), ((), ())),                   # contract S, Lh
-        precision=_DENSE_HEAD_PRECISION,
-        preferred_element_type=acc_dtype,
-    )                                                   # (head, D+1)
-    return acc.at[: onehot.shape[2]].add(head_rows.astype(acc_dtype))
+    ].add(parts[-1].reshape(-1, d + 1).astype(acc_dtype))
+    for (lo, hi), onehot, pay in zip(slabs, onehots, parts[:-1]):
+        slab_rows = jax.lax.dot_general(
+            onehot,
+            pay,
+            (((0, 1), (0, 1)), ((), ())),               # contract S, Lc
+            precision=_DENSE_HEAD_PRECISION,
+            preferred_element_type=acc_dtype,
+        )                                               # (hi - lo, D+1)
+        acc = acc.at[lo:hi].add(slab_rows.astype(acc_dtype))
+    return acc
 
 
 def _aggregate_tail_blocks(
@@ -522,7 +554,8 @@ def _step_stratified(
     compute_dtype,
     combiner: str,
     pos_head: int = 0,
-    pos_quotas=None,  # (q1, q2) static HH/HT pair counts of the batch layout
+    pos_mid: int = 0,  # second dense slab [pos_head, pos_head + pos_mid)
+    pos_quotas=None,  # static per-pool pair counts of the batch layout
     pos_shards: int = 1,  # data-parallel device blocks in the batch layout
 ) -> Tuple[SGNSParams, jax.Array]:
     """Stratified negatives: exact head + per-group random tail blocks.
@@ -590,18 +623,20 @@ def _step_stratified(
     # stratified noise head).
     dense_pos = pos_head > 0 and pos_quotas is not None
     if dense_pos:
-        q1, q2 = pos_quotas
         s = pos_shards
-        c_head, c_tail, x_head, x_tail = _dense_head_segments(
-            q1 // s, q2 // s, e // (2 * s)
+        slabs = [(0, pos_head)]
+        if pos_mid > 0:
+            slabs.append((pos_head, pos_head + pos_mid))
+        c_segs, x_segs = _dense_segments(
+            [q // s for q in pos_quotas], e // (2 * s), len(slabs) + 1
         )
         centers2 = centers.reshape(s, e // s)
         contexts2 = contexts.reshape(s, e // s)
-        v2, oh_c, idx_ct = _dense_head_gather(
-            emb_t, centers2, pos_head, c_head, c_tail, compute_dtype
+        v2, oh_c, idx_ct = _dense_slab_gather(
+            emb_t, centers2, slabs, c_segs, compute_dtype
         )
-        u2, oh_x, idx_xt = _dense_head_gather(
-            ctx_t, contexts2, pos_head, x_head, x_tail, compute_dtype
+        u2, oh_x, idx_xt = _dense_slab_gather(
+            ctx_t, contexts2, slabs, x_segs, compute_dtype
         )
         v = v2.reshape(e, d)
         u_pos = u2.reshape(e, d)
@@ -660,10 +695,10 @@ def _step_stratified(
     )
     acc_dtype = _acc_dtype_for(compute_dtype)
     if dense_pos:
-        acc_emb = _dense_head_scatter_acc(
+        acc_emb = _dense_slab_scatter_acc(
             v_size, d_center.reshape(s, e // s, d),
             jnp.ones((s, e // s), compute_dtype),
-            oh_c, idx_ct, c_head, c_tail, acc_dtype,
+            oh_c, idx_ct, slabs, c_segs, acc_dtype,
         )
         emb = _finalize_row_updates(emb_t, acc_emb, lr, combiner)
     else:
@@ -676,10 +711,10 @@ def _step_stratified(
     # ---- ctx: positive scatter + DENSE noise adds into ONE accumulator ---
     d_pos = g_pos[:, None] * v
     if dense_pos:
-        acc = _dense_head_scatter_acc(
+        acc = _dense_slab_scatter_acc(
             v_size, d_pos.reshape(s, e // s, d),
             jnp.ones((s, e // s), compute_dtype),
-            oh_x, idx_xt, x_head, x_tail, acc_dtype,
+            oh_x, idx_xt, slabs, x_segs, acc_dtype,
         )
     else:
         acc = _scatter_accumulator(
@@ -736,8 +771,9 @@ def sgns_step(
     strat_group: int = 32,
     stratified=None,  # StratifiedSpec, required for negative_mode="stratified"
     positive_head: int = 0,
-    pos_quotas=None,  # static (q1, q2): HH/HT pair counts of the batch layout
-    pos_shards: int = 1,  # per-device [HH|HT|TT] blocks (data parallelism)
+    positive_mid: int = 0,  # second dense slab [head, head + mid)
+    pos_quotas=None,  # static per-pool pair counts of the batch layout
+    pos_shards: int = 1,  # per-device class blocks (data parallelism)
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
     dense_pos = positive_head > 0 and pos_quotas is not None
@@ -749,12 +785,27 @@ def sgns_step(
             )
         if not both_directions:
             raise ValueError(
-                "positive_head requires both_directions=True (the [HH|HT|TT]"
-                " batch layout emits both directions of each pair)"
+                "positive_head requires both_directions=True (the class-"
+                "segmented batch layout emits both directions of each pair)"
             )
         b = int(pairs.shape[0])
-        q1, q2 = pos_quotas
-        if any(q % pos_shards for q in (q1, q2, b)):
+        n_classes = 3 if positive_mid > 0 else 2
+        n_pools = len(_pool_class_pairs(n_classes))
+        if len(pos_quotas) != n_pools:
+            raise ValueError(
+                f"pos_quotas {pos_quotas} must have {n_pools} entries (one "
+                f"per {n_classes}-class pool of "
+                "data/pipeline.segment_corpus_by_head)"
+            )
+        if any(q < 0 for q in pos_quotas) or sum(pos_quotas) != b:
+            # inconsistent quotas would flow into _dense_segments where
+            # Python slice clamping can silently misattribute examples to
+            # the wrong segment instead of raising
+            raise ValueError(
+                f"pos_quotas {pos_quotas} inconsistent with batch {b}: "
+                "need every quota >= 0 and sum(pos_quotas) == batch_pairs"
+            )
+        if any(q % pos_shards for q in (*pos_quotas, b)):
             raise ValueError(
                 f"pos_quotas {pos_quotas} / batch {b} must be divisible by "
                 f"pos_shards={pos_shards} (per-device segment layout)"
@@ -781,8 +832,8 @@ def sgns_step(
         return _step_stratified(
             params, centers, contexts, stratified, key, negatives,
             group_size, lr, compute_dtype, combiner,
-            pos_head=positive_head, pos_quotas=pos_quotas,
-            pos_shards=pos_shards,
+            pos_head=positive_head, pos_mid=positive_mid,
+            pos_quotas=pos_quotas, pos_shards=pos_shards,
         )
     if negative_mode == "shared":
         e = int(centers.shape[0])

@@ -44,6 +44,15 @@ if TYPE_CHECKING:  # runtime import would cycle through gene2vec_tpu.parallel
     from gene2vec_tpu.parallel.sharding import SGNSSharding
 
 
+def _positive_boundaries(config: SGNSConfig):
+    """Frequency-band boundaries for the dense-slab positive layout: one
+    boundary (head/tail) or two (head/mid/tail) when ``positive_mid`` adds
+    the second slab (sgns/step.py round 5)."""
+    if config.positive_mid > 0:
+        return (config.positive_head, config.positive_head + config.positive_mid)
+    return config.positive_head
+
+
 def make_train_epoch(
     num_pairs: int,
     num_batches: int,
@@ -67,6 +76,7 @@ def make_train_epoch(
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
     positive_head = config.positive_head if pos_quotas is not None else 0
+    positive_mid = config.positive_mid if pos_quotas is not None else 0
 
     def train_epoch(params, pairs, noise, key):
         shuffle_key, step_key = jax.random.split(key)
@@ -123,9 +133,8 @@ def make_train_epoch(
                 strat_group=config.strat_group,
                 stratified=stratified,
                 positive_head=positive_head,
-                pos_quotas=(
-                    pos_quotas[:2] if pos_quotas is not None else None
-                ),
+                positive_mid=positive_mid,
+                pos_quotas=pos_quotas,
                 pos_shards=pos_shards,
             )
             if sharding is not None:
@@ -206,8 +215,7 @@ class SGNSTrainer:
         if config.shuffle_mode not in ("offset", "full"):
             raise ValueError(f"unknown shuffle_mode {config.shuffle_mode!r}")
         config, self.pos_shards = self._resolve_positive_head(
-            config, corpus, sharding,
-            have_full_corpus=full_corpus is not None,
+            config, corpus, sharding, full_corpus=full_corpus,
         )
         dense_multihost = config.positive_head > 0 and self._procs > 1
         if config.shuffle_mode == "offset" and not dense_multihost:
@@ -236,8 +244,9 @@ class SGNSTrainer:
                 fc = host_preshuffle(fc, config.seed)
             local_pools, self.pos_quotas, self.num_batches = (
                 segment_corpus_by_head_multihost(
-                    fc.pairs, config.positive_head, config.batch_pairs,
-                    self.pos_shards, jax.process_index(), self._procs,
+                    fc.pairs, _positive_boundaries(config),
+                    config.batch_pairs, self.pos_shards,
+                    jax.process_index(), self._procs,
                 )
             )
             self.global_num_pairs = self.num_batches * config.batch_pairs
@@ -251,8 +260,8 @@ class SGNSTrainer:
             )
         elif config.positive_head > 0:
             pools, self.pos_quotas = segment_corpus_by_head(
-                corpus.pairs, config.positive_head, config.batch_pairs,
-                multiple=self.pos_shards,
+                corpus.pairs, _positive_boundaries(config),
+                config.batch_pairs, multiple=self.pos_shards,
             )
             if sharding is not None:
                 # pools live row-sharded over data like the plain corpus
@@ -307,7 +316,7 @@ class SGNSTrainer:
 
     @staticmethod
     def _resolve_positive_head(
-        config, corpus, sharding, have_full_corpus=False
+        config, corpus, sharding, full_corpus=None
     ):
         """Gate the dense-head positive path: returns (config, pos_shards)
         with ``positive_head`` clamped to the vocab, or set to 0 (with a
@@ -326,14 +335,25 @@ class SGNSTrainer:
                 f"positive_head (dense-head positives) disabled: {msg}",
                 stacklevel=3,
             )
-            return dataclasses.replace(config, positive_head=0), 1
+            return dataclasses.replace(
+                config, positive_head=0, positive_mid=0
+            ), 1
 
         if config.positive_head <= 0:
-            return config, 1
+            if config.positive_mid > 0:
+                warnings.warn(
+                    "positive_mid > 0 has no effect without positive_head "
+                    "> 0 (the mid slab extends the dense-head batch "
+                    "layout); running the plain-gather path",
+                    stacklevel=3,
+                )
+            return dataclasses.replace(config, positive_mid=0), 1
         if config.negative_mode != "stratified" or not config.both_directions:
             # silent: these configs never supported the dense path
-            return dataclasses.replace(config, positive_head=0), 1
-        if jax.process_count() > 1 and not have_full_corpus:
+            return dataclasses.replace(
+                config, positive_head=0, positive_mid=0
+            ), 1
+        if jax.process_count() > 1 and full_corpus is None:
             return disabled(
                 "multi-host run without full_corpus — per-host corpus "
                 "shards would derive mismatched segment quotas; pass the "
@@ -353,17 +373,33 @@ class SGNSTrainer:
             # explicit layout override (sharded-vs-unsharded parity tests
             # reproduce a mesh layout on one device)
             shards = config.pos_layout_shards
-        if config.batch_pairs % shards or config.batch_pairs < 3 * shards:
+        head = min(config.positive_head, corpus.vocab_size)
+        mid = min(max(config.positive_mid, 0), corpus.vocab_size - head)
+        # every NON-EMPTY class-pair pool needs quota >= shards, so the
+        # batch must cover shards x (pools actually present in the pairs
+        # the segmentation will classify — the FULL corpus on multi-host
+        # runs, where a class pair absent from one host's shard but
+        # present globally must not make hosts' gates diverge (they would
+        # compile different programs and deadlock the collectives)
+        seg_pairs = (
+            full_corpus.pairs if full_corpus is not None else corpus.pairs
+        )
+        bounds = np.asarray(
+            (head, head + mid) if mid > 0 else (head,), dtype=np.int64
+        )
+        cls = np.searchsorted(bounds, seg_pairs, side="right")
+        n_pools = len(
+            np.unique(cls.min(axis=1) * (len(bounds) + 1) + cls.max(axis=1))
+        )
+        if config.batch_pairs % shards or config.batch_pairs < n_pools * shards:
             return disabled(
                 f"batch_pairs={config.batch_pairs} cannot form {shards} "
-                "uniform [HH|HT|TT] device blocks (needs a multiple of "
-                f"{shards}, at least {3 * shards})"
+                "uniform class-segmented device blocks over the corpus's "
+                f"{n_pools} class pools (needs a multiple of {shards}, at "
+                f"least {n_pools * shards})"
             )
         return (
-            dataclasses.replace(
-                config,
-                positive_head=min(config.positive_head, corpus.vocab_size),
-            ),
+            dataclasses.replace(config, positive_head=head, positive_mid=mid),
             shards,
         )
 

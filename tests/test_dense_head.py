@@ -36,7 +36,8 @@ def _zipf_corpus(v, n, seed=0):
 
 
 def _segmented_batch(v, b, head, seed=0):
-    """One [HH|HT|TT]-ordered batch + its (q1, q2) quotas."""
+    """One class-segmented batch + its per-pool quotas (``head`` may be a
+    boundary tuple for the 3-class head/mid/tail layout)."""
     corpus = _zipf_corpus(v, b, seed)
     pools, quotas = segment_corpus_by_head(corpus.pairs, head, b)
     batch = np.concatenate([p[:q] for p, q in zip(pools, quotas)], axis=0)
@@ -69,7 +70,7 @@ def test_dense_head_step_matches_scatter(head, monkeypatch):
     p_ref, loss_ref = sgns_step(params, batch, noise, key, lr, **kw)
     p_dense, loss_dense = sgns_step(
         params, batch, noise, key, lr,
-        positive_head=head, pos_quotas=quotas[:2], **kw,
+        positive_head=head, pos_quotas=quotas, **kw,
     )
     np.testing.assert_allclose(
         float(loss_dense), float(loss_ref), rtol=1e-5
@@ -80,6 +81,93 @@ def test_dense_head_step_matches_scatter(head, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(p_dense.ctx), np.asarray(p_ref.ctx), atol=2e-6
     )
+
+
+@pytest.mark.parametrize("bounds", [(8, 24), (16, 64), (8, 200)])
+def test_dense_mid_step_matches_scatter(bounds, monkeypatch):
+    """The 3-class head/mid/tail layout (positive_mid > 0, round 5) must
+    equal the plain path on the same 6-class-segmented batch — the mid
+    slab is the same per-example update re-grouped through a second
+    one-hot contraction."""
+    monkeypatch.setattr(
+        step_mod, "_DENSE_HEAD_PRECISION", jax.lax.Precision.HIGHEST
+    )
+    v, d, b = 257, 16, 128
+    corpus, batch, quotas = _segmented_batch(v, b, bounds)
+    assert len(quotas) == 6 and sum(quotas) == b
+    spec = build_stratified_spec(corpus.vocab.counts, 32, 64, 0.75)
+    noise = NoiseTable(
+        prob=jnp.ones((v,)) / v, alias=jnp.arange(v, dtype=jnp.int32)
+    )
+    params = init_params(jax.random.PRNGKey(0), v, d, jnp.float32)
+    key = jax.random.PRNGKey(7)
+    lr = jnp.asarray(0.05, jnp.float32)
+    kw = dict(
+        negatives=5, combiner="capped", negative_mode="stratified",
+        strat_group=32, stratified=spec,
+    )
+    p_ref, loss_ref = sgns_step(params, batch, noise, key, lr, **kw)
+    p_dense, loss_dense = sgns_step(
+        params, batch, noise, key, lr,
+        positive_head=bounds[0], positive_mid=bounds[1] - bounds[0],
+        pos_quotas=quotas, **kw,
+    )
+    np.testing.assert_allclose(float(loss_dense), float(loss_ref), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p_dense.emb), np.asarray(p_ref.emb), atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(p_dense.ctx), np.asarray(p_ref.ctx), atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("shards", [1, 4])
+def test_dense_slab_gather_scatter_roundtrip(shards, monkeypatch):
+    """Unit test of the multi-slab primitives (order-independent): the
+    gather must equal table[idx] and the scatter-accumulator must equal a
+    plain per-row scatter, for a 3-class per-shard segment layout."""
+    monkeypatch.setattr(
+        step_mod, "_DENSE_HEAD_PRECISION", jax.lax.Precision.HIGHEST
+    )
+    rng = np.random.RandomState(0)
+    v, d = 300, 8
+    h1, h2 = 16, 80
+    slabs = [(0, h1), (h1, h2)]
+    quotas = [4, 6, 2, 8, 4, 8]  # per-pool PAIR counts per shard
+    b = sum(quotas)
+    c_segs, x_segs = step_mod._dense_segments(quotas, b, 3)
+    # build an index array honoring the center-class layout
+    bands = [(0, h1), (h1, h2), (h2, v)]
+
+    def fill(seg_lists):
+        idx = np.zeros((shards, 2 * b), dtype=np.int32)
+        for c, segs in enumerate(seg_lists):
+            lo, hi = bands[c]
+            for s, l in segs:
+                idx[:, s : s + l] = rng.randint(lo, hi, size=(shards, l))
+        return idx
+
+    idx = fill(c_segs)
+    table = jnp.asarray(rng.randn(v, d).astype(np.float32))
+    rows, onehots, idx_tail = step_mod._dense_slab_gather(
+        table, jnp.asarray(idx), slabs, c_segs, jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(rows), np.asarray(table)[idx], atol=1e-6
+    )
+    grads = jnp.asarray(rng.randn(shards, 2 * b, d).astype(np.float32))
+    weights = jnp.ones((shards, 2 * b), jnp.float32)
+    acc = step_mod._dense_slab_scatter_acc(
+        v, grads, weights, onehots, idx_tail, slabs, c_segs, jnp.float32
+    )
+    ref = step_mod._scatter_accumulator(
+        v,
+        jnp.asarray(idx.reshape(-1)),
+        grads.reshape(-1, d),
+        weights.reshape(-1),
+        jnp.float32,
+    )
+    np.testing.assert_allclose(np.asarray(acc), np.asarray(ref), atol=1e-4)
 
 
 def test_dense_head_default_precision_close():
@@ -102,7 +190,7 @@ def test_dense_head_default_precision_close():
     )
     p_dense, loss_dense = sgns_step(
         params, batch, noise, key, jnp.float32(0.05),
-        positive_head=head, pos_quotas=quotas[:2], **kw,
+        positive_head=head, pos_quotas=quotas, **kw,
     )
     assert abs(float(loss_dense) - float(loss_ref)) < 2e-2
     np.testing.assert_allclose(
