@@ -1,0 +1,99 @@
+"""Round 5: sweep the second dense positive slab (config.positive_mid).
+
+The single-level head sweep (positive_head_sweep.py) capped at H=512
+because one-hot FLOPs grow with ALL head examples while coverage grows
+logarithmically.  The mid slab [head, head+mid) pays its width only for
+mid-band examples (Zipf: each octave past the head covers ~5-7% of
+occurrences at a shrinking example count), so the trade is different:
+expected win = covered tail row ops (32 ns/occurrence) minus the mid
+one-hot contraction cost (E_mid x mid x (D+1) MACs x 4 ops).
+
+Measures integrated-trainer throughput at the bench headline shape
+(V=24,447 Zipf, 4M pairs, B=16,384, dim 200, stratified negatives).
+
+Run: python experiments/positive_mid_sweep.py [--combos 512:0,512:4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from bench import synth_corpus  # the bench's own corpus recipe
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.sgns.train import SGNSTrainer
+
+
+def measure(head: int, mid: int, v: int, n: int, b: int, dim: int,
+            epochs: int = 3):
+    corpus = synth_corpus(v, n)
+    cfg = SGNSConfig(dim=dim, batch_pairs=b, positive_head=head,
+                     positive_mid=mid)
+    trainer = SGNSTrainer(corpus, cfg)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    pairs_per_epoch = trainer.num_batches * cfg.batch_pairs
+    rates, loss = [], None
+    for ep in range(epochs + 1):  # first epoch includes compile
+        t0 = time.perf_counter()
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, ep))
+        loss = float(loss)  # sync
+        dt = time.perf_counter() - t0
+        if ep:
+            rates.append(pairs_per_epoch / dt)
+    return {
+        "head": head,
+        "mid": mid,
+        "pairs_per_sec": round(float(np.median(rates)), 1),
+        "rates": [round(r, 1) for r in rates],
+        "final_loss": round(loss, 4),
+        "quotas": trainer.pos_quotas,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--combos",
+        default="512:0,512:2048,512:4096,512:8192,1024:4096,256:4352,512:0",
+        help="comma-separated head:mid pairs (trailing repeat of the "
+             "baseline gauges in-process device-state drift)",
+    )
+    ap.add_argument("--vocab", type=int, default=24447)
+    ap.add_argument("--pairs", type=int, default=4_000_000)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--dim", type=int, default=200)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument(
+        "--out", default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results", "positive_mid_r5.json",
+        ),
+    )
+    args = ap.parse_args()
+    results = []
+    for combo in args.combos.split(","):
+        head, mid = (int(x) for x in combo.split(":"))
+        print(f"head={head} mid={mid} ...", flush=True)
+        r = measure(head, mid, args.vocab, args.pairs, args.batch,
+                    args.dim, args.epochs)
+        print(f"  {r['pairs_per_sec']:,.0f} pairs/s  loss={r['final_loss']}",
+              flush=True)
+        results.append(r)
+    with open(args.out, "w") as f:
+        json.dump({"device": str(jax.devices()[0]), "results": results}, f,
+                  indent=2)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,14 +40,24 @@ class SGNSConfig:
                                    # buys +7% throughput at MEASURED
                                    # parity on the real-scale protocol
                                    # (holdout AUC 0.8897 vs f32's
-                                   # 0.8896, dim 200, B=16,384) but is
-                                   # NOT the default: at small scales
-                                   # (tiny corpora/dims, the smoke-test
-                                   # regime) per-step updates round away
-                                   # against bf16 weights (update <
-                                   # |w|/256 absorbs) and the embedding
-                                   # fails to learn — f32 is the safe
-                                   # width everywhere.
+                                   # 0.8896, dim 200, B=16,384).  Round 5
+                                   # made bf16 safe at ANY scale via
+                                   # stochastic-rounded write-back
+                                   # (bf16_stochastic_round below): the
+                                   # round-4 failure mode — updates <
+                                   # |w|/512 rounding away every step so
+                                   # small-scale runs never learn — is
+                                   # gone because the EXPECTED update
+                                   # equals the f32 update.
+    bf16_stochastic_round: bool = True
+                                   # bf16 tables: write back with 16
+                                   # random carry bits below the mantissa
+                                   # (sgns/step.py
+                                   # _stochastic_round_bf16) instead of
+                                   # round-to-nearest.  Untouched rows
+                                   # pass through bit-identically.
+                                   # False restores round-4 nearest
+                                   # rounding (for A/B comparisons).
     compute_dtype: str = "float32"
     both_directions: bool = True   # emit (a→b) and (b→a) per corpus pair
     combiner: str = "capped"       # duplicate-row gradients: "capped" (sum,
@@ -112,7 +122,7 @@ class SGNSConfig:
                                    # band; oracle 0.878) — sweep in
                                    # experiments/results/positive_head_r4*,
                                    # PERF_NOTES round 4.
-    positive_mid: int = 0          # second dense positive slab (round 5):
+    positive_mid: int = 2048       # second dense positive slab (round 5):
                                    # rows [positive_head, positive_head +
                                    # positive_mid) form a MID frequency
                                    # band whose examples also move via
@@ -121,9 +131,13 @@ class SGNSConfig:
                                    # level's one-hot FLOPs scale with ITS
                                    # example count x ITS slab width, so
                                    # the mid band covers rows the single-
-                                   # level head could not afford (sweep:
-                                   # PERF_NOTES round 5).  0 disables
-                                   # (round-4 two-class layout).
+                                   # level head could not afford.  Sweep
+                                   # (v5e, V=24,447 Zipf, B=16,384,
+                                   # PERF_NOTES round 5): 2048 = 6.31 and
+                                   # 6.34M pairs/s across two runs vs
+                                   # 5.81-5.93M at mid=0; 6.24M fresh-
+                                   # process.  0 disables (round-4
+                                   # two-class layout).
     pos_layout_shards: int = 0     # dense-head batch layout: number of
                                    # per-device [HH|HT|TT] blocks per
                                    # batch.  0 = auto (the mesh's data-

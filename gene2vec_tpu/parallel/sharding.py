@@ -72,6 +72,18 @@ class SGNSSharding:
             ctx=jax.lax.with_sharding_constraint(params.ctx, s),
         )
 
+    def constrain_acc(self, acc: jax.Array) -> jax.Array:
+        """Pin the step's (V, D+1) gradient accumulator to the TABLE's row
+        sharding.  Without this the SPMD partitioner materializes the
+        accumulator replicated under vocab-sharded tables and ALL-REDUCES
+        it — ~200 MB/step at dim=512 on the 8-way mesh, the dominant
+        collective in the round-5 HLO audit
+        (experiments/results/hlo_comm_r5.json); constrained, the scatter
+        lowers to masked local updates on the owning shards."""
+        return jax.lax.with_sharding_constraint(
+            acc, NamedSharding(self.mesh, self.param_spec())
+        )
+
 
 def no_sharding() -> Optional[SGNSSharding]:
     """Single-device marker (constraints become no-ops in the trainer)."""

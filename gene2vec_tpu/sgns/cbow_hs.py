@@ -114,6 +114,7 @@ def hs_step(
     combiner: str = "capped",
     shallow_sign: Optional[jax.Array] = None,  # (V, Ns) int8, split layout
     n_shallow: int = 0,
+    sr_key: Optional[jax.Array] = None,  # bf16 stochastic write-back key
 ) -> Tuple[SGNSParams, jax.Array]:
     """One hierarchical-softmax SGD step over a batch of corpus pairs.
 
@@ -157,6 +158,9 @@ def hs_step(
         loss = loss + jnp.mean(loss_s)
         d_input = d_input + g_s @ w_s                      # (E, D) MXU
 
+    sk_emb = sk_node = None
+    if sr_key is not None and params.emb.dtype == jnp.bfloat16:
+        sk_emb, sk_node = jax.random.split(sr_key)
     emb = _apply_row_updates(
         params.emb,
         inputs,
@@ -165,6 +169,7 @@ def hs_step(
         lr,
         combiner,
         compute_dtype,
+        sr_key=sk_emb,
     )
 
     if shallow_sign is None:
@@ -180,6 +185,7 @@ def hs_step(
             lr,
             combiner,
             compute_dtype,
+            sr_key=sk_node,
         )
         return SGNSParams(emb=emb, ctx=node), loss
 
@@ -199,7 +205,7 @@ def hs_step(
     u_shallow = jnp.sum(abs_s, axis=0, dtype=acc_dtype)    # σ-free units
     acc = acc.at[:n_shallow, :d].add(d_shallow)
     acc = acc.at[:n_shallow, d].add(u_shallow)
-    node = _finalize_row_updates(params.ctx, acc, lr, combiner)
+    node = _finalize_row_updates(params.ctx, acc, lr, combiner, sr_key=sk_node)
     return SGNSParams(emb=emb, ctx=node), loss
 
 
@@ -329,6 +335,11 @@ class CBOWHSTrainer:
                         n_shallow=(
                             self.split.n_shallow if self.split else 0
                         ),
+                        sr_key=(
+                            jax.random.fold_in(step_key, step)
+                            if cfg.bf16_stochastic_round
+                            else None
+                        ),
                     )
                 else:
                     # cbow + negative sampling: swap roles so the *input*
@@ -349,6 +360,7 @@ class CBOWHSTrainer:
                         shared_groups=cfg.shared_groups,
                         strat_group=cfg.strat_group,
                         stratified=self.stratified,
+                        bf16_stochastic_round=cfg.bf16_stochastic_round,
                     )
                 if sharding is not None:
                     params = sharding.constrain_params(params)

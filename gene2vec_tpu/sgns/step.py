@@ -169,6 +169,56 @@ def _acc_dtype_for(compute_dtype):
     return jnp.float32 if compute_dtype == jnp.bfloat16 else compute_dtype
 
 
+def _stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Round f32 ``x`` to bfloat16 stochastically: add 16 uniform random
+    bits below the bf16 mantissa, then truncate — E[result] = x exactly.
+
+    This is what makes bf16 tables SAFE as a default (round 5): under
+    round-to-nearest, a per-step SGD update smaller than half the
+    weight's bf16 ulp (|w|/512) rounds away EVERY step and the row stops
+    training — the measured round-4 failure in the small-scale smoke
+    regime (config.py table_dtype note).  Under stochastic rounding the
+    update survives with probability update/ulp, so the EXPECTED update
+    equals the f32 update and training statistics are preserved at any
+    scale.  Values already representable in bf16 (e.g. rows a step never
+    touched, whose accumulated update is 0) have zero low bits and pass
+    through bit-identically — the randomness never perturbs a row that
+    did not train.  IEEE floats are sign+magnitude, so the low-bit add
+    rounds the magnitude for either sign; a carry out of the exponent
+    field correctly lands on the next binade (overflow to inf requires
+    |x| at the f32 max, never reached by embedding tables).
+
+    Noise source: a salted murmur3-finalizer hash of each element's flat
+    index rather than ``jax.random.bits`` — threefry over the full (V, D)
+    table (~10M words/step across both tables) measured 0.66 ms/step and
+    erased the bf16 win; the 6-op avalanche hash is ~10x cheaper and SR
+    only needs uniform decorrelated low bits, not cryptographic streams.
+    The two salt words come from ONE threefry block of the step key, so
+    every step (and each table) draws an independent hash family.
+    """
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    salt = jax.random.bits(key, (2,), jnp.uint32)
+    if x.ndim == 2:
+        flat = (
+            jax.lax.broadcasted_iota(jnp.uint32, x.shape, 0)
+            * jnp.uint32(x.shape[1])
+            + jax.lax.broadcasted_iota(jnp.uint32, x.shape, 1)
+        )
+    else:
+        flat = jax.lax.iota(jnp.uint32, x.size).reshape(x.shape)
+    h = (flat ^ salt[0]) * jnp.uint32(0x9E3779B1)
+    h = (h ^ (h >> 15)) * jnp.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) + salt[1]
+    rnd = h & jnp.uint32(0xFFFF)
+    # stay 32-bit wide end to end: mask the truncated mantissa in u32,
+    # bitcast back to f32 (an exactly-representable bf16 value), and let
+    # the final cast be the identity rounding — sub-word u16 bitcasts
+    # lower poorly on the VPU
+    return jax.lax.bitcast_convert_type(
+        (bits + rnd) & jnp.uint32(0xFFFF0000), jnp.float32
+    ).astype(jnp.bfloat16)
+
+
 def _scatter_accumulator(
     v: int,
     idx: jax.Array,          # (R,) row per gradient
@@ -189,13 +239,21 @@ def _scatter_accumulator(
 
 
 def _finalize_row_updates(
-    table: jax.Array, acc: jax.Array, lr: jax.Array, combiner: str
+    table: jax.Array, acc: jax.Array, lr: jax.Array, combiner: str,
+    sr_key=None,
 ) -> jax.Array:
-    """table − lr · (accumulated grads / per-row combiner divisor)."""
+    """table − lr · (accumulated grads / per-row combiner divisor).
+
+    With ``sr_key`` and a bfloat16 table, the write-back rounds
+    stochastically (:func:`_stochastic_round_bf16`) so sub-ulp updates
+    survive in expectation instead of absorbing."""
     d = table.shape[1]
     update = acc[:, :d] / _row_divisor(acc[:, d], combiner)[:, None]
     lr = jnp.asarray(lr, acc.dtype)
-    return (table.astype(acc.dtype) - lr * update).astype(table.dtype)
+    new = table.astype(acc.dtype) - lr * update
+    if sr_key is not None and table.dtype == jnp.bfloat16:
+        return _stochastic_round_bf16(new, sr_key)
+    return new.astype(table.dtype)
 
 
 def _apply_row_updates(
@@ -206,13 +264,19 @@ def _apply_row_updates(
     lr: jax.Array,
     combiner: str,
     compute_dtype,
+    sr_key=None,
+    acc_constraint=None,
 ) -> jax.Array:
     """table − lr · combined row updates, via ONE fused scatter; see
-    :func:`_scatter_accumulator` / :func:`_row_divisor` for semantics."""
+    :func:`_scatter_accumulator` / :func:`_row_divisor` for semantics.
+    ``acc_constraint`` pins the accumulator's sharding to the table's
+    (parallel/sharding.py:constrain_acc)."""
     acc = _scatter_accumulator(
         table.shape[0], idx, grads, weights, _acc_dtype_for(compute_dtype)
     )
-    return _finalize_row_updates(table, acc, lr, combiner)
+    if acc_constraint is not None:
+        acc = acc_constraint(acc)
+    return _finalize_row_updates(table, acc, lr, combiner, sr_key=sr_key)
 
 
 def _step_per_example(
@@ -223,11 +287,14 @@ def _step_per_example(
     lr: jax.Array,
     compute_dtype,
     combiner: str,
+    sr_keys=None,  # (emb_key, ctx_key) for bf16 stochastic write-back
+    acc_constraint=None,
 ) -> Tuple[SGNSParams, jax.Array]:
     loss, (d_center, d_pos, d_neg), neg_mask = sgns_loss_and_grads(
         params, centers, contexts, negs, compute_dtype
     )
     d = d_center.shape[-1]
+    sk_emb, sk_ctx = sr_keys if sr_keys is not None else (None, None)
     emb = _apply_row_updates(
         params.emb,
         centers,
@@ -236,6 +303,8 @@ def _step_per_example(
         lr,
         combiner,
         compute_dtype,
+        sr_key=sk_emb,
+        acc_constraint=acc_constraint,
     )
     # One fused scatter for positive contexts + noise draws: in per-example
     # mode each noise draw carries weight ≤ 1 (its collision mask), the same
@@ -252,6 +321,8 @@ def _step_per_example(
         lr,
         combiner,
         compute_dtype,
+        sr_key=sk_ctx,
+        acc_constraint=acc_constraint,
     )
     return SGNSParams(emb=emb, ctx=ctx), loss
 
@@ -266,6 +337,8 @@ def _step_shared(
     lr: jax.Array,
     compute_dtype,
     combiner: str,
+    sr_keys=None,  # (emb_key, ctx_key) for bf16 stochastic write-back
+    acc_constraint=None,
 ) -> Tuple[SGNSParams, jax.Array]:
     emb_t, ctx_t = params.emb, params.ctx
     e, p = centers.shape[0], negs.shape[0]
@@ -303,6 +376,7 @@ def _step_shared(
     d_pos = g_pos[:, None] * v                                  # (E, D)
     d_negrow = jnp.einsum("gep,ged->gpd", g_neg, vg).reshape(p, d)  # MXU
 
+    sk_emb, sk_ctx = sr_keys if sr_keys is not None else (None, None)
     emb = _apply_row_updates(
         emb_t,
         centers,
@@ -311,6 +385,8 @@ def _step_shared(
         lr,
         combiner,
         compute_dtype,
+        sr_key=sk_emb,
+        acc_constraint=acc_constraint,
     )
     # One fused scatter for positive contexts + pool slots, weighted in
     # example units (one positive occurrence = 1; one pool slot = its
@@ -353,6 +429,8 @@ def _step_shared(
         lr,
         combiner,
         compute_dtype,
+        sr_key=sk_ctx,
+        acc_constraint=acc_constraint,
     )
     return SGNSParams(emb=emb, ctx=ctx), jnp.mean(loss)
 
@@ -557,6 +635,8 @@ def _step_stratified(
     pos_mid: int = 0,  # second dense slab [pos_head, pos_head + pos_mid)
     pos_quotas=None,  # static per-pool pair counts of the batch layout
     pos_shards: int = 1,  # data-parallel device blocks in the batch layout
+    sr_keys=None,  # (emb_key, ctx_key) for bf16 stochastic write-back
+    acc_constraint=None,
 ) -> Tuple[SGNSParams, jax.Array]:
     """Stratified negatives: exact head + per-group random tail blocks.
 
@@ -659,8 +739,12 @@ def _step_stratified(
     )
 
     # ---- tail: one random block per group --------------------------------
+    # bounds derive from the spec's LOGICAL vocab, not the table rows:
+    # vocab-sharded tables pad their row count to the model-axis multiple
+    # (rows [v_noise, v_size) never train and carry no noise mass)
+    v_noise = spec.q.shape[0]
     blocks = jax.random.randint(key, (g,), 0, nb)
-    starts = jnp.minimum(head + blocks * block, v_size - block)
+    starts = jnp.minimum(head + blocks * block, v_noise - block)
 
     def slice_rows(tbl, s):
         return jax.lax.dynamic_slice(tbl, (s, 0), (block, tbl.shape[1]))
@@ -694,18 +778,23 @@ def _step_stratified(
         + jnp.einsum("ges,gsd->ged", g_tail, ctx_blk).reshape(e, d)
     )
     acc_dtype = _acc_dtype_for(compute_dtype)
+    sk_emb, sk_ctx = sr_keys if sr_keys is not None else (None, None)
     if dense_pos:
         acc_emb = _dense_slab_scatter_acc(
             v_size, d_center.reshape(s, e // s, d),
             jnp.ones((s, e // s), compute_dtype),
             oh_c, idx_ct, slabs, c_segs, acc_dtype,
         )
-        emb = _finalize_row_updates(emb_t, acc_emb, lr, combiner)
+        if acc_constraint is not None:
+            acc_emb = acc_constraint(acc_emb)
+        emb = _finalize_row_updates(
+            emb_t, acc_emb, lr, combiner, sr_key=sk_emb
+        )
     else:
         emb = _apply_row_updates(
             emb_t, centers, d_center,
             jnp.ones_like(centers, compute_dtype), lr, combiner,
-            compute_dtype,
+            compute_dtype, sr_key=sk_emb, acc_constraint=acc_constraint,
         )
 
     # ---- ctx: positive scatter + DENSE noise adds into ONE accumulator ---
@@ -748,9 +837,11 @@ def _step_stratified(
         acc = acc.at[head : head + (nb - 1) * block].add(
             acc_blocks[:-1].reshape((nb - 1) * block, d + 1)
         )
-    acc = acc.at[v_size - block :].add(acc_blocks[-1])
+    acc = acc.at[v_noise - block : v_noise].add(acc_blocks[-1])
 
-    ctx = _finalize_row_updates(ctx_t, acc, lr, combiner)
+    if acc_constraint is not None:
+        acc = acc_constraint(acc)
+    ctx = _finalize_row_updates(ctx_t, acc, lr, combiner, sr_key=sk_ctx)
     return SGNSParams(emb=emb, ctx=ctx), loss
 
 
@@ -774,8 +865,19 @@ def sgns_step(
     positive_mid: int = 0,  # second dense slab [head, head + mid)
     pos_quotas=None,  # static per-pool pair counts of the batch layout
     pos_shards: int = 1,  # per-device class blocks (data parallelism)
+    bf16_stochastic_round: bool = True,
+    acc_constraint=None,  # pin accumulator sharding (constrain_acc)
 ) -> Tuple[SGNSParams, jax.Array]:
     """One fused SGD step over a batch of corpus pairs."""
+    # bf16 tables write back with stochastic rounding by default (round 5)
+    # so sub-ulp SGD updates survive in expectation instead of absorbing —
+    # what makes table_dtype="bfloat16" safe at any scale.  Keys derive
+    # via fold_in so the noise-draw streams are untouched vs round 4.
+    sr_keys = None
+    if bf16_stochastic_round and params.emb.dtype == jnp.bfloat16:
+        sr_keys = (
+            jax.random.fold_in(key, 0x51EB), jax.random.fold_in(key, 0x51EC)
+        )
     dense_pos = positive_head > 0 and pos_quotas is not None
     if dense_pos:
         if negative_mode != "stratified":
@@ -833,7 +935,8 @@ def sgns_step(
             params, centers, contexts, stratified, key, negatives,
             group_size, lr, compute_dtype, combiner,
             pos_head=positive_head, pos_mid=positive_mid,
-            pos_quotas=pos_quotas, pos_shards=pos_shards,
+            pos_quotas=pos_quotas, pos_shards=pos_shards, sr_keys=sr_keys,
+            acc_constraint=acc_constraint,
         )
     if negative_mode == "shared":
         e = int(centers.shape[0])
@@ -917,11 +1020,13 @@ def sgns_step(
         negs = sample_negatives(noise, key, (g * per_group,))
         return _step_shared(
             params, centers, contexts, negs, negatives, g, lr,
-            compute_dtype, combiner,
+            compute_dtype, combiner, sr_keys=sr_keys,
+            acc_constraint=acc_constraint,
         )
     if negative_mode != "per_example":
         raise ValueError(f"unknown negative_mode {negative_mode!r}")
     negs = sample_negatives(noise, key, (centers.shape[0], negatives))
     return _step_per_example(
-        params, centers, contexts, negs, lr, compute_dtype, combiner
+        params, centers, contexts, negs, lr, compute_dtype, combiner,
+        sr_keys=sr_keys, acc_constraint=acc_constraint,
     )

@@ -59,7 +59,7 @@ def make_train_epoch(
     config: SGNSConfig,
     sharding: Optional["SGNSSharding"] = None,
     stratified=None,
-    pos_quotas: Optional[Tuple[int, int, int]] = None,
+    pos_quotas: Optional[Tuple[int, ...]] = None,
     pos_shards: int = 1,
 ) -> Callable:
     """Build the jitted epoch function.
@@ -68,10 +68,11 @@ def make_train_epoch(
     All loop structure is static; only array contents are traced.
     ``stratified`` (a StratifiedSpec) is captured in the closure — its
     arrays are per-trainer constants derived from the vocab counts.
-    With ``pos_quotas`` (dense-head positives), ``pairs`` is the
-    3-tuple of class pools from ``segment_corpus_by_head`` and each
-    batch is assembled as ``pos_shards`` device blocks, each
-    [HH|HT|TT] at static per-block quota offsets.
+    With ``pos_quotas`` (dense positives), ``pairs`` is the tuple of
+    class pools from ``segment_corpus_by_head`` — 3 for the head/tail
+    layout, 6 for head/mid/tail ([HH|HM|HT|MM|MT|TT]) — with one quota
+    per pool, and each batch is assembled as ``pos_shards`` device
+    blocks at static per-block quota offsets.
     """
     batch_pairs = config.batch_pairs
     compute_dtype = jnp.dtype(config.compute_dtype)
@@ -136,6 +137,12 @@ def make_train_epoch(
                 positive_mid=positive_mid,
                 pos_quotas=pos_quotas,
                 pos_shards=pos_shards,
+                bf16_stochastic_round=config.bf16_stochastic_round,
+                acc_constraint=(
+                    sharding.constrain_acc
+                    if sharding is not None and sharding.vocab_sharded
+                    else None
+                ),
             )
             if sharding is not None:
                 params = sharding.constrain_params(params)
@@ -165,7 +172,7 @@ def train_epochs(corpus: PairCorpus, config: SGNSConfig, epochs: int):
             params, jax.random.fold_in(jax.random.PRNGKey(config.seed), it)
         )
         losses.append(float(loss))
-    return np.asarray(params.emb), losses
+    return np.asarray(params.emb)[: corpus.vocab_size], losses
 
 
 class SGNSTrainer:
@@ -292,6 +299,15 @@ class SGNSTrainer:
         else:
             self.noise = self.sampler.table
 
+        # vocab-sharded tables need a row count divisible by the model
+        # axis; pad with zero rows that never train (no pair, noise or
+        # slab mass reaches ids >= vocab_size) and are sliced off at
+        # export (config 5 at the real 24,447-gene vocab on an 8-way mesh)
+        self.padded_vocab = corpus.vocab_size
+        if sharding is not None and sharding.vocab_sharded:
+            m = int(sharding.mesh.shape[sharding.model_axis])
+            self.padded_vocab = -(-corpus.vocab_size // m) * m
+
         self.stratified = None
         if config.negative_mode == "stratified":
             from gene2vec_tpu.data.negative_sampling import (
@@ -340,7 +356,10 @@ class SGNSTrainer:
             ), 1
 
         if config.positive_head <= 0:
-            if config.positive_mid > 0:
+            if 0 < config.positive_mid != type(config)().positive_mid:
+                # only an EXPLICIT non-default mid deserves the warning —
+                # positive_head=0 alone must not complain about the
+                # default mid the user never touched
                 warnings.warn(
                     "positive_mid > 0 has no effect without positive_head "
                     "> 0 (the mid slab extends the dense-head batch "
@@ -360,12 +379,13 @@ class SGNSTrainer:
                 "un-sharded corpus as SGNSTrainer(..., full_corpus=...) "
                 "to enable (docs/DISTRIBUTED.md)"
             )
-        if sharding is not None and sharding.vocab_sharded:
-            return disabled(
-                "vocab-sharded tables split the head slab over the model "
-                "axis — expect the plain-gather per-chip rate "
-                "(PERF_NOTES round 4)"
-            )
+        # Vocab-sharded tables run the dense path too (round 5): at the
+        # default geometry the head+mid slabs (~2.5k rows) fit inside model
+        # shard 0, so ``table[lo:hi]`` lowers to a broadcast of that
+        # shard's prefix and the slab scatter lands back on it; when a
+        # slab does span shard boundaries XLA stitches it from the
+        # owners.  Loss parity vs the unsharded layout-pinned reference is
+        # pinned in tests/test_parallel.py::test_sharded_matches_unsharded.
         shards = 1
         if sharding is not None:
             shards = int(sharding.mesh.shape[sharding.data_axis])
@@ -384,20 +404,56 @@ class SGNSTrainer:
         seg_pairs = (
             full_corpus.pairs if full_corpus is not None else corpus.pairs
         )
-        bounds = np.asarray(
-            (head, head + mid) if mid > 0 else (head,), dtype=np.int64
-        )
-        cls = np.searchsorted(bounds, seg_pairs, side="right")
-        n_pools = len(
-            np.unique(cls.min(axis=1) * (len(bounds) + 1) + cls.max(axis=1))
-        )
-        if config.batch_pairs % shards or config.batch_pairs < n_pools * shards:
+
+        def pools_present(bounds):
+            # chunked with early exit: one pass over a 100M-pair corpus
+            # only when some pool really is near-empty
+            n_classes = len(bounds) + 1
+            limit = n_classes * (n_classes + 1) // 2
+            present = set()
+            for lo in range(0, len(seg_pairs), 1 << 20):
+                c = np.searchsorted(
+                    bounds, seg_pairs[lo : lo + (1 << 20)], side="right"
+                )
+                present.update(
+                    np.unique(c.min(axis=1) * n_classes + c.max(axis=1))
+                    .tolist()
+                )
+                if len(present) == limit:
+                    break
+            return len(present)
+
+        if config.batch_pairs % shards:
             return disabled(
                 f"batch_pairs={config.batch_pairs} cannot form {shards} "
-                "uniform class-segmented device blocks over the corpus's "
-                f"{n_pools} class pools (needs a multiple of {shards}, at "
-                f"least {n_pools * shards})"
+                f"uniform device blocks (needs a multiple of {shards})"
             )
+        if mid > 0:
+            n_pools = pools_present(
+                np.asarray((head, head + mid), dtype=np.int64)
+            )
+            if config.batch_pairs < n_pools * shards:
+                # the 6-class layout does not fit this batch — fall back
+                # to the round-4 2-class head-only layout before giving
+                # up on dense positives entirely
+                warnings.warn(
+                    f"positive_mid disabled: batch_pairs="
+                    f"{config.batch_pairs} cannot cover the corpus's "
+                    f"{n_pools} head/mid/tail pools x {shards} device "
+                    "blocks; falling back to the 2-class head-only "
+                    "layout",
+                    stacklevel=3,
+                )
+                mid = 0
+        if mid == 0:
+            n_pools = pools_present(np.asarray((head,), dtype=np.int64))
+            if config.batch_pairs < n_pools * shards:
+                return disabled(
+                    f"batch_pairs={config.batch_pairs} cannot form "
+                    f"{shards} uniform class-segmented device blocks over "
+                    f"the corpus's {n_pools} class pools (needs at least "
+                    f"{n_pools * shards})"
+                )
         return (
             dataclasses.replace(config, positive_head=head, positive_mid=mid),
             shards,
@@ -411,7 +467,7 @@ class SGNSTrainer:
             init_fn = jax.jit(
                 functools.partial(
                     init_params,
-                    vocab_size=self.corpus.vocab_size,
+                    vocab_size=self.padded_vocab,
                     dim=self.config.dim,
                     dtype=jnp.dtype(self.config.table_dtype),
                 ),
@@ -420,10 +476,32 @@ class SGNSTrainer:
             return init_fn(key)
         return init_params(
             key,
-            self.corpus.vocab_size,
+            self.padded_vocab,
             self.config.dim,
             jnp.dtype(self.config.table_dtype),
         )
+
+    def _pad_params(self, params: SGNSParams) -> SGNSParams:
+        """Re-pad checkpoint-loaded (logical-vocab) tables to the sharded
+        row multiple; inverse of the export-time slice."""
+        pad = self.padded_vocab - params.emb.shape[0]
+        if pad <= 0:
+            return params
+
+        def f(t):
+            t = jnp.asarray(t)
+            return jnp.concatenate(
+                [t, jnp.zeros((pad, t.shape[1]), t.dtype)]
+            )
+
+        return SGNSParams(emb=f(params.emb), ctx=f(params.ctx))
+
+    def _export_params(self, params: SGNSParams) -> SGNSParams:
+        """Slice padding rows off for checkpoint/export (no-op unpadded)."""
+        v = self.corpus.vocab_size
+        if params.emb.shape[0] == v:
+            return params
+        return SGNSParams(emb=params.emb[:v], ctx=params.ctx[:v])
 
     # -- training ----------------------------------------------------------
 
@@ -459,6 +537,7 @@ class SGNSTrainer:
                 export_dir, cfg.dim, start_iter - 1,
                 table_dtype=cfg.table_dtype,
             )
+            params = self._pad_params(params)
             log(f"resuming from iteration {start_iter - 1}")
         else:
             params = self.init()
@@ -487,7 +566,7 @@ class SGNSTrainer:
                 export_dir,
                 cfg.dim,
                 it,
-                params,
+                self._export_params(params),
                 self.corpus.vocab,
                 txt_output=cfg.txt_output,
                 meta={"loss": loss, "pairs_per_sec": rate},

@@ -1,0 +1,141 @@
+"""Config-5 communication audit without hardware (VERDICT r4 item 7).
+
+Compiles the 8-way vocab-sharded training epoch (BASELINE config 5:
+dim=512, row-parallel tables over the model axis) on the forced-8-device
+CPU backend, then counts and sizes every collective in the optimized HLO.
+The per-step collective budget — the scan body appears once in the module
+— gives a bytes-per-pair communication model that predicts what a real
+v5e-8 would move over ICI (written up in docs/PERF_NOTES.md round 5).
+
+Run: python scripts/hlo_comm_audit.py [--dim 512] [--batch 16384]
+Writes experiments/results/hlo_comm_r5.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# sitecustomize latches env vars before we run — re-pin via the config API
+# (tests/conftest.py pattern; axon-tunnel memory note)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+
+from bench import synth_corpus  # noqa: E402
+from gene2vec_tpu.config import MeshConfig, SGNSConfig  # noqa: E402
+from gene2vec_tpu.parallel.mesh import make_mesh  # noqa: E402
+from gene2vec_tpu.parallel.sharding import SGNSSharding  # noqa: E402
+from gene2vec_tpu.sgns.train import SGNSTrainer  # noqa: E402
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1}
+
+# one HLO shape like "f32[24447,513]" or a tuple "(f32[8,2], u32[...])"
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def audit(dim: int, vocab: int, batch: int, num_pairs: int, mid: bool,
+          vocab_sharded: bool = True):
+    corpus = synth_corpus(vocab, num_pairs)
+    if vocab_sharded:
+        mesh = make_mesh(MeshConfig(data=1, model=8))
+    else:
+        mesh = make_mesh(MeshConfig(data=8, model=1))
+    cfg = SGNSConfig(
+        dim=dim, batch_pairs=batch, vocab_sharded=vocab_sharded,
+        positive_mid=2048 if mid else 0,
+    )
+    trainer = SGNSTrainer(
+        corpus, cfg, sharding=SGNSSharding(mesh, vocab_sharded=vocab_sharded)
+    )
+    params = trainer.init()
+    lowered = trainer._epoch_fn.lower(
+        params, trainer.pairs, trainer.noise, jax.random.PRNGKey(0)
+    )
+    hlo = lowered.compile().as_text()
+
+    ops = collections.defaultdict(lambda: [0, 0])
+    for line in hlo.splitlines():
+        m = re.search(
+            r"=\s*(\([^)]*\)|\S+)\s+"
+            r"(all-gather|all-reduce|reduce-scatter|collective-permute|"
+            r"all-to-all)\w*\(",
+            line,
+        )
+        if m:
+            out_shape, op = m.group(1), m.group(2)
+            ops[op][0] += 1
+            ops[op][1] += _shape_bytes(out_shape)
+    return {
+        "config": {
+            "dim": dim, "vocab": vocab, "batch_pairs": batch,
+            "mesh": (
+                "1x8 (model=8, vocab-sharded)"
+                if vocab_sharded
+                else "8x1 (data=8, replicated tables)"
+            ),
+            "positive_mid": cfg.positive_mid,
+            "positive_head": cfg.positive_head,
+        },
+        "collectives_per_step": {
+            op: {"count": c, "output_bytes": b} for op, (c, b) in ops.items()
+        },
+        "total_bytes_per_step": sum(b for _, b in ops.values()),
+        "bytes_per_pair": round(
+            sum(b for _, b in ops.values()) / batch, 1
+        ),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=24447)
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--pairs", type=int, default=131072)  # compile-only scale
+    args = ap.parse_args()
+
+    out = {
+        "with_dense_slabs": audit(
+            args.dim, args.vocab, args.batch, args.pairs, mid=True
+        ),
+        "plain_gather_round4": audit(
+            args.dim, args.vocab, args.batch, args.pairs, mid=False
+        ),
+        "data_parallel_8way": audit(
+            args.dim, args.vocab, args.batch, args.pairs, mid=True,
+            vocab_sharded=False,
+        ),
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "experiments", "results", "hlo_comm_r5.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,38 +41,35 @@ def test_make_mesh_shapes():
 
 
 @pytest.mark.parametrize("vocab_sharded", [False, True])
-def test_sharded_matches_unsharded(vocab_sharded):
+@pytest.mark.parametrize("positive_mid", [0, 24])
+def test_sharded_matches_unsharded(vocab_sharded, positive_mid):
     """Same seed, same corpus → sharded epoch ≈ single-device epoch.
 
-    Data-parallel runs use the dense-head positive path, whose per-device
-    block layout changes example ORDER (not the example set), so the
-    unsharded reference pins the same layout via pos_layout_shards.
-    Vocab-sharded runs fall back to plain gathers (the head slab would be
-    split over the model axis), so the reference disables positive_head.
+    Both mesh strategies use the dense-positive path (round 5: the slabs
+    of a vocab-sharded table broadcast from their owning model shards),
+    whose per-device block layout changes example ORDER (not the example
+    set), so the unsharded reference pins the same layout via
+    pos_layout_shards.
     """
     corpus = _corpus()
     mesh = make_mesh(MeshConfig(data=-1, model=2))
     data = mesh.shape["data"]
-    if vocab_sharded:
-        cfg = SGNSConfig(
-            dim=16, num_iters=1, batch_pairs=64, seed=3, positive_head=0
-        )
-    else:
-        cfg = SGNSConfig(
-            dim=16, num_iters=1, batch_pairs=64, seed=3,
-            pos_layout_shards=data,
-        )
+    # head=8 < V/2 keeps real head/mid/tail classes on the 64-token vocab
+    # (the default 512 would clamp to the whole vocab and leave no tail)
+    cfg = SGNSConfig(
+        dim=16, num_iters=1, batch_pairs=64, seed=3, positive_head=8,
+        positive_mid=positive_mid, pos_layout_shards=data,
+    )
 
     ref_trainer = SGNSTrainer(corpus, cfg)
     ref_params = ref_trainer.init()
     key = jax.random.PRNGKey(11)
     ref_params, ref_loss = ref_trainer.train_epoch(ref_params, key)
-    if not vocab_sharded:
-        assert ref_trainer.pos_quotas is not None  # dense path exercised
+    assert ref_trainer.pos_quotas is not None  # dense path exercised
 
     sharding = SGNSSharding(mesh, vocab_sharded=vocab_sharded)
     tr = SGNSTrainer(corpus, cfg, sharding=sharding)
-    assert (tr.pos_quotas is None) == vocab_sharded
+    assert tr.pos_quotas is not None  # dense path on the mesh too
     params = tr.init()
     params, loss = tr.train_epoch(params, key)
 
@@ -323,6 +320,71 @@ print(
     f"{tr2.pos_quotas} {dlosses[0]:.6f} {min(dlosses):.6f}",
     flush=True,
 )
+
+# phase 3: VOCAB-SHARDED tables on the multi-host runtime (round 5).
+# 3a) trainer-level, mesh (data=2, model=4): rows sharded over the model
+# axis (intra-host), while the data axis — and therefore the gradient
+# reduction into the row-sharded tables and the dense-slab broadcasts —
+# crosses the Gloo transport.  Dense positives stay ON (the round-5 gate
+# removal).
+mesh_a = make_mesh(MeshConfig(data=2, model=4))
+tr3 = SGNSTrainer(
+    local,
+    SGNSConfig(
+        dim=16, num_iters=1, batch_pairs=256, seed=3, positive_head=16,
+        positive_mid=24, strat_head=8, strat_block=16,
+    ),
+    sharding=SGNSSharding(mesh_a, vocab_sharded=True),
+    full_corpus=corpus,
+)
+assert tr3.pos_quotas is not None and len(tr3.pos_quotas) == 6
+p3 = tr3.init()
+assert p3.emb.sharding.spec[0] == "model"
+vlosses = []
+for ep in range(5):
+    p3, vl = tr3.train_epoch(p3, jax.random.fold_in(jax.random.PRNGKey(21), ep))
+    vlosses.append(float(vl))
+
+# 3b) step-level, model axis SPANNING the two processes (devices
+# interleaved (2,4).T): every sharded-table gather/scatter and slab
+# broadcast crosses the transport.  The parent re-runs the identical
+# construction single-process and pins numeric equality.
+import functools
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from gene2vec_tpu.sgns.model import init_params
+from gene2vec_tpu.sgns.step import sgns_step
+from gene2vec_tpu.data.negative_sampling import (
+    NegativeSampler, build_stratified_spec,
+)
+
+mesh_b = Mesh(np.asarray(jax.devices()).reshape(2, 4).T, ("data", "model"))
+sh_b = SGNSSharding(mesh_b, vocab_sharded=True)
+init_fn = jax.jit(
+    functools.partial(init_params, vocab_size=64, dim=16, dtype=jnp.float32),
+    out_shardings=sh_b.params_sharding(),
+)
+pb = init_fn(jax.random.PRNGKey(5))
+assert pb.emb.sharding.spec[0] == "model"
+spec = build_stratified_spec(counts, 8, 16, 0.75)
+noise = NegativeSampler(counts, 0.75).table
+step = jax.jit(
+    functools.partial(
+        sgns_step, negatives=5, negative_mode="stratified",
+        strat_group=32,
+    )
+)
+batch = jnp.asarray(corpus.pairs[:256])  # replicated global input
+bl = None
+for i in range(3):
+    pb, bl = step(
+        pb, batch, noise, jax.random.PRNGKey(100 + i), jnp.float32(0.025),
+        stratified=spec,
+    )
+print(
+    f"RESULT2 {vlosses[0]:.6f} {min(vlosses):.6f} {float(bl):.6f}",
+    flush=True,
+)
 distributed.shutdown()
 """
     )
@@ -348,7 +410,7 @@ distributed.shutdown()
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=480)
             assert p.returncode == 0, err[-2000:]
             outs.append(out)
     finally:
@@ -359,7 +421,7 @@ distributed.shutdown()
                 p.kill()
     results = [
         line for out in outs for line in out.splitlines()
-        if line.startswith("RESULT")
+        if line.startswith("RESULT ")
     ]
     assert len(results) == 2
     assert results[0] == results[1], results  # identical across processes
@@ -368,3 +430,59 @@ distributed.shutdown()
     assert l2 < l1  # and the model actually learns
     d_first, d_best = float(parts[-2]), float(parts[-1])
     assert d_best < d_first - 0.5  # dense-head multi-host path learns too
+
+    # phase-3 assertions: vocab-sharded multi-host executed and learned,
+    # identically on both processes
+    results2 = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("RESULT2")
+    ]
+    assert len(results2) == 2
+    assert results2[0] == results2[1], results2
+    v_first, v_best, bl = (float(x) for x in results2[0].split()[1:])
+    assert v_best < v_first - 0.5  # trainer-level vocab-sharded learns
+
+    # single-process reference for phase 3b: the identical construction on
+    # this process's own 8 CPU devices must produce the same loss the two
+    # workers computed over the cross-process model axis — the collectives
+    # XLA lowered onto the Gloo transport are numerically exact
+    import functools
+
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.data.negative_sampling import (
+        NegativeSampler, build_stratified_spec,
+    )
+    from gene2vec_tpu.sgns.model import init_params
+    from gene2vec_tpu.sgns.step import sgns_step
+
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 64, (4096, 2)).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=64).astype(np.int64)
+    mesh_b = Mesh(
+        np.asarray(jax.devices()).reshape(2, 4).T, ("data", "model")
+    )
+    sh_b = SGNSSharding(mesh_b, vocab_sharded=True)
+    init_fn = jax.jit(
+        functools.partial(
+            init_params, vocab_size=64, dim=16, dtype=jnp.float32
+        ),
+        out_shardings=sh_b.params_sharding(),
+    )
+    pb = init_fn(jax.random.PRNGKey(5))
+    spec = build_stratified_spec(counts, 8, 16, 0.75)
+    noise = NegativeSampler(counts, 0.75).table
+    step = jax.jit(
+        functools.partial(
+            sgns_step, negatives=5, negative_mode="stratified",
+            strat_group=32,
+        )
+    )
+    batch = jnp.asarray(pairs[:256])
+    ref_bl = None
+    for i in range(3):
+        pb, ref_bl = step(
+            pb, batch, noise, jax.random.PRNGKey(100 + i),
+            jnp.float32(0.025), stratified=spec,
+        )
+    assert abs(float(ref_bl) - bl) < 1e-4, (float(ref_bl), bl)

@@ -1,0 +1,168 @@
+"""Stochastic-rounded bf16 table write-back (round 5).
+
+The round-4 blocker for bf16-by-default was update absorption: an SGD
+update smaller than half the weight's bf16 ulp rounds away every step
+under round-to-nearest, so small-scale runs never learn.  Stochastic
+rounding (``sgns/step.py:_stochastic_round_bf16``) makes the EXPECTED
+write-back equal the f32 update; these tests pin the primitive's
+contract and that the previously-failing smoke regime now learns.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns.step import _stochastic_round_bf16
+from gene2vec_tpu.sgns.train import train_epochs
+
+
+def test_exact_bf16_values_pass_through():
+    """Values already representable in bf16 (incl. 0, negatives, denormal
+    magnitudes) must survive bit-identically — rows a step never touched
+    are never perturbed."""
+    vals = jnp.asarray(
+        [0.0, -0.0, 1.0, -1.0, 0.5, -3.25, 65280.0, 1e-30, -1e-30],
+        jnp.bfloat16,
+    ).astype(jnp.float32)
+    for seed in range(5):
+        out = _stochastic_round_bf16(vals, jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float32), np.asarray(vals, np.float32)
+        )
+
+
+def test_rounds_to_adjacent_bf16_values_only():
+    """SR must land on one of the two bf16 neighbours of x, never further."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4096).astype(np.float32)) * 3.7
+    lo = np.asarray(x.astype(jnp.bfloat16), np.float32)  # one neighbour
+    out = np.asarray(
+        _stochastic_round_bf16(x, jax.random.PRNGKey(1)), np.float32
+    )
+    xf = np.asarray(x)
+    ulp = np.spacing(np.abs(lo).astype(np.float32)) * 2 ** (24 - 8)
+    assert np.all(np.abs(out - xf) <= ulp + 1e-30)
+
+
+def test_unbiased_in_expectation():
+    """Mean over many keys converges to x (round-to-nearest would sit a
+    half-ulp away for adversarial inputs)."""
+    # x exactly halfway between bf16 neighbours: 1 + 2^-9
+    x = jnp.full((2048,), np.float32(1.0 + 2.0**-9))
+    acc = np.zeros(2048, np.float64)
+    n = 200
+    for seed in range(n):
+        acc += np.asarray(
+            _stochastic_round_bf16(x, jax.random.PRNGKey(seed)), np.float64
+        )
+    mean = acc / n
+    # neighbours are 1.0 and 1.0078125; nearest-even would always pick one
+    assert abs(mean.mean() - (1.0 + 2.0**-9)) < 3e-4
+    assert mean.std() > 0  # it actually randomizes
+
+
+def test_sub_ulp_updates_survive_in_expectation():
+    """The absorption failure: w=1.0, update=-1e-5 (way below the 2^-9
+    half-ulp).  Nearest rounding keeps w frozen forever; SR must advance
+    w by ~n*update over n steps."""
+    w = jnp.full((4096,), np.float32(1.0))
+    upd = np.float32(1e-5)
+    key = jax.random.PRNGKey(0)
+    steps = 300
+    for i in range(steps):
+        key, sub = jax.random.split(key)
+        w = _stochastic_round_bf16(
+            w.astype(jnp.float32) - upd, sub
+        ).astype(jnp.float32)
+    drift = float(1.0 - np.asarray(w, np.float64).mean())
+    expect = steps * float(upd)
+    assert 0.5 * expect < drift < 1.5 * expect
+    # nearest-rounding control: frozen at exactly 1.0
+    w2 = jnp.full((16,), np.float32(1.0))
+    for _ in range(50):
+        w2 = (w2.astype(jnp.float32) - upd).astype(jnp.bfloat16).astype(
+            jnp.float32
+        )
+    assert float(np.abs(np.asarray(w2) - 1.0).max()) == 0.0
+
+
+def _planted_corpus(v=64, n=8192, seed=0):
+    rng = np.random.RandomState(seed)
+    half = v // 2
+    pairs = np.concatenate(
+        [
+            rng.randint(0, half, size=(n // 2, 2)),
+            rng.randint(half, v, size=(n // 2, 2)),
+        ]
+    ).astype(np.int32)
+    rng.shuffle(pairs)
+    counts = np.bincount(pairs.reshape(-1), minlength=v).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(v)], counts), pairs)
+
+
+@pytest.mark.parametrize("negative_mode", ["stratified", "shared"])
+def test_bf16_tables_learn_planted_clusters(negative_mode):
+    """The round-4 documented failure regime (small scale + bf16 tables)
+    must now learn with stochastic rounding on."""
+    corpus = _planted_corpus()
+    cfg = SGNSConfig(
+        dim=16, batch_pairs=512, lr=0.05, table_dtype="bfloat16",
+        negative_mode=negative_mode, positive_head=16, strat_head=8,
+        strat_block=16, strat_group=32,
+    )
+    emb, losses = train_epochs(corpus, cfg, epochs=8)
+    assert losses[-1] < losses[0] - 0.5
+    emb = emb.astype(np.float32)
+    unit = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+    half = 32
+    intra = np.mean(unit[:half] @ unit[:half].T)
+    inter = np.mean(unit[:half] @ unit[half:].T)
+    assert intra > inter + 0.3
+
+
+def test_bf16_sr_flag_controls_dispatch(monkeypatch):
+    """bf16_stochastic_round=False restores the round-4 nearest-rounding
+    write-back (the documented A/B escape hatch); True routes every
+    table write-back through the SR primitive — and f32 tables never
+    touch it regardless of the flag."""
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.data.negative_sampling import (
+        NegativeSampler, build_stratified_spec,
+    )
+    from gene2vec_tpu.sgns import step as step_mod
+    from gene2vec_tpu.sgns.model import init_params
+    from gene2vec_tpu.sgns.step import sgns_step
+
+    corpus = _planted_corpus()
+    spec = build_stratified_spec(corpus.vocab.counts, 8, 16, 0.75)
+    noise = NegativeSampler(corpus.vocab.counts, 0.75).table
+    batch = jnp.asarray(corpus.pairs[:256])
+    calls = []
+    real = step_mod._stochastic_round_bf16
+    monkeypatch.setattr(
+        step_mod,
+        "_stochastic_round_bf16",
+        lambda x, k: calls.append(1) or real(x, k),
+    )
+    kw = dict(
+        negatives=5, negative_mode="stratified", strat_group=32,
+        stratified=spec,
+    )
+    for dtype, flag, expected_calls in [
+        (jnp.bfloat16, False, 0),
+        (jnp.float32, True, 0),
+        (jnp.bfloat16, True, 2),  # emb + ctx write-backs
+    ]:
+        calls.clear()
+        params = init_params(jax.random.PRNGKey(0), 64, 16, dtype)
+        sgns_step(
+            params, batch, noise, jax.random.PRNGKey(1),
+            jnp.float32(0.025), bf16_stochastic_round=flag, **kw,
+        )
+        assert len(calls) == expected_calls, (dtype, flag, calls)
