@@ -120,6 +120,51 @@ def bench_tsne(n: int, dim: int, seg: int, cpu_iters: int) -> dict:
     return out
 
 
+def bench_umap(n: int, dim: int, iters: int) -> dict:
+    """TPU UMAP at gene scale (round 5, VERDICT r4 item 8): time the
+    full-batch layout and record the cluster-separation sanity the t-SNE
+    bench uses (umap-learn itself is not installable in-image, so there
+    is no in-situ CPU denominator — the reference's own docs put
+    umap-learn at minutes for 24k x 50d)."""
+    from gene2vec_tpu.viz.umap import UMAPConfig, umap_layout
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(200, dim) * 4.0
+    labels = rng.randint(0, 200, n)
+    x = (centers[labels] + rng.randn(n, dim)).astype(np.float32)
+
+    cfg = UMAPConfig(n_iters=iters, pca_dims=50)
+    t0 = time.perf_counter()
+    y = umap_layout(x, cfg)
+    total = time.perf_counter() - t0
+    # per-iteration rate from a second, shorter run (compile now cached)
+    cfg_lo = UMAPConfig(n_iters=max(iters // 3, 1), pca_dims=50)
+    t0 = time.perf_counter()
+    umap_layout(x, cfg_lo)
+    lo_s = time.perf_counter() - t0
+    per_iter = max((total - lo_s) / max(iters - cfg_lo.n_iters, 1), 1e-9)
+
+    # separation sanity on a subsample: the full (N, N, 2) broadcast at
+    # 24k would cost ~8 GB of host arrays for one scalar
+    sub = np.random.RandomState(1).choice(n, size=min(n, 2000), replace=False)
+    ys, ls = y[sub], labels[sub]
+    same = ls[:, None] == ls[None, :]
+    np.fill_diagonal(same, False)
+    d = np.linalg.norm(ys[:, None] - ys[None, :], axis=-1)
+    sep = float(
+        d[~same & ~np.eye(len(sub), dtype=bool)].mean()
+        / max(d[same].mean(), 1e-9)
+    )
+    print(f"[umap] {n}x{dim}: {total:.1f}s ({1.0/per_iter:.1f} it/s), "
+          f"inter/intra = {sep:.2f}", flush=True)
+    return {
+        "n": n, "dim": dim, "n_iters": iters,
+        "total_s": round(total, 2),
+        "iters_per_sec": round(1.0 / per_iter, 2),
+        "inter_over_intra": round(sep, 2),
+    }
+
+
 def bench_corr(studies: int, samples: int, genes: int) -> dict:
     """End-to-end per-study co-expression mask extraction (what the
     corpus builder consumes): |corr| > 0.9 over all gene pairs.  The
@@ -178,12 +223,14 @@ def main() -> None:
 
     if args.quick:
         tsne = bench_tsne(n=2000, dim=200, seg=50, cpu_iters=250)
+        umap = bench_umap(n=2000, dim=200, iters=100)
         corr = bench_corr(studies=5, samples=100, genes=1000)
     else:
         tsne = bench_tsne(n=24447, dim=200, seg=100, cpu_iters=250)
+        umap = bench_umap(n=24447, dim=200, iters=400)
         corr = bench_corr(studies=50, samples=100, genes=5000)
 
-    result = {"tsne_24k": tsne, "corpus_corr": corr}
+    result = {"tsne_24k": tsne, "umap_24k": umap, "corpus_corr": corr}
     print(json.dumps(result, indent=2))
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(result, f, indent=2)

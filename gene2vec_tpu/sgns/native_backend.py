@@ -32,23 +32,65 @@ _lib: Optional[ctypes.CDLL] = None
 _build_attempted = False
 
 
+_ABI_VERSION = 2  # must match SGNS_HOGWILD_ABI_VERSION in sgns_hogwild.cpp
+
+
+def _make() -> None:
+    if not os.environ.get("GENE2VEC_TPU_NO_NATIVE_BUILD"):
+        try:
+            subprocess.run(
+                ["make", "-B", "-C", _NATIVE_DIR, "libsgns_hogwild.so"],
+                capture_output=True, timeout=120, check=False,
+            )
+        except Exception:
+            pass
+
+
+def _stale(path: str) -> bool:
+    """ABI-check WITHOUT dlopening into this process: dlopen caches by
+    path, so probing with ctypes.CDLL would pin a stale mapping that a
+    post-rebuild re-CDLL silently returns again.  A subprocess probe
+    leaves this process clean (the pairio pattern builds before loading;
+    here the .so may predate the ABI gate entirely, so we must inspect)."""
+    probe = (
+        "import ctypes, sys\n"
+        f"lib = ctypes.CDLL({path!r})\n"
+        "ok = hasattr(lib, 'sgns_hogwild_abi_version') and "
+        f"lib.sgns_hogwild_abi_version() == {_ABI_VERSION}\n"
+        "sys.exit(0 if ok else 1)\n"
+    )
+    try:
+        import sys
+
+        return (
+            subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, timeout=60,
+            ).returncode
+            != 0
+        )
+    except Exception:
+        return True
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_attempted
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build_attempted:
+    if not _build_attempted and (
+        not os.path.exists(_LIB_PATH) or _stale(_LIB_PATH)
+    ):
+        # build (or rebuild a stale pre-ABI-gate .so) BEFORE the first
+        # dlopen in this process
         _build_attempted = True
-        if not os.environ.get("GENE2VEC_TPU_NO_NATIVE_BUILD"):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    capture_output=True, timeout=120, check=False,
-                )
-            except Exception:
-                pass
+        _make()
     if not os.path.exists(_LIB_PATH):
         return None
     lib = ctypes.CDLL(_LIB_PATH)
+    if not hasattr(lib, "sgns_hogwild_abi_version") or (
+        lib.sgns_hogwild_abi_version() != _ABI_VERSION
+    ):
+        return None  # rebuild failed or was disabled; never call across ABIs
     lib.sgns_hogwild_epoch.argtypes = [
         ctypes.POINTER(ctypes.c_float),   # emb
         ctypes.POINTER(ctypes.c_float),   # ctx
@@ -66,6 +108,24 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int32,                   # both_directions
     ]
     lib.sgns_hogwild_epoch.restype = ctypes.c_float
+    lib.sgns_hogwild_abi_version.restype = ctypes.c_int64
+    lib.hs_hogwild_epoch.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # emb (input table)
+        ctypes.POINTER(ctypes.c_float),   # node table
+        ctypes.c_int32,                   # dim
+        ctypes.POINTER(ctypes.c_int32),   # pairs
+        ctypes.c_int64,                   # n_pairs
+        ctypes.POINTER(ctypes.c_int32),   # points (V, L)
+        ctypes.POINTER(ctypes.c_float),   # codes (V, L)
+        ctypes.POINTER(ctypes.c_int32),   # lengths (V,)
+        ctypes.c_int32,                   # max_len
+        ctypes.c_float,                   # lr_start
+        ctypes.c_float,                   # lr_end
+        ctypes.c_int32,                   # n_threads
+        ctypes.c_int32,                   # both_directions
+        ctypes.c_int32,                   # cbow
+    ]
+    lib.hs_hogwild_epoch.restype = ctypes.c_float
     _lib = lib
     return lib
 
@@ -80,6 +140,79 @@ def _fptr(a: np.ndarray):
 
 def _iptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class HogwildHSTrainer:
+    """Native CPU trainer for the hierarchical-softmax objectives
+    (BASELINE config 4: gensim ``sg=0, hs=1`` and the ``sg_hs`` variant) —
+    the measured denominator for ``cbow_hs_vs_cpu`` in the bench
+    secondary.  Scores the SAME Huffman tree the TPU path builds
+    (``huffman.build_tree``), so losses are comparable objective-for-
+    objective, not just rate-for-rate."""
+
+    def __init__(
+        self,
+        corpus: PairCorpus,
+        config: SGNSConfig = SGNSConfig(objective="cbow_hs"),
+        n_threads: Optional[int] = None,
+    ):
+        if _load() is None:
+            raise RuntimeError(
+                "native Hogwild library not available (make -C native failed?)"
+            )
+        if config.objective not in ("cbow_hs", "sg_hs"):
+            raise ValueError(
+                f"HogwildHSTrainer implements the hs objectives, not "
+                f"{config.objective!r}"
+            )
+        if corpus.num_pairs == 0:
+            raise ValueError("corpus is empty")
+        from gene2vec_tpu.sgns.huffman import build_huffman_tree
+
+        self.corpus = corpus
+        self.config = config
+        self.n_threads = n_threads or os.cpu_count() or 1
+        tree = build_huffman_tree(corpus.vocab.counts)
+        self._points = np.ascontiguousarray(tree.points, np.int32)
+        self._codes = np.ascontiguousarray(tree.codes, np.float32)
+        self._lengths = np.ascontiguousarray(tree.lengths, np.int32)
+
+    def init(self, seed: Optional[int] = None) -> SGNSParams:
+        cfg = self.config
+        rng = np.random.RandomState(cfg.seed if seed is None else seed)
+        emb = rng.uniform(
+            -0.5 / cfg.dim, 0.5 / cfg.dim, (self.corpus.vocab_size, cfg.dim)
+        ).astype(np.float32)
+        node = np.zeros(
+            (max(self.corpus.vocab_size - 1, 1), cfg.dim), np.float32
+        )
+        return SGNSParams(emb=emb, ctx=node)
+
+    def train_epoch(
+        self,
+        params: SGNSParams,
+        seed: int = 0,
+        rng: Optional[np.random.RandomState] = None,
+    ):
+        """One Hogwild HS epoch, updating the tables in place."""
+        cfg = self.config
+        emb = np.ascontiguousarray(np.asarray(params.emb), np.float32)
+        node = np.ascontiguousarray(np.asarray(params.ctx), np.float32)
+        pairs = self.corpus.pairs
+        if rng is not None:
+            pairs = pairs[rng.permutation(len(pairs))]
+        pairs = np.ascontiguousarray(pairs, np.int32)
+        loss = _load().hs_hogwild_epoch(
+            _fptr(emb), _fptr(node), cfg.dim,
+            _iptr(pairs), len(pairs),
+            _iptr(self._points), _fptr(self._codes), _iptr(self._lengths),
+            self._points.shape[1],
+            cfg.lr, cfg.min_lr,
+            self.n_threads,
+            int(cfg.both_directions),
+            int(cfg.objective.startswith("cbow")),
+        )
+        return SGNSParams(emb=emb, ctx=node), float(loss)
 
 
 class HogwildSGNSTrainer:

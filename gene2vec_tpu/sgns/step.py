@@ -46,6 +46,13 @@ TPU-first formulation:
   ``negative_mode="per_example"`` keeps gensim's exact per-example draws
   for oracle comparisons.
 
+* the positive side runs **dense-slab** when the trainer feeds
+  class-segmented batches (``positive_head``/``positive_mid``): tokens in
+  the frequency head — and, round 5, a second mid band — move as one-hot
+  MXU contractions over contiguous table slabs; batches arrive
+  [HH|HT|TT] (2-class) or [HH|HM|HT|MM|MT|TT] (3-class) at static
+  per-pool quotas, so only true-tail examples pay dynamic row ops.
+
 Everything is shape-static and jit-safe; under a Mesh the same code runs
 data-parallel (sharded batch, replicated tables → XLA all-reduces the
 scatter updates) or row-parallel (vocab-sharded tables → XLA turns

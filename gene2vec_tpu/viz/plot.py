@@ -24,21 +24,24 @@ def reduce_embedding(
     n_components: int = 2,
     seed: int = 0,
 ) -> np.ndarray:
-    """2-D/3-D coordinates via umap | tsne | pca (auto = first available)."""
+    """2-D/3-D coordinates via umap | tsne | pca (auto prefers umap, the
+    reference's choice — served by the in-repo TPU UMAP, `viz/umap.py`;
+    an installed umap-learn is used only for n_components != 2, which the
+    full-batch TPU layout doesn't implement)."""
     if method == "auto":
-        try:
-            import umap  # noqa: F401
-
-            method = "umap"
-        except ImportError:
-            method = "tsne"  # dependency-free, runs on TPU
+        method = "umap" if n_components == 2 else "tsne"
     if method == "umap":
+        if n_components == 2:
+            from gene2vec_tpu.viz.umap import UMAPConfig, umap_layout
+
+            return umap_layout(matrix, UMAPConfig(seed=seed))
         try:
             import umap
         except ImportError as e:
             raise ImportError(
-                "method='umap' requires the umap-learn package; use "
-                "method='tsne' (TPU) or method='pca'"
+                "method='umap' with n_components != 2 requires the "
+                "umap-learn package; use method='tsne' (TPU) or "
+                "method='pca'"
             ) from e
         return np.asarray(
             umap.UMAP(

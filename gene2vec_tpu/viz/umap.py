@@ -1,0 +1,191 @@
+"""UMAP on TPU — replaces umap-learn for the reference's plot path
+(``/root/reference/src/plot_gene2vec.py:124-133``), which is unavailable
+in-image (no umap-learn wheel, zero egress).
+
+TPU-first formulation: umap-learn's per-edge negative-sampled SGD is a
+CPU design — millions of tiny dependent row updates, exactly the
+issue-bound access pattern this framework avoids (docs/PERF_NOTES.md).
+At gene scale (N ≈ 24k) the FULL-BATCH cross-entropy gradient is two
+(N, N) elementwise passes and one force matmul per iteration — the same
+MXU shape as the exact t-SNE iteration (`viz/tsne.py`, 253 it/s at 24k),
+so a few hundred iterations cost seconds.  The graph construction
+(exact kNN via one distance matmul + top_k, smooth-kNN calibration by
+vectorized binary search, probabilistic t-conorm symmetrization) matches
+McInnes et al. (2018) §3; the optimizer differs from umap-learn exactly
+where sampling was a CPU workaround:
+
+* attraction: p_ij · 2ab·u^{b-1} / (1 + a·u^b), u = |y_i − y_j|²  — the
+  exact CE gradient, not per-epoch edge sampling;
+* repulsion: (1 − p_ij) · 2b / ((u + ε)(1 + a·u^b)), every pair every
+  iteration instead of ~5 random negatives per edge — scaled by
+  ``repulsion`` (γ) with the same ±4 per-coordinate gradient clip
+  umap-learn applies;
+* init: PCA-2 scaled to the standard 10-unit extent (deterministic; the
+  reference's spectral init needs a sparse eigensolver the TPU gains
+  nothing from).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.viz.tsne import _squared_distances, pca_reduce
+
+_HIGH = jax.lax.Precision.HIGHEST
+
+
+def fit_ab(min_dist: float = 0.1, spread: float = 1.0) -> Tuple[float, float]:
+    """Fit the low-dim kernel 1/(1 + a·d^{2b}) to the piecewise target
+    exp(−(d − min_dist)/spread) for d > min_dist, 1 otherwise — the same
+    least-squares fit umap-learn performs with scipy.curve_fit, done with
+    a coarse grid + Gauss-Newton polish (no scipy dependency).  For the
+    defaults this lands on the canonical (a ≈ 1.58, b ≈ 0.90)."""
+    d = np.linspace(0, 3.0 * spread, 300)
+    target = np.where(
+        d <= min_dist, 1.0, np.exp(-(d - min_dist) / spread)
+    )
+
+    def resid(a, b):
+        return 1.0 / (1.0 + a * d ** (2.0 * b)) - target
+
+    best = (1.0, 1.0, np.inf)
+    for a in np.linspace(0.5, 3.0, 26):
+        for b in np.linspace(0.5, 2.0, 31):
+            s = float(np.sum(resid(a, b) ** 2))
+            if s < best[2]:
+                best = (a, b, s)
+    a, b = best[0], best[1]
+    for _ in range(40):  # Gauss-Newton on (a, b)
+        u = d ** (2.0 * b)
+        q = 1.0 / (1.0 + a * u)
+        r = q - target
+        da = -u * q * q
+        db = -a * u * np.log(np.maximum(d, 1e-12)) * 2.0 * q * q
+        J = np.stack([da, db], axis=1)
+        g = J.T @ r
+        H = J.T @ J + 1e-6 * np.eye(2)
+        step = np.linalg.solve(H, g)
+        a, b = float(a - step[0]), float(b - step[1])
+        a = min(max(a, 1e-3), 10.0)
+        b = min(max(b, 1e-2), 4.0)
+    return a, b
+
+
+@dataclasses.dataclass(frozen=True)
+class UMAPConfig:
+    n_neighbors: int = 15
+    min_dist: float = 0.1
+    spread: float = 1.0
+    n_iters: int = 400
+    learning_rate: float = 1.0
+    repulsion: float = 1.0      # γ — weight on the (1 − p) repulsive term
+    pca_dims: int = 50          # high-dim pre-reduction (t-SNE parity)
+    init_scale: float = 10.0    # PCA-2 init rescaled to this max-extent
+    seed: int = 0
+    compute_dtype: str = "float32"  # (N, N) pass width; reductions f32
+
+
+def _smooth_knn_weights(
+    knn_d: jax.Array, n_neighbors: int, iters: int = 64
+) -> jax.Array:
+    """Per-point sigma binary search (smooth-kNN): find sigma_i with
+    sum_j exp(−max(d_ij − rho_i, 0)/sigma_i) = log2(k); returns the
+    (N, k) membership weights.  rho_i = nearest-neighbor distance."""
+    rho = knn_d[:, :1]
+    target = jnp.log2(jnp.float32(n_neighbors))
+    shifted = jnp.maximum(knn_d - rho, 0.0)
+
+    def body(carry, _):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        val = jnp.sum(jnp.exp(-shifted / mid), axis=1, keepdims=True)
+        hi = jnp.where(val > target, mid, hi)
+        lo = jnp.where(val > target, lo, mid)
+        return (lo, hi), None
+
+    n = knn_d.shape[0]
+    init = (
+        jnp.full((n, 1), 1e-6, jnp.float32),
+        jnp.full((n, 1), 1e3, jnp.float32),
+    )
+    (lo, hi), _ = jax.lax.scan(body, init, None, length=iters)
+    sigma = 0.5 * (lo + hi)
+    return jnp.exp(-shifted / sigma)
+
+
+def _fuzzy_graph(x: jax.Array, n_neighbors: int) -> jax.Array:
+    """Dense symmetrized fuzzy simplicial weights P (N, N): exact kNN via
+    one (N, N) distance pass + top_k, smooth-kNN weights, probabilistic
+    t-conorm P = W + Wᵀ − W∘Wᵀ."""
+    n = x.shape[0]
+    d2 = _squared_distances(x)
+    d2 = d2 + jnp.eye(n, dtype=d2.dtype) * jnp.inf  # self is not a neighbor
+    neg_d2, idx = jax.lax.top_k(-d2, n_neighbors)
+    knn_d = jnp.sqrt(jnp.maximum(-neg_d2, 0.0))
+    w = _smooth_knn_weights(knn_d, n_neighbors)
+    dense = jnp.zeros((n, n), jnp.float32)
+    dense = dense.at[jnp.arange(n)[:, None], idx].set(w.astype(jnp.float32))
+    return dense + dense.T - dense * dense.T
+
+
+def umap_layout(
+    emb: np.ndarray,
+    config: UMAPConfig = UMAPConfig(),
+    callback=None,
+) -> np.ndarray:
+    """(N, D) embedding → (N, 2) UMAP layout on the default device."""
+    cfg = config
+    a, b = fit_ab(cfg.min_dist, cfg.spread)
+    x = pca_reduce(np.asarray(emb, np.float32), cfg.pca_dims)
+    # umap-learn clamps k to N-1 (with a warning) — top_k would error on
+    # a matrix smaller than the neighbor count
+    n_neighbors = max(1, min(int(cfg.n_neighbors), x.shape[0] - 1))
+    p = jax.jit(_fuzzy_graph, static_argnums=1)(
+        jnp.asarray(x), n_neighbors
+    )
+
+    y0 = pca_reduce(x, 2)
+    y0 = y0 / max(np.abs(y0).max(), 1e-12) * cfg.init_scale
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    @jax.jit
+    def iterate(y, p, it):
+        yc = y.astype(compute_dtype)
+        u = _squared_distances(yc)
+        pb = p.astype(compute_dtype)
+        ub = jnp.power(jnp.maximum(u, 1e-12), jnp.asarray(b, compute_dtype))
+        q_inv = 1.0 + jnp.asarray(a, compute_dtype) * ub
+        attract = (2.0 * a * b) * ub / jnp.maximum(u, 1e-12) / q_inv * pb
+        repel = (
+            jnp.asarray(2.0 * b * cfg.repulsion, compute_dtype)
+            / ((u + 1e-3) * q_inv)
+            * (1.0 - pb)
+        )
+        n = y.shape[0]
+        coef = (attract - repel) * (1.0 - jnp.eye(n, dtype=compute_dtype))
+        # force_i = Σ_j coef_ij (y_i − y_j): rowsum-fold + one MXU matmul
+        rows = jnp.sum(coef, axis=1, dtype=jnp.float32)
+        force = rows[:, None] * y - jnp.matmul(
+            coef, yc, precision=_HIGH
+        ).astype(jnp.float32)
+        # umap-learn clips per-coordinate sample gradients to ±4; the
+        # full-batch analogue bounds each point's aggregated step
+        force = jnp.clip(force, -4.0, 4.0)
+        lr = cfg.learning_rate * (1.0 - it / cfg.n_iters)
+        return y - lr * force
+
+    y = jnp.asarray(y0, jnp.float32)
+    for it in range(cfg.n_iters):
+        y = iterate(y, p, jnp.float32(it))
+        if callback is not None and (it + 1) % 50 == 0:
+            callback(it + 1, np.asarray(y))
+    out = np.asarray(y, np.float32)
+    if not np.isfinite(out).all():
+        raise FloatingPointError("UMAP layout diverged (non-finite coords)")
+    return out

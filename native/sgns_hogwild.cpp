@@ -68,6 +68,11 @@ struct XorShift {
 
 extern "C" {
 
+// Bumped when symbols/signatures change; the ctypes loader rebuilds a
+// stale .so instead of dlopening across an ABI change (pairio pattern).
+enum { SGNS_HOGWILD_ABI_VERSION = 2 };
+int64_t sgns_hogwild_abi_version(void) { return SGNS_HOGWILD_ABI_VERSION; }
+
 // Trains one epoch in place. Returns the mean per-example loss.
 float sgns_hogwild_epoch(
     float* emb, float* ctx, int64_t vocab, int32_t dim,
@@ -124,6 +129,92 @@ float sgns_hogwild_epoch(
           float s = g_exp.sig(dot);
           loss_sum -= (label > 0.5f) ? g_exp.logsigf(dot) : g_exp.logsigf(-dot);
           float g = (s - label) * lr;
+          for (int d = 0; d < dim; ++d) {
+            grad[d] += g * u[d];
+            u[d] -= g * v[d];
+          }
+        }
+        for (int d = 0; d < dim; ++d) v[d] -= grad[d];
+        ++examples;
+      }
+    }
+    thread_loss[static_cast<size_t>(tid)] = loss_sum;
+    thread_examples[static_cast<size_t>(tid)] = examples;
+  };
+
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads));
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& t : threads) t.join();
+
+  double loss = 0.0;
+  int64_t examples = 0;
+  for (int t = 0; t < n_threads; ++t) {
+    loss += thread_loss[static_cast<size_t>(t)];
+    examples += thread_examples[static_cast<size_t>(t)];
+  }
+  return examples ? static_cast<float>(loss / static_cast<double>(examples))
+                  : 0.0f;
+}
+
+// Hierarchical-softmax Hogwild epoch (the reference engine's hs=1
+// variants, gensim src/gene2vec.py:59 with sg=0/1): per example the
+// input row trains against the internal nodes of the TARGET token's
+// Huffman path — per node, label = 1 - code, g = (sigmoid(v.u) - label)
+// * lr, u and v update lock-free (word2vec hs semantics; the same
+// objective gene2vec_tpu/sgns/cbow_hs.py computes batched).  The tree
+// arrives as the framework's own (V, L) padded points/codes/lengths
+// (huffman.py), so both implementations score the identical tree.
+// cbow != 0 swaps roles: input = context, path of center — the 1-token-
+// window CBOW degeneration (SURVEY §2.2 #1).  Returns mean per-example
+// loss.
+float hs_hogwild_epoch(
+    float* emb, float* node, int32_t dim,
+    const int32_t* pairs, int64_t n_pairs,
+    const int32_t* points, const float* codes, const int32_t* lengths,
+    int32_t max_len,
+    float lr_start, float lr_end,
+    int32_t n_threads, int32_t both_directions, int32_t cbow) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> progress{0};
+  std::vector<double> thread_loss(static_cast<size_t>(n_threads), 0.0);
+  std::vector<int64_t> thread_examples(static_cast<size_t>(n_threads), 0);
+
+  auto worker = [&](int tid) {
+    std::vector<float> grad(static_cast<size_t>(dim));
+    int64_t lo = n_pairs * tid / n_threads;
+    int64_t hi = n_pairs * (tid + 1) / n_threads;
+    double loss_sum = 0.0;
+    int64_t examples = 0;
+    const int64_t kProgressChunk = 4096;
+    float lr = lr_start;
+
+    for (int64_t p = lo; p < hi; ++p) {
+      if ((p - lo) % kProgressChunk == 0) {
+        int64_t done = progress.fetch_add(kProgressChunk);
+        float frac = static_cast<float>(done) / static_cast<float>(n_pairs);
+        if (frac > 1.0f) frac = 1.0f;
+        lr = lr_start + (lr_end - lr_start) * frac;
+      }
+      for (int dir = 0; dir < (both_directions ? 2 : 1); ++dir) {
+        int32_t center = pairs[2 * p + dir];
+        int32_t context = pairs[2 * p + 1 - dir];
+        int32_t input = cbow ? context : center;
+        int32_t target = cbow ? center : context;
+        float* v = emb + static_cast<int64_t>(input) * dim;
+        std::memset(grad.data(), 0, sizeof(float) * static_cast<size_t>(dim));
+
+        int32_t len = lengths[target];
+        const int32_t* pts = points + static_cast<int64_t>(target) * max_len;
+        const float* cds = codes + static_cast<int64_t>(target) * max_len;
+        for (int32_t l = 0; l < len; ++l) {
+          float* u = node + static_cast<int64_t>(pts[l]) * dim;
+          float dot = 0.0f;
+          for (int d = 0; d < dim; ++d) dot += v[d] * u[d];
+          float s = g_exp.sig(dot);
+          loss_sum -=
+              (cds[l] < 0.5f) ? g_exp.logsigf(dot) : g_exp.logsigf(-dot);
+          float g = (s - (1.0f - cds[l])) * lr;
           for (int d = 0; d < dim; ++d) {
             grad[d] += g * u[d];
             u[d] -= g * v[d];

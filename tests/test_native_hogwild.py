@@ -58,3 +58,67 @@ def test_hogwild_checkpoint_resume(tmp_path, synthetic_corpus_dir):
         PairCorpus(vocab, pairs), cfg, backend="hogwild"
     ).run(out, log=msgs.append)
     assert any("resuming from iteration 2" in m for m in msgs)
+
+
+def test_hogwild_hs_learns_and_matches_tpu_objective(synthetic_corpus_dir):
+    """The native HS oracle (BASELINE config 4's CPU denominator) must
+    optimize the SAME objective as the jitted cbow_hs path: same Huffman
+    tree, comparable loss trajectory, and planted clusters recovered."""
+    import jax
+
+    from gene2vec_tpu.sgns.cbow_hs import CBOWHSTrainer
+    from gene2vec_tpu.sgns.native_backend import HogwildHSTrainer
+
+    vocab, pairs = load_corpus(synthetic_corpus_dir, "txt")
+    corpus = PairCorpus(vocab, pairs)
+    cfg = SGNSConfig(dim=16, seed=0, objective="cbow_hs", batch_pairs=64)
+
+    native = HogwildHSTrainer(corpus, cfg, n_threads=1)
+    p_nat = native.init()
+    rng = np.random.RandomState(0)
+    nat_losses = []
+    for it in range(40):
+        p_nat, loss = native.train_epoch(p_nat, rng=rng)
+        nat_losses.append(loss)
+
+    tpu = CBOWHSTrainer(corpus, cfg)
+    p_tpu = tpu.init()
+    tpu_losses = []
+    for it in range(40):
+        p_tpu, loss = tpu.train_epoch(
+            p_tpu, jax.random.fold_in(jax.random.PRNGKey(0), it)
+        )
+        tpu_losses.append(float(loss))
+
+    # same objective: both start at the same tree-determined plateau and
+    # both minimize it (sequential Hogwild descends faster per epoch than
+    # the batched step at tiny scale — only the objective must agree)
+    assert abs(nat_losses[0] - tpu_losses[0]) < 0.6, (
+        nat_losses[0], tpu_losses[0],
+    )
+    assert nat_losses[-1] < nat_losses[0] - 0.5
+    assert tpu_losses[-1] < tpu_losses[0] - 0.5
+
+    sep = cluster_separation(np.asarray(p_nat.emb), vocab.id_to_token)
+    assert sep > 0.2, sep
+
+
+def test_hogwild_hs_sg_variant_and_validation():
+    from gene2vec_tpu.sgns.native_backend import HogwildHSTrainer
+
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 50, (2048, 2)).astype(np.int32)
+    from gene2vec_tpu.io.vocab import Vocab
+
+    counts = np.bincount(pairs.reshape(-1), minlength=50).astype(np.int64)
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(50)], counts), pairs)
+    tr = HogwildHSTrainer(
+        corpus, SGNSConfig(dim=8, objective="sg_hs"), n_threads=2
+    )
+    params = tr.init()
+    params, l0 = tr.train_epoch(params)
+    for _ in range(10):
+        params, l1 = tr.train_epoch(params)
+    assert np.isfinite(l1) and l1 < l0
+    with pytest.raises(ValueError, match="hs objectives"):
+        HogwildHSTrainer(corpus, SGNSConfig(objective="sgns"))

@@ -178,7 +178,8 @@ def test_dash_serve_gated():
         serve("/nonexistent.json")
 
 
-def test_umap_gated():
+def test_umap_3d_gated():
+    """n_components != 2 still needs umap-learn; 2-D is served in-repo."""
     try:
         import umap  # noqa: F401
 
@@ -188,7 +189,46 @@ def test_umap_gated():
     from gene2vec_tpu.viz.plot import reduce_embedding
 
     with pytest.raises(ImportError, match="umap"):
-        reduce_embedding(np.zeros((10, 4), np.float32), method="umap")
+        reduce_embedding(
+            np.zeros((10, 4), np.float32), method="umap", n_components=3
+        )
+
+
+def test_umap_fit_ab_canonical():
+    """The kernel fit at default min_dist/spread must land on the
+    canonical umap-learn values (a ~= 1.58, b ~= 0.90)."""
+    from gene2vec_tpu.viz.umap import fit_ab
+
+    a, b = fit_ab(0.1, 1.0)
+    assert abs(a - 1.58) < 0.12, a
+    assert abs(b - 0.90) < 0.08, b
+
+
+def test_umap_separates_blobs_like_tsne():
+    """TPU UMAP (full-batch CE optimizer) must separate planted blobs at
+    least as cleanly as the t-SNE sanity bound (VERDICT r4 item 8)."""
+    from gene2vec_tpu.viz.umap import UMAPConfig, umap_layout
+
+    x, labels = _blobs()
+    y = umap_layout(
+        x, UMAPConfig(pca_dims=10, n_iters=200, n_neighbors=10, seed=0)
+    )
+    assert y.shape == (x.shape[0], 2)
+    assert np.isfinite(y).all()
+    dists = np.linalg.norm(y[:, None] - y[None, :], axis=-1)
+    same = labels[:, None] == labels[None, :]
+    np.fill_diagonal(same, False)
+    intra = dists[same].mean()
+    inter = dists[~same & ~np.eye(len(y), dtype=bool)].mean()
+    assert inter > 2.0 * intra, (intra, inter)
+
+
+def test_umap_via_reduce_embedding():
+    from gene2vec_tpu.viz.plot import reduce_embedding
+
+    x, _ = _blobs()
+    y = reduce_embedding(x, method="umap")
+    assert y.shape == (x.shape[0], 2) and np.isfinite(y).all()
 
 
 _OBO = """format-version: 1.2
