@@ -40,12 +40,20 @@ from gene2vec_tpu.viz.tsne import _squared_distances, pca_reduce
 _HIGH = jax.lax.Precision.HIGHEST
 
 
-def fit_ab(min_dist: float = 0.1, spread: float = 1.0) -> Tuple[float, float]:
+def fit_ab(
+    min_dist: float = 0.1,
+    spread: float = 1.0,
+    fixed_b: Optional[float] = None,
+) -> Tuple[float, float]:
     """Fit the low-dim kernel 1/(1 + a·d^{2b}) to the piecewise target
     exp(−(d − min_dist)/spread) for d > min_dist, 1 otherwise — the same
     least-squares fit umap-learn performs with scipy.curve_fit, done with
     a coarse grid + Gauss-Newton polish (no scipy dependency).  For the
-    defaults this lands on the canonical (a ≈ 1.58, b ≈ 0.90)."""
+    defaults this lands on the canonical (a ≈ 1.58, b ≈ 0.90).
+
+    ``fixed_b`` pins the exponent and fits only ``a`` — the fast-kernel
+    path pins b = 7/8 so u^b lowers to a 3-rsqrt chain instead of a
+    transcendental pow per (N, N) element (see :func:`umap_layout`)."""
     d = np.linspace(0, 3.0 * spread, 300)
     target = np.where(
         d <= min_dist, 1.0, np.exp(-(d - min_dist) / spread)
@@ -54,26 +62,33 @@ def fit_ab(min_dist: float = 0.1, spread: float = 1.0) -> Tuple[float, float]:
     def resid(a, b):
         return 1.0 / (1.0 + a * d ** (2.0 * b)) - target
 
+    b_grid = (
+        [fixed_b] if fixed_b is not None else np.linspace(0.5, 2.0, 31)
+    )
     best = (1.0, 1.0, np.inf)
     for a in np.linspace(0.5, 3.0, 26):
-        for b in np.linspace(0.5, 2.0, 31):
+        for b in b_grid:
             s = float(np.sum(resid(a, b) ** 2))
             if s < best[2]:
                 best = (a, b, s)
     a, b = best[0], best[1]
-    for _ in range(40):  # Gauss-Newton on (a, b)
+    for _ in range(40):  # Gauss-Newton on (a, b) (or a alone)
         u = d ** (2.0 * b)
         q = 1.0 / (1.0 + a * u)
         r = q - target
         da = -u * q * q
-        db = -a * u * np.log(np.maximum(d, 1e-12)) * 2.0 * q * q
-        J = np.stack([da, db], axis=1)
-        g = J.T @ r
-        H = J.T @ J + 1e-6 * np.eye(2)
-        step = np.linalg.solve(H, g)
-        a, b = float(a - step[0]), float(b - step[1])
+        if fixed_b is not None:
+            step_a = float(np.dot(da, r) / (np.dot(da, da) + 1e-6))
+            a = float(a - step_a)
+        else:
+            db = -a * u * np.log(np.maximum(d, 1e-12)) * 2.0 * q * q
+            J = np.stack([da, db], axis=1)
+            g = J.T @ r
+            H = J.T @ J + 1e-6 * np.eye(2)
+            step = np.linalg.solve(H, g)
+            a, b = float(a - step[0]), float(b - step[1])
+            b = min(max(b, 1e-2), 4.0)
         a = min(max(a, 1e-3), 10.0)
-        b = min(max(b, 1e-2), 4.0)
     return a, b
 
 
@@ -85,6 +100,11 @@ class UMAPConfig:
     n_iters: int = 400
     learning_rate: float = 1.0
     repulsion: float = 1.0      # γ — weight on the (1 − p) repulsive term
+    fast_kernel: bool = True    # pin b = 7/8 (a refit to the same target
+                                # curve): u^b becomes u·rsqrt³(u) — the
+                                # (N, N) pow was the measured iteration
+                                # bottleneck at 24k (PERF_NOTES round 5).
+                                # False restores the exact 2-parameter fit.
     pca_dims: int = 50          # high-dim pre-reduction (t-SNE parity)
     init_scale: float = 10.0    # PCA-2 init rescaled to this max-extent
     seed: int = 0
@@ -141,7 +161,10 @@ def umap_layout(
 ) -> np.ndarray:
     """(N, D) embedding → (N, 2) UMAP layout on the default device."""
     cfg = config
-    a, b = fit_ab(cfg.min_dist, cfg.spread)
+    a, b = fit_ab(
+        cfg.min_dist, cfg.spread,
+        fixed_b=0.875 if cfg.fast_kernel else None,
+    )
     x = pca_reduce(np.asarray(emb, np.float32), cfg.pca_dims)
     # umap-learn clamps k to N-1 (with a warning) — top_k would error on
     # a matrix smaller than the neighbor count
@@ -156,10 +179,22 @@ def umap_layout(
 
     @jax.jit
     def iterate(y, p, it):
+        # The iteration is HBM-bound, so ONE (N, N) array materializes:
+        # at 2 components the pairwise distance is a 2-term broadcast sum
+        # (no matmul), which lets XLA fuse distances → kernel → coef into
+        # a single pass (the viz/tsne.py round-4 recipe, 49 → 253 it/s),
+        # and a ones-column folds the rowsum into the force matmul so
+        # coef is read exactly once.
         yc = y.astype(compute_dtype)
-        u = _squared_distances(yc)
+        y0, y1 = yc[:, 0], yc[:, 1]
+        u = (y0[:, None] - y0[None, :]) ** 2 + (y1[:, None] - y1[None, :]) ** 2
         pb = p.astype(compute_dtype)
-        ub = jnp.power(jnp.maximum(u, 1e-12), jnp.asarray(b, compute_dtype))
+        um = jnp.maximum(u, 1e-12)
+        if cfg.fast_kernel:
+            # u^{7/8} = u · u^{−1/8}, three rsqrts — no transcendental pow
+            ub = um * jax.lax.rsqrt(jax.lax.rsqrt(jax.lax.rsqrt(um)))
+        else:
+            ub = jnp.power(um, jnp.asarray(b, compute_dtype))
         q_inv = 1.0 + jnp.asarray(a, compute_dtype) * ub
         attract = (2.0 * a * b) * ub / jnp.maximum(u, 1e-12) / q_inv * pb
         repel = (
@@ -169,11 +204,12 @@ def umap_layout(
         )
         n = y.shape[0]
         coef = (attract - repel) * (1.0 - jnp.eye(n, dtype=compute_dtype))
-        # force_i = Σ_j coef_ij (y_i − y_j): rowsum-fold + one MXU matmul
-        rows = jnp.sum(coef, axis=1, dtype=jnp.float32)
-        force = rows[:, None] * y - jnp.matmul(
-            coef, yc, precision=_HIGH
-        ).astype(jnp.float32)
+        # force_i = Σ_j coef_ij (y_i − y_j) = rowsum_i·y_i − (coef @ y)_i
+        aug = jnp.concatenate(
+            [yc, jnp.ones((n, 1), compute_dtype)], axis=1
+        )
+        fr = jnp.matmul(coef, aug, precision=_HIGH).astype(jnp.float32)
+        force = fr[:, 2:3] * y - fr[:, :2]
         # umap-learn clips per-coordinate sample gradients to ±4; the
         # full-batch analogue bounds each point's aggregated step
         force = jnp.clip(force, -4.0, 4.0)

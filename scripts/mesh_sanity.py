@@ -12,7 +12,7 @@ down) on 1/2/4/8-device meshes of the FORCED-CPU backend, pinning
 
 Corpus and timing discipline are imported from bench.py itself
 (``synth_corpus``, ``_steady_rate``) so the table cannot desynchronize
-from the headline recipe.  Writes MESH_SANITY_r04.json at the repo
+from the headline recipe.  Writes MESH_SANITY_r05.json at the repo
 root.  Forced-CPU because the bench host has one TPU chip; the same
 ``bench.py --mesh-data 8`` command produces the real multi-chip number
 when hardware is attached.
@@ -107,7 +107,7 @@ def main():
         "config": {"V": V, "dim": D, "pairs": N, "batch": B},
         "rows": rows,
     }
-    with open(os.path.join(REPO, "MESH_SANITY_r04.json"), "w") as f:
+    with open(os.path.join(REPO, "MESH_SANITY_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out, indent=1))
 

@@ -1,6 +1,6 @@
 """Real-data intrinsic score via the reference's target function
 (VERDICT r3 item 4): pathway-ratio for the real-corpus-trained embedding
-vs a random table, written to INTRINSIC_r04.json.
+vs a random table, written to INTRINSIC_r05.json.
 
 **Pathway source & limitation (documented, not hidden).**  The canonical
 input is MSigDB v6.1 (``src/evaluation_target_function.py:54-60``), which
@@ -171,7 +171,7 @@ def main():
         "random_table.intra_set_cos_real_sets (no geometry at all); "
         "trained_target_func_ratio is the reference-comparable number."
     )
-    with open(os.path.join(REPO, "INTRINSIC_r04.json"), "w") as f:
+    with open(os.path.join(REPO, "INTRINSIC_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
 

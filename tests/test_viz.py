@@ -202,6 +202,10 @@ def test_umap_fit_ab_canonical():
     a, b = fit_ab(0.1, 1.0)
     assert abs(a - 1.58) < 0.12, a
     assert abs(b - 0.90) < 0.08, b
+    # fast-kernel path: b pinned to 7/8, a refit to the same curve
+    a8, b8 = fit_ab(0.1, 1.0, fixed_b=0.875)
+    assert b8 == 0.875
+    assert abs(a8 - 1.58) < 0.25, a8
 
 
 def test_umap_separates_blobs_like_tsne():
