@@ -577,7 +577,24 @@ def main() -> None:
     ap.add_argument("--data-dir", default="/root/reference/predictionData",
                     help="reference predictionData for the gate's real-"
                     "data AUC check (recorded as SKIPPED when absent)")
+    ap.add_argument("--run-dir", default=None,
+                    help="observed-run directory (manifest.json + "
+                    "events.jsonl + metrics.prom; summarize with "
+                    "`python -m gene2vec_tpu.cli.obs report`); default "
+                    "runs/bench_<unix-ts> next to this script")
     args = ap.parse_args()
+
+    from gene2vec_tpu.obs.run import Run
+
+    run_dir = args.run_dir or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "runs", f"bench_{int(time.time())}",
+    )
+    # probe_devices=False at construction: the dedicated-process probes
+    # below must see an untouched chip; backend facts are annotated after
+    # this process first initializes jax anyway.
+    run = Run(run_dir, name="bench", config=vars(args), probe_devices=False)
+    log(f"observed run dir: {run_dir}")
 
     # bf16-table opt-in probe FIRST: it needs the chip to itself, before
     # this process initializes its own TPU client (bf16_table_probe doc).
@@ -589,11 +606,15 @@ def main() -> None:
     headline = None
     if args.mesh_data == 0:
         # headline FIRST (cleanest device state), then the bf16 probe
-        headline = headline_probe(
-            args.dim, args.vocab, args.pairs, args.batch
-        )
+        with run.span("headline_probe"):
+            headline = headline_probe(
+                args.dim, args.vocab, args.pairs, args.batch
+            )
         if not args.no_secondary:
-            bf16_rate = bf16_table_probe(args.vocab, args.pairs, args.batch)
+            with run.span("bf16_table_probe"):
+                bf16_rate = bf16_table_probe(
+                    args.vocab, args.pairs, args.batch
+                )
     elif args.mesh_data > 0:
         log("dedicated-process probes skipped under --mesh-data (the "
             "device-count check below must claim the chips first)")
@@ -611,11 +632,17 @@ def main() -> None:
     quality = {}
     if not args.no_quality_gate:
         log("=== quality gate (headline config must learn) ===")
-        quality = quality_gate(args.dim, args.batch, args.data_dir)
+        with run.span("quality_gate") as span_out:
+            quality = quality_gate(args.dim, args.batch, args.data_dir)
+            span_out["passed"] = quality["passed"]
         log(f"quality: {quality}")
         if not quality["passed"]:
             # No headline for a trainer that does not learn (round-2
             # verdict: "fast and wrong is wrong").
+            run.event("quality_gate_failed", **{
+                k: v for k, v in quality.items() if not isinstance(v, dict)
+            })
+            run.close()
             print(json.dumps({
                 "metric": "sgns_pairs_per_sec",
                 "value": 0.0,
@@ -637,16 +664,24 @@ def main() -> None:
             "rate_band": band,
         }
     else:
-        tpu_rate, mesh_info = measure_pairs_per_sec(
-            args.dim, args.vocab, args.pairs, args.batch, args.mesh_data
-        )
+        with run.span("measure_headline_in_process"):
+            tpu_rate, mesh_info = measure_pairs_per_sec(
+                args.dim, args.vocab, args.pairs, args.batch, args.mesh_data
+            )
+    run.annotate(backend={
+        "platform": mesh_info["platform"],
+        "device_count": mesh_info["devices"],
+        "mesh": mesh_info["mesh"],
+    })
+    run.probe()
 
     vs = vs32 = base1 = None
     extrapolated = None
     try:
-        cpu_best, cpu_1core, curve = hogwild_baseline(
-            args.dim, args.vocab, args.cpu_pairs
-        )
+        with run.span("hogwild_baseline"):
+            cpu_best, cpu_1core, curve = hogwild_baseline(
+                args.dim, args.vocab, args.cpu_pairs
+            )
         base1 = cpu_1core
         vs = tpu_rate / cpu_best
         # Linear 32-thread extrapolation from the measured per-core rate —
@@ -665,7 +700,10 @@ def main() -> None:
 
     secondary = {}
     if not args.no_secondary:
-        secondary = secondary_metrics(args.vocab, args.secondary_pairs, args.batch)
+        with run.span("secondary_metrics"):
+            secondary = secondary_metrics(
+                args.vocab, args.secondary_pairs, args.batch
+            )
         if bf16_rate is not None:
             secondary["table_bf16_pairs_per_sec"] = bf16_rate
             # unlike the other secondaries (measured at secondary_pairs),
@@ -704,6 +742,13 @@ def main() -> None:
         result["quality"] = quality
     if secondary:
         result["secondary"] = secondary
+    run.event(
+        "bench_result",
+        **{k: v for k, v in result.items() if not isinstance(v, dict)},
+    )
+    run.registry.gauge("sgns_pairs_per_sec").set(tpu_rate)
+    run.probe()
+    run.close()
     print(json.dumps(result))
 
 

@@ -14,5 +14,7 @@ system (SURVEY §5 "config/flag system"):
 * ``python -m gene2vec_tpu.cli.tsne`` / ``...cli.plot``
   — ``src/tsne_multi_core.py`` / ``src/plot_gene2vec.py`` parity;
 * ``python -m gene2vec_tpu.cli.dashboard --figure-json fig.json``
-  — ``src/gene2vec_dash_app.py:17-27`` parity (GeneView, needs dash).
+  — ``src/gene2vec_dash_app.py:17-27`` parity (GeneView, needs dash);
+* ``python -m gene2vec_tpu.cli.obs report <run_dir>``
+  — summarize any observed run directory (docs/OBSERVABILITY.md).
 """

@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--backend", choices=("numpy", "jax"), default="numpy",
         help="correlation matmul backend (jax = TPU MXU)",
     )
+    p.add_argument(
+        "--run-dir", default=None,
+        help="observe the build: manifest.json + events.jsonl with "
+             "per-study spans (summarize with "
+             "`python -m gene2vec_tpu.cli.obs report <run_dir>`)",
+    )
     return p
 
 
@@ -54,6 +60,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parallel=args.parallel,
         num_workers=args.num_workers or None,
         backend=args.backend,
+        run_dir=args.run_dir,
     )
     return 0
 

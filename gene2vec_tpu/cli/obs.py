@@ -1,0 +1,76 @@
+"""Observability CLI: summarize observed run directories.
+
+* ``python -m gene2vec_tpu.cli.obs report <run_dir>`` — render the
+  per-phase/throughput/HBM/stall summary of any run directory that
+  holds the standard artifacts (``manifest.json`` + ``events.jsonl``,
+  written by every trainer's ``run()`` and by ``bench.py``);
+* ``python -m gene2vec_tpu.cli.obs list <root>`` — find observed run
+  directories under a root.
+
+Schema and run-dir layout: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="obs",
+        description="Summarize observed run directories "
+                    "(manifest.json + events.jsonl).",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report", help="summarize one run directory")
+    rep.add_argument("run_dir", help="directory holding events.jsonl / "
+                     "manifest.json (e.g. a trainer export dir)")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the structured summary as JSON instead of "
+                     "the human-readable report")
+    ls = sub.add_parser("list", help="find observed run dirs under a root")
+    ls.add_argument("root", nargs="?", default=".")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from gene2vec_tpu.obs import report
+
+    if args.command == "list":
+        for d in report.find_runs(args.root):
+            print(d)
+        return 0
+
+    run_dir = args.run_dir
+    if not os.path.isdir(run_dir):
+        print(f"obs report: {run_dir} is not a directory", file=sys.stderr)
+        return 2
+    has_artifacts = any(
+        os.path.exists(os.path.join(run_dir, f))
+        for f in ("events.jsonl", "manifest.json")
+    )
+    if not has_artifacts:
+        nested = report.find_runs(run_dir)
+        if len(nested) == 1:
+            run_dir = nested[0]
+        else:
+            print(
+                f"obs report: {run_dir} holds no events.jsonl/manifest.json"
+                + (f"; candidates:\n  " + "\n  ".join(nested) if nested else ""),
+                file=sys.stderr,
+            )
+            return 2
+    if args.json:
+        print(json.dumps(report.summarize(run_dir), indent=1, default=str))
+    else:
+        print(report.format_report(run_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,18 +220,68 @@ def build_pairs(
     num_workers: Optional[int] = None,
     backend: str = "numpy",
     log: Callable[[str], None] = print,
+    run_dir: Optional[str] = None,
 ) -> List[str]:
     """End-to-end: query dir (``data/SRARunTable.csv``,
     ``data/gene_counts_TPM.csv``, ``data/gene_counts.csv``) → pair lines,
-    optionally written to ``out_path``."""
+    optionally written to ``out_path``.
+
+    ``run_dir`` observes the build (docs/OBSERVABILITY.md): manifest +
+    per-study spans/pair counts, so slow or pair-heavy studies are
+    attributable from ``obs report``.
+    """
+    run = None
+    if run_dir is not None:
+        from gene2vec_tpu.obs.run import Run
+
+        run = Run(
+            run_dir, name="generate_pairs",
+            config={
+                "query_dir": query_dir, "corr_threshold": corr_threshold,
+                "min_study_samples": min_study_samples,
+                "min_total_counts": min_total_counts, "ensembl": ensembl,
+                "parallel": parallel, "backend": backend,
+            },
+            # never initialize a jax backend here, even for backend=jax:
+            # the parallel path forks an mp.Pool below, and a client
+            # initialized before the fork hangs/crashes the workers.
+            # Backend facts are annotated after the correlation work.
+            probe_devices=False,
+        )
+    try:
+        return _build_pairs_observed(
+            query_dir, out_path, corr_threshold, min_study_samples,
+            min_total_counts, ensembl, parallel, num_workers, backend, log,
+            run,
+        )
+    finally:
+        if run is not None:
+            run.close()
+
+
+def _build_pairs_observed(
+    query_dir, out_path, corr_threshold, min_study_samples,
+    min_total_counts, ensembl, parallel, num_workers, backend, log, run,
+) -> List[str]:
+    import contextlib
+
     import pandas as pd
 
-    run_table = pd.read_csv(os.path.join(query_dir, "data", "SRARunTable.csv"), index_col=0)
-    data = pd.read_csv(
-        os.path.join(query_dir, "data", "gene_counts_TPM.csv"), index_col=0
+    span = run.span if run is not None else (
+        lambda name, **a: contextlib.nullcontext({})
     )
-    gene_counts = pd.read_csv(os.path.join(query_dir, "data", "gene_counts.csv"))
-    data = data.loc[run_table.index.tolist()]
+
+    with span("load_inputs"):
+        run_table = pd.read_csv(
+            os.path.join(query_dir, "data", "SRARunTable.csv"), index_col=0
+        )
+        data = pd.read_csv(
+            os.path.join(query_dir, "data", "gene_counts_TPM.csv"), index_col=0
+        )
+        gene_counts = pd.read_csv(
+            os.path.join(query_dir, "data", "gene_counts.csv")
+        )
+        data = data.loc[run_table.index.tolist()]
 
     study_counts = run_table["SRA Study"].value_counts()
     studies = study_counts.index[study_counts >= min_study_samples].tolist()
@@ -254,15 +304,32 @@ def build_pairs(
     if parallel and len(jobs) > 1:
         import multiprocessing as mp
 
-        with mp.Pool(num_workers or os.cpu_count()) as pool:
-            results = pool.map(_study_pairs, jobs)
+        # pool workers carry no tracer; the map is one span, per-study
+        # pair counts land as events afterwards
+        with span("correlate_studies", n_studies=len(jobs), parallel=True):
+            with mp.Pool(num_workers or os.cpu_count()) as pool:
+                results = pool.map(_study_pairs, jobs)
+        if run is not None:
+            for s, r in zip(studies, results):
+                run.event("study", study=str(s), n_pairs=len(r))
     else:
-        results = [_study_pairs(j) for j in jobs]
+        results = []
+        for s, j in zip(studies, jobs):
+            with span("study", study=str(s), n_samples=len(j[2])) as out:
+                r = _study_pairs(j)
+                out["n_pairs"] = len(r)
+            results.append(r)
 
     pairs = [p for r in results for p in r]
+    if run is not None:
+        run.registry.counter("studies_total").inc(len(studies))
+        run.registry.counter("pairs_total").inc(len(pairs))
+        run.annotate_backend()  # jax (if used) is initialized by now
+        run.probe()
     log(f"{len(pairs):,} total co-expression gene pairs computed")
     if out_path is not None:
-        with open(out_path, "w", encoding="utf-8") as f:
-            f.write("\n".join(pairs))
+        with span("write_output", path=out_path):
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write("\n".join(pairs))
         log(f"wrote {out_path}")
     return pairs

@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from gene2vec_tpu.obs.run import Run
 from gene2vec_tpu.utils.metrics import MetricsLogger
 
 
@@ -54,7 +55,7 @@ class GGIPNNRun:
     """
 
     def __init__(self, out_dir: Optional[str] = None, max_to_keep: int = 5,
-                 base_dir: str = "runs"):
+                 base_dir: str = "runs", config=None):
         if out_dir is None:
             out_dir = os.path.join(base_dir, str(int(time.time())))
         self.out_dir = os.path.abspath(out_dir)
@@ -69,6 +70,10 @@ class GGIPNNRun:
             os.path.join(dev_dir, "metrics.csv"), tensorboard_dir=dev_dir
         )
         self.max_to_keep = max_to_keep
+        # the unified observability layer rides in the same run dir:
+        # manifest.json + events.jsonl + metrics.prom next to summaries/
+        # (docs/OBSERVABILITY.md), so `obs report <run_dir>` works here too
+        self.obs = Run(self.out_dir, name="ggipnn", config=config)
 
     # -- summaries ---------------------------------------------------------
 
@@ -85,18 +90,24 @@ class GGIPNNRun:
                 if self._train._tb is not None:
                     self._train._tb.add_histogram(f"{name}/grad/hist", g, step)
         self._train.log(step, metrics)
+        self.obs.registry.counter("train_steps_total").inc()
+        self.obs.registry.gauge("train_loss").set(float(loss))
+        self.obs.registry.gauge("train_accuracy").set(float(accuracy))
 
     def log_dev(self, step: int, loss: float, accuracy: float) -> None:
         self._dev.log(
             step, {"loss": float(loss), "accuracy": float(accuracy)}
         )
+        self.obs.event("dev_eval", step=step, loss=float(loss),
+                       accuracy=float(accuracy))
 
     # -- checkpoints -------------------------------------------------------
 
     def checkpoint(self, step: int, params: dict) -> str:
         """``checkpoints/model-<step>.npz``, pruned to ``max_to_keep``."""
         path = os.path.join(self.checkpoint_dir, f"model-{step}.npz")
-        np.savez(path, **_flatten_params(params))
+        with self.obs.span("checkpoint", step=step):
+            np.savez(path, **_flatten_params(params))
         kept = sorted(
             (
                 int(m.group(1)), f
@@ -111,3 +122,4 @@ class GGIPNNRun:
     def close(self) -> None:
         self._train.close()
         self._dev.close()
+        self.obs.close()

@@ -190,10 +190,13 @@ class GGIPNNTrainer:
             return self._fit_scanned(
                 params, opt_state, x_train, y_train, x_valid, y_valid, log
             )
+        import time
+
         key = jax.random.PRNGKey(cfg.seed + 1)
         stacked = np.concatenate([x_train, y_train], axis=1)
         nx = x_train.shape[1]
         for batch in batch_iter(stacked, cfg.batch_size, cfg.num_epochs, seed=cfg.seed):
+            t0 = time.perf_counter()
             bx = jnp.asarray(batch[:, :nx].astype(np.int32))
             by = jnp.asarray(batch[:, nx:].astype(np.float32))
             key, sub = jax.random.split(key)
@@ -207,7 +210,13 @@ class GGIPNNTrainer:
                 )
             self._step += 1
             if run is not None:
-                run.log_train(self._step, float(loss), float(acc), grads)
+                loss_f, acc_f = float(loss), float(acc)  # blocks the step
+                # span-free watchdog feed: per-batch spans would write
+                # thousands of records; stalls still surface as events
+                run.obs.record_step(
+                    "train_step", time.perf_counter() - t0, step=self._step
+                )
+                run.log_train(self._step, loss_f, acc_f, grads)
             if self._step % cfg.evaluate_every == 0:
                 msg = f"step {self._step}: loss {float(loss):.4f} acc {float(acc):.4f}"
                 if x_valid is not None and y_valid is not None:
@@ -363,14 +372,24 @@ def run_classification(
     if run_dir is not None:
         from gene2vec_tpu.models.ggipnn_obs import GGIPNNRun
 
-        run = GGIPNNRun(run_dir)
+        run = GGIPNNRun(run_dir, config=config)
         log(f"Writing to {run.out_dir}")
     try:
-        params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log, run=run)
+        if run is not None:
+            with run.obs.span("fit", train_examples=len(enc["train"][0])):
+                params, _ = trainer.fit(
+                    *enc["train"], *enc["valid"], log=log, run=run
+                )
+            with run.obs.span("test_eval"):
+                result = trainer.evaluate(params, *enc["test"])
+            run.obs.event("test_result", **result)
+            run.obs.probe()
+        else:
+            params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log)
+            result = trainer.evaluate(params, *enc["test"])
     finally:
         if run is not None:
             run.close()
-    result = trainer.evaluate(params, *enc["test"])
     log(f"test accuracy: {result['accuracy']:.4f}")
     if "auc" in result:
         log(f"The AUC score is {result['auc']:.6f}")

@@ -1,0 +1,28 @@
+"""Unified observability layer: spans, metrics, probes, run manifests.
+
+One subsystem replaces the per-script CSV/JSON dumps that grew alongside
+the four trainers:
+
+* :mod:`gene2vec_tpu.obs.trace` — append-only JSON-lines span/event
+  tracer (``events.jsonl``); nested spans, wall + monotonic timestamps,
+  process/thread ids, so concurrent writers land in one merged timeline;
+* :mod:`gene2vec_tpu.obs.registry` — named counters/gauges/histograms
+  with a Prometheus-style text export and a CSV sink
+  (:class:`~gene2vec_tpu.utils.metrics.MetricsLogger`);
+* :mod:`gene2vec_tpu.obs.probes` — runtime samplers: live-array HBM
+  bytes, host RSS, jit compile counts, per-step collective bytes from
+  optimized HLO (the ``scripts/hlo_comm_audit.py`` logic as a library);
+* :mod:`gene2vec_tpu.obs.run` — the per-run orchestrator: writes
+  ``manifest.json`` (config hash, git sha, backend, versions, argv) at
+  run start and flags steps exceeding a rolling p99×3 budget as
+  ``stall`` events.
+
+Every trainer's ``run(export_dir)`` writes ``manifest.json`` +
+``events.jsonl`` into its export/run directory;
+``python -m gene2vec_tpu.cli.obs report <run_dir>`` summarizes any of
+them.  Schema and layout: docs/OBSERVABILITY.md.
+"""
+
+from gene2vec_tpu.obs.registry import MetricsRegistry  # noqa: F401
+from gene2vec_tpu.obs.run import Run, StallWatchdog, config_hash  # noqa: F401
+from gene2vec_tpu.obs.trace import Tracer, ambient_span, get_tracer  # noqa: F401

@@ -1,0 +1,167 @@
+"""Runtime probes: HBM, host RSS, jit compiles, collective bytes.
+
+Everything here degrades gracefully — a probe that cannot run on this
+backend/platform returns ``None`` rather than raising, so trainers can
+sample unconditionally.  jax is imported lazily: the native Hogwild
+trainer records manifests and RSS without paying a jax backend init.
+
+The HLO collective audit (:func:`collective_stats_from_hlo` /
+:func:`collective_stats`) is the ``scripts/hlo_comm_audit.py`` scanner
+as a library call, so trainers can record their per-step comm budget in
+the run manifest and the script stays a thin CLI over the same logic.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import sys
+from typing import Dict, Optional
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
+}
+
+# one HLO shape like "f32[24447,513]" or a tuple "(f32[8,2], u32[...])"
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute|"
+    r"all-to-all)\w*\("
+)
+
+
+def shape_bytes(text: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape appearing in ``text``."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def collective_stats_from_hlo(hlo_text: str) -> Dict:
+    """Count and size every collective in an optimized-HLO module text.
+
+    Returns ``{"collectives": {op: {"count", "output_bytes"}},
+    "total_bytes": N}`` — in a scanned epoch the loop body appears once,
+    so these are per-step numbers.
+    """
+    ops = collections.defaultdict(lambda: [0, 0])
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m:
+            out_shape, op = m.group(1), m.group(2)
+            ops[op][0] += 1
+            ops[op][1] += shape_bytes(out_shape)
+    return {
+        "collectives": {
+            op: {"count": c, "output_bytes": b} for op, (c, b) in ops.items()
+        },
+        "total_bytes": sum(b for _, b in ops.values()),
+    }
+
+
+def collective_stats(compiled_or_lowered) -> Optional[Dict]:
+    """:func:`collective_stats_from_hlo` over a jitted function's
+    ``.lower(...)`` result (compiled here) or an already-compiled object."""
+    try:
+        obj = compiled_or_lowered
+        if hasattr(obj, "compile"):
+            obj = obj.compile()
+        return collective_stats_from_hlo(obj.as_text())
+    except Exception:
+        return None
+
+
+def live_array_bytes() -> Optional[int]:
+    """Total bytes of live device arrays (``jax.live_arrays``) — the HBM
+    footprint attributable to this client on accelerator backends."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Resident set size of this process."""
+    try:
+        with open("/proc/self/status", "r", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux, bytes on macOS — close enough as a
+        # peak fallback when /proc is unavailable
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss * 1024 if sys.platform != "darwin" else rss
+    except Exception:
+        return None
+
+
+class CompileWatcher:
+    """Counts jax compilation events (jit cache misses) via the public
+    ``jax.monitoring`` listener hook.  ``count`` stays 0 when the hook is
+    unavailable; ``supported`` says whether the numbers mean anything."""
+
+    _installed: Optional["CompileWatcher"] = None
+
+    def __init__(self):
+        self.count = 0
+        self.supported = False
+        self.events: Dict[str, int] = {}
+
+    def _on_event(self, key: str, **kw) -> None:
+        if "compil" in key:  # /jax/core/compile events, version-tolerant
+            self.count += 1
+            self.events[key] = self.events.get(key, 0) + 1
+
+    @classmethod
+    def install(cls) -> "CompileWatcher":
+        """Idempotent process-wide installation (listeners cannot be
+        unregistered, so one watcher serves every Run in the process)."""
+        if cls._installed is not None:
+            return cls._installed
+        watcher = cls()
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_listener(
+                lambda key, **kw: watcher._on_event(key, **kw)
+            )
+            watcher.supported = True
+        except Exception:
+            watcher.supported = False
+        cls._installed = watcher
+        return watcher
+
+
+def sample(registry=None) -> Dict[str, Optional[int]]:
+    """One probe sample: HBM bytes, host RSS, cumulative compile count.
+    With ``registry`` (a :class:`~gene2vec_tpu.obs.registry.
+    MetricsRegistry`) the values also land in gauges."""
+    watcher = CompileWatcher._installed
+    out = {
+        "hbm_bytes": live_array_bytes(),
+        "host_rss_bytes": host_rss_bytes(),
+        "jit_compiles": watcher.count if watcher is not None else None,
+    }
+    if registry is not None:
+        for k, v in out.items():
+            if v is not None:
+                registry.gauge(k).set(v)
+    return out

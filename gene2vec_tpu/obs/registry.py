@@ -1,0 +1,228 @@
+"""Metrics registry: named counters/gauges/histograms.
+
+Trainers register instruments once and update them per iteration; the
+registry renders a Prometheus-style text exposition
+(:meth:`MetricsRegistry.prometheus_text`) and snapshots it to disk at a
+bounded cadence (:meth:`MetricsRegistry.maybe_snapshot` — called from
+the per-iteration log path, so no background thread is needed).
+
+The per-row CSV convention every trainer already used
+(``training_log.csv`` via :class:`~gene2vec_tpu.utils.metrics.
+MetricsLogger`) is absorbed as the registry's CSV sink:
+:meth:`MetricsRegistry.log_row` writes the row through the attached
+logger AND mirrors numeric values into same-named gauges, so the
+Prometheus export always carries the latest row.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Union
+
+from gene2vec_tpu.utils.metrics import MetricsLogger
+
+# powers-of-4 seconds-scale buckets: 61 µs .. 4,096 s covers everything
+# from a jitted step to a full corpus build
+_DEFAULT_BUCKETS = tuple(4.0 ** e for e in range(-7, 7))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting (+Inf / integer-exact values)."""
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: Union[int, float] = 1) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> List[str]:
+        return [
+            f"# TYPE {self.name} counter",
+            f"{self.name} {_fmt(self._value)}",
+        ]
+
+
+class Gauge:
+    """Last-written value."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> List[str]:
+        return [
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {_fmt(self._value)}",
+        ]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics) + min/max."""
+
+    def __init__(self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS):
+        self.name, self.help = name, help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            i = 0
+            while i < len(self.buckets) and value > self.buckets[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
+    def expose(self) -> List[str]:
+        lines = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for le, c in zip(self.buckets, self._counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+        lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+        lines.append(f"{self.name}_count {self._count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name → instrument registry with get-or-create accessors."""
+
+    def __init__(self):
+        self._instruments: Dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._csv: Optional[MetricsLogger] = None
+        self._last_snapshot = 0.0
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, help, **kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets=_DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    # -- exposition --------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            instruments = sorted(self._instruments.items())
+        for _, inst in instruments:
+            lines.extend(inst.expose())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot_to(self, path: str) -> None:
+        """Atomic (tmp + rename) write of the Prometheus exposition."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.prometheus_text())
+        os.replace(tmp, path)
+
+    def maybe_snapshot(
+        self, path: str, interval_s: float = 15.0, now: float = None
+    ) -> bool:
+        """Time-gated :meth:`snapshot_to` — call from any periodic code
+        path (the per-iteration log row); writes at most once per
+        ``interval_s``."""
+        import time
+
+        now = time.monotonic() if now is None else now
+        if now - self._last_snapshot < interval_s:
+            return False
+        self._last_snapshot = now
+        self.snapshot_to(path)
+        return True
+
+    # -- CSV sink ----------------------------------------------------------
+
+    def attach_csv(
+        self, csv_path: str, tensorboard_dir: Optional[str] = None
+    ) -> MetricsLogger:
+        """Attach the per-row CSV sink (the repo's ``training_log.csv``
+        convention); rows then flow through :meth:`log_row`."""
+        self._csv = MetricsLogger(csv_path, tensorboard_dir=tensorboard_dir)
+        return self._csv
+
+    def log_row(self, step: int, metrics: Dict[str, float]) -> None:
+        """One iteration row: CSV append + same-named gauges updated."""
+        if self._csv is not None:
+            self._csv.log(step, metrics)
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.gauge(k).set(v)
+
+    def close(self) -> None:
+        if self._csv is not None:
+            self._csv.close()
+            self._csv = None

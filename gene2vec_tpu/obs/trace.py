@@ -1,0 +1,198 @@
+"""Structured span tracer: append-only JSON-lines events.
+
+Every record is one JSON object on one line of ``events.jsonl``:
+
+* ``type``  — ``span_start`` | ``span_end`` | ``event`` | ``stall``;
+* ``name``  — span/event name;
+* ``wall``  — ``time.time()`` (the cross-process merge key);
+* ``mono``  — ``time.monotonic()`` (the within-process duration clock);
+* ``pid`` / ``tid`` — process id / thread id, so native Hogwild worker
+  activity, subprocess probes, and the jitted step loop land in one
+  merged timeline;
+* ``span`` / ``parent`` — span id and enclosing span id (nesting);
+* ``dur``   — seconds, on ``span_end`` records only;
+* free-form ``attrs``.
+
+Writes go through one ``os.write`` on an ``O_APPEND`` fd, so concurrent
+writers (multiple processes appending to the same file) never interleave
+within a line.  The fd is reopened after ``fork`` (pid change) so child
+processes do not share a file position.
+
+A module-level *ambient* tracer lets library code emit spans without
+threading a tracer handle through every call: :func:`ambient_span` uses
+the installed tracer when a :class:`~gene2vec_tpu.obs.run.Run` is active
+and otherwise buffers a bounded number of records in memory, which the
+next installed tracer flushes to disk — e.g. the native-backend ABI
+check runs at import/construction time, before any run dir exists, and
+still shows up in that run's timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+_PENDING_MAX = 256
+
+
+class Tracer:
+    """JSON-lines span/event writer bound to one ``events.jsonl`` path."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._fd: Optional[int] = None
+        self._fd_pid: Optional[int] = None
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # -- low-level ---------------------------------------------------------
+
+    def _ensure_fd(self) -> int:
+        pid = os.getpid()
+        if self._fd is None or self._fd_pid != pid:
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+            self._fd_pid = pid
+        return self._fd
+
+    def _stack(self) -> List[str]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    def write(self, record: Dict) -> None:
+        """Append one raw record (timestamps/pid/tid added if absent)."""
+        record.setdefault("wall", time.time())
+        record.setdefault("mono", time.monotonic())
+        record.setdefault("pid", os.getpid())
+        record.setdefault("tid", threading.get_ident())
+        line = json.dumps(record, separators=(",", ":"), default=str) + "\n"
+        with self._lock:
+            os.write(self._ensure_fd(), line.encode("utf-8"))
+
+    # -- spans / events ----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Dict]:
+        """Nested timed span.  Yields a dict; keys set on it during the
+        body are recorded as ``span_end`` attrs (e.g. a loss computed
+        inside the span)."""
+        stack = self._stack()
+        span_id = f"{os.getpid()}-{next(self._ids)}"
+        parent = stack[-1] if stack else None
+        t0 = time.monotonic()
+        self.write(
+            {
+                "type": "span_start", "name": name, "span": span_id,
+                "parent": parent, "mono": t0,
+                **({"attrs": attrs} if attrs else {}),
+            }
+        )
+        stack.append(span_id)
+        out_attrs: Dict = {}
+        try:
+            yield out_attrs
+        finally:
+            stack.pop()
+            t1 = time.monotonic()
+            merged = {**attrs, **out_attrs}
+            self.write(
+                {
+                    "type": "span_end", "name": name, "span": span_id,
+                    "parent": parent, "mono": t1, "dur": t1 - t0,
+                    **({"attrs": merged} if merged else {}),
+                }
+            )
+
+    def event(self, name: str, type: str = "event", **attrs) -> None:
+        stack = self._stack()
+        self.write(
+            {
+                "type": type, "name": name,
+                "span": stack[-1] if stack else None,
+                **({"attrs": attrs} if attrs else {}),
+            }
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None and self._fd_pid == os.getpid():
+                os.close(self._fd)
+            self._fd = None
+            self._fd_pid = None
+
+
+# -- ambient tracer ---------------------------------------------------------
+
+_current: Optional[Tracer] = None
+_pending: List[Dict] = []
+_pending_lock = threading.Lock()
+
+
+def get_tracer() -> Optional[Tracer]:
+    """The installed tracer, or None when no run is active."""
+    return _current
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Install (or clear) the ambient tracer.  Buffered pre-run records
+    are flushed to the newly installed tracer."""
+    global _current
+    _current = tracer
+    if tracer is not None:
+        with _pending_lock:
+            buffered, _pending[:] = _pending[:], []
+        for rec in buffered:
+            tracer.write(rec)
+
+
+@contextlib.contextmanager
+def ambient_span(name: str, **attrs) -> Iterator[Dict]:
+    """A span on the ambient tracer; with no tracer installed the record
+    is buffered (bounded) and flushed into the next run's timeline."""
+    tracer = _current
+    if tracer is not None:
+        with tracer.span(name, **attrs) as out:
+            yield out
+        return
+    t0m, t0w = time.monotonic(), time.time()
+    out: Dict = {}
+    try:
+        yield out
+    finally:
+        t1 = time.monotonic()
+        merged = {**attrs, **out}
+        rec = {
+            "type": "span_end", "name": name, "span": None, "parent": None,
+            "wall": t0w, "mono": t1, "dur": t1 - t0m, "pid": os.getpid(),
+            "tid": threading.get_ident(), "buffered": True,
+            **({"attrs": merged} if merged else {}),
+        }
+        with _pending_lock:
+            if len(_pending) < _PENDING_MAX:
+                _pending.append(rec)
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse an ``events.jsonl`` (skipping torn/partial trailing lines),
+    ordered by wall clock — the merged multi-process timeline."""
+    events = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    events.sort(key=lambda e: e.get("wall", 0.0))
+    return events

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import TYPE_CHECKING, Callable, Optional, Tuple
 
@@ -246,9 +247,9 @@ class CBOWHSTrainer:
         self.num_batches = corpus.num_batches(config.batch_pairs)
         self.timer = StepTimer()
         self.hs = config.objective.endswith("_hs")
+        self.split: Optional[ShallowSplit] = None
         if self.hs:
             self.tree: Optional[HuffmanTree] = build_huffman_tree(corpus.vocab.counts)
-            self.split: Optional[ShallowSplit] = None
             if config.hs_dense_depth > 0 and self.tree.num_nodes > 1:
                 self.split = split_shallow(self.tree, config.hs_dense_depth)
                 points = jnp.asarray(self.split.points_deep)
@@ -416,57 +417,88 @@ class CBOWHSTrainer:
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
     ) -> SGNSParams:
-        cfg = self.config
-        if start_iter is None:
-            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
-        if start_iter > 1:
-            params, _, meta = ckpt.load_iteration(
-                export_dir, cfg.dim, start_iter - 1,
-                table_dtype=cfg.table_dtype,
-            )
-            if self.hs:
-                # node-table row ids depend on the shallow-split layout;
-                # resuming a checkpoint saved under a different
-                # hs_dense_depth would silently feed permuted node
-                # vectors into the step (absent = pre-round-4 = depth 0)
-                saved_depth = int(meta.get("hs_dense_depth", 0))
-                if saved_depth != cfg.hs_dense_depth:
-                    raise ValueError(
-                        f"checkpoint in {export_dir} was saved with "
-                        f"hs_dense_depth={saved_depth}, config has "
-                        f"{cfg.hs_dense_depth}: node-table layouts differ "
-                        "— resume with the saved depth or start a fresh "
-                        "export dir"
-                    )
-            log(f"resuming from iteration {start_iter - 1}")
-        else:
-            params = self.init()
-            start_iter = 1
+        from gene2vec_tpu.obs.run import Run
 
-        root_key = jax.random.PRNGKey(cfg.seed)
-        pairs_per_epoch = self.num_batches * cfg.batch_pairs
-        for it in range(start_iter, cfg.num_iters + 1):
-            t0 = time.perf_counter()
-            params, loss = self.train_epoch(params, jax.random.fold_in(root_key, it))
-            loss = float(loss)
-            dt = time.perf_counter() - t0
-            rate = pairs_per_epoch / dt if dt > 0 else float("inf")
-            self.timer.record(pairs_per_epoch, dt)
-            log(
-                f"gene2vec [{cfg.objective}] dimension {cfg.dim} iteration "
-                f"{it} done: loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
-            )
-            ckpt.save_iteration(
-                export_dir, cfg.dim, it, params, self.corpus.vocab,
-                txt_output=cfg.txt_output,
-                meta={
-                    "loss": loss,
-                    "pairs_per_sec": rate,
-                    "objective": cfg.objective,
-                    # node-table layout tag: resume refuses a mismatch
-                    "hs_dense_depth": cfg.hs_dense_depth if self.hs else 0,
-                },
-            )
+        cfg = self.config
+        run = Run(
+            export_dir, name=cfg.objective, config=cfg,
+            manifest_extra={
+                "num_pairs": self.corpus.num_pairs,
+                "vocab_size": self.corpus.vocab_size,
+                "num_batches": self.num_batches,
+                "hs_shallow_nodes": self.split.n_shallow if self.split else 0,
+            },
+        )
+        run.registry.attach_csv(os.path.join(export_dir, "training_log.csv"))
+        # everything after Run construction runs under its finally, so a
+        # failed resume (e.g. the hs_dense_depth mismatch below) still
+        # closes the run instead of leaking the ambient tracer
+        try:
+            if start_iter is None:
+                start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+            if start_iter > 1:
+                params, _, meta = ckpt.load_iteration(
+                    export_dir, cfg.dim, start_iter - 1,
+                    table_dtype=cfg.table_dtype,
+                )
+                if self.hs:
+                    # node-table row ids depend on the shallow-split layout;
+                    # resuming a checkpoint saved under a different
+                    # hs_dense_depth would silently feed permuted node
+                    # vectors into the step (absent = pre-round-4 = depth 0)
+                    saved_depth = int(meta.get("hs_dense_depth", 0))
+                    if saved_depth != cfg.hs_dense_depth:
+                        raise ValueError(
+                            f"checkpoint in {export_dir} was saved with "
+                            f"hs_dense_depth={saved_depth}, config has "
+                            f"{cfg.hs_dense_depth}: node-table layouts differ "
+                            "— resume with the saved depth or start a fresh "
+                            "export dir"
+                        )
+                log(f"resuming from iteration {start_iter - 1}")
+            else:
+                params = self.init()
+                start_iter = 1
+
+            root_key = jax.random.PRNGKey(cfg.seed)
+            pairs_per_epoch = self.num_batches * cfg.batch_pairs
+            pairs_counter = run.registry.counter("pairs_total")
+            for it in range(start_iter, cfg.num_iters + 1):
+                t0 = time.perf_counter()
+                with run.step(
+                    "iteration", iteration=it, pairs=pairs_per_epoch
+                ) as span_out:
+                    params, loss = self.train_epoch(
+                        params, jax.random.fold_in(root_key, it)
+                    )
+                    loss = float(loss)
+                    span_out["loss"] = loss
+                dt = time.perf_counter() - t0
+                rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+                self.timer.record(pairs_per_epoch, dt)
+                pairs_counter.inc(pairs_per_epoch)
+                log(
+                    f"gene2vec [{cfg.objective}] dimension {cfg.dim} iteration "
+                    f"{it} done: loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
+                )
+                run.log_row(
+                    it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt}
+                )
+                run.probe()
+                with run.span("checkpoint", iteration=it):
+                    ckpt.save_iteration(
+                        export_dir, cfg.dim, it, params, self.corpus.vocab,
+                        txt_output=cfg.txt_output,
+                        meta={
+                            "loss": loss,
+                            "pairs_per_sec": rate,
+                            "objective": cfg.objective,
+                            # node-table layout tag: resume refuses a mismatch
+                            "hs_dense_depth": cfg.hs_dense_depth if self.hs else 0,
+                        },
+                    )
+        finally:
+            run.close()
         return params
 
 

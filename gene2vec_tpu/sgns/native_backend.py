@@ -9,6 +9,7 @@ the baseline is a real multithreaded C++ loop, not Python.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import time
@@ -20,6 +21,7 @@ from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
 from gene2vec_tpu.data.pipeline import PairCorpus
 from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.sgns.model import SGNSParams
 
 _NATIVE_DIR = os.path.join(
@@ -46,12 +48,53 @@ def _make() -> None:
             pass
 
 
+def _stamp_path(path: str) -> str:
+    return path + ".abi"
+
+
+def _so_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()[:16]
+
+
+def _stamp_ok(path: str) -> bool:
+    """True when the sidecar ``.abi`` stamp (written at build time by the
+    Makefile, or here after a successful probe) matches ``_ABI_VERSION``
+    AND was written for this exact ``.so`` (content hash on line 2) — the
+    cheap fast path that replaces the per-process subprocess ABI probe.
+    Binding to content rather than mtime means a stamp restored by e.g.
+    a git checkout can never validate a stale library."""
+    try:
+        with open(_stamp_path(path), "r", encoding="ascii") as f:
+            lines = f.read().split()
+        if len(lines) < 2 or int(lines[0]) != _ABI_VERSION:
+            return False
+        return lines[1] == _so_digest(path)
+    except (OSError, ValueError):
+        return False
+
+
+def _write_stamp(path: str) -> None:
+    try:
+        digest = _so_digest(path)
+        with open(_stamp_path(path), "w", encoding="ascii") as f:
+            f.write(f"{_ABI_VERSION}\n{digest}\n")
+    except OSError:
+        pass  # unwritable checkout: fall back to probing next process
+
+
 def _stale(path: str) -> bool:
     """ABI-check WITHOUT dlopening into this process: dlopen caches by
     path, so probing with ctypes.CDLL would pin a stale mapping that a
     post-rebuild re-CDLL silently returns again.  A subprocess probe
     leaves this process clean (the pairio pattern builds before loading;
-    here the .so may predate the ABI gate entirely, so we must inspect)."""
+    here the .so may predate the ABI gate entirely, so we must inspect).
+
+    Only reached when the ``.abi`` sidecar stamp is missing or
+    mismatched — the common case reads the stamp and never forks."""
     probe = (
         "import ctypes, sys\n"
         f"lib = ctypes.CDLL({path!r})\n"
@@ -77,20 +120,48 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_attempted
     if _lib is not None:
         return _lib
-    if not _build_attempted and (
-        not os.path.exists(_LIB_PATH) or _stale(_LIB_PATH)
-    ):
-        # build (or rebuild a stale pre-ABI-gate .so) BEFORE the first
-        # dlopen in this process
-        _build_attempted = True
-        _make()
-    if not os.path.exists(_LIB_PATH):
-        return None
-    lib = ctypes.CDLL(_LIB_PATH)
-    if not hasattr(lib, "sgns_hogwild_abi_version") or (
-        lib.sgns_hogwild_abi_version() != _ABI_VERSION
-    ):
-        return None  # rebuild failed or was disabled; never call across ABIs
+    with ambient_span("native_abi_check", lib="libsgns_hogwild.so") as span:
+        stamp_valid = False  # carried to the post-dlopen write below, so
+        # the fast path hashes the .so once, not twice
+        if not _build_attempted and not os.path.exists(_LIB_PATH):
+            _build_attempted = True
+            span["action"] = "build"
+            _make()
+        elif not _build_attempted:
+            stamp_valid = _stamp_ok(_LIB_PATH)
+            if stamp_valid:
+                span["action"] = "stamp_ok"
+            else:
+                # no (or mismatched) build-time stamp: one subprocess
+                # probe, then a rebuild if the .so really is a different
+                # ABI — BEFORE the first dlopen in this process
+                _build_attempted = True
+                if _stale(_LIB_PATH):
+                    span["action"] = "rebuild_stale"
+                    _make()
+                else:
+                    span["action"] = "probed_ok"
+                    _write_stamp(_LIB_PATH)  # next process skips the probe
+                    stamp_valid = True
+        else:
+            span["action"] = "stamp_ok"
+        if not os.path.exists(_LIB_PATH):
+            span["action"] = "missing"
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        if not hasattr(lib, "sgns_hogwild_abi_version") or (
+            lib.sgns_hogwild_abi_version() != _ABI_VERSION
+        ):
+            span["action"] = "abi_mismatch"
+            # Whatever said this .so was fine lied — drop the stamp so the
+            # next process probes (and rebuilds) instead of repeating this.
+            try:
+                os.remove(_stamp_path(_LIB_PATH))
+            except OSError:
+                pass
+            return None  # rebuild failed or was disabled; never call across ABIs
+        if not stamp_valid:
+            _write_stamp(_LIB_PATH)  # fresh build, or pre-stamp .so
     lib.sgns_hogwild_epoch.argtypes = [
         ctypes.POINTER(ctypes.c_float),   # emb
         ctypes.POINTER(ctypes.c_float),   # ctx
@@ -194,7 +265,15 @@ class HogwildHSTrainer:
         seed: int = 0,
         rng: Optional[np.random.RandomState] = None,
     ):
-        """One Hogwild HS epoch, updating the tables in place."""
+        """One Hogwild HS epoch.  Returns ``(updated SGNSParams, loss)``.
+
+        In-place contract (same as :meth:`HogwildSGNSTrainer.train_epoch`):
+        contiguous float32 *numpy* inputs are updated in place AND
+        returned; any other input — a JAX array, a non-contiguous view,
+        a different dtype — is **copied** first, so the caller's arrays
+        stay untouched and only the returned params carry the update.
+        Always use the return value.
+        """
         cfg = self.config
         emb = np.ascontiguousarray(np.asarray(params.emb), np.float32)
         node = np.ascontiguousarray(np.asarray(params.ctx), np.float32)
@@ -253,7 +332,15 @@ class HogwildSGNSTrainer:
     def train_epoch(
         self, params: SGNSParams, seed: int, rng: Optional[np.random.RandomState] = None
     ):
-        """One Hogwild epoch, updating the tables in place."""
+        """One Hogwild epoch.  Returns ``(updated SGNSParams, loss)``.
+
+        In-place contract: contiguous float32 *numpy* inputs are updated
+        in place AND returned; any other input — a JAX array, a
+        non-contiguous view, a different dtype — is **copied** first
+        (``np.ascontiguousarray``), so the caller's arrays stay untouched
+        and only the returned params carry the update.  Always use the
+        return value.
+        """
         cfg = self.config
         emb = np.ascontiguousarray(np.asarray(params.emb), np.float32)
         ctx = np.ascontiguousarray(np.asarray(params.ctx), np.float32)
@@ -277,51 +364,81 @@ class HogwildSGNSTrainer:
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
     ) -> SGNSParams:
-        cfg = self.config
-        if start_iter is None:
-            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
-        if start_iter > 1:
-            params, _, _ = ckpt.load_iteration(
-                export_dir, cfg.dim, start_iter - 1,
-                table_dtype="float32",  # this backend computes in f32
-            )
-            params = SGNSParams(
-                emb=np.asarray(params.emb), ctx=np.asarray(params.ctx)
-            )
-            log(f"resuming from iteration {start_iter - 1}")
-        else:
-            params = self.init()
-            start_iter = 1
-        from gene2vec_tpu.utils.metrics import MetricsLogger
+        from gene2vec_tpu.obs.run import Run
 
-        metrics = MetricsLogger(os.path.join(export_dir, "training_log.csv"))
-        for it in range(start_iter, cfg.num_iters + 1):
-            t0 = time.perf_counter()
-            # shuffle stream keyed by (seed, it) so a resumed run shuffles
-            # identically to an uninterrupted one (round-1 advisor finding);
-            # SeedSequence mixes non-additively so adjacent-seed runs don't
-            # share streams (seed=2 iter 1 vs seed=1 iter 2 — round-2
-            # advisor finding, same fix as numpy_backend)
-            mixed = int(
-                np.random.SeedSequence([cfg.seed, it]).generate_state(1)[0]
-            )
-            params, loss = self.train_epoch(
-                params,
-                seed=mixed,
-                rng=np.random.RandomState(mixed),
-            )
-            dt = time.perf_counter() - t0
-            rate = self.corpus.num_pairs / dt if dt > 0 else float("inf")
-            log(
-                f"gene2vec [hogwild x{self.n_threads}] dimension {cfg.dim} "
-                f"iteration {it} done: loss={loss:.4f} {rate:,.0f} pairs/s "
-                f"({dt:.2f}s)"
-            )
-            metrics.log(it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt})
-            ckpt.save_iteration(
-                export_dir, cfg.dim, it, params, self.corpus.vocab,
-                txt_output=cfg.txt_output,
-                meta={"loss": loss, "pairs_per_sec": rate, "backend": "hogwild"},
-            )
-        metrics.close()
+        cfg = self.config
+        # probe_devices=False: this trainer must not initialize a jax
+        # backend just to write a manifest.  The buffered native_abi_check
+        # span (ambient_span at _load time) flushes into this run's
+        # events.jsonl, so the ABI-probe cost is visible per run.
+        run = Run(
+            export_dir, name="hogwild", config=cfg, probe_devices=False,
+            manifest_extra={
+                "backend": {"platform": "native-cpu", "threads": self.n_threads},
+                "num_pairs": self.corpus.num_pairs,
+                "vocab_size": self.corpus.vocab_size,
+            },
+        )
+        run.registry.attach_csv(os.path.join(export_dir, "training_log.csv"))
+        # everything after Run construction runs under its finally, so a
+        # failed resume still closes the run instead of leaking the
+        # ambient tracer into later runs in this process
+        try:
+            if start_iter is None:
+                start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+            if start_iter > 1:
+                params, _, _ = ckpt.load_iteration(
+                    export_dir, cfg.dim, start_iter - 1,
+                    table_dtype="float32",  # this backend computes in f32
+                )
+                params = SGNSParams(
+                    emb=np.asarray(params.emb), ctx=np.asarray(params.ctx)
+                )
+                log(f"resuming from iteration {start_iter - 1}")
+            else:
+                params = self.init()
+                start_iter = 1
+            pairs_counter = run.registry.counter("pairs_total")
+            for it in range(start_iter, cfg.num_iters + 1):
+                t0 = time.perf_counter()
+                # shuffle stream keyed by (seed, it) so a resumed run shuffles
+                # identically to an uninterrupted one (round-1 advisor finding);
+                # SeedSequence mixes non-additively so adjacent-seed runs don't
+                # share streams (seed=2 iter 1 vs seed=1 iter 2 — round-2
+                # advisor finding, same fix as numpy_backend)
+                mixed = int(
+                    np.random.SeedSequence([cfg.seed, it]).generate_state(1)[0]
+                )
+                with run.step(
+                    "iteration", iteration=it, pairs=self.corpus.num_pairs
+                ) as span_out:
+                    params, loss = self.train_epoch(
+                        params,
+                        seed=mixed,
+                        rng=np.random.RandomState(mixed),
+                    )
+                    span_out["loss"] = loss
+                dt = time.perf_counter() - t0
+                rate = self.corpus.num_pairs / dt if dt > 0 else float("inf")
+                pairs_counter.inc(self.corpus.num_pairs)
+                log(
+                    f"gene2vec [hogwild x{self.n_threads}] dimension {cfg.dim} "
+                    f"iteration {it} done: loss={loss:.4f} {rate:,.0f} pairs/s "
+                    f"({dt:.2f}s)"
+                )
+                run.log_row(
+                    it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt}
+                )
+                run.probe()
+                with run.span("checkpoint", iteration=it):
+                    ckpt.save_iteration(
+                        export_dir, cfg.dim, it, params, self.corpus.vocab,
+                        txt_output=cfg.txt_output,
+                        meta={
+                            "loss": loss, "pairs_per_sec": rate,
+                            "backend": "hogwild",
+                        },
+                    )
+        finally:
+            run.close()
         return params

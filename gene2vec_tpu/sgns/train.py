@@ -524,52 +524,80 @@ class SGNSTrainer:
 
         ``profile_dir`` wraps the first post-resume epoch in a
         ``jax.profiler`` trace.  Per-iteration metrics (loss, pairs/sec)
-        append to ``<export_dir>/training_log.csv``.
+        append to ``<export_dir>/training_log.csv``; the full observed
+        run (``manifest.json`` + ``events.jsonl`` + ``metrics.prom``)
+        lands in the same directory (docs/OBSERVABILITY.md).
         """
-        from gene2vec_tpu.utils.metrics import MetricsLogger
+        from gene2vec_tpu.obs.run import Run
         from gene2vec_tpu.utils.profiling import trace_context
 
         cfg = self.config
-        if start_iter is None:
-            start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
-        if start_iter > 1:
-            params, _, _ = ckpt.load_iteration(
-                export_dir, cfg.dim, start_iter - 1,
-                table_dtype=cfg.table_dtype,
-            )
-            params = self._pad_params(params)
-            log(f"resuming from iteration {start_iter - 1}")
-        else:
-            params = self.init()
-            start_iter = 1
+        run = Run(
+            export_dir, name="sgns", config=cfg,
+            manifest_extra={
+                "num_pairs": self.global_num_pairs,
+                "vocab_size": self.corpus.vocab_size,
+                "num_batches": self.num_batches,
+                "pos_quotas": list(self.pos_quotas) if self.pos_quotas else None,
+            },
+        )
+        run.registry.attach_csv(os.path.join(export_dir, "training_log.csv"))
+        # everything after Run construction runs under its finally, so a
+        # failed resume still closes the run (and uninstalls the ambient
+        # tracer) instead of leaking it into later runs in this process
+        try:
+            if start_iter is None:
+                start_iter = ckpt.latest_iteration(export_dir, cfg.dim) + 1
+            if start_iter > 1:
+                with run.span("resume", iteration=start_iter - 1):
+                    params, _, _ = ckpt.load_iteration(
+                        export_dir, cfg.dim, start_iter - 1,
+                        table_dtype=cfg.table_dtype,
+                    )
+                    params = self._pad_params(params)
+                log(f"resuming from iteration {start_iter - 1}")
+            else:
+                with run.span("init_params"):
+                    params = self.init()
+                start_iter = 1
 
-        root_key = jax.random.PRNGKey(cfg.seed)
-        pairs_per_epoch = self.num_batches * cfg.batch_pairs
-        metrics = MetricsLogger(os.path.join(export_dir, "training_log.csv"))
-        for it in range(start_iter, cfg.num_iters + 1):
-            log(f"gene2vec dimension {cfg.dim} iteration {it} start")
-            t0 = time.perf_counter()
-            with trace_context(profile_dir if it == start_iter else None):
-                params, loss = self.train_epoch(
-                    params, jax.random.fold_in(root_key, it)
+            root_key = jax.random.PRNGKey(cfg.seed)
+            pairs_per_epoch = self.num_batches * cfg.batch_pairs
+            pairs_counter = run.registry.counter("pairs_total")
+            for it in range(start_iter, cfg.num_iters + 1):
+                log(f"gene2vec dimension {cfg.dim} iteration {it} start")
+                t0 = time.perf_counter()
+                with trace_context(profile_dir if it == start_iter else None):
+                    with run.step(
+                        "iteration", iteration=it, pairs=pairs_per_epoch
+                    ) as span_out:
+                        params, loss = self.train_epoch(
+                            params, jax.random.fold_in(root_key, it)
+                        )
+                        loss = float(loss)  # blocks until the epoch finishes
+                        span_out["loss"] = loss
+                dt = time.perf_counter() - t0
+                rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+                self.timer.record(pairs_per_epoch, dt)
+                pairs_counter.inc(pairs_per_epoch)
+                log(
+                    f"gene2vec dimension {cfg.dim} iteration {it} done: "
+                    f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
                 )
-                loss = float(loss)  # blocks until the epoch finishes
-            dt = time.perf_counter() - t0
-            rate = pairs_per_epoch / dt if dt > 0 else float("inf")
-            self.timer.record(pairs_per_epoch, dt)
-            log(
-                f"gene2vec dimension {cfg.dim} iteration {it} done: "
-                f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
-            )
-            metrics.log(it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt})
-            ckpt.save_iteration(
-                export_dir,
-                cfg.dim,
-                it,
-                self._export_params(params),
-                self.corpus.vocab,
-                txt_output=cfg.txt_output,
-                meta={"loss": loss, "pairs_per_sec": rate},
-            )
-        metrics.close()
+                run.log_row(
+                    it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt}
+                )
+                run.probe()
+                with run.span("checkpoint", iteration=it):
+                    ckpt.save_iteration(
+                        export_dir,
+                        cfg.dim,
+                        it,
+                        self._export_params(params),
+                        self.corpus.vocab,
+                        txt_output=cfg.txt_output,
+                        meta={"loss": loss, "pairs_per_sec": rate},
+                    )
+        finally:
+            run.close()
         return params

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import csv
 import os
-import sys
 import time
 from typing import Dict, Optional
 
@@ -22,7 +21,6 @@ class MetricsLogger:
     def __init__(self, csv_path: Optional[str], tensorboard_dir: Optional[str] = None):
         self.csv_path = csv_path
         self._fieldnames: Optional[list] = None
-        self._warned_dropped = False
         self._tb = None
         if csv_path:
             os.makedirs(os.path.dirname(os.path.abspath(csv_path)), exist_ok=True)
@@ -48,14 +46,13 @@ class MetricsLogger:
                     self._fieldnames = new_fields
                     with open(self.csv_path, "w", encoding="utf-8", newline="") as f:
                         csv.DictWriter(f, self._fieldnames).writeheader()
-            dropped = set(row) - set(self._fieldnames)
-            if dropped and not self._warned_dropped:
-                self._warned_dropped = True
-                print(
-                    f"MetricsLogger: {self.csv_path} header lacks columns "
-                    f"{sorted(dropped)}; their values are not recorded",
-                    file=sys.stderr,
-                )
+            new_keys = sorted(set(row) - set(self._fieldnames))
+            if new_keys:
+                # a metric key appeared after the header froze (e.g. a
+                # trainer starts reporting stalls mid-run): rewrite the
+                # CSV with the widened header, backfilling empty cells,
+                # instead of silently discarding the values
+                self._widen_header(new_keys)
             with open(self.csv_path, "a", encoding="utf-8", newline="") as f:
                 csv.DictWriter(
                     f, self._fieldnames, extrasaction="ignore"
@@ -64,6 +61,24 @@ class MetricsLogger:
             for k, v in metrics.items():
                 if isinstance(v, (int, float)):
                     self._tb.add_scalar(k, v, step)
+
+    def _widen_header(self, new_keys: list) -> None:
+        """Rewrite the CSV under a header widened by ``new_keys`` (atomic
+        tmp + rename); existing rows get empty cells for the new columns."""
+        with open(self.csv_path, "r", encoding="utf-8", newline="") as f:
+            rows = list(csv.DictReader(f, fieldnames=self._fieldnames))
+        if rows and list(rows[0].values())[: len(self._fieldnames)] == list(
+            self._fieldnames
+        ):
+            rows = rows[1:]  # drop the header row DictReader re-parsed
+        self._fieldnames = self._fieldnames + new_keys
+        tmp = f"{self.csv_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8", newline="") as f:
+            w = csv.DictWriter(f, self._fieldnames, extrasaction="ignore")
+            w.writeheader()
+            for r in rows:
+                w.writerow({k: (v if v is not None else "") for k, v in r.items()})
+        os.replace(tmp, self.csv_path)
 
     def close(self) -> None:
         if self._tb is not None:

@@ -145,7 +145,10 @@ def _fuzzy_graph(x: jax.Array, n_neighbors: int) -> jax.Array:
     t-conorm P = W + Wᵀ − W∘Wᵀ."""
     n = x.shape[0]
     d2 = _squared_distances(x)
-    d2 = d2 + jnp.eye(n, dtype=d2.dtype) * jnp.inf  # self is not a neighbor
+    # self is not a neighbor; mask (never add) the diagonal — `d2 +
+    # eye*inf` makes every OFF-diagonal entry 0*inf = NaN under eager/
+    # disable_jit, where the multiply isn't fused away
+    d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
     neg_d2, idx = jax.lax.top_k(-d2, n_neighbors)
     knn_d = jnp.sqrt(jnp.maximum(-neg_d2, 0.0))
     w = _smooth_knn_weights(knn_d, n_neighbors)

@@ -1,0 +1,91 @@
+"""Lint: no bare ``print()`` in gene2vec_tpu/ library code.
+
+Library modules must emit through the observability layer
+(``gene2vec_tpu.obs``), an injected ``log`` callable, or an explicit
+stream (``print(..., file=sys.stderr)``) — a bare ``print`` call writes
+to stdout, which CLI contracts own (bench.py prints exactly ONE JSON
+line on stdout; a stray library print corrupts it).
+
+Allowed:
+
+* anything under ``gene2vec_tpu/cli/`` — the CLI layer owns stdout;
+* ``print(..., file=...)`` calls — the stream choice is explicit;
+* referencing ``print`` without calling it (the ``log: Callable = print``
+  default-argument idiom).
+
+Run: ``python scripts/check_no_bare_prints.py [root]`` — exits non-zero
+listing violations.  Wired into tier-1 via tests/test_obs.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+
+def bare_prints_in_source(source: str, filename: str) -> List[Tuple[int, str]]:
+    """(lineno, line) for every ``print(...)`` call without ``file=``."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Name) and fn.id == "print"):
+            continue
+        if any(kw.arg == "file" for kw in node.keywords):
+            continue
+        line = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
+        out.append((node.lineno, line))
+    return out
+
+
+def check_tree(pkg_root: str) -> List[str]:
+    """Violation strings for every library module under ``pkg_root``
+    (the ``gene2vec_tpu`` package dir), skipping the CLI layer."""
+    violations = []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        if os.path.basename(dirpath) == "cli":
+            dirnames[:] = []
+            continue
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            rel = os.path.relpath(path, os.path.dirname(pkg_root))
+            for lineno, line in bare_prints_in_source(source, path):
+                violations.append(f"{rel}:{lineno}: {line}")
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "gene2vec_tpu",
+    )
+    violations = check_tree(root)
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(
+            f"{len(violations)} bare print() call(s) in library code — "
+            "route through gene2vec_tpu.obs, a log callable, or an "
+            "explicit file= stream",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

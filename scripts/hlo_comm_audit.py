@@ -14,10 +14,8 @@ Writes experiments/results/hlo_comm_r5.json.
 from __future__ import annotations
 
 import argparse
-import collections
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -27,35 +25,30 @@ import jax
 # sitecustomize latches env vars before we run — re-pin via the config API
 # (tests/conftest.py pattern; axon-tunnel memory note)
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-
-import numpy as np  # noqa: E402
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.5 jax has no such option; the XLA flag is read at backend
+    # initialization, which hasn't happened yet
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 from bench import synth_corpus  # noqa: E402
 from gene2vec_tpu.config import MeshConfig, SGNSConfig  # noqa: E402
+from gene2vec_tpu.obs.probes import collective_stats  # noqa: E402
 from gene2vec_tpu.parallel.mesh import make_mesh  # noqa: E402
 from gene2vec_tpu.parallel.sharding import SGNSSharding  # noqa: E402
 from gene2vec_tpu.sgns.train import SGNSTrainer  # noqa: E402
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1}
-
-# one HLO shape like "f32[24447,513]" or a tuple "(f32[8,2], u32[...])"
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-
-def _shape_bytes(text: str) -> int:
-    total = 0
-    for dtype, dims in _SHAPE_RE.findall(text):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
-    return total
-
 
 def audit(dim: int, vocab: int, batch: int, num_pairs: int, mid: bool,
           vocab_sharded: bool = True):
+    """The HLO collective scan itself lives in
+    ``gene2vec_tpu.obs.probes.collective_stats`` so trainers can record
+    their comm budget per run; this script is the standalone CLI."""
     corpus = synth_corpus(vocab, num_pairs)
     if vocab_sharded:
         mesh = make_mesh(MeshConfig(data=1, model=8))
@@ -72,20 +65,16 @@ def audit(dim: int, vocab: int, batch: int, num_pairs: int, mid: bool,
     lowered = trainer._epoch_fn.lower(
         params, trainer.pairs, trainer.noise, jax.random.PRNGKey(0)
     )
-    hlo = lowered.compile().as_text()
-
-    ops = collections.defaultdict(lambda: [0, 0])
-    for line in hlo.splitlines():
-        m = re.search(
-            r"=\s*(\([^)]*\)|\S+)\s+"
-            r"(all-gather|all-reduce|reduce-scatter|collective-permute|"
-            r"all-to-all)\w*\(",
-            line,
+    stats = collective_stats(lowered)
+    if stats is None:
+        # collective_stats swallows exceptions so trainers can probe
+        # unconditionally; in this standalone audit a silent None would
+        # just crash below with an opaque TypeError — fail loudly instead.
+        raise RuntimeError(
+            f"HLO collective audit failed to compile/scan config "
+            f"dim={dim} batch={batch} vocab_sharded={vocab_sharded}"
         )
-        if m:
-            out_shape, op = m.group(1), m.group(2)
-            ops[op][0] += 1
-            ops[op][1] += _shape_bytes(out_shape)
+
     return {
         "config": {
             "dim": dim, "vocab": vocab, "batch_pairs": batch,
@@ -97,13 +86,9 @@ def audit(dim: int, vocab: int, batch: int, num_pairs: int, mid: bool,
             "positive_mid": cfg.positive_mid,
             "positive_head": cfg.positive_head,
         },
-        "collectives_per_step": {
-            op: {"count": c, "output_bytes": b} for op, (c, b) in ops.items()
-        },
-        "total_bytes_per_step": sum(b for _, b in ops.values()),
-        "bytes_per_pair": round(
-            sum(b for _, b in ops.values()) / batch, 1
-        ),
+        "collectives_per_step": stats["collectives"],
+        "total_bytes_per_step": stats["total_bytes"],
+        "bytes_per_pair": round(stats["total_bytes"] / batch, 1),
     }
 
 

@@ -185,6 +185,18 @@ def test_run_dir_summaries_and_checkpoints(tmp_path):
     # checkpoints on the every-20 cadence: steps 20 and 40
     ckpts = sorted(os.listdir(os.path.join(run_dir, "checkpoints")))
     assert ckpts == ["model-20.npz", "model-40.npz"]
+    # unified obs layer rides in the same run dir (docs/OBSERVABILITY.md)
+    import json
+
+    manifest = json.load(open(os.path.join(run_dir, "manifest.json")))
+    assert manifest["name"] == "ggipnn"
+    assert manifest["config"]["batch_size"] == 16
+    from gene2vec_tpu.obs.trace import read_events
+
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    names = {e["name"] for e in events}
+    assert {"fit", "test_eval", "checkpoint", "dev_eval"} <= names
+    assert os.path.exists(os.path.join(run_dir, "metrics.prom"))
 
 
 def test_run_checkpoints_keep_five(tmp_path):

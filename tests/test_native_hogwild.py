@@ -122,3 +122,35 @@ def test_hogwild_hs_sg_variant_and_validation():
     assert np.isfinite(l1) and l1 < l0
     with pytest.raises(ValueError, match="hs objectives"):
         HogwildHSTrainer(corpus, SGNSConfig(objective="sgns"))
+
+
+def test_abi_stamp_sidecar(tmp_path):
+    """The .abi sidecar replaces the per-process subprocess probe: a
+    stamp written for this exact .so passes; missing/mismatched ones, or
+    stamps describing a different build, do not."""
+    so = tmp_path / "lib.so"
+    so.write_bytes(b"\x7fELF fake")
+    assert not native_backend._stamp_ok(str(so))  # no stamp yet
+    native_backend._write_stamp(str(so))
+    assert native_backend._stamp_ok(str(so))
+    digest = native_backend._so_digest(str(so))
+    (tmp_path / "lib.so.abi").write_text(
+        f"{native_backend._ABI_VERSION + 1}\n{digest}\n"
+    )
+    assert not native_backend._stamp_ok(str(so))  # version mismatch
+    (tmp_path / "lib.so.abi").write_text("garbage\n")
+    assert not native_backend._stamp_ok(str(so))  # unparseable
+    (tmp_path / "lib.so.abi").write_text(f"{native_backend._ABI_VERSION}\n")
+    assert not native_backend._stamp_ok(str(so))  # legacy stamp: no hash
+    # a stamp is bound to the .so's content: after the library changes
+    # (stale build + stamp restored by a git checkout, say) it must fail
+    # _stamp_ok no matter the mtimes, forcing the probe-and-rebuild path
+    native_backend._write_stamp(str(so))
+    so.write_bytes(b"\x7fELF a different build")
+    assert not native_backend._stamp_ok(str(so))
+
+
+def test_loaded_lib_wrote_stamp():
+    """After available() the real library carries a matching stamp, so
+    future processes skip the subprocess ABI probe."""
+    assert native_backend._stamp_ok(native_backend._LIB_PATH)

@@ -1,0 +1,301 @@
+"""Unified observability layer: tracer, registry, run manifest, watchdog,
+report CLI, and the no-bare-prints lint (docs/OBSERVABILITY.md)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gene2vec_tpu.obs.registry import MetricsRegistry
+from gene2vec_tpu.obs.run import Run, StallWatchdog, config_hash
+from gene2vec_tpu.obs.trace import Tracer, ambient_span, read_events
+from gene2vec_tpu.obs import report
+
+
+# -- tracer -----------------------------------------------------------------
+
+
+def test_span_nesting_and_ordering(tmp_path):
+    t = Tracer(str(tmp_path / "events.jsonl"))
+    with t.span("outer", phase="a"):
+        with t.span("inner") as out:
+            out["loss"] = 1.5
+        t.event("marker", k=1)
+    t.close()
+    events = read_events(str(tmp_path / "events.jsonl"))
+    assert [e["type"] for e in events] == [
+        "span_start", "span_start", "span_end", "event", "span_end",
+    ]
+    outer_start, inner_start, inner_end, marker, outer_end = events
+    assert inner_start["parent"] == outer_start["span"]
+    assert outer_start["parent"] is None
+    # the marker fired between inner and outer end, inside outer
+    assert marker["span"] == outer_start["span"]
+    # body-set attrs land on span_end; enter attrs on both
+    assert inner_end["attrs"]["loss"] == 1.5
+    assert outer_start["attrs"]["phase"] == "a"
+    assert inner_end["dur"] >= 0
+    # monotonic timestamps are ordered within the process
+    monos = [e["mono"] for e in events]
+    assert monos == sorted(monos)
+
+
+def test_multi_process_event_merge(tmp_path):
+    """Two processes appending to one events.jsonl merge into one
+    timeline: every line parses, both pids appear, wall-ordering holds."""
+    path = str(tmp_path / "events.jsonl")
+    t = Tracer(path)
+    with t.span("parent_phase"):
+        child = (
+            "from gene2vec_tpu.obs.trace import Tracer\n"
+            f"t = Tracer({path!r})\n"
+            "with t.span('child_phase', role='worker'):\n"
+            "    t.event('child_event')\n"
+            "t.close()\n"
+        )
+        res = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert res.returncode == 0, res.stderr.decode()
+    t.close()
+    events = read_events(path)
+    assert len(events) == 5  # 2 parent + 3 child records
+    pids = {e["pid"] for e in events}
+    assert len(pids) == 2
+    walls = [e["wall"] for e in events]
+    assert walls == sorted(walls)
+    child_names = {e["name"] for e in events if e["pid"] != os.getpid()}
+    assert child_names == {"child_phase", "child_event"}
+
+
+def test_ambient_span_buffers_until_run_exists(tmp_path):
+    with ambient_span("pre_run_work", what="abi_check") as out:
+        out["action"] = "probe"
+    run = Run(str(tmp_path / "r"), name="t", probe_devices=False)
+    run.close()
+    events = read_events(str(tmp_path / "r" / "events.jsonl"))
+    buffered = [e for e in events if e.get("buffered")]
+    assert any(
+        e["name"] == "pre_run_work"
+        and e["attrs"]["action"] == "probe"
+        for e in buffered
+    )
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_prometheus_export(tmp_path):
+    r = MetricsRegistry()
+    r.counter("pairs_total").inc(100)
+    r.counter("pairs_total").inc(28)
+    r.gauge("loss").set(1.25)
+    h = r.histogram("step_seconds")
+    for v in (0.1, 0.2, 100.0):
+        h.observe(v)
+    text = r.prometheus_text()
+    assert "# TYPE pairs_total counter" in text
+    assert "pairs_total 128" in text
+    assert "loss 1.25" in text
+    assert "step_seconds_count 3" in text
+    assert 'step_seconds_bucket{le="+Inf"} 3' in text
+    assert h.max == 100.0
+    path = str(tmp_path / "m" / "metrics.prom")
+    r.snapshot_to(path)
+    assert open(path).read() == text
+    with pytest.raises(TypeError):
+        r.gauge("pairs_total")  # name already a counter
+    with pytest.raises(ValueError):
+        r.counter("pairs_total").inc(-1)
+
+
+def test_registry_csv_sink_and_gauges(tmp_path):
+    r = MetricsRegistry()
+    csv_path = str(tmp_path / "log.csv")
+    r.attach_csv(csv_path)
+    r.log_row(1, {"loss": 2.0})
+    r.log_row(2, {"loss": 1.0, "auc": 0.9})
+    r.close()
+    assert r.gauge("auc").value == 0.9
+    import csv as csv_mod
+
+    rows = list(csv_mod.DictReader(open(csv_path)))
+    # the header widened when `auc` appeared; row 1 backfilled empty
+    assert rows[0]["auc"] == "" and rows[1]["auc"] == "0.9"
+
+
+# -- run manifest + watchdog ------------------------------------------------
+
+
+def test_manifest_determinism_and_content(tmp_path):
+    from gene2vec_tpu.config import SGNSConfig
+
+    assert config_hash(SGNSConfig(dim=16)) == config_hash(SGNSConfig(dim=16))
+    assert config_hash(SGNSConfig(dim=16)) != config_hash(SGNSConfig(dim=32))
+    assert config_hash({"a": 1, "b": 2}) == config_hash({"b": 2, "a": 1})
+
+    run = Run(
+        str(tmp_path / "r"), name="unit", config=SGNSConfig(dim=16),
+        probe_devices=False,
+    )
+    run.close()
+    manifest = json.load(open(tmp_path / "r" / "manifest.json"))
+    assert manifest["name"] == "unit"
+    assert manifest["config"]["dim"] == 16
+    assert manifest["config_hash"] == config_hash(SGNSConfig(dim=16))
+    assert manifest["pid"] == os.getpid()
+    assert "versions" in manifest and "argv" in manifest
+
+
+def test_watchdog_flags_synthetic_slow_step():
+    w = StallWatchdog(window=32, factor=3.0, min_samples=5)
+    assert w.budget() is None  # warming up
+    for _ in range(20):
+        assert not w.record(0.010)
+    assert w.budget() == pytest.approx(0.030)
+    assert w.record(0.050)       # 5x the p99/3 budget → stall
+    assert not w.record(0.012)   # normal step after the stall is clean
+
+
+def test_run_step_emits_stall_event(tmp_path):
+    import time
+
+    run = Run(str(tmp_path / "r"), name="t", probe_devices=False,
+              watchdog=StallWatchdog(min_samples=3))
+    for _ in range(6):
+        with run.step("iteration"):
+            time.sleep(0.005)
+    with run.step("iteration"):   # synthetic slow step: >> 3x rolling p99
+        time.sleep(0.12)
+    run.close()
+    events = read_events(str(tmp_path / "r" / "events.jsonl"))
+    stalls = [e for e in events if e["type"] == "stall"]
+    # scheduler jitter may flag a fast step too; the slow one MUST be there
+    assert any(e["attrs"]["dur"] > 0.1 for e in stalls)
+    assert all(
+        e["attrs"]["dur"] > e["attrs"]["budget"] for e in stalls
+    )
+    assert run.registry.counter("stalls_total").value == len(stalls)
+
+
+# -- trainer + bench integration -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def observed_sgns_run(tmp_path_factory):
+    """A real (tiny) SGNSTrainer.run — the fixture run dir for the
+    report-CLI tests."""
+    import numpy as np
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 30, size=(256, 2)).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=30).astype(np.int64)
+    corpus = PairCorpus(Vocab([f"G{i}" for i in range(30)], counts), pairs)
+    out = str(tmp_path_factory.mktemp("obs_run") / "export")
+    SGNSTrainer(
+        corpus, SGNSConfig(dim=8, num_iters=3, batch_pairs=64)
+    ).run(out, log=lambda s: None)
+    return out
+
+
+def test_trainer_run_writes_obs_artifacts(observed_sgns_run):
+    out = observed_sgns_run
+    assert os.path.exists(os.path.join(out, "manifest.json"))
+    assert os.path.exists(os.path.join(out, "events.jsonl"))
+    assert os.path.exists(os.path.join(out, "metrics.prom"))
+    manifest = json.load(open(os.path.join(out, "manifest.json")))
+    assert manifest["name"] == "sgns"
+    assert manifest["config"]["dim"] == 8
+    events = read_events(os.path.join(out, "events.jsonl"))
+    iters = [
+        e for e in events
+        if e["type"] == "span_end" and e["name"] == "iteration"
+    ]
+    assert len(iters) == 3
+    assert all("loss" in e["attrs"] for e in iters)
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "pairs_total" in prom and "step_seconds_count 3" in prom
+
+
+def test_obs_report_cli(observed_sgns_run, capsys):
+    from gene2vec_tpu.cli import obs as obs_cli
+
+    assert obs_cli.main(["report", observed_sgns_run]) == 0
+    out = capsys.readouterr().out
+    assert "run: sgns" in out
+    assert "iteration" in out
+    assert "config hash:" in out
+    assert "stalls: none" in out
+    assert obs_cli.main(["report", "--json", observed_sgns_run]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["phases"]["iteration"]["count"] == 3
+    assert summary["pairs_per_sec"] and summary["pairs_per_sec"] > 0
+
+
+def test_obs_report_cli_rejects_empty_dir(tmp_path, capsys):
+    from gene2vec_tpu.cli import obs as obs_cli
+
+    assert obs_cli.main(["report", str(tmp_path)]) == 2
+    capsys.readouterr()
+
+
+def test_collective_stats_from_hlo():
+    from gene2vec_tpu.obs.probes import collective_stats_from_hlo, shape_bytes
+
+    assert shape_bytes("f32[8,4]") == 128
+    assert shape_bytes("(f32[2], u32[2])") == 16
+    hlo = (
+        "  %ar = f32[100,8]{1,0} all-reduce(f32[100,8] %x), replica_groups={}\n"
+        "  %ag = f32[16]{0} all-gather(f32[2] %y), dimensions={0}\n"
+        "  %plain = f32[4] add(f32[4] %a, f32[4] %b)\n"
+    )
+    stats = collective_stats_from_hlo(hlo)
+    assert stats["collectives"]["all-reduce"]["count"] == 1
+    assert stats["collectives"]["all-reduce"]["output_bytes"] == 3200
+    assert stats["collectives"]["all-gather"]["output_bytes"] == 64
+    assert stats["total_bytes"] == 3264
+
+
+def test_probe_sample_runs():
+    from gene2vec_tpu.obs import probes
+
+    r = MetricsRegistry()
+    values = probes.sample(r)
+    assert values["host_rss_bytes"] is None or values["host_rss_bytes"] > 0
+    # jax is imported by the suite, so live-array accounting is available
+    assert values["hbm_bytes"] is None or values["hbm_bytes"] >= 0
+
+
+# -- lint: no bare prints in library code (tier-1 wiring) -------------------
+
+
+def test_no_bare_prints_in_library_code():
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ),
+    )
+    try:
+        from check_no_bare_prints import bare_prints_in_source, check_tree
+    finally:
+        sys.path.pop(0)
+
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "gene2vec_tpu",
+    )
+    assert check_tree(pkg) == []
+    # the checker itself sees what it should
+    assert bare_prints_in_source("print('x')", "<t>") != []
+    assert bare_prints_in_source("import sys\nprint('x', file=sys.stderr)", "<t>") == []
+    assert bare_prints_in_source("log = print", "<t>") == []
