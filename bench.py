@@ -594,162 +594,167 @@ def main() -> None:
     # below must see an untouched chip; backend facts are annotated after
     # this process first initializes jax anyway.
     run = Run(run_dir, name="bench", config=vars(args), probe_devices=False)
-    log(f"observed run dir: {run_dir}")
-
-    # bf16-table opt-in probe FIRST: it needs the chip to itself, before
-    # this process initializes its own TPU client (bf16_table_probe doc).
-    # Measured at the HEADLINE corpus/batch so the number reads as
-    # "the headline config with bf16 tables" — NOT at secondary_pairs.
-    # Skipped under --mesh-data: the device-count check below must claim
-    # the chips first, and a probe sharing them reads ~35% low.
-    bf16_rate = None
-    headline = None
-    if args.mesh_data == 0:
-        # headline FIRST (cleanest device state), then the bf16 probe
-        with run.span("headline_probe"):
-            headline = headline_probe(
-                args.dim, args.vocab, args.pairs, args.batch
-            )
-        if not args.no_secondary:
-            with run.span("bf16_table_probe"):
-                bf16_rate = bf16_table_probe(
-                    args.vocab, args.pairs, args.batch
-                )
-    elif args.mesh_data > 0:
-        log("dedicated-process probes skipped under --mesh-data (the "
-            "device-count check below must claim the chips first)")
-
-    if args.mesh_data > 0:
-        # fail in seconds, not after the multi-minute quality gate
-        import jax
-
-        n = len(jax.devices())
-        if args.mesh_data > n:
-            raise SystemExit(
-                f"--mesh-data {args.mesh_data}: only {n} device(s) attached"
-            )
-
-    quality = {}
-    if not args.no_quality_gate:
-        log("=== quality gate (headline config must learn) ===")
-        with run.span("quality_gate") as span_out:
-            quality = quality_gate(args.dim, args.batch, args.data_dir)
-            span_out["passed"] = quality["passed"]
-        log(f"quality: {quality}")
-        if not quality["passed"]:
-            # No headline for a trainer that does not learn (round-2
-            # verdict: "fast and wrong is wrong").
-            run.event("quality_gate_failed", **{
-                k: v for k, v in quality.items() if not isinstance(v, dict)
-            })
-            run.close()
-            print(json.dumps({
-                "metric": "sgns_pairs_per_sec",
-                "value": 0.0,
-                "unit": "pairs/s",
-                "vs_baseline": 0.0,
-                "quality": quality,
-                "error": "quality gate FAILED — throughput withheld",
-            }))
-            sys.exit(1)
-
-    if headline is not None:
-        tpu_rate, band = headline
-        import jax
-
-        mesh_info = {
-            "devices": 1,
-            "platform": jax.devices()[0].platform,
-            "mesh": None,
-            "rate_band": band,
-        }
-    else:
-        with run.span("measure_headline_in_process"):
-            tpu_rate, mesh_info = measure_pairs_per_sec(
-                args.dim, args.vocab, args.pairs, args.batch, args.mesh_data
-            )
-    run.annotate(backend={
-        "platform": mesh_info["platform"],
-        "device_count": mesh_info["devices"],
-        "mesh": mesh_info["mesh"],
-    })
-    run.probe()
-
-    vs = vs32 = base1 = None
-    extrapolated = None
     try:
-        with run.span("hogwild_baseline"):
-            cpu_best, cpu_1core, curve = hogwild_baseline(
-                args.dim, args.vocab, args.cpu_pairs
-            )
-        base1 = cpu_1core
-        vs = tpu_rate / cpu_best
-        # Linear 32-thread extrapolation from the measured per-core rate —
-        # an upper bound on Hogwild scaling, hence a conservative speedup.
-        vs32 = tpu_rate / (32.0 * cpu_1core)
-        # the denominator is synthetic unless 32 threads were actually run
-        # (VERDICT r3 item 7: the ratio must not be quotable as measured;
-        # a >32-core host still never measures the 32-thread point unless
-        # it is in the curve)
-        extrapolated = 32 not in curve
-        log(f"hogwild curve: {curve}; 32-thread linear extrapolation "
-            f"{32.0 * cpu_1core:,.0f} pairs/s"
-            + (" (EXTRAPOLATED from fewer cores)" if extrapolated else ""))
-    except Exception as e:
-        log(f"hogwild baseline failed: {e}")
+        log(f"observed run dir: {run_dir}")
 
-    secondary = {}
-    if not args.no_secondary:
-        with run.span("secondary_metrics"):
-            secondary = secondary_metrics(
-                args.vocab, args.secondary_pairs, args.batch
-            )
-        if bf16_rate is not None:
-            secondary["table_bf16_pairs_per_sec"] = bf16_rate
-            # unlike the other secondaries (measured at secondary_pairs),
-            # this one is the HEADLINE workload with bf16 tables — the
-            # comparison the opt-in claim is about
-            secondary["table_bf16_note"] = (
-                "headline corpus/batch, dedicated process"
-            )
+        # bf16-table opt-in probe FIRST: it needs the chip to itself, before
+        # this process initializes its own TPU client (bf16_table_probe doc).
+        # Measured at the HEADLINE corpus/batch so the number reads as
+        # "the headline config with bf16 tables" — NOT at secondary_pairs.
+        # Skipped under --mesh-data: the device-count check below must claim
+        # the chips first, and a probe sharing them reads ~35% low.
+        bf16_rate = None
+        headline = None
+        if args.mesh_data == 0:
+            # headline FIRST (cleanest device state), then the bf16 probe
+            with run.span("headline_probe"):
+                headline = headline_probe(
+                    args.dim, args.vocab, args.pairs, args.batch
+                )
+            if not args.no_secondary:
+                with run.span("bf16_table_probe"):
+                    bf16_rate = bf16_table_probe(
+                        args.vocab, args.pairs, args.batch
+                    )
+        elif args.mesh_data > 0:
+            log("dedicated-process probes skipped under --mesh-data (the "
+                "device-count check below must claim the chips first)")
+
+        if args.mesh_data > 0:
+            # fail in seconds, not after the multi-minute quality gate
+            import jax
+
+            n = len(jax.devices())
+            if args.mesh_data > n:
+                raise SystemExit(
+                    f"--mesh-data {args.mesh_data}: only {n} device(s) attached"
+                )
+
+        quality = {}
+        if not args.no_quality_gate:
+            log("=== quality gate (headline config must learn) ===")
+            with run.span("quality_gate") as span_out:
+                quality = quality_gate(args.dim, args.batch, args.data_dir)
+                span_out["passed"] = quality["passed"]
+            log(f"quality: {quality}")
+            if not quality["passed"]:
+                # No headline for a trainer that does not learn (round-2
+                # verdict: "fast and wrong is wrong").
+                run.event("quality_gate_failed", **{
+                    k: v for k, v in quality.items() if not isinstance(v, dict)
+                })
+                run.close()
+                print(json.dumps({
+                    "metric": "sgns_pairs_per_sec",
+                    "value": 0.0,
+                    "unit": "pairs/s",
+                    "vs_baseline": 0.0,
+                    "quality": quality,
+                    "error": "quality gate FAILED — throughput withheld",
+                }))
+                sys.exit(1)
+
+        if headline is not None:
+            tpu_rate, band = headline
+            import jax
+
+            mesh_info = {
+                "devices": 1,
+                "platform": jax.devices()[0].platform,
+                "mesh": None,
+                "rate_band": band,
+            }
+        else:
+            with run.span("measure_headline_in_process"):
+                tpu_rate, mesh_info = measure_pairs_per_sec(
+                    args.dim, args.vocab, args.pairs, args.batch, args.mesh_data
+                )
+        run.annotate(backend={
+            "platform": mesh_info["platform"],
+            "device_count": mesh_info["devices"],
+            "mesh": mesh_info["mesh"],
+        })
+        run.probe()
+
+        vs = vs32 = base1 = None
+        extrapolated = None
         try:
-            with open(
-                os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_EXTRA.json"), "w"
-            ) as f:
-                json.dump(secondary, f, indent=1)
-        except OSError as e:
-            log(f"could not write BENCH_EXTRA.json: {e}")
+            with run.span("hogwild_baseline"):
+                cpu_best, cpu_1core, curve = hogwild_baseline(
+                    args.dim, args.vocab, args.cpu_pairs
+                )
+            base1 = cpu_1core
+            vs = tpu_rate / cpu_best
+            # Linear 32-thread extrapolation from the measured per-core rate —
+            # an upper bound on Hogwild scaling, hence a conservative speedup.
+            vs32 = tpu_rate / (32.0 * cpu_1core)
+            # the denominator is synthetic unless 32 threads were actually run
+            # (VERDICT r3 item 7: the ratio must not be quotable as measured;
+            # a >32-core host still never measures the 32-thread point unless
+            # it is in the curve)
+            extrapolated = 32 not in curve
+            log(f"hogwild curve: {curve}; 32-thread linear extrapolation "
+                f"{32.0 * cpu_1core:,.0f} pairs/s"
+                + (" (EXTRAPOLATED from fewer cores)" if extrapolated else ""))
+        except Exception as e:
+            log(f"hogwild baseline failed: {e}")
 
-    result = {
-        "metric": "sgns_pairs_per_sec",
-        "value": round(tpu_rate, 1),
-        "unit": "pairs/s",
-        # the measured min..max of this run's timed epochs: quote ratios
-        # as bands — numerator AND the extrapolated CPU denominator carry
-        # run-to-run noise (README "honest position" table is sourced
-        # from these fields, VERDICT r4 number-hygiene item)
-        "rate_band": mesh_info.get("rate_band"),
-        "vs_baseline": round(vs, 2) if vs else None,
-        "vs_32thread_equiv": round(vs32, 2) if vs32 else None,
-        "vs_32thread_equiv_extrapolated": extrapolated,
-        "baseline_1core": round(base1, 1) if base1 else None,
-        "platform": mesh_info["platform"],
-        "devices": mesh_info["devices"],
-        "mesh": mesh_info["mesh"],
-    }
-    if quality:
-        result["quality"] = quality
-    if secondary:
-        result["secondary"] = secondary
-    run.event(
-        "bench_result",
-        **{k: v for k, v in result.items() if not isinstance(v, dict)},
-    )
-    run.registry.gauge("sgns_pairs_per_sec").set(tpu_rate)
-    run.probe()
-    run.close()
-    print(json.dumps(result))
+        secondary = {}
+        if not args.no_secondary:
+            with run.span("secondary_metrics"):
+                secondary = secondary_metrics(
+                    args.vocab, args.secondary_pairs, args.batch
+                )
+            if bf16_rate is not None:
+                secondary["table_bf16_pairs_per_sec"] = bf16_rate
+                # unlike the other secondaries (measured at secondary_pairs),
+                # this one is the HEADLINE workload with bf16 tables — the
+                # comparison the opt-in claim is about
+                secondary["table_bf16_note"] = (
+                    "headline corpus/batch, dedicated process"
+                )
+            try:
+                with open(
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_EXTRA.json"), "w"
+                ) as f:
+                    json.dump(secondary, f, indent=1)
+            except OSError as e:
+                log(f"could not write BENCH_EXTRA.json: {e}")
+
+        result = {
+            "metric": "sgns_pairs_per_sec",
+            "value": round(tpu_rate, 1),
+            "unit": "pairs/s",
+            # the measured min..max of this run's timed epochs: quote ratios
+            # as bands — numerator AND the extrapolated CPU denominator carry
+            # run-to-run noise (README "honest position" table is sourced
+            # from these fields, VERDICT r4 number-hygiene item)
+            "rate_band": mesh_info.get("rate_band"),
+            "vs_baseline": round(vs, 2) if vs else None,
+            "vs_32thread_equiv": round(vs32, 2) if vs32 else None,
+            "vs_32thread_equiv_extrapolated": extrapolated,
+            "baseline_1core": round(base1, 1) if base1 else None,
+            "platform": mesh_info["platform"],
+            "devices": mesh_info["devices"],
+            "mesh": mesh_info["mesh"],
+        }
+        if quality:
+            result["quality"] = quality
+        if secondary:
+            result["secondary"] = secondary
+        run.event(
+            "bench_result",
+            **{k: v for k, v in result.items() if not isinstance(v, dict)},
+        )
+        run.registry.gauge("sgns_pairs_per_sec").set(tpu_rate)
+        run.probe()
+        print(json.dumps(result))
+    finally:
+        # error exits (device-count SystemExit, probe failures) must
+        # still terminate the observed run — run_end + metrics.prom —
+        # exactly like the trainers' try/finally
+        run.close()
 
 
 if __name__ == "__main__":

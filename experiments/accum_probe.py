@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+import sys
 
 V, D, E = 24447, 200, 32768
 BLOCK = 128
@@ -69,7 +70,7 @@ def scanned(body):
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     # Zipf-ish indices, like real batch rows
     p = 1.0 / np.arange(1, V + 1)
@@ -92,7 +93,7 @@ def main():
 
     t = bench(lambda c, ix: scalar_scatter(c, ix), jnp.zeros(V), idx)
     print(f"a1 scalar scatter-add E={E} -> (V,): {t*1e3:.3f} ms "
-          f"({t/E*1e9:.2f} ns/el)")
+          f"({t/E*1e9:.2f} ns/el)", file=sys.stderr)
 
     @scanned
     def scalar_gather(carry, i, tbl):
@@ -100,7 +101,7 @@ def main():
 
     t = bench(lambda c, tbl: scalar_gather(c, tbl), jnp.zeros(()), jnp.ones(V))
     print(f"a2 scalar gather   E={E} <- (V,): {t*1e3:.3f} ms "
-          f"({t/E*1e9:.2f} ns/el)")
+          f"({t/E*1e9:.2f} ns/el)", file=sys.stderr)
 
     # row scatter reference (the known ~16 ns/row-op)
     @scanned
@@ -110,7 +111,7 @@ def main():
     t = bench(lambda c, ix, r: row_scatter(c, ix, r),
               jnp.zeros((V, D)), idx, rows)
     print(f"a3 row scatter-add E={E} x {D}f32:  {t*1e3:.3f} ms "
-          f"({t/E*1e9:.2f} ns/row)")
+          f"({t/E*1e9:.2f} ns/row)", file=sys.stderr)
 
     # --- b. slab scatter vs acc_blocks detour ----------------------------
     nb = (V - HEAD) // BLOCK + 1
@@ -127,7 +128,7 @@ def main():
 
     blocks_idx = (starts - HEAD) // BLOCK
     t = bench(lambda a, b, s: via_blocks(a, b, s), acc0, blocks_idx, slabs)
-    print(f"b1 acc_blocks detour G={G}: {t*1e3:.3f} ms")
+    print(f"b1 acc_blocks detour G={G}: {t*1e3:.3f} ms", file=sys.stderr)
 
     @scanned
     def via_slab_scatter(acc, i, starts, slabs):
@@ -141,7 +142,7 @@ def main():
         ), None
 
     t = bench(lambda a, s, sl: via_slab_scatter(a, s, sl), acc0, starts, slabs)
-    print(f"b2 windowed slab scatter G={G}x({BLOCK},{D+1}): {t*1e3:.3f} ms")
+    print(f"b2 windowed slab scatter G={G}x({BLOCK},{D+1}): {t*1e3:.3f} ms", file=sys.stderr)
 
     # --- c. dense accumulator pass, f32 vs bf16 --------------------------
     @scanned
@@ -151,9 +152,9 @@ def main():
 
     accf = jnp.abs(jnp.asarray(rng.randn(V, D + 1).astype(np.float32)))
     t = bench(lambda tb, a: dense_pass(tb, a), table, accf)
-    print(f"c1 finalize pass f32 acc: {t*1e3:.3f} ms")
+    print(f"c1 finalize pass f32 acc: {t*1e3:.3f} ms", file=sys.stderr)
     t = bench(lambda tb, a: dense_pass(tb, a), table, accf.astype(jnp.bfloat16))
-    print(f"c2 finalize pass bf16 acc: {t*1e3:.3f} ms")
+    print(f"c2 finalize pass bf16 acc: {t*1e3:.3f} ms", file=sys.stderr)
 
     @scanned
     def zeros_scatter(carry, i, idx, rows):
@@ -163,7 +164,7 @@ def main():
         return carry + acc[0, 0], None
 
     t = bench(lambda c, ix, r: zeros_scatter(c, ix, r), jnp.zeros(()), idx, rows)
-    print(f"c3 zeros+fused scatter f32 (V,D+1): {t*1e3:.3f} ms")
+    print(f"c3 zeros+fused scatter f32 (V,D+1): {t*1e3:.3f} ms", file=sys.stderr)
 
     @scanned
     def zeros_scatter_bf16(carry, i, idx, rows):
@@ -176,7 +177,7 @@ def main():
 
     t = bench(lambda c, ix, r: zeros_scatter_bf16(c, ix, r),
               jnp.zeros(()), idx, rows)
-    print(f"c4 zeros+fused scatter bf16 (V,D+1): {t*1e3:.3f} ms")
+    print(f"c4 zeros+fused scatter bf16 (V,D+1): {t*1e3:.3f} ms", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -65,7 +65,7 @@ def bench_tsne(n: int, dim: int, seg: int, cpu_iters: int) -> dict:
         model.fit(x50, snapshot_iters=[iters], log=lambda m: None)
         times[iters] = time.perf_counter() - t0
         print(f"[tsne] full {iters}-iter run: {times[iters]:.2f}s",
-              flush=True)
+              flush=True, file=sys.stderr)
     per_iter = (times[hi] - times[lo]) / (hi - lo)
     fixed = max(times[lo] - per_iter * lo, 0.0)
     out["tpu_run_s"] = {k: round(v, 2) for k, v in times.items()}
@@ -89,7 +89,7 @@ def bench_tsne(n: int, dim: int, seg: int, cpu_iters: int) -> dict:
         method="barnes_hut",
     )
     print(f"[tsne] sklearn BH baseline ({max(cpu_iters, 250)} iters)",
-          flush=True)
+          flush=True, file=sys.stderr)
     t0 = time.perf_counter()
     try:
         sk = SkTSNE(max_iter=max(cpu_iters, 250), **kw)
@@ -156,7 +156,7 @@ def bench_umap(n: int, dim: int, iters: int) -> dict:
         / max(d[same].mean(), 1e-9)
     )
     print(f"[umap] {n}x{dim}: {total:.1f}s ({1.0/per_iter:.1f} it/s), "
-          f"inter/intra = {sep:.2f}", flush=True)
+          f"inter/intra = {sep:.2f}", flush=True, file=sys.stderr)
     return {
         "n": n, "dim": dim, "n_iters": iters,
         "total_s": round(total, 2),
@@ -231,7 +231,7 @@ def main() -> None:
         corr = bench_corr(studies=50, samples=100, genes=5000)
 
     result = {"tsne_24k": tsne, "umap_24k": umap, "corpus_corr": corr}
-    print(json.dumps(result, indent=2))
+    print(json.dumps(result, indent=2), file=sys.stdout)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(result, f, indent=2)
         f.write("\n")

@@ -16,6 +16,7 @@ from gene2vec_tpu.sgns.model import SGNSParams
 from gene2vec_tpu.sgns.train import SGNSTrainer, make_train_epoch
 from gene2vec_tpu.data.pipeline import PairCorpus
 from gene2vec_tpu.io.vocab import Vocab
+import sys
 
 V, D = 24447, 200
 N = 4_000_000
@@ -44,11 +45,11 @@ def run(label, corpus, cfg):
         dt = time.perf_counter() - t0
         rates.append(trainer.num_batches * trainer.config.batch_pairs / dt)
     rs = ", ".join(f"{r / 1e6:6.2f}" for r in rates)
-    print(f"{label:44s} [{rs}] M pairs/s  (best {max(rates)/1e6:.2f})")
+    print(f"{label:44s} [{rs}] M pairs/s  (best {max(rates)/1e6:.2f})", file=sys.stderr)
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     corpus = make_corpus(rng)
 

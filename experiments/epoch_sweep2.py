@@ -7,6 +7,7 @@ from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.sgns.train import SGNSTrainer
 from gene2vec_tpu.data.pipeline import PairCorpus
 from gene2vec_tpu.io.vocab import Vocab
+import sys
 
 V, D, N, REPS = 24447, 200, 4_000_000, 3
 
@@ -29,10 +30,10 @@ def run(label, corpus, cfg):
         dt = time.perf_counter() - t0
         rates.append(trainer.num_batches * trainer.config.batch_pairs / dt)
     rs = ", ".join(f"{r / 1e6:6.2f}" for r in rates)
-    print(f"{label:40s} [{rs}] M pairs/s (best {max(rates)/1e6:.2f}, loss {lv:.4f})")
+    print(f"{label:40s} [{rs}] M pairs/s (best {max(rates)/1e6:.2f}, loss {lv:.4f})", file=sys.stderr)
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     corpus = make_corpus(rng)
     run("inplace B=16k offset f32", corpus, SGNSConfig(dim=D, batch_pairs=16384))

@@ -51,7 +51,7 @@ def main():
         f"corpus: {corpus.num_pairs} pairs, vocab {corpus.vocab_size}; "
         f"holdout {len(split.hold_pairs)} pairs; oracle {ORACLE_COS_AUC}",
         flush=True,
-    )
+    file=sys.stderr)
     results = {}
     for s in specs:
         parts = [int(x) for x in s.split(":")]
@@ -72,12 +72,12 @@ def main():
             "seconds": round(dt, 1),
         }
         print(f"g{group} h{head} s{block}: AUC {auc:.4f} "
-              f"loss {losses[0]:.3f}->{losses[-1]:.3f} ({dt:.0f}s)", flush=True)
+              f"loss {losses[0]:.3f}->{losses[-1]:.3f} ({dt:.0f}s)", flush=True, file=sys.stderr)
     out = os.path.join(os.path.dirname(__file__), "results",
                        "geom_quality_r4.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
-    print(json.dumps(results))
+    print(json.dumps(results), file=sys.stdout)
 
 
 if __name__ == "__main__":

@@ -93,7 +93,7 @@ def main() -> None:
         row = rate(g, b, args.vocab, args.pairs, args.batch)
         if args.quality:
             row["holdout_auc"] = quality(g, b)
-        print(json.dumps(row), flush=True)
+        print(json.dumps(row), flush=True, file=sys.stdout)
         rows.append(row)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(rows, f, indent=1)

@@ -45,7 +45,7 @@ def measure(head: int, v: int, n: int, b: int, dim: int, epochs: int = 3):
         if ep:
             rates.append(pairs_per_epoch / dt)
     if trainer.pos_quotas is not None:
-        print(f"  quotas={trainer.pos_quotas}")
+        print(f"  quotas={trainer.pos_quotas}", file=sys.stderr)
     return {
         "head": head,
         "pairs_per_sec": round(float(np.median(rates)), 1),
@@ -68,7 +68,7 @@ def main():
     rows = []
     for h in [int(x) for x in args.heads.split(",")]:
         row = measure(h, args.vocab, args.pairs, args.batch, args.dim)
-        print(json.dumps(row), flush=True)
+        print(json.dumps(row), flush=True, file=sys.stdout)
         rows.append(row)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(rows, f, indent=1)

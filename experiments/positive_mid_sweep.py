@@ -83,16 +83,16 @@ def main():
     results = []
     for combo in args.combos.split(","):
         head, mid = (int(x) for x in combo.split(":"))
-        print(f"head={head} mid={mid} ...", flush=True)
+        print(f"head={head} mid={mid} ...", flush=True, file=sys.stderr)
         r = measure(head, mid, args.vocab, args.pairs, args.batch,
                     args.dim, args.epochs)
         print(f"  {r['pairs_per_sec']:,.0f} pairs/s  loss={r['final_loss']}",
-              flush=True)
+              flush=True, file=sys.stderr)
         results.append(r)
     with open(args.out, "w") as f:
         json.dump({"device": str(jax.devices()[0]), "results": results}, f,
                   indent=2)
-    print(f"wrote {args.out}")
+    print(f"wrote {args.out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

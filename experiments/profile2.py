@@ -9,6 +9,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import sys
 
 V, D, B, P = 24447, 200, 16384, 64
 E = 2 * B
@@ -33,12 +34,12 @@ def bench(label, fn, *args, iters=NB, pairs=None):
     sync(out)
     dt = (time.perf_counter() - t0) / iters
     extra = f" -> {pairs / dt / 1e6:8.2f}M pairs/s" if pairs else ""
-    print(f"{label:46s} {dt * 1e3:8.3f} ms{extra}")
+    print(f"{label:46s} {dt * 1e3:8.3f} ms{extra}", file=sys.stderr)
     return dt
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
     ctx = jnp.asarray(rng.randn(V, D).astype(np.float32))
@@ -113,7 +114,7 @@ def main():
             p, _ = stepb(p, pairs_b, noise, jax.random.fold_in(key, i))
         sync(p)
         dt = (time.perf_counter() - t0) / n
-        print(f"{'FULL step B=%d' % b:46s} {dt * 1e3:8.3f} ms -> {b / dt / 1e6:8.2f}M pairs/s")
+        print(f"{'FULL step B=%d' % b:46s} {dt * 1e3:8.3f} ms -> {b / dt / 1e6:8.2f}M pairs/s", file=sys.stderr)
 
     # per_example mode for comparison
     pairs_b = jnp.asarray(rng.randint(0, V, (16384, 2)).astype(np.int32))
@@ -132,7 +133,7 @@ def main():
         p, _ = step_pe(p, pairs_b, noise, jax.random.fold_in(key, i))
     sync(p)
     dt = (time.perf_counter() - t0) / 30
-    print(f"{'FULL step per_example B=16384':46s} {dt * 1e3:8.3f} ms -> {16384 / dt / 1e6:8.2f}M pairs/s")
+    print(f"{'FULL step per_example B=16384':46s} {dt * 1e3:8.3f} ms -> {16384 / dt / 1e6:8.2f}M pairs/s", file=sys.stderr)
 
 
 if __name__ == "__main__":

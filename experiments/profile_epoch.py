@@ -15,13 +15,14 @@ from gene2vec_tpu.config import SGNSConfig
 from gene2vec_tpu.data.negative_sampling import NegativeSampler
 from gene2vec_tpu.sgns.model import SGNSParams
 from gene2vec_tpu.sgns.train import make_train_epoch
+import sys
 
 V, D, B = 24447, 200, 16384
 N = 4_000_000
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     p = 1.0 / np.arange(1, V + 1)
     p /= p.sum()
@@ -52,7 +53,7 @@ def main():
         params, loss = fn(params, pairs, noise, jax.random.fold_in(key, 1))
         float(loss)
         dt = time.perf_counter() - t0
-        print(f"{label:28s}: {dt:7.3f}s/epoch -> {nb * cfg.batch_pairs / dt / 1e6:8.2f}M pairs/s")
+        print(f"{label:28s}: {dt:7.3f}s/epoch -> {nb * cfg.batch_pairs / dt / 1e6:8.2f}M pairs/s", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import sys
 
 V, D, B = 24447, 200, 16384
 NB = 244  # scan length
@@ -25,11 +26,11 @@ def bench(label, fn, *args):
     out = fn(*args)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    print(f"{label:52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter")
+    print(f"{label:52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter", file=sys.stderr)
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     table = jnp.asarray(rng.randn(V, D).astype(np.float32))
     corpus = jnp.asarray(rng.randint(0, V, (NB * B, 2)).astype(np.int32))
@@ -96,7 +97,7 @@ def main():
         t = step(t, idx, grads)
     jax.block_until_ready(t)
     dt = time.perf_counter() - t0
-    print(f"{'python loop of jitted zeros-acc steps':52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter")
+    print(f"{'python loop of jitted zeros-acc steps':52s} {dt * 1e3:9.2f} ms total, {dt / NB * 1e3:7.3f} ms/iter", file=sys.stderr)
 
     # 7. gather per iter from carried table
     @jax.jit

@@ -13,6 +13,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import sys
 
 V, D, B, P = 24447, 200, 16384, 64
 E = 2 * B
@@ -26,12 +27,12 @@ def timeit(name, fn, *args, iters=30):
         out = fn(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
-    print(f"{name:42s} {dt * 1e3:8.3f} ms")
+    print(f"{name:42s} {dt * 1e3:8.3f} ms", file=sys.stderr)
     return dt
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
     ctx = jnp.asarray(rng.randn(V, D).astype(np.float32))
@@ -120,7 +121,7 @@ def main():
     jax.block_until_ready(p2)
     dt = (time.perf_counter() - t0) / iters
     print(f"{'FULL sgns_step (shared, donated)':42s} {dt * 1e3:8.3f} ms "
-          f"-> {B / dt / 1e6:.2f}M pairs/s")
+          f"-> {B / dt / 1e6:.2f}M pairs/s", file=sys.stderr)
 
     # 7. batch-size sweep of the full step
     for b in (4096, 16384, 65536, 262144):
@@ -138,7 +139,7 @@ def main():
             p, _ = stepb(p, pairs_b, noise, jax.random.fold_in(key, i))
         jax.block_until_ready(p)
         dt = (time.perf_counter() - t0) / n
-        print(f"  full step B={b:7d}: {dt * 1e3:8.3f} ms -> {b / dt / 1e6:7.2f}M pairs/s")
+        print(f"  full step B={b:7d}: {dt * 1e3:8.3f} ms -> {b / dt / 1e6:7.2f}M pairs/s", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -252,7 +252,7 @@ def main() -> None:
     payload = json.dumps({"suite": args.suite, "epochs": args.epochs,
                           "rows": rows}, indent=1)
     if args.out == "-":
-        print(payload)
+        print(payload, file=sys.stdout)
     else:
         with open(args.out, "w") as f:
             f.write(payload)

@@ -323,7 +323,7 @@ def main():
         "base", "onehot", "bf16noise", "maskfree", "merged", "all", "sum",
         "g64", "g128",
     ]
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     p = 1.0 / np.arange(1, V + 1)
     p /= p.sum()
@@ -363,7 +363,7 @@ def main():
         print(
             f"{variant:10s} [{rs}] M pairs/s  (best {max(rates)/1e6:.2f})"
             f"  loss {losses[-1]:.4f}"
-        )
+        , file=sys.stderr)
 
 
 if __name__ == "__main__":

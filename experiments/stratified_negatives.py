@@ -167,7 +167,7 @@ def main():
     ap.add_argument("--data-dir", default="/root/reference/predictionData")
     args = ap.parse_args()
     rows = {"rate": suite_rate, "quality": suite_quality}[args.suite](args)
-    print(json.dumps({"suite": args.suite, "rows": rows}, indent=1))
+    print(json.dumps({"suite": args.suite, "rows": rows}, indent=1), file=sys.stdout)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 _sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
 SCAN = 50
@@ -22,7 +23,7 @@ def bench(label, loop, x, nbytes):
     out = loop(x)
     float(_sum(out))
     dt = (time.perf_counter() - t0) / SCAN
-    print(f"{label:46s} {dt * 1e6:9.1f} us/call  {nbytes / dt / 1e9:7.1f} GB/s")
+    print(f"{label:46s} {dt * 1e6:9.1f} us/call  {nbytes / dt / 1e9:7.1f} GB/s", file=sys.stderr)
 
 
 def xla_axpy_loop(shape, dtype):
@@ -61,7 +62,7 @@ def pallas_copy_loop(shape, dtype, block_rows):
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
 
     for mb in (25, 100, 400):

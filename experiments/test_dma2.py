@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 V, D = 24576, 256
 _sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
@@ -22,11 +23,11 @@ def try_kernel(label, fn, *args):
     try:
         out = jax.jit(fn)(*args)
         float(_sum(out))
-        print(f"{label:56s} OK")
+        print(f"{label:56s} OK", file=sys.stderr)
         return out
     except Exception as e:
         lines = [l for l in str(e).splitlines() if "Mosaic" in l or "INTERNAL" in l or "Error" in l][:1]
-        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}")
+        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}", file=sys.stderr)
         return None
 
 
@@ -66,7 +67,7 @@ def main():
 
     out = try_kernel("g: dynamic-row HBM DMA source", callg, idx1, table)
     if out is not None:
-        print("   err:", np.abs(np.asarray(out)[0] - np.asarray(table)[7]).max())
+        print("   err:", np.abs(np.asarray(out)[0] - np.asarray(table)[7]).max(), file=sys.stderr)
 
     # h: aligned dynamic VMEM write in fori loop (start = 8*j)
     E = 1024
@@ -156,7 +157,7 @@ def main():
         out = try_kernel(f"i: aligned DMA-ring gather E={E} K={K}", call, idx, table)
         if out is not None:
             want = np.asarray(table)[np.asarray(idx)]
-            print("   err:", np.abs(np.asarray(out) - want).max())
+            print("   err:", np.abs(np.asarray(out) - want).max(), file=sys.stderr)
 
     # timing inside a scan (amortize dispatch): compare vs XLA gather
     E = 32768
@@ -186,9 +187,9 @@ def main():
             t0 = time.perf_counter()
             float(loop(table, idxb))
             dt = (time.perf_counter() - t0) / 20
-            print(f"{label} gather 32768 rows: {dt * 1e6:8.1f} us/call  ({dt / E * 1e9:.1f} ns/row)")
+            print(f"{label} gather 32768 rows: {dt * 1e6:8.1f} us/call  ({dt / E * 1e9:.1f} ns/row)", file=sys.stderr)
         except Exception as e:
-            print(f"{label} FAIL: {str(e).splitlines()[0][:110]}")
+            print(f"{label} FAIL: {str(e).splitlines()[0][:110]}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 V, D, E = 24576, 256, 4096
 
@@ -21,16 +22,16 @@ def try_kernel(label, fn, *args):
     try:
         out = jax.jit(fn)(*args)
         s = float(_sum(out))
-        print(f"{label:46s} OK (sum {s:.1f})")
+        print(f"{label:46s} OK (sum {s:.1f})", file=sys.stderr)
         return out
     except Exception as e:
         lines = [l for l in str(e).splitlines() if l.strip()][:3]
-        print(f"{label:46s} FAIL: {' | '.join(l[:120] for l in lines)}")
+        print(f"{label:46s} FAIL: {' | '.join(l[:120] for l in lines)}", file=sys.stderr)
         return None
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     table = jnp.asarray(rng.randn(V, D).astype(np.float32))
     idx = jnp.asarray(rng.randint(0, V, E).astype(np.int32))
@@ -130,7 +131,7 @@ def main():
     out = try_kernel("3: fori of dynamic row DMAs (1 in flight)", call3, idx, table)
     if out is not None:
         want = np.asarray(table)[np.asarray(idx)]
-        print("   max err:", np.abs(np.asarray(out) - want).max())
+        print("   max err:", np.abs(np.asarray(out) - want).max(), file=sys.stderr)
 
     # 4. ring with semaphore array, K in flight
     K = 8
@@ -184,7 +185,7 @@ def main():
     out = try_kernel(f"4: DMA ring K={K}", call4, idx, table)
     if out is not None:
         want = np.asarray(table)[np.asarray(idx)]
-        print("   max err:", np.abs(np.asarray(out) - want).max())
+        print("   max err:", np.abs(np.asarray(out) - want).max(), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 _sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
 N, D = 256, 256
@@ -17,10 +18,10 @@ def try_kernel(label, fn, *args):
     try:
         out = jax.jit(fn)(*args)
         float(_sum(out))
-        print(f"{label:56s} OK")
+        print(f"{label:56s} OK", file=sys.stderr)
     except Exception as e:
         lines = [l for l in str(e).splitlines() if "Mosaic" in l or "NotImplemented" in l or "INTERNAL" in l][:1]
-        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}")
+        print(f"{label:56s} FAIL: {lines[0][:110] if lines else str(e).splitlines()[0][:110]}", file=sys.stderr)
 
 
 def main():

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 D, V, BUCKET = 256, 24576, 128
 NBUCKETS = V // BUCKET  # 192
@@ -43,7 +44,7 @@ def bench_scan(label, call, table_t, *args):
     out = loop(table_t, *args)
     sync(out)
     dt = (time.perf_counter() - t0) / SCAN
-    print(f"{label:52s} {dt * 1e6:9.1f} us/call")
+    print(f"{label:52s} {dt * 1e6:9.1f} us/call", file=sys.stderr)
     return out
 
 
@@ -107,7 +108,7 @@ def stream_copy(table_t):
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     table_np = rng.randn(D, V).astype(np.float32)
     table_t = jnp.asarray(table_np)
@@ -122,9 +123,9 @@ def main():
         want = np.stack(
             [ref[:, b, offs_np[8 * b]] for b in range(NBUCKETS)], axis=1
         ).reshape(D, V)
-        print("gather max err:", np.abs(got - want).max())
+        print("gather max err:", np.abs(got - want).max(), file=sys.stderr)
     except Exception as e:
-        print("gather FAILED:", str(e).splitlines()[0][:200])
+        print("gather FAILED:", str(e).splitlines()[0][:200], file=sys.stderr)
         return
 
     grads = jnp.asarray((rng.randn(D, V) * 0.01).astype(np.float32))
@@ -136,9 +137,9 @@ def main():
             for j in range(BUCKET):
                 t_np[:, b, offs_np[8 * b, j]] += g_np[:, b, j]
         got = np.asarray(out).reshape(D, NBUCKETS, BUCKET)
-        print("scatter max err:", np.abs(got - t_np).max())
+        print("scatter max err:", np.abs(got - t_np).max(), file=sys.stderr)
     except Exception as e:
-        print("scatter FAILED:", str(e).splitlines()[0][:200])
+        print("scatter FAILED:", str(e).splitlines()[0][:200], file=sys.stderr)
 
     bench_scan("stream copy f32 (roofline: 25MB r + 25MB w)", stream_copy, table_t)
     bench_scan("bucketed lane-gather f32", lambda t, o: bucketed_gather(t, o), table_t, offs)
@@ -154,7 +155,7 @@ def main():
             tb, grads.astype(jnp.bfloat16), offs,
         )
     except Exception as e:
-        print("bf16 FAILED:", str(e).splitlines()[0][:200])
+        print("bf16 FAILED:", str(e).splitlines()[0][:200], file=sys.stderr)
 
     # XLA row-gather equivalent inside scan, for comparison:
     # gather 24576 rows of width 256 from a (24576, 256) table.
@@ -177,7 +178,7 @@ def main():
     out = xla_loop(table_r, idx)
     sync(out)
     dt = (time.perf_counter() - t0) / SCAN
-    print(f"{'XLA row-gather 24576 rows (V,256)':52s} {dt * 1e6:9.1f} us/call")
+    print(f"{'XLA row-gather 24576 rows (V,256)':52s} {dt * 1e6:9.1f} us/call", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 M, D = 32768, 256
 
@@ -54,12 +55,12 @@ def bench(label, fn, *args, iters=50):
         out = fn(*args)
     sync(out)
     dt = (time.perf_counter() - t0) / iters
-    print(f"{label:44s} {dt * 1e6:10.1f} us")
+    print(f"{label:44s} {dt * 1e6:10.1f} us", file=sys.stderr)
     return out
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
     table = jnp.asarray(rng.randn(M, D).astype(np.float32))
     idx = jnp.asarray(rng.randint(0, M, M).astype(np.int32))
@@ -67,7 +68,7 @@ def main():
     out_p = bench("pallas dynamic_gather (32768,256) f32", pallas_gather, idx, table)
     out_x = bench("xla row gather (32768,256) f32", jax.jit(lambda t, i: t[i]), table, idx)
     err = float(_sum(jnp.abs(out_p - out_x)))
-    print("abs diff:", err)
+    print("abs diff:", err, file=sys.stderr)
 
     tb = table.astype(jnp.bfloat16)
     bench("pallas dynamic_gather bf16", pallas_gather, idx, tb)

@@ -9,6 +9,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import sys
 
 _sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
 
@@ -20,11 +21,11 @@ def bench(label, loop, x, iters_inside):
     out = loop(x)
     float(_sum(out))
     dt = (time.perf_counter() - t0) / iters_inside
-    print(f"{label:52s} {dt * 1e6:9.2f} us/iter")
+    print(f"{label:52s} {dt * 1e6:9.2f} us/iter", file=sys.stderr)
 
 
 def main():
-    print("device:", jax.devices()[0])
+    print("device:", jax.devices()[0], file=sys.stderr)
     rng = np.random.RandomState(0)
 
     for n_iter in (100, 1000):

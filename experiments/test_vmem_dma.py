@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+import sys
 
 _sum = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
 
@@ -41,7 +42,7 @@ def vmem_probe(mb: int) -> bool:
         return True
     except Exception as e:
         msg = str(e).split("\n")[0][:160]
-        print(f"  {mb}MB in+out failed: {msg}")
+        print(f"  {mb}MB in+out failed: {msg}", file=sys.stderr)
         return False
 
 
@@ -102,16 +103,16 @@ def bench(label, fn, *args, iters=30):
         out = fn(*args)
     sync(out)
     dt = (time.perf_counter() - t0) / iters
-    print(f"{label:48s} {dt * 1e6:9.1f} us")
+    print(f"{label:48s} {dt * 1e6:9.1f} us", file=sys.stderr)
     return out
 
 
 def main():
-    print("device:", jax.devices()[0])
-    print("VMEM capacity probe (in+out both VMEM, so ~2x the MB):")
+    print("device:", jax.devices()[0], file=sys.stderr)
+    print("VMEM capacity probe (in+out both VMEM, so ~2x the MB):", file=sys.stderr)
     for mb in (8, 16, 24, 32, 48, 56, 60):
         ok = vmem_probe(mb)
-        print(f"  {mb}MB blocks x2: {'OK' if ok else 'FAIL'}")
+        print(f"  {mb}MB blocks x2: {'OK' if ok else 'FAIL'}", file=sys.stderr)
         if not ok:
             break
 
@@ -129,9 +130,9 @@ def main():
             fn = jax.jit(functools.partial(dma_gather, n_inflight=k))
             out_p = bench(f"pallas DMA-ring gather k={k}", fn, table, idx)
             err = float(_sum(jnp.abs(out_p - out_x)))
-            print(f"    abs err vs xla: {err}")
+            print(f"    abs err vs xla: {err}", file=sys.stderr)
         except Exception as e:
-            print(f"  k={k} failed: {str(e).splitlines()[0][:160]}")
+            print(f"  k={k} failed: {str(e).splitlines()[0][:160]}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,29 @@
+"""graftcheck: first-party static analysis + sanitizer gating.
+
+Three tiers (docs/STATIC_ANALYSIS.md):
+
+1. AST lint passes for JAX footguns (:mod:`.passes_ast`) — fast, jax-free,
+   run inside tier-1 and ``python -m gene2vec_tpu.cli.analyze``;
+2. jaxpr/HLO invariant checks (:mod:`.passes_hlo`) — compile the SGNS /
+   CBOW-HS / GGIPNN steps on CPU and assert budgets (host callbacks,
+   dtype discipline, jit cache stability, collective bytes);
+3. sanitizer wiring for ``native/`` (:mod:`.sanitize`) — ASAN/UBSAN/TSAN
+   build targets and parity runs.
+
+Findings from every tier share one JSON schema (:mod:`.findings`).
+"""
+
+from gene2vec_tpu.analysis.findings import (  # noqa: F401
+    SCHEMA,
+    Finding,
+    dumps,
+    gating,
+    to_report,
+)
+from gene2vec_tpu.analysis.passes_ast import ALL_PASSES  # noqa: F401
+from gene2vec_tpu.analysis.runner import (  # noqa: F401
+    REPO_ROOT,
+    pass_ids,
+    run_ast_passes,
+    select_passes,
+)

@@ -1,0 +1,367 @@
+"""AST pass framework: module loading, import resolution, traced scopes.
+
+The JAX-footgun passes all need the same two facts about a module:
+
+* which dotted names mean what (``np`` → ``numpy``, ``lax`` →
+  ``jax.lax``) — :func:`import_table` + :func:`resolve_chain`;
+* which function bodies execute **under a trace** (inside ``jit`` /
+  ``scan`` / ``vmap`` / ... ) — :func:`traced_functions`.
+
+Trace detection is lexical and name-based, deliberately: a function is
+traced when it is (a) decorated with a jit-like wrapper, (b) passed by
+name in a *function-valued argument position* of a trace-entry call
+anywhere in the module (``jax.jit(f)``, ``lax.scan(body, ...)`` — see
+:data:`TRACE_HOF_FN_ARGS`; carry/operand positions never mark), or (c)
+lexically nested inside a traced function.  Helpers *called* from traced code are not followed — that is
+an inter-procedural analysis this tier does not attempt (documented in
+docs/STATIC_ANALYSIS.md), and in practice the repo's traced helpers are
+nested closures, which (c) covers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: wrappers whose *argument function* runs traced
+TRACE_WRAPPERS = {
+    "jax.jit", "jax.pjit", "jax.pmap", "jax.vmap", "jax.grad",
+    "jax.value_and_grad", "jax.checkpoint", "jax.remat", "jax.linearize",
+    "jax.experimental.shard_map.shard_map", "jax.jacfwd", "jax.jacrev",
+}
+
+#: higher-order control-flow entry points: first (or any) function-valued
+#: argument runs traced
+TRACE_HOF = {
+    "jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop",
+    "jax.lax.map", "jax.lax.cond", "jax.lax.switch",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+}
+
+#: which positional indices of each HOF are function-valued — only those
+#: mark a passed name as traced.  Without this, a carry/xs operand whose
+#: local name collides with a module-level function (``lax.scan(body,
+#: init, xs)`` where ``init`` is a float carry AND ``def init`` exists
+#: host-side) would falsely mark the host function traced.
+#: Signatures: scan(f, init, xs) / fori_loop(lo, hi, body, init) /
+#: while_loop(cond, body, init) / map(f, xs) / cond(pred, true, false,
+#: *ops) / switch(index, branches, *ops) / associative_scan(fn, elems) /
+#: custom_root(f, x0, solve, tangent_solve)
+TRACE_HOF_FN_ARGS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.map": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": (0, 2, 3),
+}
+
+
+@dataclasses.dataclass
+class ModuleSource:
+    """One parsed module, path-relative to the repo root."""
+
+    path: str           # absolute
+    rel: str            # repo-relative, for findings
+    source: str
+    tree: ast.AST
+    lines: List[str]
+
+    @classmethod
+    def load(cls, path: str, root: str) -> Optional["ModuleSource"]:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, root)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None  # the syntax-error finding is the runner's job
+        return cls(path, rel, source, tree, source.splitlines())
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def imports(self) -> Dict[str, str]:
+        """Memoized :func:`import_table` — every pass needs it, so derive
+        it once per module instead of once per pass."""
+        cached = getattr(self, "_imports_cache", None)
+        if cached is None:
+            cached = import_table(self.tree)
+            self._imports_cache = cached
+        return cached
+
+
+def import_table(tree: ast.AST) -> Dict[str, str]:
+    """Local alias → canonical dotted module path.
+
+    ``import numpy as np`` → {"np": "numpy"};
+    ``from jax import numpy as jnp`` → {"jnp": "jax.numpy"};
+    ``from numpy import random`` → {"random": "numpy.random"} (shadows a
+    bare ``import random`` seen earlier, matching runtime semantics).
+    """
+    table: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                table[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                table[a.asname or a.name] = f"{node.module}.{a.name}"
+    return table
+
+
+def chain_of(node: ast.AST) -> Optional[str]:
+    """``ast.Attribute``/``ast.Name`` → dotted string ("np.random.rand"),
+    or None for non-name roots (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve_chain(chain: str, imports: Dict[str, str]) -> str:
+    """Rewrite a dotted chain's root through the module's import table:
+    ``np.random.rand`` → ``numpy.random.rand``."""
+    root, _, rest = chain.partition(".")
+    base = imports.get(root, root)
+    return f"{base}.{rest}" if rest else base
+
+
+def _resolved_call_target(call: ast.Call, imports: Dict[str, str]) -> Optional[str]:
+    chain = chain_of(call.func)
+    return resolve_chain(chain, imports) if chain else None
+
+
+def is_jit_chain(resolved: Optional[str]) -> bool:
+    """Does this resolved dotted name denote ``jax.jit`` (or pjit)?"""
+    return resolved in ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+def _trace_entry_kind(resolved: Optional[str]) -> Optional[str]:
+    if resolved is None:
+        return None
+    if resolved in TRACE_WRAPPERS:
+        return "wrapper"
+    if resolved in TRACE_HOF:
+        return "hof"
+    # functools.partial(jax.jit, ...) handled at the decorator site
+    return None
+
+
+def _decorator_is_tracing(dec: ast.AST, imports: Dict[str, str]) -> bool:
+    """@jax.jit / @jit / @functools.partial(jax.jit, ...) /
+    @jax.jit-with-kwargs-call forms."""
+    if isinstance(dec, ast.Call):
+        resolved = _resolved_call_target(dec, imports)
+        if resolved in ("functools.partial", "partial") and dec.args:
+            inner = chain_of(dec.args[0])
+            if inner and _trace_entry_kind(resolve_chain(inner, imports)):
+                return True
+        return _trace_entry_kind(resolved) == "wrapper"
+    chain = chain_of(dec)
+    if chain is None:
+        return False
+    return _trace_entry_kind(resolve_chain(chain, imports)) == "wrapper"
+
+
+@dataclasses.dataclass
+class TracedFunction:
+    node: ast.AST          # FunctionDef | AsyncFunctionDef | Lambda
+    reason: str            # "decorator" | "wrapped:<entry>" | "nested:<outer>"
+    name: str
+    #: the TracedFunction of the nearest *traced* enclosing function, by
+    #: node identity (never by name — two traced fns may share a name);
+    #: None for roots and for fns nested in untraced factories
+    outer: Optional["TracedFunction"] = None
+
+
+def traced_functions(mod: ModuleSource) -> List[TracedFunction]:
+    """Every function definition in the module whose body runs traced.
+    Memoized on the module (three passes share the result)."""
+    cached = getattr(mod, "_traced_cache", None)
+    if cached is None:
+        cached = _compute_traced(mod)
+        mod._traced_cache = cached
+    return cached
+
+
+def _scope_index(tree: ast.AST):
+    """(scope_chain, defs_in): for every node the tuple of enclosing
+    function nodes (innermost last), and for every scope (module = None)
+    the name → def-node table it defines.  This is what lets a bare-name
+    reference at a call site resolve to THE def visible there, instead of
+    any same-named def anywhere in the module."""
+    scope_chain: Dict[int, Tuple[ast.AST, ...]] = {}
+    defs_in: Dict[Optional[int], Dict[str, ast.AST]] = {}
+
+    def visit(parent: ast.AST, chain: Tuple[ast.AST, ...]) -> None:
+        for child in ast.iter_child_nodes(parent):
+            scope_chain[id(child)] = chain
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner = id(chain[-1]) if chain else None
+                defs_in.setdefault(owner, {})[child.name] = child
+                visit(child, chain + (child,))
+            elif isinstance(child, ast.Lambda):
+                visit(child, chain + (child,))
+            else:
+                visit(child, chain)
+
+    visit(tree, ())
+    return scope_chain, defs_in
+
+
+def _compute_traced(mod: ModuleSource) -> List[TracedFunction]:
+    imports = mod.imports()
+    scope_chain, defs_in = _scope_index(mod.tree)
+
+    def resolve_def(name: str, at: ast.AST) -> Optional[ast.AST]:
+        """The def a bare ``name`` at node ``at`` lexically refers to:
+        innermost enclosing scope outward to module, or None (imported /
+        non-def value)."""
+        chain = scope_chain.get(id(at), ())
+        for scope in (*reversed(chain), None):
+            owner = None if scope is None else id(scope)
+            d = defs_in.get(owner, {}).get(name)
+            if d is not None:
+                return d
+        return None
+
+    # def nodes passed to trace-entry calls → reason, resolved per call
+    # site so a host-side def sharing a name with a traced closure is
+    # never dragged into traced scope
+    wrapped_defs: Dict[int, str] = {}
+
+    def mark_wrapped(name: str, at: ast.AST, reason: str) -> None:
+        d = resolve_def(name, at)
+        if d is not None and id(d) not in wrapped_defs:
+            wrapped_defs[id(d)] = reason
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = _resolved_call_target(node, imports)
+        kind = _trace_entry_kind(resolved)
+        if kind is None:
+            # functools.partial(jax.jit, ...) used as a value, not decorator
+            if resolved in ("functools.partial", "partial") and node.args:
+                inner = chain_of(node.args[0])
+                if inner and _trace_entry_kind(resolve_chain(inner, imports)):
+                    # partial(jax.jit, f, ...): only the first bound
+                    # positional is the wrapped function
+                    for arg in node.args[1:2]:
+                        c = chain_of(arg)
+                        if c and "." not in c:
+                            mark_wrapped(
+                                c, node,
+                                f"wrapped:{resolve_chain(inner, imports)}",
+                            )
+            continue
+        if kind == "wrapper":
+            fn_args = node.args[:1]  # jax.jit(f, ...): fn is position 0
+        else:
+            idxs = TRACE_HOF_FN_ARGS.get(resolved, (0,))
+            fn_args = [node.args[i] for i in idxs if i < len(node.args)]
+        for arg in fn_args:
+            # lax.switch takes a literal *sequence* of branch functions
+            cands = arg.elts if isinstance(arg, (ast.List, ast.Tuple)) else [arg]
+            for cand in cands:
+                c = chain_of(cand)
+                if c and "." not in c:
+                    mark_wrapped(c, node, f"wrapped:{resolved}")
+                elif isinstance(cand, ast.Call):
+                    # jax.jit(functools.partial(f, ...)) — the inner f
+                    inner_t = _resolved_call_target(cand, imports)
+                    if inner_t in ("functools.partial", "partial") and cand.args:
+                        ic = chain_of(cand.args[0])
+                        if ic and "." not in ic:
+                            mark_wrapped(ic, node, f"wrapped:{resolved}")
+
+    marked: Dict[int, str] = {}
+    order: List[ast.AST] = []
+
+    def mark(node, reason):
+        if id(node) in marked:
+            return
+        marked[id(node)] = reason
+        order.append(node)
+        # (c) everything lexically nested runs under the same trace;
+        # each nested def's reason names its NEAREST enclosing function
+        # (not the root) so closure-param accumulation stays precise
+        for child in ast.walk(node):
+            if child is not node and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                if id(child) not in marked:
+                    chain = scope_chain.get(id(child), ())
+                    outer_name = (
+                        getattr(chain[-1], "name", "<lambda>")
+                        if chain else getattr(node, "name", "<lambda>")
+                    )
+                    marked[id(child)] = f"nested:{outer_name}"
+                    order.append(child)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(
+                _decorator_is_tracing(d, imports) for d in node.decorator_list
+            ):
+                mark(node, "decorator")
+            elif id(node) in wrapped_defs:
+                mark(node, wrapped_defs[id(node)])
+
+    # ast.walk is breadth-first, so an enclosing fn always precedes its
+    # nested defs in `order` — outer links resolve by node identity
+    by_id: Dict[int, TracedFunction] = {}
+    out: List[TracedFunction] = []
+    for node in order:
+        chain = scope_chain.get(id(node), ())
+        outer = by_id.get(id(chain[-1])) if chain else None
+        tf = TracedFunction(
+            node, marked[id(node)], getattr(node, "name", "<lambda>"), outer,
+        )
+        by_id[id(node)] = tf
+        out.append(tf)
+    return out
+
+
+def walk_body(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a traced function's subtree, including nested defs (they are
+    traced too; per-def findings stay deduplicated because passes anchor
+    on the node's location)."""
+    yield from ast.walk(fn_node)
+
+
+def params_of(fn_node: ast.AST) -> Set[str]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return set()
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def iter_py_files(
+    root_dir: str, skip_dirs: Tuple[str, ...] = ("__pycache__",)
+) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root_dir):
+        dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)

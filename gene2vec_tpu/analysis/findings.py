@@ -1,0 +1,89 @@
+"""Machine-readable findings for the graftcheck analysis suite.
+
+One schema serves every tier — AST lint passes, jaxpr/HLO invariant
+checks, sanitizer parity runs — so ``python -m gene2vec_tpu.cli.analyze
+--json`` and ``scripts/run_static_analysis.sh`` emit a single artifact
+that CI (or a human) can diff across rounds.  The schema is documented
+in docs/STATIC_ANALYSIS.md; bump :data:`SCHEMA` on any shape change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional
+
+SCHEMA = "gene2vec-tpu/findings/v1"
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation (or informational fact) produced by a pass.
+
+    ``path``/``line``/``col`` locate source findings; HLO/runtime
+    findings use ``path`` for a logical label (e.g. ``hlo:sgns/cpu8``)
+    and line 0.  ``data`` carries pass-specific structured detail
+    (budget numbers, dtype census, ...) and must stay JSON-serializable.
+    """
+
+    pass_id: str
+    message: str
+    path: str = ""
+    line: int = 0
+    col: int = 0
+    severity: str = "error"
+    snippet: str = ""
+    data: Optional[Dict] = None
+
+    def to_dict(self) -> Dict:
+        d = {
+            "pass": self.pass_id,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.snippet:
+            d["snippet"] = self.snippet
+        if self.data is not None:
+            d["data"] = self.data
+        return d
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        head = f"{loc}: [{self.pass_id}] {self.message}"
+        return head + (f"\n    {self.snippet}" if self.snippet else "")
+
+
+def gating(findings: Iterable[Finding]) -> List[Finding]:
+    """The subset that should fail a build (``info`` never gates)."""
+    return [f for f in findings if f.severity in ("error", "warning")]
+
+
+def to_report(findings: Iterable[Finding], meta: Optional[Dict] = None) -> Dict:
+    """The findings JSON document (schema + findings + summary)."""
+    fs = sorted(
+        findings, key=lambda f: (f.path, f.line, f.col, f.pass_id)
+    )
+    by_pass: Dict[str, int] = {}
+    for f in fs:
+        by_pass[f.pass_id] = by_pass.get(f.pass_id, 0) + 1
+    doc = {
+        "schema": SCHEMA,
+        "findings": [f.to_dict() for f in fs],
+        "summary": {
+            "total": len(fs),
+            "gating": len(gating(fs)),
+            "by_pass": by_pass,
+        },
+    }
+    if meta:
+        doc["meta"] = meta
+    return doc
+
+
+def dumps(findings: Iterable[Finding], meta: Optional[Dict] = None) -> str:
+    return json.dumps(to_report(findings, meta), indent=2, sort_keys=False)

@@ -1,0 +1,439 @@
+"""Tier-1 AST lint passes: JAX footguns + the stdout-discipline lint.
+
+Every pass is cheap (pure ``ast``, no jax import) and runs inside tier-1
+via tests/test_analysis.py.  Pass semantics, rationale, and the exact
+false-positive trade-offs are documented in docs/STATIC_ANALYSIS.md; the
+planted-violation fixtures in tests/test_analysis.py pin each pass to
+fire exactly once on its fixture and never on the package at HEAD.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from gene2vec_tpu.analysis.astpass import (
+    ModuleSource,
+    TracedFunction,
+    chain_of,
+    import_table,
+    is_jit_chain,
+    params_of,
+    resolve_chain,
+    traced_functions,
+)
+from gene2vec_tpu.analysis.findings import Finding
+
+#: attribute calls that force a device→host sync (or are host-only)
+_HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+
+#: builtins that coerce a traced value to a Python scalar
+_SCALAR_COERCIONS = {"float", "int", "bool", "complex"}
+
+_TRAINY_NAME = re.compile(r"(?:^|_)(train|epoch|step|update)")
+_DONATE_EXEMPT = re.compile(r"(init|predict|eval|loss|infer|metric)")
+
+
+class Pass:
+    """Base: subclasses set ``id``/``title``/``severity``/``roots`` and
+    implement :meth:`run` over one module."""
+
+    id: str = ""
+    title: str = ""
+    severity: str = "error"
+    #: which file sets the runner feeds this pass ("package",
+    #: "experiments"); the cli layer is excluded per-pass via applies()
+    roots = ("package",)
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleSource, node: ast.AST, message: str,
+                severity: Optional[str] = None, data=None) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(
+            pass_id=self.id,
+            message=message,
+            path=mod.rel,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            severity=severity or self.severity,
+            snippet=mod.line(line),
+            data=data,
+        )
+
+
+def _iter_own_body(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's subtree but stop at nested function boundaries
+    (nested defs get their own TracedFunction entry)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class TracedScopePass(Pass):
+    """Shared driver for passes that inspect traced function bodies:
+    resolves traced scopes once and hands each (function, visible
+    parameter set) to :meth:`check`."""
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        traced = traced_functions(mod)
+        if not traced:
+            return
+        imports = mod.imports()
+        # visible params accumulate outer → nested (closure variables of
+        # an enclosing traced fn are still traced values inside a nested
+        # one); linked by node identity via tf.outer — never by name,
+        # which would cross wires between same-named functions
+        visible: Dict[int, Set[str]] = {}
+        for tf in traced:
+            base: Set[str] = set()
+            if tf.outer is not None:
+                base = visible.get(id(tf.outer.node), set())
+            visible[id(tf.node)] = base | params_of(tf.node)
+            yield from self.check(mod, imports, tf, visible[id(tf.node)])
+
+    def check(
+        self, mod: ModuleSource, imports: Dict[str, str],
+        tf: TracedFunction, params: Set[str],
+    ) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class HostSyncInJitPass(TracedScopePass):
+    """Host-sync calls inside traced code: ``.item()`` / ``.tolist()`` /
+    ``block_until_ready()``, ``np.*`` calls on non-constant values, and
+    ``float()/int()/bool()`` applied to traced parameters.  Under ``jit``
+    these either fail with a tracer error at runtime or (worse, under
+    ``io_callback``-style escapes) silently serialize the device stream.
+    """
+
+    id = "host-sync-in-jit"
+    title = "host synchronization inside jit/scan"
+
+    def check(self, mod, imports, tf, params):
+        for node in _iter_own_body(tf.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _HOST_SYNC_ATTRS:
+                yield self.finding(
+                    mod, node,
+                    f".{fn.attr}() inside traced function "
+                    f"'{tf.name}' forces a device->host sync "
+                    f"(traced via {tf.reason})",
+                )
+                continue
+            chain = chain_of(fn)
+            if chain is None:
+                continue
+            resolved = resolve_chain(chain, imports)
+            if resolved.startswith("numpy."):
+                args_have_names = any(
+                    _names_in(a) for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                )
+                if args_have_names:
+                    yield self.finding(
+                        mod, node,
+                        f"numpy call {chain}(...) on non-constant values "
+                        f"inside traced function '{tf.name}' runs on host "
+                        "(tracer error or silent constant-folding); use "
+                        "jax.numpy",
+                    )
+            elif chain in _SCALAR_COERCIONS and node.args:
+                if _names_in(node.args[0]) & params:
+                    yield self.finding(
+                        mod, node,
+                        f"{chain}() coerces a traced value to a Python "
+                        f"scalar inside traced function '{tf.name}'",
+                    )
+
+
+class PythonRNGInTracePass(TracedScopePass):
+    """Python-side RNG (``random``, ``np.random``) inside traced code:
+    the draw happens once at trace time and is baked into the compiled
+    program as a constant — every execution reuses the same "random"
+    numbers.  Use ``jax.random`` with explicit keys."""
+
+    id = "py-rng-in-trace"
+    title = "host RNG inside traced code"
+
+    def check(self, mod, imports, tf, params):
+        for node in _iter_own_body(tf.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = chain_of(node.func)
+            if chain is None:
+                continue
+            resolved = resolve_chain(chain, imports)
+            if resolved.startswith("numpy.random.") or (
+                resolved.startswith("random.") and resolved.count(".") == 1
+            ):
+                yield self.finding(
+                    mod, node,
+                    f"host RNG {chain}(...) inside traced function "
+                    f"'{tf.name}' is drawn once at trace time and baked "
+                    "into the compiled program; use jax.random",
+                )
+
+
+class TracerLeakPass(TracedScopePass):
+    """Assignments to instance or global state inside traced code leak
+    tracers out of the trace: the stored object is a ``Tracer`` that
+    escapes its trace context and poisons later computations (JAX raises
+    ``UnexpectedTracerError`` only when it is *used*, far from the
+    leak)."""
+
+    id = "tracer-leak"
+    title = "tracer leaked into instance/global state"
+
+    def check(self, mod, imports, tf, params):
+        for node in _iter_own_body(tf.node):
+            if isinstance(node, ast.Global):
+                yield self.finding(
+                    mod, node,
+                    f"global statement inside traced function '{tf.name}' "
+                    "— assigning module state under a trace leaks tracers",
+                )
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    chain = chain_of(t)
+                    if chain and chain.startswith("self."):
+                        yield self.finding(
+                            mod, node,
+                            f"assignment to {chain} inside traced function "
+                            f"'{tf.name}' stores a tracer on the instance; "
+                            "return the value through the traced outputs "
+                            "instead",
+                        )
+
+
+class JitRecompileHazardPass(Pass):
+    """Jit cache-miss hazards detectable lexically:
+
+    * ``jax.jit(f, ...)(args)`` — a wrapper constructed and invoked in
+      one expression is a fresh callable every execution, so it misses
+      the jit cache unconditionally (recompiles per call; the viz/tsne
+      docstring measured minutes-vs-seconds over device tunnels);
+    * a dict/set literal passed at a jitted call site — dict structure
+      is part of the cache key (changing keys recompile) and unhashable
+      as a static argument.
+    """
+
+    id = "jit-recompile-hazard"
+    title = "jit recompilation hazard"
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        imports = mod.imports()
+
+        # names bound to jitted callables in this module
+        jitted_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                resolved = resolve_chain(
+                    chain_of(node.value.func) or "", imports
+                )
+                if is_jit_chain(resolved):
+                    for t in node.targets:
+                        c = chain_of(t)
+                        if c:
+                            jitted_names.add(c)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dchain = chain_of(
+                        dec.func if isinstance(dec, ast.Call) else dec
+                    )
+                    if dchain is None and isinstance(dec, ast.Call):
+                        continue
+                    resolved = resolve_chain(dchain or "", imports)
+                    if is_jit_chain(resolved):
+                        jitted_names.add(node.name)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and resolved in ("functools.partial", "partial")
+                        and dec.args
+                        and is_jit_chain(
+                            resolve_chain(chain_of(dec.args[0]) or "", imports)
+                        )
+                    ):
+                        jitted_names.add(node.name)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # jax.jit(...)(...) immediately invoked
+            if isinstance(node.func, ast.Call):
+                resolved = resolve_chain(
+                    chain_of(node.func.func) or "", imports
+                )
+                if is_jit_chain(resolved):
+                    yield self.finding(
+                        mod, node,
+                        "jax.jit(...) constructed and invoked in one "
+                        "expression: a fresh wrapper misses the jit cache "
+                        "every call (recompiles); bind the jitted function "
+                        "once at module or __init__ scope",
+                    )
+                    continue
+            callee = chain_of(node.func)
+            if callee in jitted_names:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, (ast.Dict, ast.Set, ast.DictComp,
+                                        ast.SetComp)):
+                        yield self.finding(
+                            mod, arg,
+                            f"dict/set literal passed to jitted '{callee}': "
+                            "its structure is part of the jit cache key "
+                            "(key changes recompile) and it is unhashable "
+                            "as a static argument; pass arrays or a "
+                            "stable-structure pytree",
+                        )
+
+
+class MissingDonatePass(Pass):
+    """Trainer-shaped jit entry points (name matching train/epoch/step/
+    update) that thread large parameter pytrees through every call should
+    donate them — without ``donate_argnums`` XLA double-buffers the
+    tables (2x HBM for the SGNS tables at the 24k-vocab scale).
+    init/predict/eval-named functions are exempt (their buffers are
+    genuinely consumed by the caller).  Severity ``warning`` records that
+    this is a *name heuristic* — it still gates (``findings.gating``
+    treats error and warning alike); a legitimately-non-donating match is
+    silenced at the site with ``# graftcheck: disable=missing-donate``,
+    never by weakening the pass or the repo gate."""
+
+    id = "missing-donate"
+    title = "large-param jit entry point without donate_argnums"
+    severity = "warning"
+
+    def _check_kwargs(self, call: ast.Call) -> bool:
+        return any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in call.keywords
+        )
+
+    def _wrapped_name(self, arg: ast.AST, imports) -> Optional[str]:
+        c = chain_of(arg)
+        if c is not None:
+            return c.split(".")[-1]
+        if isinstance(arg, ast.Call):
+            resolved = resolve_chain(chain_of(arg.func) or "", imports)
+            if resolved in ("functools.partial", "partial") and arg.args:
+                inner = chain_of(arg.args[0])
+                if inner:
+                    return inner.split(".")[-1]
+        return None
+
+    def _name_gated(self, name: Optional[str]) -> bool:
+        return bool(
+            name
+            and _TRAINY_NAME.search(name)
+            and not _DONATE_EXEMPT.search(name)
+        )
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        imports = mod.imports()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                resolved = resolve_chain(chain_of(node.func) or "", imports)
+                if is_jit_chain(resolved) and node.args:
+                    name = self._wrapped_name(node.args[0], imports)
+                    if self._name_gated(name) and not self._check_kwargs(node):
+                        yield self.finding(
+                            mod, node,
+                            f"jax.jit({name}, ...) looks like a training "
+                            "entry point but does not donate its parameter "
+                            "buffers (donate_argnums) — XLA will "
+                            "double-buffer the tables",
+                        )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not self._name_gated(node.name):
+                    continue
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        # bare @jax.jit on a trainy name: no kwargs at all
+                        resolved = resolve_chain(chain_of(dec) or "", imports)
+                        if is_jit_chain(resolved):
+                            yield self.finding(
+                                mod, dec,
+                                f"@jit on '{node.name}' without "
+                                "donate_argnums — training entry points "
+                                "should donate their parameter buffers",
+                            )
+                        continue
+                    resolved = resolve_chain(chain_of(dec.func) or "", imports)
+                    is_jit_dec = is_jit_chain(resolved) or (
+                        resolved in ("functools.partial", "partial")
+                        and dec.args
+                        and is_jit_chain(
+                            resolve_chain(chain_of(dec.args[0]) or "", imports)
+                        )
+                    )
+                    if is_jit_dec and not self._check_kwargs(dec):
+                        yield self.finding(
+                            mod, dec,
+                            f"jit decorator on '{node.name}' without "
+                            "donate_argnums — training entry points should "
+                            "donate their parameter buffers",
+                        )
+
+
+class BarePrintPass(Pass):
+    """No bare ``print()`` outside the cli layer (absorbs
+    scripts/check_no_bare_prints.py; that script is now a shim over this
+    pass).  Library modules emit through ``gene2vec_tpu.obs``, an
+    injected ``log`` callable, or an explicit ``file=`` stream — a bare
+    print writes to stdout, which CLI contracts own (bench.py prints
+    exactly ONE JSON line on stdout).  Extended to ``experiments/``:
+    probe scripts route progress chatter to stderr and claim stdout
+    explicitly when a JSON payload *is* the product."""
+
+    id = "bare-print"
+    title = "bare print() outside the cli layer"
+    roots = ("package", "experiments")
+
+    def applies(self, rel: str) -> bool:
+        parts = rel.replace("\\", "/").split("/")
+        return "cli" not in parts
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Name) and fn.id == "print"):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue
+            yield self.finding(
+                mod, node,
+                "bare print() writes to stdout, which CLI contracts own — "
+                "route through gene2vec_tpu.obs, a log callable, or an "
+                "explicit file= stream",
+            )
+
+
+ALL_PASSES = (
+    BarePrintPass(),
+    HostSyncInJitPass(),
+    PythonRNGInTracePass(),
+    TracerLeakPass(),
+    JitRecompileHazardPass(),
+    MissingDonatePass(),
+)

@@ -1,0 +1,430 @@
+"""Tier-2 jaxpr/HLO invariant checks: compile the hot paths, assert budgets.
+
+These compile the SGNS step, CBOW-HS step, and GGIPNN train step on the
+(virtual 8-device) CPU backend and check:
+
+* **no host callbacks** — no ``*callback*`` custom-calls, infeed/outfeed,
+  or host transfers in the optimized module (a host callback inside the
+  epoch scan serializes the device stream);
+* **dtype discipline** — no f64 anywhere (an accidental
+  ``jax_enable_x64`` or a float64 numpy constant upcasts the whole
+  program), and no half-precision types in an f32-configured program
+  (a silent downcast loses the partition sums tsne/step docs budget
+  for);
+* **jit cache stability** — repeated calls with fresh identically-shaped
+  inputs must not recompile (cache-key hazards: unhashable statics,
+  weak-type drift, non-pytree aux args);
+* **collective budgets** — per-step collective bytes per mesh config
+  from ``budgets.json``, the enforced version of
+  ``scripts/hlo_comm_audit.py`` (obs.probes does the scanning), so the
+  config-5 22.7 KB/pair regression class cannot land silently.
+
+Everything here imports jax lazily and is marked ``slow`` in the test
+suite; ``scripts/run_static_analysis.sh`` is the standalone driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.analysis.findings import Finding
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "budgets.json")
+
+_SHAPE_DTYPE_RE = re.compile(r"\b(pred|[fsu]\d+|bf16)\[")
+
+#: host-callback custom-call targets (jax python callbacks, ffi callbacks)
+_CALLBACK_TARGET_RE = re.compile(
+    r'custom_call_target="[^"]*(callback|py_func|host)[^"]*"', re.IGNORECASE
+)
+_HOST_OP_RE = re.compile(r"^\s*\S+\s*=\s*\S+\s+(infeed|outfeed)\(")
+_HOST_TRANSFER_RE = re.compile(
+    r"\b(send|recv|send-done|recv-done)\(.*is_host_transfer=true"
+)
+
+
+# -- HLO text checks --------------------------------------------------------
+
+
+def dtype_census(hlo_text: str) -> Dict[str, int]:
+    """Occurrence count of every scalar dtype appearing in HLO shapes."""
+    census: Dict[str, int] = {}
+    for m in _SHAPE_DTYPE_RE.finditer(hlo_text):
+        census[m.group(1)] = census.get(m.group(1), 0) + 1
+    return census
+
+
+def host_callback_findings(hlo_text: str, label: str) -> List[Finding]:
+    out = []
+    for lineno, line in enumerate(hlo_text.splitlines(), 1):
+        if (
+            _CALLBACK_TARGET_RE.search(line)
+            or _HOST_OP_RE.search(line)
+            or _HOST_TRANSFER_RE.search(line)
+        ):
+            out.append(Finding(
+                pass_id="hlo-host-callback",
+                message=(
+                    "host callback / host transfer in the compiled hot "
+                    "path — the device stream serializes on the host at "
+                    "every step"
+                ),
+                path=label,
+                line=lineno,
+                snippet=line.strip()[:160],
+            ))
+    return out
+
+
+def dtype_findings(
+    hlo_text: str,
+    label: str,
+    compute_dtype: str = "float32",
+    forbid_f64: bool = True,
+) -> List[Finding]:
+    """Dtype-discipline findings + one info finding with the census."""
+    census = dtype_census(hlo_text)
+    out: List[Finding] = [Finding(
+        pass_id="hlo-dtype",
+        severity="info",
+        path=label,
+        message="dtype census",
+        data={"census": census, "compute_dtype": compute_dtype},
+    )]
+    if forbid_f64 and census.get("f64"):
+        out.append(Finding(
+            pass_id="hlo-dtype",
+            path=label,
+            message=(
+                f"f64 appears {census['f64']}x in the optimized module — "
+                "an unintended f32->f64 upcast (x64 mode or a float64 "
+                "host constant) doubles bytes on every affected tensor"
+            ),
+            data={"census": census},
+        ))
+    if compute_dtype == "float32":
+        for half in ("bf16", "f16"):
+            if census.get(half):
+                out.append(Finding(
+                    pass_id="hlo-dtype",
+                    path=label,
+                    message=(
+                        f"{half} appears {census[half]}x in an "
+                        "f32-configured program — a silent downcast "
+                        "(reductions lose the partition function at "
+                        "corpus scale)"
+                    ),
+                    data={"census": census},
+                ))
+    return out
+
+
+# -- jit cache stability ----------------------------------------------------
+
+
+def cache_stability_findings(
+    fn: Callable,
+    args_maker: Callable[[], Tuple],
+    label: str,
+    calls: int = 3,
+) -> List[Finding]:
+    """Call ``fn`` ``calls`` times with fresh identically-shaped inputs
+    from ``args_maker``; after the warm-up call the jit cache must not
+    grow (a growth means every production step would recompile)."""
+    import jax
+
+    size = getattr(fn, "_cache_size", None)
+    out = jax.block_until_ready(fn(*args_maker()))
+    del out
+    after_warmup = size() if size is not None else None
+    for _ in range(calls - 1):
+        jax.block_until_ready(fn(*args_maker()))
+    if size is None:
+        # data.checked=False lets callers distinguish this skip from a
+        # real pass — tests assert on it so a jax upgrade that removes
+        # the introspection hook cannot vacuously satisfy the gate
+        return [Finding(
+            pass_id="hlo-cache-stability",
+            severity="info",
+            path=label,
+            message="jit cache size introspection unavailable on this "
+                    "jax version; stability not checked",
+            data={"checked": False},
+        )]
+    after = size()
+    if after > after_warmup:
+        return [Finding(
+            pass_id="hlo-cache-stability",
+            path=label,
+            message=(
+                f"jit cache grew {after_warmup} -> {after} across "
+                f"{calls - 1} calls with fresh identically-shaped inputs "
+                "— every step recompiles in production"
+            ),
+            data={"checked": True, "after_warmup": after_warmup,
+                  "after": after},
+        )]
+    return [Finding(
+        pass_id="hlo-cache-stability",
+        severity="info",
+        path=label,
+        message=f"stable at {after} cached executable(s) over {calls} calls",
+        data={"checked": True, "cached": after},
+    )]
+
+
+# -- collective budgets -----------------------------------------------------
+
+
+def load_budgets(path: str = BUDGETS_PATH) -> Dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def collective_budget_findings(
+    lowered_or_compiled,
+    label: str,
+    budget: Dict,
+) -> List[Finding]:
+    """Enforce one ``budgets.json`` entry: per-pair collective bytes of a
+    compiled epoch must stay within ``max_bytes_per_pair``."""
+    from gene2vec_tpu.obs.probes import collective_stats
+
+    stats = collective_stats(lowered_or_compiled)
+    if stats is None:
+        return [Finding(
+            pass_id="hlo-collective-budget",
+            path=label,
+            message="failed to compile/scan the module for collectives",
+        )]
+    batch = budget["batch_pairs"]
+    bytes_per_pair = stats["total_bytes"] / batch
+    data = {
+        "bytes_per_pair": round(bytes_per_pair, 1),
+        "max_bytes_per_pair": budget["max_bytes_per_pair"],
+        "reference_bytes_per_pair": budget.get("reference_bytes_per_pair"),
+        "collectives": stats["collectives"],
+    }
+    if bytes_per_pair > budget["max_bytes_per_pair"]:
+        return [Finding(
+            pass_id="hlo-collective-budget",
+            path=label,
+            message=(
+                f"per-pair collective bytes {bytes_per_pair:,.1f} exceed "
+                f"the budget {budget['max_bytes_per_pair']:,} "
+                f"(reference {budget.get('reference_bytes_per_pair')}) — "
+                "a comm regression of the config-5 class"
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id="hlo-collective-budget",
+        severity="info",
+        path=label,
+        message=(
+            f"{bytes_per_pair:,.1f} bytes/pair within budget "
+            f"{budget['max_bytes_per_pair']:,}"
+        ),
+        data=data,
+    )]
+
+
+# -- hot-path builders ------------------------------------------------------
+
+
+def _synth_corpus(vocab_size: int, num_pairs: int, seed: int = 0):
+    """Zipf-ish pair corpus (the bench.py recipe, inlined so the package
+    does not import the repo-root bench script)."""
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+
+    rng = np.random.RandomState(seed)
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    pairs = rng.choice(vocab_size, size=(num_pairs, 2), p=p).astype(np.int32)
+    counts = np.bincount(
+        pairs.reshape(-1), minlength=vocab_size
+    ).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(vocab_size)], counts), pairs)
+
+
+def build_sgns(
+    dim: int = 32,
+    vocab: int = 128,
+    batch_pairs: int = 64,
+    num_pairs: int = 512,
+    mesh: Optional[Tuple[int, int]] = None,
+    **cfg_kw,
+):
+    """(trainer, params, lowered, args_maker) for the SGNS epoch.
+
+    ``mesh=(data, model)`` compiles the sharded program (needs the
+    virtual multi-device CPU backend); None runs unsharded.
+    """
+    import jax
+
+    from gene2vec_tpu.config import MeshConfig, SGNSConfig
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    corpus = _synth_corpus(vocab, num_pairs)
+    config = SGNSConfig(dim=dim, batch_pairs=batch_pairs, **cfg_kw)
+    sharding = None
+    if mesh is not None:
+        from gene2vec_tpu.parallel.mesh import make_mesh
+        from gene2vec_tpu.parallel.sharding import SGNSSharding
+
+        data, model = mesh
+        sharding = SGNSSharding(
+            make_mesh(MeshConfig(data=data, model=model)),
+            vocab_sharded=config.vocab_sharded,
+        )
+    trainer = SGNSTrainer(corpus, config, sharding=sharding)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    lowered = trainer._epoch_fn.lower(
+        params, trainer.pairs, trainer.noise, key
+    )
+
+    def args_maker():
+        return (trainer.init(), trainer.pairs, trainer.noise,
+                jax.random.PRNGKey(1))
+
+    return trainer, params, lowered, args_maker
+
+
+def build_cbow_hs(
+    objective: str = "cbow_hs",
+    dim: int = 32,
+    vocab: int = 128,
+    batch_pairs: int = 64,
+    num_pairs: int = 512,
+    **cfg_kw,
+):
+    """(trainer, params, lowered, args_maker) for a CBOW/HS epoch."""
+    import dataclasses
+
+    import jax
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.sgns.cbow_hs import make_trainer
+
+    corpus = _synth_corpus(vocab, num_pairs)
+    config = dataclasses.replace(
+        SGNSConfig(dim=dim, batch_pairs=batch_pairs, **cfg_kw),
+        objective=objective,
+    )
+    trainer = make_trainer(corpus, config)
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    lowered = trainer._epoch_fn.lower(params, trainer.pairs, key)
+
+    def args_maker():
+        return (trainer.init(), trainer.pairs, jax.random.PRNGKey(1))
+
+    return trainer, params, lowered, args_maker
+
+
+def build_ggipnn(vocab_size: int = 64, batch: int = 16):
+    """(trainer, state, lowered, args_maker) for the GGIPNN train step."""
+    import jax
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.config import GGIPNNConfig
+    from gene2vec_tpu.models.ggipnn_data import PairTextVocab
+    from gene2vec_tpu.models.ggipnn_train import GGIPNNTrainer
+
+    config = GGIPNNConfig(embedding_dim=16, batch_size=batch)
+    vocab = PairTextVocab().fit(
+        [f"G{i} G{(i + 1) % vocab_size}" for i in range(vocab_size)]
+    )
+    trainer = GGIPNNTrainer(config, vocab)
+    params, opt_state = trainer.init_state()
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        x = jnp.asarray(
+            rng.randint(0, vocab_size, (batch, 2)), jnp.int32
+        )
+        y = jnp.asarray(np.eye(2, dtype=np.float32)[
+            rng.randint(0, 2, (batch,))
+        ])
+        return x, y
+
+    x, y = make_batch()
+    key = jax.random.PRNGKey(0)
+    lowered = type(trainer).train_step.lower(
+        trainer, params, opt_state, x, y, key
+    )
+
+    def args_maker():
+        p, o = trainer.init_state()
+        bx, by = make_batch()
+        return (p, o, bx, by, jax.random.PRNGKey(1))
+
+    return trainer, (params, opt_state), lowered, args_maker
+
+
+def hot_path_findings(
+    include_cache_checks: bool = True,
+) -> List[Finding]:
+    """The default tier-2 sweep over small unsharded instances of all
+    three hot paths: host callbacks + dtype discipline (+ cache
+    stability).  Budgets need the full-scale mesh configs and run via
+    :func:`budget_findings`."""
+    findings: List[Finding] = []
+    specs = [
+        ("hlo:sgns", build_sgns, {}),
+        ("hlo:cbow_hs", build_cbow_hs, {}),
+        ("hlo:ggipnn", build_ggipnn, {}),
+    ]
+    for label, builder, kw in specs:
+        trainer, _, lowered, args_maker = builder(**kw)
+        compiled = lowered.compile()
+        text = compiled.as_text()
+        findings.extend(host_callback_findings(text, label))
+        compute = getattr(
+            getattr(trainer, "config", None), "compute_dtype", "float32"
+        )
+        findings.extend(dtype_findings(text, label, compute_dtype=compute))
+        if include_cache_checks:
+            fn = getattr(trainer, "_epoch_fn", None) or getattr(
+                trainer, "train_step", None
+            )
+            if fn is not None:
+                findings.extend(
+                    cache_stability_findings(fn, args_maker, label)
+                )
+    return findings
+
+
+def budget_findings(
+    keys: Optional[List[str]] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Compile each budgeted mesh config at its recorded geometry and
+    enforce its per-pair collective-bytes ceiling."""
+    budgets = load_budgets(budgets_path)
+    findings: List[Finding] = []
+    for key, entry in budgets["sgns"].items():
+        if keys is not None and key not in keys:
+            continue
+        _, _, lowered, _ = build_sgns(
+            dim=entry["dim"],
+            vocab=entry["vocab"],
+            batch_pairs=entry["batch_pairs"],
+            num_pairs=entry["num_pairs"],
+            mesh=tuple(entry["mesh"]),
+            vocab_sharded=entry["vocab_sharded"],
+            positive_mid=entry.get("positive_mid", 0),
+        )
+        findings.extend(
+            collective_budget_findings(lowered, f"hlo:sgns/{key}", entry)
+        )
+    return findings

@@ -1,0 +1,291 @@
+"""Sanitizer harness for the native/ kernels (tier 3 of graftcheck).
+
+Builds the ``*_asan.so`` / ``*_ubsan.so`` / ``*_tsan.so`` variants via
+``make -C native <kind>`` and runs the pairio + Hogwild parity workload
+in a **subprocess** with the right runtime environment:
+
+* ASAN must be the first DSO in the process, so the child runs under
+  ``LD_PRELOAD=libasan.so`` (CPython itself is uninstrumented — fine:
+  the interceptors still wrap malloc/str* globally, which is exactly
+  what caught the pairio tokens-blob over-read this subsystem was built
+  around);
+* UBSAN links its shared runtime into the .so and needs no preload;
+  ``-fno-sanitize-recover`` turns the first report into an abort, so a
+  nonzero child exit IS the finding;
+* TSAN needs ``LD_PRELOAD=libtsan.so`` plus the intended-race
+  suppressions in native/tsan.supp (Hogwild's lock-free table updates
+  are the algorithm, not a bug — see that file).
+
+The workload itself (:data:`PARITY_SCRIPT`) re-points the production
+ctypes wrappers at the sanitized libraries, so the exact code paths
+tier-1 trusts are the ones being checked.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+KINDS = ("asan", "ubsan", "tsan")
+
+_RUNTIME_LIB = {"asan": "libasan.so", "ubsan": None, "tsan": "libtsan.so"}
+
+_OPTIONS_ENV = {
+    "asan": ("ASAN_OPTIONS", "detect_leaks=0:abort_on_error=1"),
+    "ubsan": ("UBSAN_OPTIONS", "halt_on_error=1:print_stacktrace=1"),
+    "tsan": (
+        "TSAN_OPTIONS",
+        f"suppressions={os.path.join(NATIVE_DIR, 'tsan.supp')}:"
+        "halt_on_error=1:exitcode=66",
+    ),
+}
+
+#: run in the child: pairio parity (native vs pure-Python reader, messy
+#: corpus) + a multithreaded Hogwild epoch, against the sanitized .so
+PARITY_SCRIPT = r"""
+import os, sys, tempfile
+import numpy as np
+
+kind = sys.argv[1]
+repo = sys.argv[2]
+sys.path.insert(0, repo)
+os.environ["GENE2VEC_TPU_NO_NATIVE_BUILD"] = "1"  # libs are prebuilt
+
+from gene2vec_tpu.io import native_pairio
+from gene2vec_tpu.sgns import native_backend
+
+native_pairio._LIB_PATH = os.path.join(
+    repo, "native", f"libpairio_{kind}.so"
+)
+native_backend._LIB_PATH = os.path.join(
+    repo, "native", f"libsgns_hogwild_{kind}.so"
+)
+
+# -- pairio parity (the messy-lines fixture that used to flake) -------------
+with tempfile.TemporaryDirectory() as d:
+    with open(os.path.join(d, "a.txt"), "wb") as f:
+        f.write(
+            b"A B\n\nC\nD E F\nB\tA\nG\xe9NE1 G\xe9NE2\n  A   B  \n"
+        )
+    with open(os.path.join(d, "b.txt"), "wb") as f:
+        f.write(b"H I\nI H\nH I\n" * 50)
+    from gene2vec_tpu.io.pair_reader import iter_pair_files, load_corpus
+
+    vp, pp = load_corpus(d, "txt", use_native=False)
+    for _ in range(20):  # heap churn across repeated loads
+        vn, pn = native_pairio.load_corpus(iter_pair_files(d, "txt"))
+    assert vn.id_to_token == vp.id_to_token, "pairio token parity"
+    assert np.array_equal(np.asarray(vn.counts), np.asarray(vp.counts))
+    assert np.array_equal(pn, pp), "pairio pair parity"
+
+    # strict-cp1252 rejection path (the -3 early return)
+    with open(os.path.join(d, "bad.txt"), "wb") as f:
+        f.write(b"GENE1 GENE2\nGEN\x81E3 X\n")
+    try:
+        native_pairio.load_corpus([os.path.join(d, "bad.txt")])
+        raise SystemExit("expected UnicodeDecodeError")
+    except UnicodeDecodeError:
+        pass
+
+# -- Hogwild epoch under threads -------------------------------------------
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io.vocab import Vocab
+
+rng = np.random.RandomState(0)
+# GRAFTCHECK_SMALL shrinks the epoch for unsuppressed-TSAN auditing,
+# where every racy table access logs a report (full size would spend
+# minutes printing)
+V, N = (200, 20000) if not os.environ.get("GRAFTCHECK_SMALL") else (50, 400)
+pairs = rng.randint(0, V, (N, 2)).astype(np.int32)
+counts = np.bincount(pairs.reshape(-1), minlength=V).astype(np.int64)
+corpus = PairCorpus(Vocab([f"G{i}" for i in range(V)], counts), pairs)
+
+cfg = SGNSConfig(dim=32, negatives=5)
+tr = native_backend.HogwildSGNSTrainer(corpus, cfg, n_threads=4)
+params = tr.init()
+before = np.array(params.emb, copy=True)
+params, loss = tr.train_epoch(params, seed=1)
+assert np.isfinite(loss), f"hogwild loss not finite: {loss}"
+assert not np.array_equal(before, np.asarray(params.emb)), "tables unchanged"
+
+hs = native_backend.HogwildHSTrainer(
+    corpus, SGNSConfig(dim=32, objective="cbow_hs"), n_threads=4
+)
+hs_params, hs_loss = hs.train_epoch(hs.init(), seed=1)
+assert np.isfinite(hs_loss), f"hs loss not finite: {hs_loss}"
+print("PARITY_OK", kind, file=sys.stderr)
+"""
+
+
+def _compiler() -> str:
+    """The compiler native/Makefile will use (its ``CXX ?=`` default)."""
+    return os.environ.get("CXX", "g++").split()[0]
+
+
+def runtime_lib_path(kind: str) -> Optional[str]:
+    """Absolute path of the sanitizer runtime to LD_PRELOAD, None when
+    the kind needs no preload, or "" when the toolchain lacks it."""
+    name = _RUNTIME_LIB[kind]
+    if name is None:
+        return None
+    cxx = _compiler()
+    if "clang" in os.path.basename(cxx):
+        # clang's runtimes (libclang_rt.<san>-<arch>.so) have a different
+        # preload story; discovery here knows the GNU layout only — report
+        # unavailable (info skip) rather than preload a mismatched GCC
+        # runtime and falsely gate on the resulting startup abort
+        return ""
+    try:
+        out = subprocess.run(
+            [cxx, f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except Exception:
+        return ""
+    # the compiler echoes the bare name back when it cannot find the file
+    return out if os.path.isabs(out) and os.path.exists(out) else ""
+
+
+def build(kind: str, timeout: int = 300) -> Tuple[bool, str]:
+    """``make -C native <kind>`` → (ok, detail).  ``detail`` carries the
+    make stderr tail on failure: a broken sanitized build must surface
+    (and gate) as build breakage, never read as a missing toolchain."""
+    try:
+        proc = subprocess.run(
+            ["make", "-C", NATIVE_DIR, kind],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except Exception as e:
+        return False, f"make {kind} did not run: {e}"
+    if proc.returncode != 0:
+        return False, (
+            f"make {kind} failed (exit {proc.returncode}); stderr tail:\n"
+            + proc.stderr[-4000:]
+        )
+    missing = [
+        f"{stem}_{kind}.so"
+        for stem in ("libpairio", "libsgns_hogwild")
+        if not os.path.exists(os.path.join(NATIVE_DIR, f"{stem}_{kind}.so"))
+    ]
+    if missing:
+        return False, f"make {kind} exited 0 but did not produce {missing}"
+    return True, ""
+
+
+def toolchain_available(kind: str) -> bool:
+    """Compiler + sanitizer runtime present.  Deliberately does NOT
+    attempt the build: on a machine with a working toolchain a failed
+    sanitized build is a gating finding (see :func:`sanitizer_findings`)
+    / test failure, not a silent skip."""
+    if shutil.which(_compiler()) is None:
+        return False
+    return runtime_lib_path(kind) != ""
+
+
+def _libstdcxx_path() -> str:
+    try:
+        out = subprocess.run(
+            [_compiler(), "-print-file-name=libstdc++.so.6"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+        return out if os.path.isabs(out) and os.path.exists(out) else ""
+    except Exception:
+        return ""
+
+
+def run_parity(
+    kind: str, timeout: int = 600, options: Optional[str] = None
+) -> subprocess.CompletedProcess:
+    """Run :data:`PARITY_SCRIPT` in a sanitized child process.
+    ``options`` overrides the default ``*SAN_OPTIONS`` (e.g. an
+    unsuppressed TSAN audit)."""
+    env = dict(os.environ)
+    # pin the CHILD to CPU (it imports jax transitively and must not
+    # claim an accelerator) — scoped here so the calling process's env
+    # is never mutated by the sanitizer tier
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    preload = runtime_lib_path(kind)
+    if preload:
+        # co-preload libstdc++: the sanitizer's __cxa_throw interceptor
+        # must resolve the real symbol at startup, or the first C++
+        # exception thrown from an uninstrumented late-loaded DSO
+        # (jaxlib's MLIR bindings) aborts with an interceptor CHECK
+        stdcxx = _libstdcxx_path()
+        env["LD_PRELOAD"] = f"{preload} {stdcxx}".strip()
+    opt_key, opt_val = _OPTIONS_ENV[kind]
+    env[opt_key] = opt_val if options is None else options
+    argv = [sys.executable, "-c", PARITY_SCRIPT, kind, REPO_ROOT]
+    try:
+        return subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=REPO_ROOT,
+        )
+    except subprocess.TimeoutExpired as e:
+        # a hung instrumented child is a gating failure, not an internal
+        # analyzer crash — synthesize a nonzero result carrying whatever
+        # the child said before the clock ran out
+        def _text(b):
+            return b.decode(errors="replace") if isinstance(b, bytes) else (b or "")
+
+        return subprocess.CompletedProcess(
+            argv, returncode=124, stdout=_text(e.stdout),
+            stderr=_text(e.stderr)
+            + f"\n[graftcheck] {kind} parity child timed out after {timeout}s",
+        )
+
+
+def sanitizer_findings(kinds=("asan", "ubsan")) -> List[Finding]:
+    """Build + run each requested sanitizer; failures carry the tail of
+    the child's stderr (the sanitizer report).  A missing toolchain is an
+    info skip; a *failed build on a present toolchain* is a gating
+    finding — otherwise build breakage would silently disable the
+    memory-safety gate while it reports green."""
+    findings: List[Finding] = []
+    for kind in kinds:
+        label = f"sanitizer:{kind}"
+        if not toolchain_available(kind):
+            findings.append(Finding(
+                pass_id="sanitizer",
+                severity="info",
+                path=label,
+                message=f"{kind} toolchain unavailable; skipped",
+            ))
+            continue
+        ok, detail = build(kind)
+        if not ok:
+            findings.append(Finding(
+                pass_id="sanitizer",
+                path=label,
+                message=(
+                    f"{kind} instrumented build failed — the sanitizer "
+                    f"gate did not run: {detail}"
+                ),
+            ))
+            continue
+        proc = run_parity(kind)
+        if proc.returncode != 0:
+            findings.append(Finding(
+                pass_id="sanitizer",
+                path=label,
+                message=(
+                    f"{kind} parity run failed (exit {proc.returncode})"
+                ),
+                data={"stderr_tail": proc.stderr[-4000:]},
+            ))
+        else:
+            findings.append(Finding(
+                pass_id="sanitizer",
+                severity="info",
+                path=label,
+                message=f"{kind} parity run clean",
+            ))
+    return findings

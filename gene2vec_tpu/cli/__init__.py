@@ -16,5 +16,8 @@ system (SURVEY §5 "config/flag system"):
 * ``python -m gene2vec_tpu.cli.dashboard --figure-json fig.json``
   — ``src/gene2vec_dash_app.py:17-27`` parity (GeneView, needs dash);
 * ``python -m gene2vec_tpu.cli.obs report <run_dir>``
-  — summarize any observed run directory (docs/OBSERVABILITY.md).
+  — summarize any observed run directory (docs/OBSERVABILITY.md);
+* ``python -m gene2vec_tpu.cli.analyze [--hlo all] [--sanitizers ...]``
+  — graftcheck static analysis + sanitizer gates
+  (docs/STATIC_ANALYSIS.md).
 """

@@ -1,0 +1,175 @@
+"""graftcheck CLI: static analysis + sanitizer gates for the hot paths.
+
+Tiers (docs/STATIC_ANALYSIS.md):
+
+* default — the fast AST lint passes over ``gene2vec_tpu/`` (+
+  ``experiments/`` for stdout discipline) and the round-summary claim
+  scan; jax never imports;
+* ``--hlo hot`` — compile small SGNS / CBOW-HS / GGIPNN instances on the
+  virtual 8-device CPU backend and check host callbacks, dtype
+  discipline, jit cache stability;
+* ``--hlo budgets`` — compile the budgeted mesh configs at full geometry
+  and enforce the per-pair collective-bytes ceilings in
+  ``gene2vec_tpu/analysis/budgets.json``;
+* ``--sanitizers asan,ubsan[,tsan]`` — build the instrumented native
+  libraries and run the pairio + Hogwild parity workload under each.
+
+Exit status: 0 clean, 1 when any gating (error/warning) finding exists,
+2 on internal failure.  ``--json`` emits the findings document
+(schema ``gene2vec-tpu/findings/v1``) on stdout.
+
+Examples::
+
+    python -m gene2vec_tpu.cli.analyze
+    python -m gene2vec_tpu.cli.analyze --json --select bare-print
+    python -m gene2vec_tpu.cli.analyze --hlo all --sanitizers asan,ubsan
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+
+def _pin_cpu_backend(devices: int = 8) -> None:
+    """Force the virtual multi-device CPU backend before jax initializes
+    (the scripts/hlo_comm_audit.py pattern: the session env may pin a
+    real accelerator; analysis always runs on CPU).  In-process env
+    mutation is required here — jax reads these at first import — which
+    is why only the ``--hlo`` tiers call this; the sanitizer tier pins
+    its *children* inside sanitize.run_parity instead."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", devices)
+    except AttributeError:
+        pass  # pre-0.5 jax: the XLA flag above is read at backend init
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gene2vec_tpu.cli.analyze",
+        description="graftcheck: JAX-aware static analysis for gene2vec-tpu",
+    )
+    ap.add_argument("files", nargs="*", help=(
+        "explicit .py files to lint (default: gene2vec_tpu/ and "
+        "experiments/ per-pass roots)"
+    ))
+    ap.add_argument("--json", action="store_true",
+                    help="emit the findings JSON document on stdout")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass ids to run (default all)")
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated pass ids to skip")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list AST pass ids and exit")
+    ap.add_argument("--no-summaries", action="store_true",
+                    help="skip the round-summary claim scan")
+    ap.add_argument("--collect", action="store_true", help=(
+        "run `pytest --collect-only` to enforce summary claims against "
+        "the live test count (slow: imports the whole suite)"
+    ))
+    ap.add_argument("--hlo", choices=("hot", "budgets", "all"), default=None,
+                    help="add tier-2 jaxpr/HLO invariant checks")
+    ap.add_argument("--sanitizers", default=None, metavar="KINDS",
+                    help="comma-separated sanitizer parity runs "
+                         "(asan,ubsan,tsan)")
+    args = ap.parse_args(argv)
+    try:
+        return _run(args)
+    except ValueError as e:  # bad pass/config selection
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except Exception:  # the documented "2 on internal failure" contract
+        import traceback
+
+        traceback.print_exc()
+        print("error: internal analyzer failure (traceback above)",
+              file=sys.stderr)
+        return 2
+
+
+def _run(args) -> int:
+    from gene2vec_tpu.analysis import (
+        REPO_ROOT,
+        dumps,
+        gating,
+        pass_ids,
+        run_ast_passes,
+    )
+
+    if args.list_passes:
+        for pid in pass_ids():
+            print(pid)
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    skip = args.skip.split(",") if args.skip else None
+
+    # validate sanitizer kinds up front — a typo must fail in
+    # milliseconds, not after minutes of HLO compilation
+    kinds: List[str] = []
+    if args.sanitizers:
+        from gene2vec_tpu.analysis.sanitize import KINDS
+
+        kinds = [k for k in args.sanitizers.split(",") if k]
+        unknown = [k for k in kinds if k not in KINDS]
+        if unknown:
+            print(f"error: unknown sanitizer(s) {unknown}", file=sys.stderr)
+            return 2
+
+    findings = run_ast_passes(
+        select=select, skip=skip, files=args.files or None,
+    )
+
+    if not args.no_summaries and not args.files and select is None:
+        from gene2vec_tpu.analysis.summaries import (
+            check_summaries,
+            collect_count_via_pytest,
+        )
+
+        count = collect_count_via_pytest(REPO_ROOT) if args.collect else None
+        findings.extend(
+            check_summaries(os.path.join(REPO_ROOT, "docs"), count)
+        )
+
+    if args.hlo:
+        _pin_cpu_backend()
+    if args.hlo in ("hot", "all"):
+        from gene2vec_tpu.analysis.passes_hlo import hot_path_findings
+
+        findings.extend(hot_path_findings())
+    if args.hlo in ("budgets", "all"):
+        from gene2vec_tpu.analysis.passes_hlo import budget_findings
+
+        findings.extend(budget_findings())
+    if kinds:
+        from gene2vec_tpu.analysis.sanitize import sanitizer_findings
+
+        findings.extend(sanitizer_findings(kinds))
+
+    gate = gating(findings)
+    if args.json:
+        print(dumps(findings, meta={"argv": sys.argv[1:]}))
+    else:
+        for f in gate:
+            print(f.format())
+        infos = len(findings) - len(gate)
+        print(
+            f"graftcheck: {len(gate)} gating finding(s), "
+            f"{infos} informational"
+        )
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

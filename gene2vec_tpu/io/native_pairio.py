@@ -36,7 +36,14 @@ class _PairioResult(ctypes.Structure):
         ("pairs", ctypes.POINTER(ctypes.c_int32)),
         ("vocab_size", ctypes.c_int64),
         ("counts", ctypes.POINTER(ctypes.c_int64)),
-        ("tokens", ctypes.c_char_p),
+        # POINTER(c_char), NOT c_char_p: a c_char_p field auto-converts to
+        # a temporary Python bytes on attribute access by scanning for a
+        # NUL the C side never wrote (an over-read past the malloc), and
+        # ctypes.cast() of that temporary does not keep it alive — the
+        # pointer dangles once the temp is collected, and string_at then
+        # reads reused heap (the state-dependent token/count-mismatch /
+        # UnicodeDecodeError flake in test_parity_with_messy_lines).
+        ("tokens", ctypes.POINTER(ctypes.c_char)),
         ("tokens_len", ctypes.c_int64),
         ("err_file", ctypes.c_int32),
         ("err_offset", ctypes.c_int64),
@@ -146,9 +153,10 @@ def load_corpus(
             if v
             else np.zeros(0, np.int64)
         )
-        raw = ctypes.string_at(
-            ctypes.cast(res.tokens, ctypes.c_void_p), int(res.tokens_len)
-        )
+        # string_at on the live C buffer, length-bounded — runs before
+        # pairio_free, copies exactly tokens_len bytes, never scans for a
+        # terminator
+        raw = ctypes.string_at(res.tokens, int(res.tokens_len))
         tokens: List[str] = (
             raw.decode(encoding).split("\n")[:-1] if res.tokens_len else []
         )

@@ -157,6 +157,13 @@ def _fuzzy_graph(x: jax.Array, n_neighbors: int) -> jax.Array:
     return dense + dense.T - dense * dense.T
 
 
+# module-level binding: a per-call ``jax.jit(_fuzzy_graph, ...)`` wrapper
+# is a fresh callable each umap_layout() invocation and always misses the
+# jit cache (graftcheck jit-recompile-hazard; same recipe as
+# viz/tsne.py's _calibrate_points)
+_fuzzy_graph_jit = jax.jit(_fuzzy_graph, static_argnums=1)
+
+
 def umap_layout(
     emb: np.ndarray,
     config: UMAPConfig = UMAPConfig(),
@@ -172,9 +179,7 @@ def umap_layout(
     # umap-learn clamps k to N-1 (with a warning) — top_k would error on
     # a matrix smaller than the neighbor count
     n_neighbors = max(1, min(int(cfg.n_neighbors), x.shape[0] - 1))
-    p = jax.jit(_fuzzy_graph, static_argnums=1)(
-        jnp.asarray(x), n_neighbors
-    )
+    p = _fuzzy_graph_jit(jnp.asarray(x), n_neighbors)
 
     y0 = pca_reduce(x, 2)
     y0 = y0 / max(np.abs(y0).max(), 1e-12) * cfg.init_scale

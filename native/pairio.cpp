@@ -194,7 +194,11 @@ int pairio_load_files(const char** paths, int32_t n_paths, int64_t min_count,
 
   out->vocab_size = vocab_size;
   out->counts = static_cast<int64_t*>(malloc(sizeof(int64_t) * static_cast<size_t>(vocab_size ? vocab_size : 1)));
-  out->tokens = static_cast<char*>(malloc(tokens_bytes ? tokens_bytes : 1));
+  // +1: NUL-terminate the blob.  tokens_len excludes the terminator; the
+  // Python wrapper reads length-bounded, but a terminator keeps any
+  // C-string consumer (and ASAN's string interceptors) inside the
+  // allocation.
+  out->tokens = static_cast<char*>(malloc(tokens_bytes + 1));
   if (!out->counts || !out->tokens) return -2;
   char* tp = out->tokens;
   for (int64_t i = 0; i < vocab_size; ++i) {
@@ -205,6 +209,7 @@ int pairio_load_files(const char** paths, int32_t n_paths, int64_t min_count,
     *tp++ = '\n';
   }
   out->tokens_len = static_cast<int64_t>(tp - out->tokens);
+  *tp = '\0';
 
   // encode pairs, dropping any with a filtered token
   out->pairs = static_cast<int32_t*>(

@@ -1,20 +1,12 @@
-"""Lint: no bare ``print()`` in gene2vec_tpu/ library code.
-
-Library modules must emit through the observability layer
-(``gene2vec_tpu.obs``), an injected ``log`` callable, or an explicit
-stream (``print(..., file=sys.stderr)``) — a bare ``print`` call writes
-to stdout, which CLI contracts own (bench.py prints exactly ONE JSON
-line on stdout; a stray library print corrupts it).
-
-Allowed:
-
-* anything under ``gene2vec_tpu/cli/`` — the CLI layer owns stdout;
-* ``print(..., file=...)`` calls — the stream choice is explicit;
-* referencing ``print`` without calling it (the ``log: Callable = print``
-  default-argument idiom).
+"""Thin shim: the no-bare-print lint now lives in the graftcheck pass
+framework (``gene2vec_tpu.analysis.passes_ast.BarePrintPass``), where it
+also covers ``experiments/``.  This script keeps the original CLI and
+function surface (``bare_prints_in_source`` / ``check_tree``) so existing
+wiring — tests/test_obs.py, docs, muscle memory — keeps working.
 
 Run: ``python scripts/check_no_bare_prints.py [root]`` — exits non-zero
-listing violations.  Wired into tier-1 via tests/test_obs.py.
+listing violations.  Equivalent: ``python -m gene2vec_tpu.cli.analyze
+--select bare-print``.
 """
 
 from __future__ import annotations
@@ -24,56 +16,65 @@ import os
 import sys
 from typing import List, Tuple
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from gene2vec_tpu.analysis.astpass import ModuleSource  # noqa: E402
+from gene2vec_tpu.analysis.passes_ast import BarePrintPass  # noqa: E402
+from gene2vec_tpu.analysis.runner import suppressed  # noqa: E402
+
+_PASS = BarePrintPass()
+
 
 def bare_prints_in_source(source: str, filename: str) -> List[Tuple[int, str]]:
-    """(lineno, line) for every ``print(...)`` call without ``file=``."""
+    """(lineno, line) for every ``print(...)`` call without ``file=``.
+    Honors ``# graftcheck: disable=bare-print`` like every other entry
+    point (the pragma must mean the same thing in the shim and the CLI)."""
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as e:
         return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = source.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Name) and fn.id == "print"):
-            continue
-        if any(kw.arg == "file" for kw in node.keywords):
-            continue
-        line = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
-        out.append((node.lineno, line))
-    return out
+    mod = ModuleSource(
+        filename, filename, source, tree, source.splitlines()
+    )
+    return [
+        (f.line, f.snippet)
+        for f in _PASS.run(mod)
+        if not suppressed(mod, f)
+    ]
 
 
 def check_tree(pkg_root: str) -> List[str]:
     """Violation strings for every library module under ``pkg_root``
     (the ``gene2vec_tpu`` package dir), skipping the CLI layer."""
+    from gene2vec_tpu.analysis.astpass import iter_py_files
+
+    repo_root = os.path.dirname(os.path.abspath(pkg_root))
     violations = []
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        if os.path.basename(dirpath) == "cli":
-            dirnames[:] = []
+    for path in iter_py_files(pkg_root):
+        rel = os.path.relpath(path, repo_root)
+        if not _PASS.applies(rel):
             continue
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-            rel = os.path.relpath(path, os.path.dirname(pkg_root))
-            for lineno, line in bare_prints_in_source(source, path):
-                violations.append(f"{rel}:{lineno}: {line}")
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        for lineno, line in bare_prints_in_source(source, path):
+            violations.append(f"{rel}:{lineno}: {line}")
     return violations
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "gene2vec_tpu",
-    )
-    violations = check_tree(root)
+    if argv:
+        violations = check_tree(argv[0])
+    else:
+        # no explicit root: the full pass (package + experiments/)
+        from gene2vec_tpu.analysis import run_ast_passes
+
+        violations = [
+            f"{f.path}:{f.line}: {f.snippet}"
+            for f in run_ast_passes(select=["bare-print"])
+        ]
     for v in violations:
         print(v, file=sys.stderr)
     if violations:

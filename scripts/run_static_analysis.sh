@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# graftcheck driver: lint passes + HLO budget checks (+ optional
+# sanitizer parity runs).  Nonzero exit on any gating finding.
+#
+#   scripts/run_static_analysis.sh                 # lint + tier-2 HLO
+#   scripts/run_static_analysis.sh --fast          # lint only (tier-1 scope)
+#   scripts/run_static_analysis.sh --with-sanitizers   # + asan,ubsan,tsan
+#   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
+#                                                  # (expect intended-race
+#                                                  # reports; for auditing
+#                                                  # native/tsan.supp)
+#
+# The fast AST passes also run inside tier-1 (tests/test_analysis.py);
+# the HLO/sanitizer tiers are the `slow`/`sanitizer`-marked tests
+# (tests/test_analysis_hlo.py, tests/test_sanitizers.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="full"
+SAN=""
+for arg in "$@"; do
+  case "$arg" in
+    --fast) MODE="fast" ;;
+    --with-sanitizers) SAN="asan,ubsan,tsan" ;;
+    --tsan-raw)
+      make -C native tsan
+      echo "== unsuppressed TSAN Hogwild run (intended races WILL report) ==" >&2
+      GRAFTCHECK_SMALL=1 python - <<'EOF'
+from gene2vec_tpu.analysis.sanitize import run_parity
+import sys
+p = run_parity("tsan", options="halt_on_error=0")
+races = p.stderr.count("WARNING: ThreadSanitizer: data race")
+print(f"tsan raw run: exit {p.returncode}, {races} race report(s)",
+      file=sys.stderr)
+EOF
+      exit 0
+      ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+
+ARGS=(--json)
+if [ "$MODE" = "full" ]; then
+  ARGS+=(--hlo all)
+fi
+if [ -n "$SAN" ]; then
+  ARGS+=(--sanitizers "$SAN")
+fi
+
+OUT="${GRAFTCHECK_OUT:-/tmp/graftcheck_findings.json}"
+if python -m gene2vec_tpu.cli.analyze "${ARGS[@]}" > "$OUT"; then
+  rc=0
+else
+  rc=$?
+fi
+python - "$OUT" "$rc" <<'EOF'
+import json, sys
+rc = int(sys.argv[2])
+try:
+    with open(sys.argv[1]) as f:
+        doc = json.load(f)
+except (OSError, ValueError):
+    # analyzer died before emitting JSON (stdout was redirected into
+    # $OUT, so it is empty/truncated) — report THAT, preserving the
+    # analyzer's exit code, instead of tracebacking on the decode
+    print(
+        f"graftcheck: analyzer crashed before emitting findings JSON "
+        f"(exit {rc}); its stderr above is the real error",
+        file=sys.stderr,
+    )
+    sys.exit(rc or 2)
+s = doc["summary"]
+print(f"graftcheck: {s['gating']} gating / {s['total']} total finding(s) "
+      f"-> {sys.argv[1]}", file=sys.stderr)
+for f in doc["findings"]:
+    if f["severity"] != "info":
+        loc = f"{f['path']}:{f['line']}" if f.get("line") else f["path"]
+        print(f"  {loc}: [{f['pass']}] {f['message']}", file=sys.stderr)
+EOF
+exit "$rc"

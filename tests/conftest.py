@@ -30,6 +30,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_deselected(items):
+    """Track deselected items so tests/test_analysis.py can reconstruct
+    the FULL collected count (selected + deselected) of this session and
+    cross-check round-summary test-count claims against it without a
+    second (expensive) collection pass."""
+    if items:
+        config = items[0].session.config
+        config._gene2vec_deselected = (
+            getattr(config, "_gene2vec_deselected", 0) + len(items)
+        )
+
+
+def pytest_collection_finish(session):
+    session.config._gene2vec_collected = len(session.items) + getattr(
+        session.config, "_gene2vec_deselected", 0
+    )
+
+
 @pytest.fixture(scope="session")
 def synthetic_corpus_dir(tmp_path_factory):
     """A small gene-pair corpus directory shaped like the reference's

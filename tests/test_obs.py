@@ -299,3 +299,7 @@ def test_no_bare_prints_in_library_code():
     assert bare_prints_in_source("print('x')", "<t>") != []
     assert bare_prints_in_source("import sys\nprint('x', file=sys.stderr)", "<t>") == []
     assert bare_prints_in_source("log = print", "<t>") == []
+    # the shim honors the inline pragma exactly like cli.analyze does
+    assert bare_prints_in_source(
+        "print('x')  # graftcheck: disable=bare-print", "<t>"
+    ) == []

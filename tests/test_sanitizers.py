@@ -1,0 +1,65 @@
+"""graftcheck tier-3 (slow, sanitizer): native parity under ASAN/UBSAN
+(+ TSAN with Hogwild's intended races suppressed).
+
+Each test builds the instrumented libraries via ``make -C native <kind>``
+and runs the pairio + Hogwild parity workload in a sanitized child
+process (see gene2vec_tpu/analysis/sanitize.py for the preload
+mechanics).  A nonzero child exit carries the sanitizer report in the
+failure message.  Skips cleanly when the toolchain lacks a runtime —
+but a failed *build* on a present toolchain FAILS (with the make stderr)
+rather than skipping, so build breakage cannot silently disable the
+memory-safety gate.
+
+Run: ``pytest tests/test_sanitizers.py -m sanitizer`` or
+``scripts/run_static_analysis.sh --with-sanitizers``.
+"""
+
+import pytest
+
+from gene2vec_tpu.analysis.sanitize import (
+    KINDS,
+    build,
+    run_parity,
+    toolchain_available,
+)
+
+pytestmark = [pytest.mark.slow, pytest.mark.sanitizer]
+
+
+def _built(kind):
+    """Skip on missing toolchain; fail loudly on a broken build."""
+    if not toolchain_available(kind):
+        pytest.skip(f"{kind} toolchain unavailable")
+    ok, detail = build(kind)
+    assert ok, f"{kind} instrumented build failed (gates, not skips):\n{detail}"
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_parity_under_sanitizer(kind):
+    _built(kind)
+    proc = run_parity(kind)
+    assert proc.returncode == 0, (
+        f"{kind} parity run failed (exit {proc.returncode}); report tail:\n"
+        + proc.stderr[-4000:]
+    )
+    assert "PARITY_OK" in proc.stderr
+
+
+def test_tsan_suppressions_are_load_bearing():
+    """Without native/tsan.supp the Hogwild kernel MUST report races —
+    they are the algorithm.  This guards against a future build change
+    (e.g. accidentally serializing the workers) silently turning the
+    suppressed TSAN run into a vacuous pass."""
+    import os
+
+    _built("tsan")
+    os.environ["GRAFTCHECK_SMALL"] = "1"
+    try:
+        proc = run_parity("tsan", options="halt_on_error=0")
+    finally:
+        os.environ.pop("GRAFTCHECK_SMALL", None)
+    assert "WARNING: ThreadSanitizer: data race" in proc.stderr, (
+        "unsuppressed TSAN saw no races — the Hogwild workers are no "
+        "longer racing (serialized build?) or TSAN is not engaging:\n"
+        + proc.stderr[-2000:]
+    )
