@@ -371,18 +371,128 @@ def build_ggipnn(vocab_size: int = 64, batch: int = 16):
     return trainer, (params, opt_state), lowered, args_maker
 
 
+def build_serve(
+    dim: int = 16,
+    vocab: int = 128,
+    max_batch: int = 8,
+    k: int = 4,
+    mesh: Optional[Tuple[int, int]] = None,
+):
+    """(engine, unit, lowered, args_maker) for the serve top-k kernel.
+
+    ``mesh=(data, model)`` row-shards the unit matrix over the model
+    axis (``parallel/sharding.py:row_sharding``) — the layout whose
+    per-query collective bytes the ``serve`` budget section gates."""
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.serve.engine import SimilarityEngine
+    from gene2vec_tpu.serve.registry import l2_normalize
+
+    rng = np.random.RandomState(0)
+    unit_np = l2_normalize(rng.randn(vocab, dim).astype(np.float32))
+    valid = None
+    mesh_obj = None
+    if mesh is not None:
+        import jax
+
+        from gene2vec_tpu.config import MeshConfig
+        from gene2vec_tpu.parallel.mesh import make_mesh
+        from gene2vec_tpu.parallel.sharding import row_sharding
+        from gene2vec_tpu.serve.registry import dim0_shards
+
+        data, model = mesh
+        mesh_obj = make_mesh(MeshConfig(data=data, model=model))
+        sharding = row_sharding(mesh_obj)
+        pad = (-vocab) % dim0_shards(sharding)
+        if pad:
+            unit_np = np.concatenate(
+                [unit_np, np.zeros((pad, dim), np.float32)]
+            )
+            valid = vocab
+        unit = jax.device_put(jnp.asarray(unit_np), sharding)
+    else:
+        unit = jnp.asarray(unit_np)
+    engine = SimilarityEngine(max_batch=max_batch, mesh=mesh_obj)
+    queries = jnp.asarray(rng.randn(max_batch, dim).astype(np.float32))
+    lowered = engine._topk_fn.lower(unit, queries, k, valid)
+
+    def args_maker():
+        q = jnp.asarray(rng.randn(max_batch, dim).astype(np.float32))
+        return (unit, q, k, valid)
+
+    return engine, unit, lowered, args_maker
+
+
+def serve_bucket_findings(
+    dim: int = 16, vocab: int = 128, max_batch: int = 8, k: int = 4
+) -> List[Finding]:
+    """Jit-cache stability ACROSS the engine's bucketed batch shapes:
+    one warm cycle over every bucket compiles each once; a second cycle
+    must not grow the cache (the padded-shape contract that keeps
+    production request mixes from recompiling)."""
+    import numpy as _np
+
+    engine, unit, _, _ = build_serve(
+        dim=dim, vocab=vocab, max_batch=max_batch, k=k
+    )
+    rng = _np.random.RandomState(1)
+    label = "hlo:serve/buckets"
+
+    def cycle():
+        for n in engine.buckets:
+            engine.top_k(unit, rng.randn(n, dim).astype(_np.float32), k)
+
+    cycle()
+    after_warmup = engine._cache_size()
+    if after_warmup is None:
+        return [Finding(
+            pass_id="hlo-cache-stability",
+            severity="info",
+            path=label,
+            message="jit cache size introspection unavailable on this "
+                    "jax version; bucket stability not checked",
+            data={"checked": False},
+        )]
+    cycle()
+    after = engine._cache_size()
+    if after > after_warmup:
+        return [Finding(
+            pass_id="hlo-cache-stability",
+            path=label,
+            message=(
+                f"jit cache grew {after_warmup} -> {after} on a repeat "
+                f"cycle over buckets {engine.buckets} — padded request "
+                "shapes are not hitting the compiled executables"
+            ),
+            data={"checked": True, "after_warmup": after_warmup,
+                  "after": after, "buckets": list(engine.buckets)},
+        )]
+    return [Finding(
+        pass_id="hlo-cache-stability",
+        severity="info",
+        path=label,
+        message=(
+            f"stable at {after} cached executable(s) across buckets "
+            f"{engine.buckets}"
+        ),
+        data={"checked": True, "cached": after,
+              "buckets": list(engine.buckets)},
+    )]
+
+
 def hot_path_findings(
     include_cache_checks: bool = True,
 ) -> List[Finding]:
     """The default tier-2 sweep over small unsharded instances of all
-    three hot paths: host callbacks + dtype discipline (+ cache
-    stability).  Budgets need the full-scale mesh configs and run via
-    :func:`budget_findings`."""
+    four hot paths (SGNS / CBOW-HS / GGIPNN / serve top-k): host
+    callbacks + dtype discipline (+ cache stability).  Budgets need the
+    full-scale mesh configs and run via :func:`budget_findings`."""
     findings: List[Finding] = []
     specs = [
         ("hlo:sgns", build_sgns, {}),
         ("hlo:cbow_hs", build_cbow_hs, {}),
         ("hlo:ggipnn", build_ggipnn, {}),
+        ("hlo:serve", build_serve, {}),
     ]
     for label, builder, kw in specs:
         trainer, _, lowered, args_maker = builder(**kw)
@@ -394,14 +504,71 @@ def hot_path_findings(
         )
         findings.extend(dtype_findings(text, label, compute_dtype=compute))
         if include_cache_checks:
-            fn = getattr(trainer, "_epoch_fn", None) or getattr(
-                trainer, "train_step", None
+            fn = (
+                getattr(trainer, "_epoch_fn", None)
+                or getattr(trainer, "train_step", None)
+                or getattr(trainer, "_topk_fn", None)
             )
             if fn is not None:
                 findings.extend(
                     cache_stability_findings(fn, args_maker, label)
                 )
+    if include_cache_checks:
+        findings.extend(serve_bucket_findings())
     return findings
+
+
+def serve_budget_findings(
+    lowered,
+    label: str,
+    budget: Dict,
+) -> List[Finding]:
+    """Enforce one serve budget entry: per-QUERY collective bytes of the
+    compiled row-sharded top-k must stay within
+    ``max_bytes_per_query``."""
+    from gene2vec_tpu.obs.probes import collective_stats
+
+    stats = collective_stats(lowered)
+    if stats is None:
+        return [Finding(
+            pass_id="hlo-collective-budget",
+            path=label,
+            message="failed to compile/scan the module for collectives",
+        )]
+    batch = budget["max_batch"]
+    bytes_per_query = stats["total_bytes"] / batch
+    data = {
+        "bytes_per_query": round(bytes_per_query, 1),
+        "max_bytes_per_query": budget["max_bytes_per_query"],
+        "reference_bytes_per_query": budget.get(
+            "reference_bytes_per_query"
+        ),
+        "collectives": stats["collectives"],
+    }
+    if bytes_per_query > budget["max_bytes_per_query"]:
+        return [Finding(
+            pass_id="hlo-collective-budget",
+            path=label,
+            message=(
+                f"per-query collective bytes {bytes_per_query:,.1f} "
+                f"exceed the budget {budget['max_bytes_per_query']:,} "
+                f"(reference "
+                f"{budget.get('reference_bytes_per_query')}) — the "
+                "sharded top-k is gathering more than its candidate "
+                "rows"
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id="hlo-collective-budget",
+        severity="info",
+        path=label,
+        message=(
+            f"{bytes_per_query:,.1f} bytes/query within budget "
+            f"{budget['max_bytes_per_query']:,}"
+        ),
+        data=data,
+    )]
 
 
 def budget_findings(
@@ -409,7 +576,8 @@ def budget_findings(
     budgets_path: str = BUDGETS_PATH,
 ) -> List[Finding]:
     """Compile each budgeted mesh config at its recorded geometry and
-    enforce its per-pair collective-bytes ceiling."""
+    enforce its per-pair (sgns) / per-query (serve) collective-bytes
+    ceiling."""
     budgets = load_budgets(budgets_path)
     findings: List[Finding] = []
     for key, entry in budgets["sgns"].items():
@@ -426,5 +594,18 @@ def budget_findings(
         )
         findings.extend(
             collective_budget_findings(lowered, f"hlo:sgns/{key}", entry)
+        )
+    for key, entry in budgets.get("serve", {}).items():
+        if keys is not None and key not in keys:
+            continue
+        _, _, lowered, _ = build_serve(
+            dim=entry["dim"],
+            vocab=entry["vocab"],
+            max_batch=entry["max_batch"],
+            k=entry["k"],
+            mesh=tuple(entry["mesh"]),
+        )
+        findings.extend(
+            serve_budget_findings(lowered, f"hlo:serve/{key}", entry)
         )
     return findings

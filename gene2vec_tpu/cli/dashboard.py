@@ -31,6 +31,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8050)
     p.add_argument("--debug", action="store_true")
+    p.add_argument("--serve-url", default=None,
+                   help="base URL of a running `python -m gene2vec_tpu."
+                        "cli.serve` instance; adds a live neighbor-search "
+                        "box backed by its /v1/similar endpoint (lookups "
+                        "fall back to the figure-json path on failure)")
+    p.add_argument("--serve-k", type=int, default=10,
+                   help="neighbors fetched per --serve-url lookup")
     return p
 
 
@@ -50,6 +57,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         host=args.host,
         port=args.port,
         debug=args.debug,
+        serve_url=args.serve_url,
+        serve_k=args.serve_k,
     )
     return 0
 

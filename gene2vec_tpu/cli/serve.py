@@ -1,0 +1,158 @@
+"""Serve CLI: the embedding query engine over an export dir.
+
+::
+
+    python -m gene2vec_tpu.cli.serve --export-dir exports/ --port 8000
+
+Emits exactly ONE JSON line on stdout once the server is listening —
+``{"url": ..., "dim": ..., "iteration": ..., "run_dir": ...}`` — so
+``scripts/serve_loadgen.py --spawn`` (and any other harness) can parse
+the bound address; human-readable status goes to stderr.  Every serve
+session stamps a ``manifest.json`` run record via
+:class:`gene2vec_tpu.obs.run.Run` (default run dir
+``<export_dir>/serve_runs/<unix-ts>``); ``/metrics`` serves that run's
+registry and the span timeline lands in its ``events.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve",
+        description="Batched embedding query server over a checkpoint "
+        "export dir (similar / embedding / interaction endpoints).",
+    )
+    p.add_argument("--export-dir", required=True,
+                   help="io/checkpoint.py export dir (npz + vocab.tsv; "
+                        "*_w2v.txt text exports work as a fallback)")
+    p.add_argument("--dim", type=int, default=None,
+                   help="serve only this table width (default: newest of "
+                        "any dim)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks an ephemeral port (printed in the JSON "
+                        "status line)")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="micro-batch admission window")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="bounded queue depth; beyond it requests get 429")
+    p.add_argument("--cache-size", type=int, default=4096,
+                   help="LRU entries keyed by (model version, gene, k); "
+                        "0 disables")
+    p.add_argument("--timeout-ms", type=float, default=2000.0,
+                   help="default per-request deadline")
+    p.add_argument("--poll-interval", type=float, default=5.0,
+                   help="seconds between export-dir rescans (hot swap)")
+    p.add_argument("--run-dir", default=None,
+                   help="obs run dir (default: "
+                        "<export-dir>/serve_runs/<unix-ts>)")
+    p.add_argument("--ggipnn-checkpoint", default=None,
+                   help="models/ggipnn_obs checkpoint npz backing "
+                        "/v1/interaction (without it the MLP head is "
+                        "untrained and responses say so)")
+    p.add_argument("--shard-rows", action="store_true",
+                   help="row-shard the table over every visible device "
+                        "(parallel/sharding.py row_sharding)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from gene2vec_tpu.obs.run import Run
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_server,
+    )
+
+    run_dir = args.run_dir or os.path.join(
+        args.export_dir, "serve_runs", str(int(time.time()))
+    )
+    run = Run(run_dir, name="serve", config=vars(args))
+    sharding = None
+    mesh = None
+    if args.shard_rows:
+        import jax
+
+        from gene2vec_tpu.config import MeshConfig
+        from gene2vec_tpu.parallel.mesh import make_mesh
+        from gene2vec_tpu.parallel.sharding import row_sharding
+
+        mesh = make_mesh(MeshConfig(data=1, model=len(jax.devices())))
+        sharding = row_sharding(mesh)
+    registry = ModelRegistry(
+        args.export_dir, dim=args.dim, sharding=sharding,
+        metrics=run.registry,
+    )
+    if not registry.refresh():
+        print(
+            f"error: no checkpoint found in {args.export_dir!r} "
+            "(expected gene2vec_dim_<D>_iter_<N>.npz or *_w2v.txt)",
+            file=sys.stderr,
+        )
+        run.close()
+        return 2
+    registry.start_watcher(args.poll_interval)
+    app = ServeApp(
+        registry,
+        config=ServeConfig(
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+            cache_size=args.cache_size,
+            timeout_ms=args.timeout_ms,
+        ),
+        metrics=run.registry,
+        ggipnn_checkpoint=args.ggipnn_checkpoint,
+        mesh=mesh,
+    ).start()
+    server = make_server(app, args.host, args.port)
+    host, port = server.server_address[:2]
+    url = f"http://{host}:{port}"
+    model = registry.model
+    run.annotate(serve_url=url)
+    run.event("serve_start", url=url, iteration=model.iteration)
+    # the one stdout line is the machine-readable contract (loadgen
+    # --spawn parses it); everything else goes to stderr
+    print(
+        json.dumps(
+            {
+                "url": url,
+                "dim": model.dim,
+                "iteration": model.iteration,
+                "run_dir": run.run_dir,
+            }
+        ),
+        flush=True,
+    )
+    print(
+        f"serving {args.export_dir} (dim {model.dim}, iteration "
+        f"{model.iteration}, vocab {len(model)}) on {url}; "
+        f"run dir {run.run_dir}",
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down", file=sys.stderr)
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+        run.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

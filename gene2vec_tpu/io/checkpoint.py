@@ -28,6 +28,34 @@ from gene2vec_tpu.io.vocab import Vocab
 from gene2vec_tpu.sgns.model import SGNSParams
 
 _CKPT_RE = re.compile(r"^gene2vec_dim_(\d+)_iter_(\d+)\.npz$")
+_W2V_RE = re.compile(r"^gene2vec_dim_(\d+)_iter_(\d+)_w2v\.txt$")
+
+
+def iter_checkpoints(export_dir: str, text_fallback: bool = False):
+    """Yield ``(dim, iteration, path)`` for every checkpoint in
+    ``export_dir`` under this module's naming scheme — the discovery
+    primitive the serve registry polls.  With ``text_fallback`` the
+    word2vec-format text exports (``*_w2v.txt``) are yielded too, so
+    export dirs produced by the reference scripts (text only, no
+    ``.npz``) are still discoverable; npz checkpoints for the same
+    (dim, iteration) shadow their text twin."""
+    if not os.path.isdir(export_dir):
+        return
+    seen = set()
+    names = sorted(os.listdir(export_dir))
+    for name in names:
+        m = _CKPT_RE.match(name)
+        if m:
+            key = (int(m.group(1)), int(m.group(2)))
+            seen.add(key)
+            yield (*key, os.path.join(export_dir, name))
+    if text_fallback:
+        for name in names:
+            m = _W2V_RE.match(name)
+            if m:
+                key = (int(m.group(1)), int(m.group(2)))
+                if key not in seen:
+                    yield (*key, os.path.join(export_dir, name))
 
 
 def ckpt_prefix(export_dir: str, dim: int, iteration: int) -> str:

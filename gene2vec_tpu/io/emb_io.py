@@ -55,22 +55,30 @@ def write_word2vec_format(path: str, tokens: Sequence[str], matrix: np.ndarray) 
 
 
 def read_word2vec_format(path: str) -> Tuple[List[str], np.ndarray]:
+    """Streaming reader: the ``"<count> <dim>"`` header preallocates the
+    full (count, dim) matrix and rows parse straight into it — no Python
+    row-list accumulation or final ``vstack`` copy, so peak memory is one
+    matrix (the serve registry loads full-vocab exports through this
+    path on its text-format fallback)."""
     tokens: List[str] = []
-    rows: List[np.ndarray] = []
     with open(path, "r", encoding="utf-8") as f:
         header = f.readline().split()
         if len(header) != 2:
             raise ValueError(f"{path}: missing word2vec '<count> <dim>' header")
         count, dim = int(header[0]), int(header[1])
+        matrix = np.empty((count, dim), dtype=np.float32)
+        n = 0
         for line in f:
             parts = line.rstrip("\n").split(" ")
             if len(parts) < dim + 1:
                 continue
-            tokens.append(parts[0])
-            rows.append(np.asarray(parts[1 : dim + 1], dtype=np.float32))
-    if len(tokens) != count:
-        raise ValueError(f"{path}: header says {count} rows, found {len(tokens)}")
-    return tokens, np.vstack(rows) if rows else np.zeros((0, dim), np.float32)
+            if n < count:
+                matrix[n] = np.asarray(parts[1 : dim + 1], dtype=np.float32)
+                tokens.append(parts[0])
+            n += 1
+    if n != count:
+        raise ValueError(f"{path}: header says {count} rows, found {n}")
+    return tokens, matrix if count else np.zeros((0, dim), np.float32)
 
 
 def load_embedding_any(path: str) -> Tuple[List[str], np.ndarray]:

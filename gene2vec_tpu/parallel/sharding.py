@@ -85,6 +85,16 @@ class SGNSSharding:
         )
 
 
+def row_sharding(mesh: Mesh, axis: str = "model") -> NamedSharding:
+    """Row-shard a (V, D) embedding matrix over ``axis`` — each device
+    owns V/P contiguous vocab rows.  This is the serve engine's layout
+    for tables too big to replicate: the query×tableᵀ matmul computes
+    per-shard score columns locally and only the top-k selection
+    communicates (see serve/engine.py and the ``serve`` section of
+    analysis/budgets.json for the enforced per-query byte ceiling)."""
+    return NamedSharding(mesh, P(axis, None))
+
+
 def no_sharding() -> Optional[SGNSSharding]:
     """Single-device marker (constraints become no-ops in the trainer)."""
     return None

@@ -1,0 +1,37 @@
+"""Online serving: batched embedding queries over training checkpoints.
+
+Layering (docs/SERVING.md):
+
+* :mod:`~gene2vec_tpu.serve.registry` — checkpoint discovery + atomic
+  hot swap of the device-resident L2-normalized table;
+* :mod:`~gene2vec_tpu.serve.engine` — the jitted bucketed top-k cosine
+  kernel;
+* :mod:`~gene2vec_tpu.serve.batcher` — micro-batching with max-delay /
+  max-batch admission, bounded-queue backpressure, deadlines, LRU;
+* :mod:`~gene2vec_tpu.serve.interaction` — GGIPNN pair scoring;
+* :mod:`~gene2vec_tpu.serve.server` — the stdlib JSON HTTP API.
+
+``python -m gene2vec_tpu.cli.serve`` runs the stack;
+``scripts/serve_loadgen.py`` measures it.
+"""
+
+from gene2vec_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    RejectedError,
+)
+from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.registry import LoadedModel, ModelRegistry
+from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
+
+__all__ = [
+    "DeadlineExceeded",
+    "LoadedModel",
+    "MicroBatcher",
+    "ModelRegistry",
+    "RejectedError",
+    "ServeApp",
+    "ServeConfig",
+    "SimilarityEngine",
+    "make_server",
+]

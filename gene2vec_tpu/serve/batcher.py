@@ -1,0 +1,306 @@
+"""Micro-batching request queue: admission policy, backpressure, caching.
+
+Requests enqueue from HTTP handler threads and one worker thread drains
+them in batches under a **max-delay / max-batch** admission policy: the
+first waiting request opens a batch window; the batch closes when either
+``max_batch`` requests have joined or ``max_delay_s`` has elapsed since
+the window opened, whichever is first.  An idle queue therefore costs a
+single request at most ``max_delay_s`` of added latency, while a busy
+queue closes batches on size and never waits.
+
+Overload never grows memory: the queue is bounded at ``max_queue`` and
+:meth:`MicroBatcher.submit` rejects immediately (:class:`RejectedError`
+-> HTTP 429) when full — callers shed load instead of stacking it.  Each
+request carries a deadline; requests that expire while queued are failed
+(:class:`DeadlineExceeded` -> HTTP 504) without spending compute on
+them.  A bounded LRU keyed by (model version, query, k) serves repeat
+lookups without touching the queue at all.
+
+Every batch runs under an obs span (``serve_batch`` wrapping
+``serve_compute``), so a run's ``events.jsonl`` shows the
+enqueue->batch->compute->respond pipeline per batch; counters/gauges
+(queue depth, batch size, rejections, expirations, cache hits) land in
+the same registry ``/metrics`` exports.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Hashable, List, Optional, Tuple
+
+from gene2vec_tpu.obs.trace import ambient_span
+
+
+class RejectedError(RuntimeError):
+    """Queue at capacity — explicit backpressure (HTTP 429)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request deadline passed before a result was ready (HTTP 504)."""
+
+
+class _Pending:
+    __slots__ = ("item", "k", "deadline", "event", "result", "error")
+
+    def __init__(self, item: Any, k: int, deadline: float):
+        self.item = item
+        self.k = k
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+
+class LRUCache:
+    """Bounded thread-safe LRU (size 0 disables)."""
+
+    def __init__(self, max_size: int):
+        self.max_size = max_size
+        self._data: "collections.OrderedDict[Hashable, Any]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    def get(self, key: Hashable):
+        with self._lock:
+            if key not in self._data:
+                return None
+            self._data.move_to_end(key)
+            return self._data[key]
+
+    def put(self, key: Hashable, value: Any) -> None:
+        if self.max_size <= 0:
+            return
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.max_size:
+                self._data.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class Ticket:
+    """Handle for one submitted request; :meth:`get` blocks for the
+    result, raising the request's failure."""
+
+    __slots__ = ("_batcher", "_pending", "_cache_key", "_t0", "_timeout_s",
+                 "_cached")
+
+    def __init__(self, batcher, pending, cache_key, t0,
+                 timeout_s: float = 0.0, cached=None):
+        self._batcher = batcher
+        self._pending = pending
+        self._cache_key = cache_key
+        self._t0 = t0
+        self._timeout_s = timeout_s
+        self._cached = cached
+
+    def get(self):
+        if self._pending is None:
+            return self._cached
+        b = self._batcher
+        remaining = (self._t0 + self._timeout_s) - time.monotonic()
+        if not self._pending.event.wait(max(0.0, remaining)):
+            b._count("serve_deadline_expired_total")
+            raise DeadlineExceeded(
+                f"no result within {self._timeout_s:.3f}s"
+            )
+        if self._pending.error is not None:
+            raise self._pending.error
+        b._observe("serve_request_seconds", time.monotonic() - self._t0)
+        if self._cache_key is not None:
+            b.cache.put(self._cache_key, self._pending.result)
+        return self._pending.result
+
+
+class MicroBatcher:
+    """Batches ``(item, k)`` requests into calls of
+    ``compute(items, k_max) -> list-of-results`` on one worker thread.
+
+    ``compute`` receives the batch's items and the max padded ``k`` over
+    the batch and must return one result per item, in order.  Mixed-k
+    batches compute at the largest k; each caller gets its own result
+    back untouched (the compute fn crops per-item if it cares).
+    """
+
+    def __init__(
+        self,
+        compute: Callable[[List[Any], int], List[Any]],
+        max_batch: int = 64,
+        max_delay_s: float = 0.005,
+        max_queue: int = 256,
+        cache_size: int = 1024,
+        default_timeout_s: float = 2.0,
+        metrics=None,
+    ):
+        self.compute = compute
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.max_queue = max_queue
+        self.default_timeout_s = default_timeout_s
+        self.cache = LRUCache(cache_size)
+        self.metrics = metrics
+        self._q: "collections.deque[_Pending]" = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._worker is None:
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._run, name="micro-batcher", daemon=True
+            )
+            self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        if self._worker is None:
+            return
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5.0)
+        self._worker = None
+
+    # -- metrics helpers ---------------------------------------------------
+
+    def _count(self, name: str, amount: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc(amount)
+
+    def _observe(self, name: str, value: float) -> None:
+        if self.metrics is not None:
+            self.metrics.histogram(name).observe(value)
+
+    def _gauge_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("serve_queue_depth").set(len(self._q))
+
+    # -- submission --------------------------------------------------------
+
+    def submit_async(
+        self,
+        item: Any,
+        k: int,
+        cache_key: Optional[Hashable] = None,
+        timeout_s: Optional[float] = None,
+    ) -> "Ticket":
+        """Enqueue one request and return a :class:`Ticket` immediately
+        (so a multi-query HTTP request lands all its queries in the same
+        batch window before blocking on any of them).
+
+        Raises :class:`RejectedError` right here when the queue is full
+        — backpressure is decided at admission, never deferred.
+        """
+        self._count("serve_requests_total")
+        if cache_key is not None:
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                self._count("serve_cache_hits_total")
+                return Ticket(self, None, None, 0.0, cached=hit)
+        timeout_s = (
+            self.default_timeout_s if timeout_s is None else float(timeout_s)
+        )
+        t0 = time.monotonic()
+        pending = _Pending(item, int(k), t0 + timeout_s)
+        with self._cv:
+            if self._worker is None:
+                raise RuntimeError("MicroBatcher not started")
+            if len(self._q) >= self.max_queue:
+                self._count("serve_rejected_total")
+                raise RejectedError(
+                    f"queue full ({self.max_queue} waiting requests)"
+                )
+            self._q.append(pending)
+            self._gauge_depth()
+            self._cv.notify_all()
+        return Ticket(self, pending, cache_key, t0, timeout_s=timeout_s)
+
+    def submit(
+        self,
+        item: Any,
+        k: int,
+        cache_key: Optional[Hashable] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Any:
+        """Blocking :meth:`submit_async`: the result, or
+        :class:`RejectedError` / :class:`DeadlineExceeded` /
+        the compute failure."""
+        return self.submit_async(
+            item, k, cache_key=cache_key, timeout_s=timeout_s
+        ).get()
+
+    # -- worker ------------------------------------------------------------
+
+    def _gather(self) -> List[_Pending]:
+        """Admission policy: block for the first request, then hold the
+        window open until ``max_batch`` joined or ``max_delay_s`` passed."""
+        with self._cv:
+            while not self._q and not self._stop:
+                self._cv.wait()
+            if self._stop and not self._q:
+                return []
+            window_ends = time.monotonic() + self.max_delay_s
+            batch: List[_Pending] = []
+            while len(batch) < self.max_batch:
+                while self._q and len(batch) < self.max_batch:
+                    batch.append(self._q.popleft())
+                remaining = window_ends - time.monotonic()
+                if remaining <= 0 or len(batch) >= self.max_batch:
+                    break
+                self._cv.wait(timeout=remaining)
+                if self._stop and not self._q:
+                    break
+            self._gauge_depth()
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._gather()
+            if not batch:
+                if self._stop:
+                    return
+                continue
+            now = time.monotonic()
+            live: List[_Pending] = []
+            for p in batch:
+                if p.deadline <= now:
+                    # expired while queued: fail it without computing
+                    # (submit() already returned DeadlineExceeded; this
+                    # keeps the slot from consuming batch capacity)
+                    p.error = DeadlineExceeded("expired in queue")
+                    p.event.set()
+                    self._count("serve_expired_in_queue_total")
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            self._observe("serve_batch_size", len(live))
+            k_max = max(p.k for p in live)
+            try:
+                with ambient_span(
+                    "serve_batch", size=len(live), k=k_max
+                ) as span:
+                    with ambient_span("serve_compute"):
+                        results = self.compute([p.item for p in live], k_max)
+                    span["ok"] = True
+                if len(results) != len(live):
+                    raise RuntimeError(
+                        f"compute returned {len(results)} results for "
+                        f"{len(live)} items"
+                    )
+                for p, r in zip(live, results):
+                    p.result = r
+                    p.event.set()
+            except BaseException as e:  # noqa: BLE001 — failures propagate per request
+                for p in live:
+                    p.error = e
+                    p.event.set()
+                self._count("serve_batch_errors_total")

@@ -1,0 +1,115 @@
+"""GGIPNN interaction scoring over the registry's served embedding.
+
+``/v1/interaction`` scores gene pairs with the
+:class:`~gene2vec_tpu.models.ggipnn_train.GGIPNNTrainer` predict path —
+the same jitted scanned inference the classification harness uses, so a
+request batch costs one compiled call.  The scorer binds to one
+:class:`~gene2vec_tpu.serve.registry.LoadedModel` snapshot (version
+checked by the server, which rebuilds on hot swap):
+
+* the embedding table is the served model's raw table, row-aligned to
+  the served vocab;
+* the MLP head loads from a GGIPNN run checkpoint
+  (``checkpoints/model-<step>.npz``, the
+  :mod:`gene2vec_tpu.models.ggipnn_obs` format) when one is supplied;
+  without one the head keeps its random init and scores are only useful
+  for wiring tests — ``trained`` records which case this is, and the
+  server echoes it in every response so untrained scores cannot
+  masquerade as predictions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.config import GGIPNNConfig
+from gene2vec_tpu.models.ggipnn_data import PairTextVocab
+from gene2vec_tpu.models.ggipnn_obs import load_checkpoint
+from gene2vec_tpu.models.ggipnn_train import GGIPNNTrainer
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> dict:
+    """``{'hidden1/kernel': a, ...}`` (the ggipnn_obs checkpoint layout)
+    back to the nested param pytree."""
+    out: dict = {}
+    for path, value in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+class InteractionScorer:
+    """GGIPNN softmax scores for (gene, gene) pairs from one model
+    snapshot."""
+
+    def __init__(
+        self,
+        model,
+        checkpoint_path: Optional[str] = None,
+        batch_size: int = 64,
+    ):
+        import jax.numpy as jnp
+
+        self.version = model.version
+        vocab = PairTextVocab()
+        vocab.token_to_id = dict(model.index)
+        vocab.id_to_token = list(model.tokens)
+        config = GGIPNNConfig(
+            embedding_dim=model.dim, batch_size=batch_size
+        )
+        self.trainer = GGIPNNTrainer(config, vocab)
+        params, _ = self.trainer.init_state()
+        params = dict(params)
+        params["embedding"] = jnp.asarray(model.emb)
+        self.trained = False
+        if checkpoint_path is not None:
+            loaded = unflatten_params(load_checkpoint(checkpoint_path))
+            emb = loaded.get("embedding")
+            if emb is not None and emb.shape != params["embedding"].shape:
+                raise ValueError(
+                    f"{checkpoint_path}: embedding {emb.shape} does not "
+                    f"match the served model "
+                    f"{tuple(params['embedding'].shape)} — the checkpoint "
+                    "was trained against a different vocab/dim"
+                )
+            for name, value in loaded.items():
+                # head weights only: the served model's table stays (the
+                # module contract), so hot swaps change scores and the
+                # checkpoint's own table — row-ordered by its TRAINING
+                # vocab, not the served one — can never be indexed by
+                # served-vocab ids
+                if name == "embedding":
+                    continue
+                params[name] = (
+                    jnp.asarray(value) if not isinstance(value, dict)
+                    else value
+                )
+            self.trained = True
+        self.params = params
+
+    def encode(self, pairs: Sequence[Tuple[str, str]]) -> np.ndarray:
+        """(N, 2) int32 ids; raises KeyError naming the first unknown
+        gene (the server maps it to HTTP 400)."""
+        index = self.trainer.vocab.token_to_id
+        out = []
+        for a, b in pairs:
+            if a not in index:
+                raise KeyError(a)
+            if b not in index:
+                raise KeyError(b)
+            out.append((index[a], index[b]))
+        return np.asarray(out, dtype=np.int32).reshape(-1, 2)
+
+    def score(self, pairs: Sequence[Tuple[str, str]]) -> List[float]:
+        """Positive-class softmax score per pair (``scores[:, 1]``, the
+        column the reference's ROC-AUC reads)."""
+        if not pairs:
+            return []
+        ids = self.encode(pairs)
+        scores, _, _ = self.trainer.predict(self.params, ids)
+        return [float(s) for s in scores[:, 1]]

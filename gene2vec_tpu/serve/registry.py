@@ -1,0 +1,256 @@
+"""Model registry: checkpoint discovery, device-resident tables, hot swap.
+
+The registry watches one ``<export_dir>`` written by
+:mod:`gene2vec_tpu.io.checkpoint` (``gene2vec_dim_<D>_iter_<N>.npz`` +
+``vocab.tsv``), loads the newest iteration into an immutable
+:class:`LoadedModel` — the raw f32 table for ``/v1/embedding`` plus an
+L2-normalized device-resident copy for the cosine top-k engine — and
+swaps it in atomically: readers take one reference
+(:meth:`ModelRegistry.model`) and every field they then touch belongs to
+the same iteration.  A new checkpoint never mutates a served model.
+
+Export dirs produced by the reference scripts carry only the text
+exports; the registry falls back to the word2vec-format twin
+(``*_w2v.txt``) through the streaming preallocating reader in
+``io/emb_io.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.io.checkpoint import iter_checkpoints
+from gene2vec_tpu.io.emb_io import read_word2vec_format
+from gene2vec_tpu.obs.trace import ambient_span
+
+
+def l2_normalize(matrix: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    """Unit-normalize rows (zero rows stay zero instead of dividing by 0
+    — a gene with a zero vector simply never wins a cosine top-k)."""
+    matrix = np.asarray(matrix, dtype=np.float32)
+    norms = np.linalg.norm(matrix, axis=1, keepdims=True)
+    return matrix / np.maximum(norms, eps)
+
+
+def dim0_shards(sharding) -> int:
+    """How many ways ``sharding`` splits dim 0 (1 for replicated or
+    unrecognized specs) — the row-pad multiple the loader must honor."""
+    try:
+        spec0 = sharding.spec[0]
+    except (AttributeError, IndexError):
+        return 1
+    if spec0 is None:
+        return 1
+    axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+    n = 1
+    for a in axes:
+        n *= sharding.mesh.shape[a]
+    return n
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadedModel:
+    """One immutable loaded iteration.  ``unit`` is the L2-normalized
+    device-resident matrix the engine matmuls against — row-padded with
+    zeros up to the shard multiple when the registry places it sharded
+    (``len(self)`` is the real row count; the engine masks the pad);
+    ``emb`` is the raw, unpadded host table ``/v1/embedding`` serves."""
+
+    dim: int
+    iteration: int
+    tokens: Tuple[str, ...]
+    index: Dict[str, int]
+    emb: np.ndarray
+    unit: "object"  # jax.Array — typed loosely so the module imports jax lazily
+    source: str
+    meta: Dict
+
+    @property
+    def version(self) -> Tuple[int, int]:
+        return (self.dim, self.iteration)
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+def discover_newest(
+    export_dir: str, dim: Optional[int] = None
+) -> Optional[Tuple[int, int, str]]:
+    """Newest ``(dim, iteration, path)`` in ``export_dir`` — highest
+    iteration wins; among equal iterations the largest dim.  ``dim``
+    restricts the scan to one table width."""
+    best: Optional[Tuple[int, int, str]] = None
+    for d, it, path in iter_checkpoints(export_dir, text_fallback=True):
+        if dim is not None and d != dim:
+            continue
+        if best is None or (it, d) > (best[1], best[0]):
+            best = (d, it, path)
+    return best
+
+
+def _load_npz(path: str) -> Tuple[List[str], np.ndarray, Dict]:
+    with np.load(path) as z:
+        meta = json.loads(str(z["meta"])) if "meta" in z.files else {}
+        emb = np.asarray(z["emb"], dtype=np.float32)
+    vocab_path = os.path.join(os.path.dirname(path), "vocab.tsv")
+    tokens: List[str] = []
+    with open(vocab_path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line:
+                tokens.append(line.split("\t")[0])
+    if len(tokens) != emb.shape[0]:
+        raise ValueError(
+            f"{path}: {emb.shape[0]} embedding rows vs {len(tokens)} vocab "
+            f"tokens in {vocab_path}"
+        )
+    return tokens, emb, meta
+
+
+class ModelRegistry:
+    """Discovers, loads, and hot-swaps checkpoints from one export dir.
+
+    ``sharding`` (a ``jax.sharding.Sharding``, e.g.
+    :func:`gene2vec_tpu.parallel.sharding.row_sharding`) places the
+    normalized matrix when given; default is the backend's default
+    placement.  ``metrics`` (an obs ``MetricsRegistry``) receives
+    ``model_iteration`` / ``model_vocab_size`` gauges and a
+    ``model_swaps_total`` counter.
+    """
+
+    def __init__(
+        self,
+        export_dir: str,
+        dim: Optional[int] = None,
+        sharding=None,
+        metrics=None,
+    ):
+        self.export_dir = export_dir
+        self.dim = dim
+        self.sharding = sharding
+        self.metrics = metrics
+        self._model: Optional[LoadedModel] = None
+        self._refresh_lock = threading.Lock()
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- reading -----------------------------------------------------------
+
+    @property
+    def model(self) -> LoadedModel:
+        """The current model.  The returned object is immutable — hold the
+        reference for the duration of one request and every field is from
+        the same iteration, regardless of concurrent swaps."""
+        m = self._model
+        if m is None:
+            raise RuntimeError(
+                f"no checkpoint loaded yet from {self.export_dir!r} "
+                "(call refresh() or check the export dir)"
+            )
+        return m
+
+    @property
+    def loaded(self) -> bool:
+        return self._model is not None
+
+    # -- loading / swapping ------------------------------------------------
+
+    def _load(self, dim: int, iteration: int, path: str) -> LoadedModel:
+        import jax
+        import jax.numpy as jnp
+
+        with ambient_span(
+            "model_load", dim=dim, iteration=iteration, path=path
+        ):
+            if path.endswith(".npz"):
+                tokens, emb, meta = _load_npz(path)
+            else:
+                tokens, emb = read_word2vec_format(path)
+                meta = {"dim": dim, "iteration": iteration, "format": "w2v"}
+            unit_np = l2_normalize(emb)
+            if self.sharding is not None:
+                pad = (-unit_np.shape[0]) % dim0_shards(self.sharding)
+                if pad:
+                    unit_np = np.concatenate(
+                        [unit_np,
+                         np.zeros((pad, unit_np.shape[1]), np.float32)]
+                    )
+                unit = jax.device_put(jnp.asarray(unit_np), self.sharding)
+            else:
+                unit = jnp.asarray(unit_np)
+            unit.block_until_ready()
+        return LoadedModel(
+            dim=dim,
+            iteration=iteration,
+            tokens=tuple(tokens),
+            index={tok: i for i, tok in enumerate(tokens)},
+            emb=emb,
+            unit=unit,
+            source=path,
+            meta=meta,
+        )
+
+    def refresh(self) -> bool:
+        """Scan the export dir; load and atomically swap in the newest
+        iteration when it is newer than the served one.  Returns whether a
+        swap happened.  Serialized — concurrent refreshes load once."""
+        with self._refresh_lock:
+            newest = discover_newest(self.export_dir, self.dim)
+            if newest is None:
+                return False
+            dim, iteration, path = newest
+            cur = self._model
+            if cur is not None and (iteration, dim) <= (
+                cur.iteration, cur.dim
+            ):
+                return False
+            model = self._load(dim, iteration, path)
+            # one reference assignment IS the swap: in-flight readers keep
+            # the old immutable model, new readers see the new one
+            self._model = model
+        if self.metrics is not None:
+            self.metrics.counter("model_swaps_total").inc()
+            self.metrics.gauge("model_iteration").set(model.iteration)
+            self.metrics.gauge("model_vocab_size").set(len(model))
+        return True
+
+    # -- watching ----------------------------------------------------------
+
+    def start_watcher(self, interval_s: float = 5.0) -> None:
+        """Poll :meth:`refresh` every ``interval_s`` on a daemon thread
+        (load errors are recorded as tracer events, never kill the
+        watcher — a half-written checkpoint retries next poll)."""
+        if self._watcher is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.refresh()
+                except Exception as e:
+                    from gene2vec_tpu.obs import trace
+
+                    tracer = trace.get_tracer()
+                    if tracer is not None:
+                        tracer.event(
+                            "model_refresh_error", error=repr(e)[:200]
+                        )
+
+        self._watcher = threading.Thread(
+            target=loop, name="model-registry-watcher", daemon=True
+        )
+        self._watcher.start()
+
+    def stop_watcher(self) -> None:
+        if self._watcher is None:
+            return
+        self._stop.set()
+        self._watcher.join(timeout=5.0)
+        self._watcher = None

@@ -1,0 +1,491 @@
+"""JSON HTTP API over the registry + engine + batcher stack.
+
+Endpoints (all JSON; schema in docs/SERVING.md):
+
+* ``POST /v1/similar``     — ``{"genes": [...]}`` or ``{"vectors":
+  [[...]]}`` + ``"k"`` -> per-query neighbor lists (gene queries drop
+  the query row itself from its own neighbors);
+* ``POST /v1/embedding``   — raw embedding rows for named genes;
+* ``POST /v1/interaction`` — GGIPNN softmax scores for gene pairs;
+* ``GET  /v1/genes``       — a slice of the served vocab (loadgen uses
+  this to draw realistic query keys);
+* ``GET  /healthz``        — served model version + queue facts;
+* ``GET  /metrics``        — the obs Prometheus registry, text format.
+
+Status mapping: queue-full backpressure -> **429**, per-request deadline
+-> **504**, unknown gene / malformed body -> **400**, no model loaded ->
+**503**.  The handler layer is a thin stdlib ``ThreadingHTTPServer``
+shell; every route is a method on :class:`ServeApp`, which tests drive
+directly and through ephemeral-port HTTP.
+
+Each request runs under an obs span (``serve_request``), batches under
+``serve_batch``/``serve_compute`` (batcher.py) — with a
+:class:`~gene2vec_tpu.obs.run.Run` installed (cli/serve.py always makes
+one) the whole enqueue->batch->compute->respond pipeline lands in that
+run's ``events.jsonl`` and ``/metrics`` serves its registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from gene2vec_tpu.obs.registry import MetricsRegistry
+from gene2vec_tpu.obs.trace import ambient_span
+from gene2vec_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    RejectedError,
+)
+from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.interaction import InteractionScorer
+from gene2vec_tpu.serve.registry import ModelRegistry
+
+
+class ApiError(Exception):
+    """Route failure with an HTTP status."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine/batcher/queue policy knobs (cli/serve.py flags)."""
+
+    max_batch: int = 64
+    max_delay_ms: float = 5.0
+    max_queue: int = 256
+    cache_size: int = 4096
+    timeout_ms: float = 2000.0
+    max_k: int = 256
+    max_queries_per_request: int = 64
+
+
+class ServeApp:
+    """The route layer: owns the registry, engine, batcher, and scorer."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        config: ServeConfig = ServeConfig(),
+        metrics: Optional[MetricsRegistry] = None,
+        ggipnn_checkpoint: Optional[str] = None,
+        mesh=None,
+    ):
+        self.registry = registry
+        self.config = config
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if registry.metrics is None:
+            registry.metrics = self.metrics
+        if registry.loaded:
+            # the registry publishes these on swap; backfill for a model
+            # loaded before the metrics registry was attached
+            self.metrics.gauge("model_iteration").set(
+                registry.model.iteration
+            )
+            self.metrics.gauge("model_vocab_size").set(len(registry.model))
+        # mesh set => the two-stage distributed top-k over the
+        # registry's row-sharded matrix (engine._make_topk_sharded)
+        self.engine = SimilarityEngine(
+            max_batch=config.max_batch, mesh=mesh
+        )
+        self.batcher = MicroBatcher(
+            self._compute_batch,
+            max_batch=config.max_batch,
+            max_delay_s=config.max_delay_ms / 1000.0,
+            max_queue=config.max_queue,
+            cache_size=config.cache_size,
+            default_timeout_s=config.timeout_ms / 1000.0,
+            metrics=self.metrics,
+        )
+        self.ggipnn_checkpoint = ggipnn_checkpoint
+        self._scorer: Optional[InteractionScorer] = None
+        self._scorer_lock = threading.Lock()
+        self._started = time.monotonic()
+
+    def start(self) -> "ServeApp":
+        self.batcher.start()
+        return self
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        self.registry.stop_watcher()
+
+    # -- batch compute (worker thread) ------------------------------------
+
+    def _compute_batch(self, items: List[dict], k_max: int) -> List[dict]:
+        """Resolve every queued query against ONE model snapshot and run
+        the padded top-k.  Items resolved here (not at submit) so a hot
+        swap mid-queue cannot mix two iterations inside one batch."""
+        model = self.registry.model
+        vectors: List[np.ndarray] = []
+        self_rows: List[Optional[int]] = []
+        for item in items:
+            if "gene" in item:
+                row = model.index.get(item["gene"])
+                if row is None:
+                    # swapped away between admission and compute —
+                    # per-item failure, the rest of the batch proceeds
+                    vectors.append(np.zeros(model.dim, np.float32))
+                    self_rows.append(-2)
+                    continue
+                vectors.append(model.emb[row])
+                self_rows.append(row)
+            else:
+                vectors.append(
+                    np.asarray(item["vector"], dtype=np.float32)
+                )
+                self_rows.append(None)
+        # gene queries ask one extra so dropping the self-hit still
+        # leaves k neighbors
+        kq = min(k_max + 1, len(model))
+        neighbors = self.engine.similar_batch(model, vectors, kq)
+        out: List[dict] = []
+        for item, row, hits in zip(items, self_rows, neighbors):
+            if row == -2:
+                out.append(
+                    {"error": f"gene {item['gene']!r} not in the "
+                              f"served model (iteration "
+                              f"{model.iteration})"}
+                )
+                continue
+            if row is not None:
+                gene = model.tokens[row]
+                hits = [h for h in hits if h[0] != gene]
+            out.append(
+                {
+                    "neighbors": [
+                        {"gene": g, "score": round(s, 6)}
+                        for g, s in hits[: item["k"]]
+                    ],
+                    "iteration": model.iteration,
+                }
+            )
+        return out
+
+    # -- routes ------------------------------------------------------------
+
+    def _model_or_503(self):
+        try:
+            return self.registry.model
+        except RuntimeError as e:
+            raise ApiError(503, str(e)) from e
+
+    def _validate_k(self, body: dict) -> int:
+        k = body.get("k", 10)
+        if not isinstance(k, int) or k < 1 or k > self.config.max_k:
+            raise ApiError(
+                400, f"k must be an int in [1, {self.config.max_k}]"
+            )
+        return k
+
+    def similar(self, body: dict) -> dict:
+        model = self._model_or_503()
+        k = self._validate_k(body)
+        timeout_s = self._timeout_s(body)
+        genes = body.get("genes")
+        vectors = body.get("vectors")
+        if (genes is None) == (vectors is None):
+            raise ApiError(
+                400, "provide exactly one of 'genes' or 'vectors'"
+            )
+        queries: List[dict] = []
+        if genes is not None:
+            if not isinstance(genes, list) or not genes:
+                raise ApiError(400, "'genes' must be a non-empty list")
+            unknown = [g for g in genes if g not in model.index]
+            if unknown:
+                raise ApiError(
+                    400,
+                    f"unknown gene(s) {unknown[:5]!r} "
+                    f"(model iteration {model.iteration})",
+                )
+            queries = [{"gene": g, "k": k} for g in genes]
+        else:
+            if not isinstance(vectors, list) or not vectors:
+                raise ApiError(400, "'vectors' must be a non-empty list")
+            for v in vectors:
+                if not isinstance(v, list) or len(v) != model.dim:
+                    raise ApiError(
+                        400,
+                        f"each vector must have dim {model.dim}",
+                    )
+            queries = [{"vector": v, "k": k} for v in vectors]
+        if len(queries) > self.config.max_queries_per_request:
+            raise ApiError(
+                400,
+                f"at most {self.config.max_queries_per_request} queries "
+                "per request",
+            )
+        # submit everything before waiting on anything, so one request's
+        # queries share a batch window instead of paying it per query
+        tickets = []
+        try:
+            for q in queries:
+                cache_key = (
+                    (model.version, "similar", q["gene"], k)
+                    if "gene" in q else None
+                )
+                tickets.append(
+                    (q, self.batcher.submit_async(
+                        q, k, cache_key=cache_key, timeout_s=timeout_s
+                    ))
+                )
+        except RejectedError as e:
+            raise ApiError(429, str(e)) from e
+        results = []
+        for q, ticket in tickets:
+            try:
+                r = ticket.get()
+            except DeadlineExceeded as e:
+                raise ApiError(504, str(e)) from e
+            if "error" in r:
+                raise ApiError(400, r["error"])
+            results.append(
+                {"query": q.get("gene"), "neighbors": r["neighbors"]}
+            )
+        return {
+            "model": {"dim": model.dim, "iteration": model.iteration},
+            "results": results,
+        }
+
+    def embedding(self, body: dict) -> dict:
+        model = self._model_or_503()
+        genes = body.get("genes")
+        if not isinstance(genes, list) or not genes:
+            raise ApiError(400, "'genes' must be a non-empty list")
+        if len(genes) > self.config.max_queries_per_request:
+            raise ApiError(
+                400,
+                f"at most {self.config.max_queries_per_request} genes "
+                "per request",
+            )
+        rows = []
+        for g in genes:
+            row = model.index.get(g)
+            if row is None:
+                raise ApiError(
+                    400,
+                    f"unknown gene {g!r} (model iteration "
+                    f"{model.iteration})",
+                )
+            rows.append(
+                {"gene": g, "vector": [float(v) for v in model.emb[row]]}
+            )
+        return {
+            "model": {"dim": model.dim, "iteration": model.iteration},
+            "embeddings": rows,
+        }
+
+    def _get_scorer(self, model) -> InteractionScorer:
+        """Scorer bound to the served iteration; rebuilt after hot swap."""
+        with self._scorer_lock:
+            if self._scorer is None or self._scorer.version != model.version:
+                with ambient_span(
+                    "scorer_build", iteration=model.iteration
+                ):
+                    self._scorer = InteractionScorer(
+                        model, checkpoint_path=self.ggipnn_checkpoint
+                    )
+            return self._scorer
+
+    def interaction(self, body: dict) -> dict:
+        model = self._model_or_503()
+        pairs = body.get("pairs")
+        if not isinstance(pairs, list) or not pairs or not all(
+            isinstance(p, list) and len(p) == 2 for p in pairs
+        ):
+            raise ApiError(
+                400, "'pairs' must be a non-empty list of [gene, gene]"
+            )
+        if len(pairs) > self.config.max_queries_per_request:
+            raise ApiError(
+                400,
+                f"at most {self.config.max_queries_per_request} pairs "
+                "per request",
+            )
+        scorer = self._get_scorer(model)
+        try:
+            scores = scorer.score([tuple(p) for p in pairs])
+        except KeyError as e:
+            raise ApiError(
+                400,
+                f"unknown gene {e.args[0]!r} (model iteration "
+                f"{model.iteration})",
+            ) from e
+        self.metrics.counter("serve_interaction_pairs_total").inc(
+            len(pairs)
+        )
+        return {
+            "model": {"dim": model.dim, "iteration": model.iteration},
+            "trained_head": scorer.trained,
+            "scores": [
+                {"pair": p, "score": round(s, 6)}
+                for p, s in zip(pairs, scores)
+            ],
+        }
+
+    @staticmethod
+    def _int_param(query: Dict[str, List[str]], name: str,
+                   default: int) -> int:
+        raw = query.get(name, [str(default)])[0]
+        try:
+            return int(raw)
+        except ValueError:
+            raise ApiError(
+                400, f"{name} must be an integer, got {raw!r}"
+            ) from None
+
+    def genes(self, query: Dict[str, List[str]]) -> dict:
+        model = self._model_or_503()
+        limit = self._int_param(query, "limit", 100)
+        offset = self._int_param(query, "offset", 0)
+        if limit < 0 or offset < 0:
+            raise ApiError(400, "limit/offset must be >= 0")
+        return {
+            "total": len(model),
+            "genes": list(model.tokens[offset : offset + limit]),
+        }
+
+    def healthz(self) -> dict:
+        out = {
+            "status": "ok" if self.registry.loaded else "loading",
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "queue_depth": len(self.batcher._q),
+            "max_queue": self.config.max_queue,
+        }
+        if self.registry.loaded:
+            m = self.registry.model
+            out["model"] = {
+                "dim": m.dim,
+                "iteration": m.iteration,
+                "vocab_size": len(m),
+                "source": m.source,
+            }
+        return out
+
+    def _timeout_s(self, body: dict) -> Optional[float]:
+        t = body.get("timeout_ms")
+        if t is None:
+            return None
+        if not isinstance(t, (int, float)) or t <= 0:
+            raise ApiError(400, "timeout_ms must be a positive number")
+        return float(t) / 1000.0
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle(
+        self, method: str, path: str, body: Optional[dict]
+    ) -> Tuple[int, dict]:
+        """(status, payload) for one request.  ``/metrics`` is the only
+        non-JSON route and is dispatched by the handler directly."""
+        url = urlparse(path)
+        route = url.path.rstrip("/") or "/"
+        query = parse_qs(url.query)
+        t0 = time.monotonic()
+        try:
+            with ambient_span("serve_request", route=route) as span:
+                if method == "GET" and route == "/healthz":
+                    return 200, self.healthz()
+                if method == "GET" and route == "/v1/genes":
+                    return 200, self.genes(query)
+                if method == "GET" and route == "/v1/similar":
+                    gene = query.get("gene", [None])[0]
+                    if gene is None:
+                        raise ApiError(400, "missing ?gene= parameter")
+                    k = self._int_param(query, "k", 10)
+                    return 200, self.similar({"genes": [gene], "k": k})
+                if method == "POST" and route == "/v1/similar":
+                    return 200, self.similar(body or {})
+                if method == "POST" and route == "/v1/embedding":
+                    return 200, self.embedding(body or {})
+                if method == "POST" and route == "/v1/interaction":
+                    return 200, self.interaction(body or {})
+                span["status"] = 404
+                return 404, {"error": f"no route {method} {route}"}
+        except ApiError as e:
+            self.metrics.counter(
+                f"serve_http_{e.status}_total"
+            ).inc()
+            return e.status, {"error": str(e)}
+        except Exception as e:  # route crash -> 500, server stays up
+            self.metrics.counter("serve_http_500_total").inc()
+            return 500, {"error": f"internal error: {e!r}"}
+        finally:
+            self.metrics.histogram("serve_handle_seconds").observe(
+                time.monotonic() - t0
+            )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one keep-alive friendly protocol version; loadgen reuses sockets
+    protocol_version = "HTTP/1.1"
+    app: ServeApp  # set by make_server on the server class
+
+    def log_message(self, format: str, *args) -> None:
+        # default writes per-request lines to stderr; serve volume makes
+        # that noise — request accounting lives in /metrics instead
+        pass
+
+    def _reply(self, status: int, payload: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _reply_json(self, status: int, doc: dict) -> None:
+        self._reply(
+            status,
+            json.dumps(doc).encode("utf-8"),
+            "application/json",
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        app = self.server.app  # type: ignore[attr-defined]
+        if urlparse(self.path).path.rstrip("/") == "/metrics":
+            self._reply(
+                200,
+                app.metrics.prometheus_text().encode("utf-8"),
+                "text/plain; version=0.0.4",
+            )
+            return
+        status, doc = app.handle("GET", self.path, None)
+        self._reply_json(status, doc)
+
+    def do_POST(self) -> None:  # noqa: N802
+        app = self.server.app  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length) if length else b"{}"
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            self._reply_json(400, {"error": f"bad JSON body: {e}"})
+            return
+        status, doc = app.handle("POST", self.path, body)
+        self._reply_json(status, doc)
+
+
+def make_server(
+    app: ServeApp, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    """A ``ThreadingHTTPServer`` bound to (host, port) — port 0 picks an
+    ephemeral one (``server.server_address[1]`` has it).  The caller owns
+    the serve loop (``serve_forever`` on a thread for tests, blocking in
+    cli/serve.py) and shutdown ordering: ``server.shutdown()`` then
+    ``app.stop()``."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.app = app  # type: ignore[attr-defined]
+    return server

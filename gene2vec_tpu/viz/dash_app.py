@@ -231,6 +231,32 @@ def load_reactome_table(
     return {rid: list(g) for rid, g in members.items()}, info
 
 
+def fetch_neighbors(
+    serve_url: str, gene: str, k: int = 10, timeout_s: float = 2.0
+) -> Optional[List[Tuple[str, float]]]:
+    """Top-k neighbor list for ``gene`` from a running serve instance
+    (``GET /v1/similar?gene=...&k=...``, see docs/SERVING.md).  Returns
+    ``None`` on ANY failure — server down, unknown gene, bad URL — so
+    callers fall back to the figure-json path instead of crashing the
+    dashboard (stdlib urllib only; no client dependency)."""
+    import urllib.parse
+    import urllib.request
+
+    url = (
+        f"{serve_url.rstrip('/')}/v1/similar?"
+        + urllib.parse.urlencode({"gene": gene, "k": k})
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            doc = json.load(resp)
+        return [
+            (n["gene"], float(n["score"]))
+            for n in doc["results"][0]["neighbors"]
+        ]
+    except Exception:
+        return None
+
+
 def go_description(
     term: GOTerm, member_genes: Sequence[str], gene_rep: str = "Gene Symbol"
 ) -> str:
@@ -331,6 +357,8 @@ def serve(
     port: int = 8050,
     debug: bool = False,
     run: bool = True,
+    serve_url: Optional[str] = None,
+    serve_k: int = 10,
 ):  # pragma: no cover - needs dash + a browser
     """Launch the GeneView dashboard (requires the dash package).
 
@@ -339,7 +367,15 @@ def serve(
     dropdown, read-only description textarea — beside the scatter; dark
     dropdown styling ships as the package's own ``assets/geneview.css``
     (behavioral stand-in for the reference's Darkly overrides).  Pass
-    ``run=False`` to get the wired app back without serving (tests)."""
+    ``run=False`` to get the wired app back without serving (tests).
+
+    With ``serve_url`` (a running ``cli.serve`` instance) the sidebar
+    grows a *Neighbors* search box: typing a gene highlights its live
+    top-``serve_k`` cosine neighbors from ``/v1/similar`` and prints
+    them in the description panel — no pre-exported similarity figure
+    needed.  Lookup failures (server down, unknown gene) degrade to the
+    base coloring; the figure-json annotation dropdowns keep working
+    either way."""
     try:
         import dash
         from dash import dcc, html
@@ -378,6 +414,21 @@ def serve(
                 className="geneview-dropdown",
             )
         ]
+    if serve_url:
+        sidebar_children += [
+            html.Div(
+                [
+                    html.H4("Neighbors", className="display-8"),
+                    html.Hr(),
+                    dcc.Input(
+                        id="gene-search", type="text", debounce=True,
+                        placeholder="gene symbol...",
+                        className="geneview-search",
+                    ),
+                ],
+                className="geneview-dropdown",
+            )
+        ]
     sidebar_children += [
         html.Div(
             [
@@ -402,19 +453,49 @@ def serve(
 
     inputs = [Input(f"dd-{k.lower()}", "value") for k in sources]
     kinds = list(sources)
+    if serve_url:
+        inputs.append(Input("gene-search", "value"))
 
     def _selected(values):
-        """(kind, term) for the triggering dropdown; (None, None) when it
-        was CLEARED (value None) — callers must reset, not no_update, or
-        the near-invisible highlight state sticks forever."""
+        """(kind, term) for the triggering control; kind ``"__serve__"``
+        when it was the neighbor search box; (None, None) when it was
+        CLEARED (value None) — callers must reset, not no_update, or the
+        near-invisible highlight state sticks forever."""
         ctx = dash.callback_context
         trigger = ctx.triggered[0]["prop_id"].split(".")[0]
+        if serve_url and trigger == "gene-search":
+            gene = values[-1]
+            return ("__serve__", gene.strip()) if gene and gene.strip() \
+                else (None, None)
         for kind, value in zip(kinds, values):
             if f"dd-{kind.lower()}" == trigger and value:
                 return kind, value
         return None, None
 
-    if sources:  # a figure-only dashboard has no dropdowns or callbacks
+    # both callbacks (figure + description) fire per keystroke; a short
+    # TTL memo makes them share ONE /v1/similar round trip — and caches
+    # failures too, so an unreachable server blocks one timeout, not two
+    _neighbor_memo: Dict[str, tuple] = {}
+
+    def _neighbor_genes(gene):
+        """The gene + its live neighbors, or None when the serve lookup
+        failed (fall back to base coloring rather than erroring)."""
+        import time
+
+        now = time.monotonic()
+        cached = _neighbor_memo.get(gene)
+        if cached is not None and now - cached[0] < 5.0:
+            hits = cached[1]
+        else:
+            hits = fetch_neighbors(serve_url, gene, serve_k)
+            _neighbor_memo[gene] = (now, hits)
+            while len(_neighbor_memo) > 64:
+                _neighbor_memo.pop(next(iter(_neighbor_memo)))
+        if hits is None:
+            return None, None
+        return [gene] + [g for g, _ in hits], hits
+
+    if sources or serve_url:  # figure-only dashboards have no callbacks
         @app.callback(
             Output("scatter", "figure"), inputs, State("scatter", "figure")
         )
@@ -423,6 +504,9 @@ def serve(
             kind, term = _selected(values)
             if kind is None:  # cleared: restore the base coloring
                 return highlight_genes(fig or figure, [])
+            if kind == "__serve__":
+                genes, _ = _neighbor_genes(term)
+                return highlight_genes(fig or figure, genes or [])
             genes = sources[kind]["members"].get(term, [])
             return highlight_genes(fig or figure, genes)
 
@@ -431,6 +515,16 @@ def serve(
             kind, term = _selected(values)
             if kind is None:
                 return ""
+            if kind == "__serve__":
+                genes, hits = _neighbor_genes(term)
+                if hits is None:
+                    return (
+                        f"{term}: neighbor lookup failed "
+                        f"({serve_url} unreachable or unknown gene)"
+                    )
+                return f"Nearest to {term}:\n" + "\n".join(
+                    f"{g}\t{s:.4f}" for g, s in hits
+                )
             genes = sources[kind]["members"].get(term, [])
             return sources[kind]["describe"](term, genes)
 

@@ -2,6 +2,12 @@
 # graftcheck driver: lint passes + HLO budget checks (+ optional
 # sanitizer parity runs).  Nonzero exit on any gating finding.
 #
+# Coverage spans every compiled hot path: the SGNS/CBOW-HS epochs, the
+# GGIPNN train step, and the serve/ top-k engine (host-callback + dtype
+# + bucketed jit-cache-stability via `--hlo hot`; the row-sharded
+# engine's per-query collective-bytes ceiling via `--hlo budgets`,
+# budgets.json section "serve").
+#
 #   scripts/run_static_analysis.sh                 # lint + tier-2 HLO
 #   scripts/run_static_analysis.sh --fast          # lint only (tier-1 scope)
 #   scripts/run_static_analysis.sh --with-sanitizers   # + asan,ubsan,tsan

@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""Load generator for the serve subsystem: latency/throughput/rejection
+curves vs offered load.
+
+::
+
+    # against a running server
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8000 \
+        --mode open --levels 50,200,800 --duration 5 \
+        --output BENCH_SERVE_r06.json
+
+    # spawn `python -m gene2vec_tpu.cli.serve` on an export dir first
+    python scripts/serve_loadgen.py --spawn exports/ --levels 50,200,800
+
+Two loops:
+
+* **open** — ``--levels`` are offered request rates (rps); arrivals are
+  paced on a fixed schedule regardless of completions, so queue growth /
+  backpressure at overload is visible (429s count into
+  ``rejection_rate``, they never stall the clock);
+* **closed** — ``--levels`` are concurrency (N workers firing
+  back-to-back), the classic saturation-throughput measurement.
+
+Per level: p50/p99/mean latency over successful requests, achieved
+throughput, and the rejected (429) / expired (504) / error counts.  The
+JSON document goes to ``--output`` and stdout (the product — progress
+chatter is stderr-only, matching the repo's stdout discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _http_json(
+    url: str, body: Optional[dict] = None, timeout: float = 10.0
+) -> Dict:
+    data = json.dumps(body).encode("utf-8") if body is not None else None
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+class _Stats:
+    """Thread-safe request accounting for one load level."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latencies_ms: List[float] = []
+        self.ok = 0
+        self.rejected = 0
+        self.expired = 0
+        self.errors = 0
+
+    def record(self, status: int, latency_ms: float) -> None:
+        with self.lock:
+            if status == 200:
+                self.ok += 1
+                self.latencies_ms.append(latency_ms)
+            elif status == 429:
+                self.rejected += 1
+            elif status == 504:
+                self.expired += 1
+            else:
+                self.errors += 1
+
+    @property
+    def total(self) -> int:
+        return self.ok + self.rejected + self.expired + self.errors
+
+
+def _percentile(sorted_values: List[float], q: float) -> Optional[float]:
+    if not sorted_values:
+        return None
+    i = min(len(sorted_values) - 1, int(q * len(sorted_values)))
+    return sorted_values[i]
+
+
+def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
+                 stats: _Stats, timeout_s: float) -> None:
+    body = {"genes": [rng.choice(genes)], "k": k}
+    t0 = time.monotonic()
+    try:
+        req = urllib.request.Request(
+            f"{url}/v1/similar",
+            data=json.dumps(body).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s):
+            pass
+        status = 200
+    except urllib.error.HTTPError as e:
+        status = e.code
+        e.close()
+    except Exception:
+        status = -1
+    stats.record(status, (time.monotonic() - t0) * 1000.0)
+
+
+def run_open_level(url: str, genes: List[str], k: int, rps: float,
+                   duration_s: float, seed: int, timeout_s: float) -> _Stats:
+    """Fixed-schedule arrivals at ``rps`` for ``duration_s``; each
+    arrival gets its own thread so a slow/queued response never delays
+    the next arrival (that is what makes the loop open)."""
+    stats = _Stats()
+    rng = random.Random(seed)
+    threads: List[threading.Thread] = []
+    interval = 1.0 / rps
+    t_start = time.monotonic()
+    n = int(rps * duration_s)
+    for i in range(n):
+        target = t_start + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(
+            target=_one_request,
+            args=(url, genes, k, rng, stats, timeout_s),
+            daemon=True,
+        )
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s + 5.0)
+    stats.wall_s = time.monotonic() - t_start  # type: ignore[attr-defined]
+    return stats
+
+
+def run_closed_level(url: str, genes: List[str], k: int, workers: int,
+                     duration_s: float, seed: int,
+                     timeout_s: float) -> _Stats:
+    """N workers firing back-to-back until the clock runs out."""
+    stats = _Stats()
+    stop = time.monotonic() + duration_s
+
+    def loop(worker_seed: int) -> None:
+        rng = random.Random(worker_seed)
+        while time.monotonic() < stop:
+            _one_request(url, genes, k, rng, stats, timeout_s)
+
+    t_start = time.monotonic()
+    threads = [
+        threading.Thread(target=loop, args=(seed + w,), daemon=True)
+        for w in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + timeout_s + 5.0)
+    stats.wall_s = time.monotonic() - t_start  # type: ignore[attr-defined]
+    return stats
+
+
+def summarize(level: float, stats: _Stats, mode: str) -> Dict:
+    lat = sorted(stats.latencies_ms)
+    wall = getattr(stats, "wall_s", 1.0) or 1.0
+    return {
+        ("offered_rps" if mode == "open" else "concurrency"): level,
+        "requests": stats.total,
+        "ok": stats.ok,
+        "rejected_429": stats.rejected,
+        "expired_504": stats.expired,
+        "errors": stats.errors,
+        "achieved_rps": round(stats.ok / wall, 2),
+        "rejection_rate": round(
+            stats.rejected / stats.total, 4
+        ) if stats.total else None,
+        "p50_ms": round(_percentile(lat, 0.50), 3) if lat else None,
+        "p99_ms": round(_percentile(lat, 0.99), 3) if lat else None,
+        "mean_ms": round(sum(lat) / len(lat), 3) if lat else None,
+        "wall_s": round(wall, 3),
+    }
+
+
+def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
+    """Launch ``python -m gene2vec_tpu.cli.serve`` and parse its one
+    stdout JSON status line for the bound URL."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gene2vec_tpu.cli.serve",
+         "--export-dir", export_dir, "--port", "0", *extra],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(
+            f"serve CLI exited rc={proc.returncode} before reporting a URL"
+        )
+    info = json.loads(line)
+    return proc, info
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve_loadgen",
+        description="Closed/open-loop load generator for the serve API.",
+    )
+    ap.add_argument("--url", default=None, help="target server base URL")
+    ap.add_argument("--spawn", default=None, metavar="EXPORT_DIR",
+                    help="spawn cli.serve on this export dir instead of "
+                         "--url")
+    ap.add_argument("--spawn-arg", action="append", default=[],
+                    help="extra flag passed through to the spawned "
+                         "cli.serve (repeatable)")
+    ap.add_argument("--mode", choices=("open", "closed"), default="open")
+    ap.add_argument("--levels", default="50,200,800",
+                    help="comma-separated offered rps (open) or worker "
+                         "counts (closed)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per level")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--num-genes", type=int, default=256,
+                    help="distinct query genes sampled from /v1/genes")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="client-side socket timeout (s)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--warmup", type=int, default=64,
+                    help="largest warm-up burst; concurrent bursts of "
+                         "1,2,4,...,N give the batcher a chance to form "
+                         "each batch bucket so jit compiles land before "
+                         "the first measured level")
+    ap.add_argument("--output", default="BENCH_SERVE_r06.json")
+    args = ap.parse_args(argv)
+    if (args.url is None) == (args.spawn is None):
+        print("error: provide exactly one of --url / --spawn",
+              file=sys.stderr)
+        return 2
+
+    proc = None
+    try:
+        if args.spawn is not None:
+            proc, info = spawn_server(args.spawn, args.spawn_arg)
+            url = info["url"]
+            print(f"spawned serve at {url} (iteration "
+                  f"{info['iteration']})", file=sys.stderr)
+        else:
+            url = args.url.rstrip("/")
+
+        health = _http_json(f"{url}/healthz", timeout=args.timeout)
+        genes_doc = _http_json(
+            f"{url}/v1/genes?limit={args.num_genes}", timeout=args.timeout
+        )
+        genes = genes_doc["genes"]
+        if not genes:
+            print("error: server reports an empty vocab", file=sys.stderr)
+            return 2
+
+        rng = random.Random(args.seed)
+        burst = 1
+        while burst <= max(1, args.warmup):
+            stats = _Stats()
+            threads = [
+                threading.Thread(
+                    target=_one_request,
+                    args=(url, genes, args.k, rng, stats, args.timeout),
+                    daemon=True,
+                )
+                for _ in range(burst)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=args.timeout + 5.0)
+            burst *= 2
+
+        levels = [float(x) for x in args.levels.split(",") if x]
+        results = []
+        for level in levels:
+            print(f"level {level:g} ({args.mode}) for "
+                  f"{args.duration:g}s ...", file=sys.stderr)
+            if args.mode == "open":
+                stats = run_open_level(
+                    url, genes, args.k, level, args.duration, args.seed,
+                    args.timeout,
+                )
+            else:
+                stats = run_closed_level(
+                    url, genes, args.k, int(level), args.duration,
+                    args.seed, args.timeout,
+                )
+            row = summarize(level, stats, args.mode)
+            print(f"  -> {json.dumps(row)}", file=sys.stderr)
+            results.append(row)
+
+        doc = {
+            "bench": "serve_loadgen",
+            "mode": args.mode,
+            "k": args.k,
+            "duration_s": args.duration,
+            "num_query_genes": len(genes),
+            "server": health.get("model", {}),
+            "levels": results,
+        }
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        # the one stdout line is the product; chatter above is stderr
+        print(json.dumps(doc), file=sys.stdout)
+        return 0
+    finally:
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,10 +1,12 @@
 """graftcheck tier-2 (slow): jaxpr/HLO invariant checks on the hot paths.
 
-Compiles the SGNS epoch, CBOW-HS epoch, and GGIPNN train step on the
-virtual 8-device CPU backend and enforces: no host callbacks, dtype
-discipline, jit cache stability, and the per-mesh collective-bytes
-budgets in gene2vec_tpu/analysis/budgets.json.  Driven standalone by
-``scripts/run_static_analysis.sh`` (or ``cli.analyze --hlo all``).
+Compiles the SGNS epoch, CBOW-HS epoch, GGIPNN train step, and the
+serve top-k engine on the virtual 8-device CPU backend and enforces: no
+host callbacks, dtype discipline, jit cache stability (including across
+the serve engine's bucketed batch shapes), and the per-mesh
+collective-bytes budgets in gene2vec_tpu/analysis/budgets.json.  Driven
+standalone by ``scripts/run_static_analysis.sh`` (or ``cli.analyze
+--hlo all``).
 """
 
 import pytest
@@ -55,11 +57,15 @@ def test_host_callback_detection():
 
 
 def test_hot_paths_clean():
-    """SGNS + CBOW-HS + GGIPNN compiled steps: no host callbacks, no
-    dtype violations, stable jit caches under fresh same-shape inputs."""
+    """SGNS + CBOW-HS + GGIPNN + serve top-k compiled steps: no host
+    callbacks, no dtype violations, stable jit caches under fresh
+    same-shape inputs (and across the serve engine's batch buckets)."""
     findings = hot_path_findings()
     bad = gating(findings)
     assert bad == [], "\n".join(f.format() for f in bad)
+    labels = {f.path for f in findings}
+    assert "hlo:serve" in labels
+    assert "hlo:serve/buckets" in labels
     # the cache checks must actually have RUN — the introspection-
     # unavailable skip also emits this pass_id, so assert on the
     # structured checked flag, not mere presence
@@ -94,17 +100,19 @@ def test_collective_budgets_hold():
     labels = {f.path for f in findings}
     assert "hlo:sgns/data_parallel_8way" in labels
     assert "hlo:sgns/vocab_sharded_8way_dense" in labels
+    assert "hlo:serve/row_sharded_8way" in labels
 
 
 def test_budget_file_documented():
     budgets = load_budgets()
-    for key, entry in budgets["sgns"].items():
-        assert entry["max_bytes_per_pair"] >= entry["reference_bytes_per_pair"], key
-        # headroom stays a budget, not a blank check (< 10%)
-        assert (
-            entry["max_bytes_per_pair"]
-            < entry["reference_bytes_per_pair"] * 1.10
-        ), key
+    units = {"sgns": "bytes_per_pair", "serve": "bytes_per_query"}
+    for section, unit in units.items():
+        assert budgets[section], section
+        for key, entry in budgets[section].items():
+            ref, cap = entry[f"reference_{unit}"], entry[f"max_{unit}"]
+            assert cap >= ref, key
+            # headroom stays a budget, not a blank check (< 10%)
+            assert cap < ref * 1.10, key
 
 
 def test_cache_stability_catches_recompiles():

@@ -1,0 +1,468 @@
+"""Serve subsystem: registry hot swap, engine parity, batcher policy,
+HTTP round trip.  Everything runs on the CPU backend with tiny models
+(conftest pins JAX_PLATFORMS=cpu)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gene2vec_tpu.io.checkpoint import save_iteration
+from gene2vec_tpu.io.emb_io import read_word2vec_format
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    RejectedError,
+)
+from gene2vec_tpu.serve.engine import SimilarityEngine, next_pow2
+from gene2vec_tpu.serve.registry import (
+    ModelRegistry,
+    discover_newest,
+    l2_normalize,
+)
+from gene2vec_tpu.serve.server import (
+    ServeApp,
+    ServeConfig,
+    make_server,
+)
+from gene2vec_tpu.sgns.model import SGNSParams
+
+V, D = 32, 8
+
+
+def _write_iteration(export_dir, iteration, seed):
+    rng = np.random.RandomState(seed)
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    emb = rng.randn(V, D).astype(np.float32)
+    params = SGNSParams(
+        emb=jnp.asarray(emb), ctx=jnp.asarray(np.zeros((V, D), np.float32))
+    )
+    save_iteration(str(export_dir), D, iteration, params, vocab)
+    return emb
+
+
+@pytest.fixture
+def export_dir(tmp_path):
+    d = tmp_path / "exports"
+    _write_iteration(d, 1, seed=1)
+    _write_iteration(d, 2, seed=2)
+    return d
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_loads_newest_iteration(export_dir):
+    reg = ModelRegistry(str(export_dir))
+    assert reg.refresh()
+    m = reg.model
+    assert m.iteration == 2 and m.dim == D and len(m) == V
+    assert m.meta["iteration"] == 2
+    # unit rows are L2-normalized
+    norms = np.linalg.norm(np.asarray(m.unit), axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    # stale rescan is a no-op
+    assert not reg.refresh()
+
+
+def test_registry_hot_swap_is_atomic_under_reader(export_dir):
+    reg = ModelRegistry(str(export_dir))
+    reg.refresh()
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            m = reg.model  # one snapshot; all fields must cohere
+            if not (
+                m.meta["iteration"] == m.iteration
+                and len(m.tokens) == m.emb.shape[0]
+                and m.unit.shape[0] == m.emb.shape[0]
+            ):
+                torn.append(m.iteration)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for it in range(3, 7):
+        _write_iteration(export_dir, it, seed=it)
+        assert reg.refresh()
+        assert reg.model.iteration == it
+    stop.set()
+    t.join(timeout=5)
+    assert torn == []
+
+
+def test_registry_text_format_fallback(export_dir):
+    # strip the npz checkpoints: only the reference-style text exports
+    # remain, exercising the streaming word2vec reader path
+    for p in export_dir.glob("*.npz"):
+        p.unlink()
+    assert discover_newest(str(export_dir))[2].endswith("_w2v.txt")
+    reg = ModelRegistry(str(export_dir))
+    assert reg.refresh()
+    m = reg.model
+    assert m.iteration == 2 and len(m) == V
+    assert m.meta.get("format") == "w2v"
+
+
+def test_registry_empty_dir(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    assert not reg.refresh()
+    with pytest.raises(RuntimeError):
+        reg.model
+
+
+def test_word2vec_streaming_reader_errors(tmp_path):
+    p = tmp_path / "bad_w2v.txt"
+    p.write_text("3 2\nA 1.0 2.0\nB 3.0 4.0\n")
+    with pytest.raises(ValueError, match="header says 3 rows, found 2"):
+        read_word2vec_format(str(p))
+    p.write_text("1 2\nA 1.0 2.0\nB 3.0 4.0\n")
+    with pytest.raises(ValueError, match="header says 1 rows, found 2"):
+        read_word2vec_format(str(p))
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def test_engine_topk_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    unit = jnp.asarray(l2_normalize(rng.randn(V, D).astype(np.float32)))
+    queries = rng.randn(5, D).astype(np.float32)
+    engine = SimilarityEngine(max_batch=8)
+    scores, idx = engine.top_k(unit, queries, k=6)
+    qn = l2_normalize(queries)
+    oracle = qn @ np.asarray(unit).T
+    expect_idx = np.argsort(-oracle, axis=1)[:, :6]
+    np.testing.assert_array_equal(idx, expect_idx)
+    np.testing.assert_allclose(
+        scores, np.take_along_axis(oracle, expect_idx, axis=1), atol=1e-5
+    )
+
+
+def test_engine_valid_mask_hides_pad_rows():
+    rng = np.random.RandomState(0)
+    unit = np.zeros((8, D), np.float32)
+    unit[:5] = l2_normalize(rng.randn(5, D).astype(np.float32))
+    engine = SimilarityEngine(max_batch=4)
+    _, idx = engine.top_k(jnp.asarray(unit), rng.randn(2, D), k=5, valid=5)
+    assert (idx < 5).all()
+
+
+def test_engine_buckets_bound_compiles():
+    engine = SimilarityEngine(max_batch=8)
+    assert engine.buckets == (1, 2, 4, 8)
+    assert [engine.bucket(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(ValueError):
+        engine.bucket(9)
+    assert next_pow2(1) == 1 and next_pow2(5) == 8
+    rng = np.random.RandomState(0)
+    unit = jnp.asarray(l2_normalize(rng.randn(V, D).astype(np.float32)))
+    size0 = engine._cache_size()
+    if size0 is None:
+        pytest.skip("jit cache introspection unavailable")
+    for n in (1, 2, 3, 4, 5, 8):
+        engine.top_k(unit, rng.randn(n, D), k=3)
+    first = engine._cache_size()
+    # same shapes again: the cache must not grow
+    for n in (3, 5, 8, 1):
+        engine.top_k(unit, rng.randn(n, D), k=3)
+    assert engine._cache_size() == first
+    # n=3,4 share bucket 4 and n=5,8 share 8: at most one compile per
+    # bucket (the size counter may be process-global, hence the delta)
+    assert first - size0 <= len(engine.buckets)
+
+
+# -- batcher -----------------------------------------------------------------
+
+
+def _echo_compute(items, k_max):
+    return [(item, k_max) for item in items]
+
+
+def test_batcher_coalesces_within_window():
+    batches = []
+
+    def compute(items, k_max):
+        batches.append(len(items))
+        return _echo_compute(items, k_max)
+
+    b = MicroBatcher(
+        compute, max_batch=8, max_delay_s=0.2, max_queue=32
+    ).start()
+    try:
+        tickets = [b.submit_async(i, 4) for i in range(5)]
+        results = [t.get() for t in tickets]
+        assert [r[0] for r in results] == list(range(5))
+        assert max(batches) >= 2  # coalesced, not all singletons
+    finally:
+        b.stop()
+
+
+def test_batcher_max_batch_closes_window():
+    batches = []
+
+    def compute(items, k_max):
+        batches.append(len(items))
+        return _echo_compute(items, k_max)
+
+    # a huge window: only max_batch can close it
+    b = MicroBatcher(
+        compute, max_batch=4, max_delay_s=5.0, max_queue=32
+    ).start()
+    try:
+        tickets = [b.submit_async(i, 1) for i in range(4)]
+        t0 = time.monotonic()
+        for t in tickets:
+            t.get()
+        assert time.monotonic() - t0 < 2.0  # did not wait out the window
+        assert batches[0] == 4
+    finally:
+        b.stop()
+
+
+def test_batcher_queue_full_rejects():
+    release = threading.Event()
+
+    def compute(items, k_max):
+        release.wait(5.0)
+        return _echo_compute(items, k_max)
+
+    b = MicroBatcher(
+        compute, max_batch=1, max_delay_s=0.0, max_queue=2,
+        default_timeout_s=10.0,
+    ).start()
+    try:
+        first = b.submit_async(0, 1)
+        time.sleep(0.05)  # worker drains it into the blocked batch
+        fillers = [b.submit_async(10 + i, 1) for i in range(2)]
+        with pytest.raises(RejectedError):
+            for i in range(3):
+                b.submit_async(20 + i, 1)
+        release.set()
+        first.get()
+        for t in fillers:
+            t.get()
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_batcher_deadline_expires():
+    def compute(items, k_max):
+        time.sleep(0.3)
+        return _echo_compute(items, k_max)
+
+    b = MicroBatcher(compute, max_batch=4, max_delay_s=0.0).start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            b.submit("x", 1, timeout_s=0.05)
+    finally:
+        b.stop()
+
+
+def test_batcher_lru_cache_hits():
+    calls = []
+
+    def compute(items, k_max):
+        calls.extend(items)
+        return _echo_compute(items, k_max)
+
+    b = MicroBatcher(compute, max_batch=4, max_delay_s=0.0).start()
+    try:
+        r1 = b.submit("q", 3, cache_key=("m", "q", 3))
+        r2 = b.submit("q", 3, cache_key=("m", "q", 3))
+        assert r1 == r2
+        assert calls == ["q"]  # second submit served from cache
+        b.submit("q", 3, cache_key=("m2", "q", 3))
+        assert calls == ["q", "q"]  # new model version misses
+    finally:
+        b.stop()
+
+
+def test_batcher_compute_failure_propagates():
+    def compute(items, k_max):
+        raise RuntimeError("boom")
+
+    b = MicroBatcher(compute, max_batch=4, max_delay_s=0.0).start()
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit("x", 1, timeout_s=1.0)
+    finally:
+        b.stop()
+
+
+# -- HTTP round trip ---------------------------------------------------------
+
+
+@pytest.fixture
+def serving(export_dir):
+    reg = ModelRegistry(str(export_dir))
+    assert reg.refresh()
+    app = ServeApp(
+        reg, ServeConfig(max_batch=8, max_delay_ms=2.0, max_queue=16)
+    ).start()
+    server = make_server(app, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url, reg, app
+    server.shutdown()
+    server.server_close()
+    app.stop()
+
+
+def _post(url, path, body, timeout=10.0):
+    req = urllib.request.Request(
+        f"{url}{path}",
+        data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path, timeout=10.0):
+    try:
+        with urllib.request.urlopen(f"{url}{path}", timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_similar_round_trip(serving):
+    url, reg, _ = serving
+    status, doc = _post(url, "/v1/similar", {"genes": ["G0", "G3"], "k": 4})
+    assert status == 200
+    assert doc["model"]["iteration"] == 2
+    assert len(doc["results"]) == 2
+    for res in doc["results"]:
+        assert len(res["neighbors"]) == 4
+        # gene queries exclude the query row itself
+        assert res["query"] not in [n["gene"] for n in res["neighbors"]]
+    # oracle: best neighbor of G0
+    m = reg.model
+    scores = np.asarray(m.unit) @ np.asarray(m.unit)[0]
+    order = [m.tokens[i] for i in np.argsort(-scores) if i != 0]
+    got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+    assert got == order[:4]
+
+
+def test_http_similar_get_and_errors(serving):
+    url, _, _ = serving
+    status, raw = _get(url, "/v1/similar?gene=G1&k=3")
+    assert status == 200
+    assert len(json.loads(raw)["results"][0]["neighbors"]) == 3
+    assert _post(url, "/v1/similar", {"genes": ["NOPE"]})[0] == 400
+    assert _post(url, "/v1/similar", {"k": 3})[0] == 400
+    assert _post(url, "/v1/similar", {"genes": ["G0"], "k": 0})[0] == 400
+    # malformed query ints are client errors, not route crashes
+    assert _get(url, "/v1/similar?gene=G1&k=abc")[0] == 400
+    assert _get(url, "/v1/genes?limit=abc")[0] == 400
+    assert _get(url, "/nope")[0] == 404
+
+
+def test_http_embedding_and_genes(serving):
+    url, reg, _ = serving
+    status, doc = _post(url, "/v1/embedding", {"genes": ["G5"]})
+    assert status == 200
+    np.testing.assert_allclose(
+        doc["embeddings"][0]["vector"], reg.model.emb[5], atol=1e-6
+    )
+    status, raw = _get(url, "/v1/genes?limit=4&offset=2")
+    assert status == 200
+    doc = json.loads(raw)
+    assert doc["total"] == V
+    assert doc["genes"] == ["G2", "G3", "G4", "G5"]
+
+
+def test_http_interaction(serving):
+    url, _, _ = serving
+    status, doc = _post(
+        url, "/v1/interaction", {"pairs": [["G0", "G1"], ["G2", "G3"]]}
+    )
+    assert status == 200
+    assert doc["trained_head"] is False  # no checkpoint supplied
+    assert len(doc["scores"]) == 2
+    for row in doc["scores"]:
+        assert 0.0 <= row["score"] <= 1.0
+    assert _post(url, "/v1/interaction", {"pairs": [["G0", "NO"]]})[0] == 400
+
+
+def test_interaction_checkpoint_loads_head_not_table(export_dir, tmp_path):
+    """A --ggipnn-checkpoint supplies the MLP head ONLY: its embedding
+    table is row-ordered by the GGIPNN training vocab, so adopting it
+    under served-vocab ids would score silently wrong pairs — and would
+    also freeze scores across hot swaps."""
+    from gene2vec_tpu.models.ggipnn_obs import _flatten_params
+    from gene2vec_tpu.serve.interaction import InteractionScorer
+
+    reg = ModelRegistry(str(export_dir))
+    assert reg.refresh()
+    base = InteractionScorer(reg.model)
+    flat = _flatten_params(base.params)
+    flat["embedding"] = np.zeros_like(flat["embedding"])  # poisoned table
+    marked = {
+        k: (v + 1.0 if k.endswith("kernel") else v)
+        for k, v in flat.items()
+    }
+    ckpt = tmp_path / "model-100.npz"
+    np.savez(str(ckpt), **marked)
+    s = InteractionScorer(reg.model, checkpoint_path=str(ckpt))
+    assert s.trained
+    np.testing.assert_allclose(
+        np.asarray(s.params["embedding"]), reg.model.emb, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(s.params["hidden1"]["kernel"]),
+        np.asarray(base.params["hidden1"]["kernel"]) + 1.0,
+        atol=1e-6,
+    )
+
+
+def test_http_healthz_and_metrics(serving):
+    url, _, _ = serving
+    status, raw = _get(url, "/healthz")
+    doc = json.loads(raw)
+    assert status == 200 and doc["status"] == "ok"
+    assert doc["model"]["iteration"] == 2
+    _post(url, "/v1/similar", {"genes": ["G0"], "k": 2})
+    status, raw = _get(url, "/metrics")
+    assert status == 200
+    text = raw.decode()
+    assert "serve_requests_total" in text
+    assert "model_iteration" in text
+
+
+def test_http_serves_new_iteration_after_swap(serving, export_dir):
+    url, reg, _ = serving
+    emb3 = _write_iteration(export_dir, 3, seed=33)
+    assert reg.refresh()
+    status, doc = _post(url, "/v1/embedding", {"genes": ["G0"]})
+    assert status == 200
+    assert doc["model"]["iteration"] == 3
+    np.testing.assert_allclose(
+        doc["embeddings"][0]["vector"], emb3[0], atol=1e-6
+    )
+
+
+def test_dashboard_fetch_neighbors(serving):
+    from gene2vec_tpu.viz.dash_app import fetch_neighbors
+
+    url, _, _ = serving
+    hits = fetch_neighbors(url, "G0", k=3)
+    assert hits is not None and len(hits) == 3
+    assert all(isinstance(g, str) and isinstance(s, float) for g, s in hits)
+    # every failure mode degrades to None (the figure-json fallback)
+    assert fetch_neighbors(url, "NOPE", k=3) is None
+    assert fetch_neighbors("http://127.0.0.1:9", "G0") is None
