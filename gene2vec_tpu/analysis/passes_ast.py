@@ -429,6 +429,75 @@ class BarePrintPass(Pass):
             )
 
 
+#: disk-touching calls forbidden on an async submit path (resolved
+#: through the module's import table, so ``np.savez`` matches too)
+_BLOCKING_IO_CHAINS = {
+    "os.fsync", "os.fdatasync", "os.replace", "os.rename", "os.link",
+    "numpy.save", "numpy.savez", "numpy.savez_compressed",
+    "json.dump", "shutil.copy", "shutil.copyfile", "shutil.move",
+}
+
+
+class CkptBlockingIOPass(Pass):
+    """No blocking disk I/O on an async writer's submit path.
+
+    The resilience async-checkpoint contract (docs/RESILIENCE.md): a
+    ``submit``/``submit_*`` method is the producer side of a
+    staging-queue handoff — the train loop (or request path) calls it
+    every cycle, and its whole point is that the expensive work happens
+    on the consumer thread.  A file ``open()``, an ``os.fsync``/
+    ``os.replace``, an ``np.savez`` or a ``.block_until_ready()``
+    sneaking into a submit body silently re-serializes the caller on
+    disk (or device) latency — exactly the stall the async writer
+    exists to remove, and invisible in tests that use tiny tables.
+    Heavy lifting belongs in the closure the submit *enqueues* (a
+    lambda/def handed over is a nested scope, which this pass does not
+    descend into) or on the worker thread.
+    """
+
+    id = "ckpt-blocking-io"
+    title = "blocking disk I/O on an async submit hot path"
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        imports = mod.imports()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (node.name == "submit" or node.name.startswith("submit_")):
+                continue
+            for sub in _iter_own_body(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if isinstance(fn, ast.Name) and fn.id == "open":
+                    yield self.finding(
+                        mod, sub,
+                        f"open() inside '{node.name}' blocks the submit "
+                        "hot path on disk; move file I/O into the "
+                        "enqueued closure / writer thread",
+                    )
+                    continue
+                if isinstance(fn, ast.Attribute) and fn.attr == "block_until_ready":
+                    yield self.finding(
+                        mod, sub,
+                        f".block_until_ready() inside '{node.name}' "
+                        "serializes the submit hot path on the device "
+                        "stream; stage the host copy and return",
+                    )
+                    continue
+                chain = chain_of(fn)
+                if chain is None:
+                    continue
+                resolved = resolve_chain(chain, imports)
+                if resolved in _BLOCKING_IO_CHAINS:
+                    yield self.finding(
+                        mod, sub,
+                        f"{chain}(...) inside '{node.name}' blocks the "
+                        "submit hot path on disk; move it into the "
+                        "enqueued closure / writer thread",
+                    )
+
+
 ALL_PASSES = (
     BarePrintPass(),
     HostSyncInJitPass(),
@@ -436,4 +505,5 @@ ALL_PASSES = (
     TracerLeakPass(),
     JitRecompileHazardPass(),
     MissingDonatePass(),
+    CkptBlockingIOPass(),
 )

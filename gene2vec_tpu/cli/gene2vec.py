@@ -106,6 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-txt-output", action="store_true",
         help="skip matrix-txt / word2vec-format exports per iteration",
     )
+    p.add_argument(
+        "--async-checkpoint", action="store_true",
+        help="write per-iteration checkpoints on the resilience/ "
+             "background writer (disk I/O overlaps the next epoch; "
+             "docs/RESILIENCE.md); jax sgns backend only",
+    )
     return p
 
 
@@ -132,6 +138,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         hs_dense_depth=args.hs_dense_depth,
         vocab_sharded=args.vocab_sharded,
         txt_output=not args.no_txt_output,
+        async_checkpoint=args.async_checkpoint,
     )
 
     from gene2vec_tpu.data.pipeline import PairCorpus
@@ -181,7 +188,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         trainer = make_backend_trainer(corpus, config, backend=args.backend)
 
-    trainer.run(args.export_dir)
+    # SIGTERM/SIGINT → finish the iteration, commit its checkpoint, exit
+    # EXIT_PREEMPTED so schedulers can tell "resume me" from failure
+    # (docs/RESILIENCE.md)
+    from gene2vec_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
+
+    with PreemptionHandler() as handler:
+        trainer.run(args.export_dir, preempt=handler)
+    if handler.triggered:
+        print(
+            f"preempted (signal {handler.received}); checkpoints are "
+            "committed — rerun the same command to resume",
+            file=sys.stderr,
+        )
+        return EXIT_PREEMPTED
     return 0
 
 

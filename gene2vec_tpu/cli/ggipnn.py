@@ -63,8 +63,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed,
     )
     from gene2vec_tpu.models.ggipnn_train import run_classification
+    from gene2vec_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
 
-    run_classification(args.data_dir, args.emb, config, run_dir=args.run_dir)
+    with PreemptionHandler() as handler:
+        run_classification(
+            args.data_dir, args.emb, config, run_dir=args.run_dir,
+            preempt=handler,
+        )
+    if handler.triggered:
+        # 113 here means "drained cleanly", NOT "resume me": this
+        # harness has no resume path — a rerun retrains from scratch
+        # (--run-dir step checkpoints are artifacts for analysis, not
+        # resume points).  docs/RESILIENCE.md exit-code table.
+        print(
+            f"preempted (signal {handler.received}); training drained "
+            "cleanly. NOTE: ggipnn has no resume path — rerunning "
+            "restarts training"
+            + (
+                " (step checkpoints are under --run-dir)"
+                if args.run_dir
+                else ""
+            ),
+            file=sys.stderr,
+        )
+        return EXIT_PREEMPTED
     return 0
 
 

@@ -185,6 +185,15 @@ class SGNSConfig:
                                    # per-epoch permutation; a V-row random
                                    # gather per epoch, latency-bound on TPU)
     txt_output: bool = True        # also export matrix-txt + w2v-format per iter
+    async_checkpoint: bool = False
+                                   # per-iteration checkpoints written by the
+                                   # resilience/ double-buffered background
+                                   # writer: the train loop stages a host copy
+                                   # and moves on; disk I/O overlaps the next
+                                   # epoch (docs/RESILIENCE.md).  jax SGNS
+                                   # trainer only; the CPU oracle backends
+                                   # ignore it (their epochs are host-bound
+                                   # anyway).
 
     # parallelism
     data_axis: str = "data"

@@ -12,6 +12,19 @@ Layout in <export_dir>:
     gene2vec_dim_<D>_iter_<N>.npz           emb, ctx, meta json
     gene2vec_dim_<D>_iter_<N>.txt           matrix-txt export
     gene2vec_dim_<D>_iter_<N>_w2v.txt       word2vec-format export
+    gene2vec_dim_<D>_iter_<N>.MANIFEST.json crc/size stamp (commit record)
+
+Crash safety (docs/RESILIENCE.md): every file is written to a temp name
+and atomically renamed into place, and the iteration's ``MANIFEST`` —
+CRC32 + byte size of every artifact, written LAST — is the commit
+record.  Discovery with ``verified_only`` skips any iteration whose
+manifest is missing (killed mid-save), torn, or disagrees with the
+bytes on disk (truncated/bit-rotted after commit), so a resuming
+trainer or the serve watcher falls back to the newest iteration that
+actually verifies.  Checkpoints that predate manifests (the reference
+scripts' text-only layout, pre-upgrade export dirs) are accepted as-is
+— per dim, an unmanifested iteration older than the dim's first
+manifested one is legacy, not torn (see ``_verified_entries``).
 """
 
 from __future__ import annotations
@@ -25,37 +38,117 @@ import numpy as np
 
 from gene2vec_tpu.io.emb_io import write_matrix_txt, write_word2vec_format
 from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.resilience import snapshot as snap
 from gene2vec_tpu.sgns.model import SGNSParams
 
 _CKPT_RE = re.compile(r"^gene2vec_dim_(\d+)_iter_(\d+)\.npz$")
 _W2V_RE = re.compile(r"^gene2vec_dim_(\d+)_iter_(\d+)_w2v\.txt$")
+_MANIFEST_RE = re.compile(
+    r"^gene2vec_dim_(\d+)_iter_(\d+)" + re.escape(snap.MANIFEST_SUFFIX) + r"$"
+)
 
 
-def iter_checkpoints(export_dir: str, text_fallback: bool = False):
-    """Yield ``(dim, iteration, path)`` for every checkpoint in
-    ``export_dir`` under this module's naming scheme — the discovery
-    primitive the serve registry polls.  With ``text_fallback`` the
-    word2vec-format text exports (``*_w2v.txt``) are yielded too, so
-    export dirs produced by the reference scripts (text only, no
-    ``.npz``) are still discoverable; npz checkpoints for the same
-    (dim, iteration) shadow their text twin."""
-    if not os.path.isdir(export_dir):
-        return
-    seen = set()
+def _scan(export_dir: str, text_fallback: bool):
+    """One directory listing → (candidate entries, manifested keys).
+    Entries are ``(dim, iteration, path, prefix)`` in name order, with
+    npz checkpoints shadowing their text twins (both share the same
+    prefix, hence the same manifest); ``manifested`` is the set of
+    (dim, iteration) keys that carry a manifest file."""
     names = sorted(os.listdir(export_dir))
+    manifested = set()
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            manifested.add((int(m.group(1)), int(m.group(2))))
+    entries = []
+    seen = set()
     for name in names:
         m = _CKPT_RE.match(name)
         if m:
             key = (int(m.group(1)), int(m.group(2)))
             seen.add(key)
-            yield (*key, os.path.join(export_dir, name))
+            path = os.path.join(export_dir, name)
+            entries.append((*key, path, path[: -len(".npz")]))
     if text_fallback:
         for name in names:
             m = _W2V_RE.match(name)
             if m:
                 key = (int(m.group(1)), int(m.group(2)))
                 if key not in seen:
-                    yield (*key, os.path.join(export_dir, name))
+                    path = os.path.join(export_dir, name)
+                    entries.append((*key, path, path[: -len("_w2v.txt")]))
+    return entries, manifested
+
+
+def _verified_entries(entries, manifested, verified_only: bool):
+    """Lazily filter scan entries through the manifest contract.
+
+    With ``verified_only``, an iteration that HAS a manifest must pass
+    CRC/size verification (a torn export silently falls back to the
+    previous one).  An iteration WITHOUT a manifest is either *legacy*
+    — written before this dim adopted manifests, i.e. strictly older
+    than the dim's first manifested iteration — and accepted as-is, or
+    *uncommitted* — at/after the adoption point, meaning the writer
+    died between the artifacts and the commit record — and skipped.
+    Scoped per dim: another dim's manifests say nothing about this
+    one's history.  Lazy on purpose: verification CRCs the artifact
+    bytes, so consumers that stop at the first hit
+    (``latest_iteration``, the registry's newest-first scan) pay for
+    one checkpoint, not the whole history."""
+    if not verified_only:
+        for dim, it, path, _ in entries:
+            yield (dim, it, path)
+        return
+    first_manifested: dict = {}
+    for d, i in manifested:
+        if d not in first_manifested or i < first_manifested[d]:
+            first_manifested[d] = i
+    for dim, it, path, prefix in entries:
+        if (dim, it) in manifested:
+            if snap.verify_manifest(prefix):
+                yield (dim, it, path)
+        elif dim not in first_manifested or it < first_manifested[dim]:
+            yield (dim, it, path)  # legacy pre-manifest checkpoint
+        # else: files without a commit record, newer than the dim's
+        # manifest adoption → died mid-save → skip
+
+
+def iter_checkpoints(
+    export_dir: str,
+    text_fallback: bool = False,
+    verified_only: bool = False,
+):
+    """Yield ``(dim, iteration, path)`` for every checkpoint in
+    ``export_dir`` under this module's naming scheme — the discovery
+    primitive the serve registry polls.  With ``text_fallback`` the
+    word2vec-format text exports (``*_w2v.txt``) are yielded too, so
+    export dirs produced by the reference scripts (text only, no
+    ``.npz``) are still discoverable; npz checkpoints for the same
+    (dim, iteration) shadow their text twin.  ``verified_only`` applies
+    the manifest contract (see :func:`_verified_entries`)."""
+    if not os.path.isdir(export_dir):
+        return
+    entries, manifested = _scan(export_dir, text_fallback)
+    yield from _verified_entries(entries, manifested, verified_only)
+
+
+def iter_checkpoints_newest_first(
+    export_dir: str,
+    text_fallback: bool = False,
+    verified_only: bool = False,
+    dim: Optional[int] = None,
+):
+    """Like :func:`iter_checkpoints` but ordered newest first (highest
+    iteration; ties broken by larger dim) and verified LAZILY — taking
+    the first yielded candidate costs one manifest check, not a CRC
+    sweep of the whole export history."""
+    if not os.path.isdir(export_dir):
+        return
+    entries, manifested = _scan(export_dir, text_fallback)
+    if dim is not None:
+        entries = [e for e in entries if e[0] == dim]
+    entries.sort(key=lambda e: (e[1], e[0]), reverse=True)
+    yield from _verified_entries(entries, manifested, verified_only)
 
 
 def ckpt_prefix(export_dir: str, dim: int, iteration: int) -> str:
@@ -82,7 +175,7 @@ def save_iteration(
                 "checkpoints with mismatched vocabularies in one export dir"
             )
     else:
-        vocab.save(vocab_path)
+        snap.atomic_write_via(vocab.save, vocab_path)
     prefix = ckpt_prefix(export_dir, dim, iteration)
     # npz has no bfloat16 dtype: store f32 (a lossless upcast of bf16
     # tables — every bf16 value is exactly representable) and record the
@@ -97,10 +190,27 @@ def save_iteration(
         vocab_size=len(vocab),
         table_dtype=table_dtype,
     )
-    np.savez(prefix + ".npz", emb=emb, ctx=ctx, meta=json.dumps(meta))
+    # every artifact lands atomically (temp + fsync + rename), then the
+    # manifest commits the iteration as a whole — a reader discovering
+    # with verified_only never sees a half-written iteration
+    snap.atomic_savez(prefix + ".npz", emb=emb, ctx=ctx, meta=json.dumps(meta))
+    files = [prefix + ".npz", vocab_path]
+    optional = []
     if txt_output:
-        write_matrix_txt(prefix + ".txt", vocab.id_to_token, emb)
-        write_word2vec_format(prefix + "_w2v.txt", vocab.id_to_token, emb)
+        snap.atomic_write_via(
+            lambda p: write_matrix_txt(p, vocab.id_to_token, emb),
+            prefix + ".txt",
+        )
+        snap.atomic_write_via(
+            lambda p: write_word2vec_format(p, vocab.id_to_token, emb),
+            prefix + "_w2v.txt",
+        )
+        # optional: corruption of a text twin is detected while it
+        # exists, but deleting the (large) convenience exports must not
+        # un-commit the npz checkpoint
+        optional = [prefix + ".txt", prefix + "_w2v.txt"]
+        files += optional
+    snap.write_manifest(prefix, files, meta=meta, optional=optional)
     return prefix + ".npz"
 
 
@@ -140,13 +250,19 @@ def load_iteration(
     return SGNSParams(emb=emb, ctx=ctx), vocab, meta
 
 
-def latest_iteration(export_dir: str, dim: int) -> int:
-    """Highest saved iteration for ``dim`` in ``export_dir``, or 0."""
-    best = 0
-    if not os.path.isdir(export_dir):
-        return 0
-    for name in os.listdir(export_dir):
-        m = _CKPT_RE.match(name)
-        if m and int(m.group(1)) == dim:
-            best = max(best, int(m.group(2)))
-    return best
+def latest_iteration(
+    export_dir: str, dim: int, verified_only: bool = True
+) -> int:
+    """Highest saved iteration for ``dim`` in ``export_dir``, or 0.
+
+    Routed through the manifest check by default: a torn newest export
+    (killed mid-save, truncated, bit-rotted) is skipped so resume picks
+    the newest iteration that actually verifies — the fallback the
+    chaos drill's kill-at-random-step relies on.  Newest-first + lazy,
+    so the common case (intact newest) verifies exactly one
+    checkpoint."""
+    for _, it, _ in iter_checkpoints_newest_first(
+        export_dir, verified_only=verified_only, dim=dim
+    ):
+        return it
+    return 0

@@ -175,20 +175,28 @@ class GGIPNNTrainer:
         log: Callable[[str], None] = print,
         checkpoint_fn: Optional[Callable[[int, dict], None]] = None,
         run=None,
+        preempt=None,
     ) -> Tuple[dict, optax.OptState]:
         """Train.  With ``run`` (a :class:`~gene2vec_tpu.models.ggipnn_obs.
         GGIPNNRun`) the reference's observed step loop runs regardless of
         ``scan_fit``: per-step train summaries with grad histograms/
         sparsity, dev summaries every ``evaluate_every``, checkpoints every
         ``checkpoint_every`` keeping 5 (``src/GGIPNN_Classification.py:
-        129-163,216-222``)."""
+        129-163,216-222``).
+
+        ``preempt`` (a resilience ``PreemptionHandler``) drains the step
+        loop cooperatively: the in-flight step finishes, a final
+        checkpoint is forced through ``checkpoint_fn``/``run`` so no
+        progress past the last cadence checkpoint is lost, and the
+        partially trained state returns (docs/RESILIENCE.md)."""
         cfg = self.config
         params, opt_state = getattr(self, "_state", (None, None))
         if params is None:
             params, opt_state = self.init_state()
         if cfg.scan_fit and checkpoint_fn is None and run is None:
             return self._fit_scanned(
-                params, opt_state, x_train, y_train, x_valid, y_valid, log
+                params, opt_state, x_train, y_train, x_valid, y_valid, log,
+                preempt=preempt,
             )
         import time
 
@@ -232,11 +240,22 @@ class GGIPNNTrainer:
                     checkpoint_fn(self._step, params)
                 if run is not None:
                     run.checkpoint(self._step, params)
+            if preempt is not None and preempt.triggered:
+                # drain: force a checkpoint at THIS step (the cadence one
+                # may be hundreds of steps back) and stop
+                log(f"preemption requested; drained after step {self._step}")
+                if self._step % cfg.checkpoint_every != 0:
+                    if checkpoint_fn is not None:
+                        checkpoint_fn(self._step, params)
+                    if run is not None:
+                        run.checkpoint(self._step, params)
+                break
         self._state = (params, opt_state)
         return params, opt_state
 
     def _fit_scanned(
-        self, params, opt_state, x_train, y_train, x_valid, y_valid, log
+        self, params, opt_state, x_train, y_train, x_valid, y_valid, log,
+        preempt=None,
     ) -> Tuple[dict, optax.OptState]:
         """Scanned-epoch fast path: per-epoch dev evaluation instead of the
         reference's every-200-steps cadence (set scan_fit=False or pass a
@@ -255,6 +274,9 @@ class GGIPNNTrainer:
         num_batches = x.shape[0] // bs
         key = jax.random.PRNGKey(cfg.seed + 1)
         for epoch in range(cfg.num_epochs):
+            if preempt is not None and preempt.triggered:
+                log(f"preemption requested; drained after epoch {epoch}")
+                break
             params, opt_state, loss, acc = self._fit_epoch_scanned(
                 params, opt_state, x, y, num_batches,
                 jax.random.fold_in(key, epoch),
@@ -342,6 +364,7 @@ def run_classification(
     config: GGIPNNConfig = GGIPNNConfig(),
     log: Callable[[str], None] = print,
     run_dir: Optional[str] = None,
+    preempt=None,
 ) -> Dict[str, float]:
     """End-to-end: the reference's main flow
     (``src/GGIPNN_Classification.py:40-254``) over a ``predictionData/``-shaped
@@ -374,23 +397,41 @@ def run_classification(
 
         run = GGIPNNRun(run_dir, config=config)
         log(f"Writing to {run.out_dir}")
+    def drained() -> bool:
+        return preempt is not None and preempt.triggered
+
     try:
         if run is not None:
             with run.obs.span("fit", train_examples=len(enc["train"][0])):
                 params, _ = trainer.fit(
-                    *enc["train"], *enc["valid"], log=log, run=run
+                    *enc["train"], *enc["valid"], log=log, run=run,
+                    preempt=preempt,
                 )
-            with run.obs.span("test_eval"):
-                result = trainer.evaluate(params, *enc["test"])
-            run.obs.event("test_result", **result)
-            run.obs.probe()
+            if drained():
+                # the grace window is for draining, not for a full
+                # test-set pass over a half-trained model
+                result = {"interrupted": True}
+            else:
+                with run.obs.span("test_eval"):
+                    result = trainer.evaluate(params, *enc["test"])
+                run.obs.event("test_result", **result)
+                run.obs.probe()
         else:
-            params, _ = trainer.fit(*enc["train"], *enc["valid"], log=log)
-            result = trainer.evaluate(params, *enc["test"])
+            params, _ = trainer.fit(
+                *enc["train"], *enc["valid"], log=log, preempt=preempt
+            )
+            result = (
+                {"interrupted": True}
+                if drained()
+                else trainer.evaluate(params, *enc["test"])
+            )
     finally:
         if run is not None:
+            if preempt is not None and preempt.triggered:
+                run.obs.mark_interrupted("signal", signal=preempt.received)
             run.close()
-    log(f"test accuracy: {result['accuracy']:.4f}")
+    if "accuracy" in result:
+        log(f"test accuracy: {result['accuracy']:.4f}")
     if "auc" in result:
         log(f"The AUC score is {result['auc']:.6f}")
     return result

@@ -204,6 +204,14 @@ class Run:
         self.manifest.update(fields)
         self._write_manifest()
 
+    def mark_interrupted(self, reason: str = "preempted", **fields) -> None:
+        """Stamp the on-disk manifest ``interrupted=true`` — the
+        preemption-drain contract (docs/RESILIENCE.md): a resumed run
+        can tell a drained predecessor from one that finished, and
+        dashboards can count preemptions per run dir."""
+        self.annotate(interrupted=True, interrupted_reason=reason, **fields)
+        self.tracer.event("interrupted", reason=reason, **fields)
+
     def annotate_backend(self) -> None:
         """Merge live backend facts into the manifest — for callers that
         construct with ``probe_devices=False`` (to keep jax uninitialized

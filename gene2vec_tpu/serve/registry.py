@@ -13,6 +13,15 @@ Export dirs produced by the reference scripts carry only the text
 exports; the registry falls back to the word2vec-format twin
 (``*_w2v.txt``) through the streaming preallocating reader in
 ``io/emb_io.py``.
+
+Crash safety (docs/RESILIENCE.md): discovery runs manifest-verified, so
+a torn or bit-rotted newest export is filtered before it is ever read;
+a checkpoint that verifies but still fails to load (vocab mismatch,
+rotted bytes whose stamp was forged, deleted mid-load) is retried with
+exponential backoff and, after ``quarantine_after`` consecutive
+failures, quarantined — the watcher keeps serving the last good
+snapshot and falls back to the next-newest candidate instead of letting
+one bad directory entry kill polling.
 """
 
 from __future__ import annotations
@@ -21,13 +30,22 @@ import dataclasses
 import json
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from gene2vec_tpu.io.checkpoint import iter_checkpoints
+from gene2vec_tpu.io.checkpoint import iter_checkpoints_newest_first
 from gene2vec_tpu.io.emb_io import read_word2vec_format
 from gene2vec_tpu.obs.trace import ambient_span
+
+
+def _trace_event(name: str, **attrs) -> None:
+    from gene2vec_tpu.obs import trace
+
+    tracer = trace.get_tracer()
+    if tracer is not None:
+        tracer.event(name, **attrs)
 
 
 def l2_normalize(matrix: np.ndarray, eps: float = 1e-12) -> np.ndarray:
@@ -79,19 +97,26 @@ class LoadedModel:
         return len(self.tokens)
 
 
+def discover_candidates(
+    export_dir: str, dim: Optional[int] = None, verified_only: bool = True
+):
+    """Lazy iterator of loadable ``(dim, iteration, path)`` candidates,
+    newest first (highest iteration wins; among equal iterations the
+    largest dim).  ``dim`` restricts the scan to one table width.
+    ``verified_only`` filters through the checkpoint manifests — torn
+    exports never appear as candidates at all, and because the filter
+    is lazy, consumers that stop at the first acceptable candidate CRC
+    one checkpoint, not the whole export history."""
+    return iter_checkpoints_newest_first(
+        export_dir, text_fallback=True, verified_only=verified_only, dim=dim
+    )
+
+
 def discover_newest(
-    export_dir: str, dim: Optional[int] = None
+    export_dir: str, dim: Optional[int] = None, verified_only: bool = True
 ) -> Optional[Tuple[int, int, str]]:
-    """Newest ``(dim, iteration, path)`` in ``export_dir`` — highest
-    iteration wins; among equal iterations the largest dim.  ``dim``
-    restricts the scan to one table width."""
-    best: Optional[Tuple[int, int, str]] = None
-    for d, it, path in iter_checkpoints(export_dir, text_fallback=True):
-        if dim is not None and d != dim:
-            continue
-        if best is None or (it, d) > (best[1], best[0]):
-            best = (d, it, path)
-    return best
+    """Newest verified ``(dim, iteration, path)`` in ``export_dir``."""
+    return next(discover_candidates(export_dir, dim, verified_only), None)
 
 
 def _load_npz(path: str) -> Tuple[List[str], np.ndarray, Dict]:
@@ -120,8 +145,16 @@ class ModelRegistry:
     :func:`gene2vec_tpu.parallel.sharding.row_sharding`) places the
     normalized matrix when given; default is the backend's default
     placement.  ``metrics`` (an obs ``MetricsRegistry``) receives
-    ``model_iteration`` / ``model_vocab_size`` gauges and a
-    ``model_swaps_total`` counter.
+    ``model_iteration`` / ``model_vocab_size`` / ``model_quarantined``
+    gauges and ``model_swaps_total`` / ``model_load_failures_total``
+    counters.
+
+    A candidate that fails to load is retried with exponential backoff
+    (``retry_backoff_s`` doubling per consecutive failure, capped at
+    5 min) and quarantined after ``quarantine_after`` failures;
+    meanwhile ``refresh`` falls back to the next-newest verified
+    candidate, and the served model — immutable, already resident —
+    stays up regardless.
     """
 
     def __init__(
@@ -130,15 +163,26 @@ class ModelRegistry:
         dim: Optional[int] = None,
         sharding=None,
         metrics=None,
+        retry_backoff_s: float = 2.0,
+        quarantine_after: int = 3,
     ):
         self.export_dir = export_dir
         self.dim = dim
         self.sharding = sharding
         self.metrics = metrics
+        self.retry_backoff_s = retry_backoff_s
+        self.quarantine_after = quarantine_after
         self._model: Optional[LoadedModel] = None
         self._refresh_lock = threading.Lock()
         self._watcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # path -> (consecutive failures, stat signature at last failure):
+        # failure verdicts apply to BYTES, not names — a checkpoint
+        # rewritten under the same name sheds its failure count, backoff
+        # window, and quarantine alike
+        self._failures: Dict[str, Tuple[int, Optional[Tuple]]] = {}
+        self._next_retry: Dict[str, float] = {}
+        self._quarantined: Dict[str, Tuple[str, Optional[Tuple]]] = {}
 
     # -- reading -----------------------------------------------------------
 
@@ -196,21 +240,102 @@ class ModelRegistry:
             meta=meta,
         )
 
+    @staticmethod
+    def _stat_sig(path: str) -> Optional[Tuple]:
+        from gene2vec_tpu.resilience.snapshot import stat_sig
+
+        return stat_sig(path)
+
+    def _record_failure(self, path: str, err: BaseException) -> None:
+        n = self._failures.get(path, (0, None))[0] + 1
+        self._failures[path] = (n, self._stat_sig(path))
+        # exponential backoff per consecutive failure, capped: a flapping
+        # NFS mount retries gently, a genuinely bad file stops costing a
+        # load attempt every poll
+        self._next_retry[path] = time.monotonic() + min(
+            self.retry_backoff_s * (2 ** (n - 1)), 300.0
+        )
+        if self.metrics is not None:
+            self.metrics.counter("model_load_failures_total").inc()
+        _trace_event(
+            "model_load_error", path=path, attempt=n, error=repr(err)[:200]
+        )
+        if n >= self.quarantine_after and path not in self._quarantined:
+            self._quarantined[path] = (repr(err)[:200], self._stat_sig(path))
+            _trace_event("model_quarantined", path=path, error=repr(err)[:200])
+            if self.metrics is not None:
+                self.metrics.gauge("model_quarantined").set(
+                    len(self._quarantined)
+                )
+
+    def _clear_failure_state(self, path: str) -> None:
+        self._failures.pop(path, None)
+        self._next_retry.pop(path, None)
+        if self._quarantined.pop(path, None) is not None:
+            _trace_event("model_quarantine_cleared", path=path)
+            if self.metrics is not None:
+                self.metrics.gauge("model_quarantined").set(
+                    len(self._quarantined)
+                )
+
+    def _skip_for_failures(self, path: str, now: float) -> bool:
+        """Whether refresh should pass over this candidate because of
+        earlier failures — quarantine or an open backoff window.  Every
+        verdict is pinned to the bytes it judged: if the file changed
+        (or was replaced) since, the slate is wiped and the candidate
+        gets a fresh attempt."""
+        recorded = self._quarantined.get(path) or self._failures.get(path)
+        if recorded is None:
+            return False
+        if self._stat_sig(path) != recorded[1]:
+            self._clear_failure_state(path)
+            return False
+        if path in self._quarantined:
+            return True
+        return now < self._next_retry.get(path, 0.0)
+
+    def _gc_failure_state(self) -> None:
+        """Drop failure records for paths that no longer exist — a
+        long-lived server churning through exports must not accumulate
+        bookkeeping forever."""
+        for path in list(self._failures) + list(self._quarantined):
+            if not os.path.exists(path):
+                self._clear_failure_state(path)
+
+    @property
+    def quarantined(self) -> Dict[str, str]:
+        """Quarantined checkpoint paths → last error (diagnostics)."""
+        return {p: reason for p, (reason, _) in self._quarantined.items()}
+
     def refresh(self) -> bool:
-        """Scan the export dir; load and atomically swap in the newest
-        iteration when it is newer than the served one.  Returns whether a
-        swap happened.  Serialized — concurrent refreshes load once."""
+        """Scan the export dir (manifest-verified); load and atomically
+        swap in the newest candidate newer than the served one, falling
+        back through older candidates when the newest fails to load.
+        Returns whether a swap happened.  Serialized — concurrent
+        refreshes load once.  Load failures are counted/backed off, not
+        raised: the caller keeps its last good model."""
         with self._refresh_lock:
-            newest = discover_newest(self.export_dir, self.dim)
-            if newest is None:
-                return False
-            dim, iteration, path = newest
+            self._gc_failure_state()
+            candidates = discover_candidates(self.export_dir, self.dim)
             cur = self._model
-            if cur is not None and (iteration, dim) <= (
-                cur.iteration, cur.dim
-            ):
+            now = time.monotonic()
+            model = None
+            for dim, iteration, path in candidates:
+                if cur is not None and (iteration, dim) <= (
+                    cur.iteration, cur.dim
+                ):
+                    break  # nothing newer than the served model remains
+                if self._skip_for_failures(path, now):
+                    continue
+                try:
+                    model = self._load(dim, iteration, path)
+                except Exception as e:
+                    self._record_failure(path, e)
+                    continue  # fall back to the next-newest candidate
+                self._clear_failure_state(path)
+                break
+            if model is None:
                 return False
-            model = self._load(dim, iteration, path)
             # one reference assignment IS the swap: in-flight readers keep
             # the old immutable model, new readers see the new one
             self._model = model
@@ -223,9 +348,11 @@ class ModelRegistry:
     # -- watching ----------------------------------------------------------
 
     def start_watcher(self, interval_s: float = 5.0) -> None:
-        """Poll :meth:`refresh` every ``interval_s`` on a daemon thread
-        (load errors are recorded as tracer events, never kill the
-        watcher — a half-written checkpoint retries next poll)."""
+        """Poll :meth:`refresh` every ``interval_s`` on a daemon thread.
+        Load failures are absorbed inside :meth:`refresh` (counted,
+        backed off, quarantined); the catch here is the last line of
+        defense for discovery-level surprises — logged via obs and
+        counted, never allowed to kill polling."""
         if self._watcher is not None:
             return
         self._stop.clear()
@@ -235,13 +362,11 @@ class ModelRegistry:
                 try:
                     self.refresh()
                 except Exception as e:
-                    from gene2vec_tpu.obs import trace
-
-                    tracer = trace.get_tracer()
-                    if tracer is not None:
-                        tracer.event(
-                            "model_refresh_error", error=repr(e)[:200]
-                        )
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "model_refresh_errors_total"
+                        ).inc()
+                    _trace_event("model_refresh_error", error=repr(e)[:200])
 
         self._watcher = threading.Thread(
             target=loop, name="model-registry-watcher", daemon=True

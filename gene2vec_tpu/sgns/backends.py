@@ -90,6 +90,7 @@ class GensimTrainer:
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
+        preempt=None,
     ):
         import os
         import random
@@ -112,11 +113,21 @@ class GensimTrainer:
             # model and continue training (src/gene2vec.py:86-88)
             prev = self.model_path(export_dir, cfg.dim, start_iter - 1)
             if os.path.exists(prev):
-                model = gensim.models.Word2Vec.load(prev)
-                log(
-                    f"resuming from iteration {start_iter - 1} "
-                    "(gensim model reloaded)"
-                )
+                try:
+                    model = gensim.models.Word2Vec.load(prev)
+                except Exception as e:
+                    # a torn .gensim file (pre-atomic-save dirs) must
+                    # degrade to the retrain path, not crash resume
+                    log(
+                        f"saved gensim model {prev} failed to load "
+                        f"({e!r}); retraining from iteration 1"
+                    )
+                    start_iter = 1
+                else:
+                    log(
+                        f"resuming from iteration {start_iter - 1} "
+                        "(gensim model reloaded)"
+                    )
             else:
                 # older export dirs carry only the npz tables; without
                 # gensim's own save file the run restarts from scratch
@@ -140,6 +151,9 @@ class GensimTrainer:
 
         canonical = sentences
         for it in range(start_iter, cfg.num_iters + 1):
+            if preempt is not None and preempt.triggered:
+                log(f"preemption requested; drained after iteration {it - 1}")
+                break
             # iteration N's order is shuffle_N(canonical) — derived from
             # the canonical corpus order, not the previous iteration's, so
             # a resumed run sees exactly the sequence an uninterrupted one
@@ -179,10 +193,38 @@ class GensimTrainer:
                 if i is not None:
                     emb[row] = mat[i]
             params = SGNSParams(emb=emb, ctx=np.zeros_like(emb))
+            # gensim's own resume artifact lands (atomically) BEFORE the
+            # manifest-stamped checkpoint: the manifest is the commit
+            # record, so nothing an iteration needs for resume may be
+            # written after it — a kill in between would otherwise leave
+            # a "committed" iteration whose resume restarts from scratch.
+            # model.save is a FAMILY of files at real scale (arrays over
+            # gensim's sep_limit become '<target>.<attr>.npy' sidecars,
+            # resolved from the LOAD path), so the whole temp-prefixed
+            # family renames together, main pickle last.
+            from gene2vec_tpu.resilience import snapshot as snap
+
+            final = self.model_path(export_dir, cfg.dim, it)
+            tmp = f"{final}.tmp{os.getpid()}"
+            try:
+                model.save(tmp)
+                family = sorted(
+                    os.path.join(export_dir, name)
+                    for name in os.listdir(export_dir)
+                    if os.path.join(export_dir, name) == tmp
+                    or os.path.join(export_dir, name).startswith(tmp + ".")
+                )
+                for path in family:
+                    if path != tmp:  # sidecars first
+                        snap.atomic_replace(path, final + path[len(tmp):])
+                snap.atomic_replace(tmp, final)
+            finally:
+                for name in os.listdir(export_dir):
+                    if name.startswith(os.path.basename(tmp)):
+                        os.unlink(os.path.join(export_dir, name))
             ckpt.save_iteration(
                 export_dir, cfg.dim, it, params, vocab,
                 txt_output=cfg.txt_output, meta={"backend": "gensim"},
             )
-            model.save(self.model_path(export_dir, cfg.dim, it))
             log(f"gene2vec [gensim] dimension {cfg.dim} iteration {it} done")
         return model

@@ -416,7 +416,12 @@ class CBOWHSTrainer:
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
+        preempt=None,
     ) -> SGNSParams:
+        """``preempt`` (a resilience ``PreemptionHandler``) drains the
+        loop at the next iteration boundary after a SIGTERM/SIGINT and
+        stamps the run manifest ``interrupted=true``
+        (docs/RESILIENCE.md)."""
         from gene2vec_tpu.obs.run import Run
 
         cfg = self.config
@@ -464,6 +469,8 @@ class CBOWHSTrainer:
             pairs_per_epoch = self.num_batches * cfg.batch_pairs
             pairs_counter = run.registry.counter("pairs_total")
             for it in range(start_iter, cfg.num_iters + 1):
+                if preempt is not None and preempt.triggered:
+                    break
                 t0 = time.perf_counter()
                 with run.step(
                     "iteration", iteration=it, pairs=pairs_per_epoch
@@ -497,7 +504,15 @@ class CBOWHSTrainer:
                             "hs_dense_depth": cfg.hs_dense_depth if self.hs else 0,
                         },
                     )
+                if preempt is not None and preempt.triggered:
+                    log(
+                        f"preemption requested (signal {preempt.received}); "
+                        f"drained after iteration {it}"
+                    )
+                    break
         finally:
+            if preempt is not None and preempt.triggered:
+                run.mark_interrupted("signal", signal=preempt.received)
             run.close()
         return params
 

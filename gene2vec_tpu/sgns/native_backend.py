@@ -363,6 +363,7 @@ class HogwildSGNSTrainer:
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
+        preempt=None,
     ) -> SGNSParams:
         from gene2vec_tpu.obs.run import Run
 
@@ -400,6 +401,8 @@ class HogwildSGNSTrainer:
                 start_iter = 1
             pairs_counter = run.registry.counter("pairs_total")
             for it in range(start_iter, cfg.num_iters + 1):
+                if preempt is not None and preempt.triggered:
+                    break
                 t0 = time.perf_counter()
                 # shuffle stream keyed by (seed, it) so a resumed run shuffles
                 # identically to an uninterrupted one (round-1 advisor finding);
@@ -439,6 +442,14 @@ class HogwildSGNSTrainer:
                             "backend": "hogwild",
                         },
                     )
+                if preempt is not None and preempt.triggered:
+                    log(
+                        f"preemption requested (signal {preempt.received}); "
+                        f"drained after iteration {it}"
+                    )
+                    break
         finally:
+            if preempt is not None and preempt.triggered:
+                run.mark_interrupted("signal", signal=preempt.received)
             run.close()
         return params

@@ -108,6 +108,7 @@ class NumpySGNSTrainer:
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
+        preempt=None,
     ) -> SGNSParams:
         cfg = self.config
         if start_iter is None:
@@ -126,6 +127,8 @@ class NumpySGNSTrainer:
             start_iter = 1
         pairs_per_epoch = (self.corpus.num_pairs // self.batch) * self.batch
         for it in range(start_iter, cfg.num_iters + 1):
+            if preempt is not None and preempt.triggered:
+                break
             t0 = time.perf_counter()
             # per-iteration stream keyed by (seed, it): a resumed run draws
             # the same shuffles/negatives as an uninterrupted one (round-1
@@ -156,4 +159,7 @@ class NumpySGNSTrainer:
                 txt_output=cfg.txt_output,
                 meta={"loss": loss, "pairs_per_sec": rate, "backend": "numpy"},
             )
+            if preempt is not None and preempt.triggered:
+                log(f"preemption requested; drained after iteration {it}")
+                break
         return params

@@ -511,12 +511,73 @@ class SGNSTrainer:
         params, loss = self._epoch_fn(params, self.pairs, self.noise, epoch_key)
         return params, loss
 
+    def _ckpt_meta(self, run, it: int, loss: float, rate: float) -> dict:
+        cfg = self.config
+        return {
+            "loss": loss,
+            "pairs_per_sec": rate,
+            "config_hash": run.manifest.get("config_hash"),
+            # RNG lineage + cursor: iteration N trains with epoch key
+            # fold_in(PRNGKey(seed), N) over a corpus preshuffled by
+            # `seed`, so (seed, iteration) is the COMPLETE data/RNG
+            # cursor — a resumed run replays the exact stream an
+            # uninterrupted one would (the chaos drill's bit-exactness
+            # contract, docs/RESILIENCE.md)
+            "rng": {
+                "seed": cfg.seed,
+                "epoch_key": f"fold_in(PRNGKey({cfg.seed}), iteration)",
+            },
+        }
+
+    def _checkpoint(self, writer, export_dir, it, params, meta) -> None:
+        """Commit iteration ``it``: inline when ``writer`` is None, else
+        stage a host copy (the device→host half of the double buffer —
+        it must happen before the next epoch donates these buffers) and
+        hand the disk half to the background writer."""
+        cfg = self.config
+        exported = self._export_params(params)
+        if writer is None:
+            ckpt.save_iteration(
+                export_dir, cfg.dim, it, exported, self.corpus.vocab,
+                txt_output=cfg.txt_output, meta=meta,
+            )
+            return
+        # copy=True is load-bearing: np.asarray of a CPU-backed jax array
+        # can be a zero-copy VIEW of the device buffer, and the next
+        # epoch donates that buffer (donate_argnums) — an aliased "host
+        # copy" would let the writer serialize bytes XLA is overwriting,
+        # and the manifest would CRC-stamp the corruption as valid
+        host = SGNSParams(
+            emb=np.array(exported.emb, copy=True),
+            ctx=np.array(exported.ctx, copy=True),
+        )
+
+        def write() -> Optional[int]:
+            from gene2vec_tpu.resilience import snapshot as snap
+
+            path = ckpt.save_iteration(
+                export_dir, cfg.dim, it, host, self.corpus.vocab,
+                txt_output=cfg.txt_output, meta=meta,
+            )
+            # the writer verifies its own commit; the byte count feeds
+            # ckpt_bytes_total
+            res = snap.verify_manifest(path[: -len(".npz")])
+            if not res:
+                raise IOError(
+                    f"checkpoint iteration {it} failed post-write "
+                    f"verification: {res.reason}"
+                )
+            return snap.manifest_bytes(res.manifest)
+
+        writer.submit(write, iteration=it)
+
     def run(
         self,
         export_dir: str,
         start_iter: Optional[int] = None,
         log: Callable[[str], None] = print,
         profile_dir: Optional[str] = None,
+        preempt=None,
     ) -> SGNSParams:
         """The reference iteration loop: resume from the last saved
         iteration if present, else init fresh; each iteration reshuffles
@@ -527,7 +588,20 @@ class SGNSTrainer:
         append to ``<export_dir>/training_log.csv``; the full observed
         run (``manifest.json`` + ``events.jsonl`` + ``metrics.prom``)
         lands in the same directory (docs/OBSERVABILITY.md).
+
+        With ``config.async_checkpoint`` the per-iteration save runs on
+        the resilience double-buffered writer (disk I/O overlaps the
+        next epoch; ``ckpt_*`` metrics quantify the residue).
+
+        ``preempt`` (a :class:`gene2vec_tpu.resilience.preempt.
+        PreemptionHandler`) makes the loop drain cooperatively: the
+        current iteration finishes, its checkpoint commits, the run
+        manifest is stamped ``interrupted=true``, and the method returns
+        normally — the caller maps :attr:`preempt.triggered` to
+        ``EXIT_PREEMPTED`` (docs/RESILIENCE.md).
         """
+        import contextlib
+
         from gene2vec_tpu.obs.run import Run
         from gene2vec_tpu.utils.profiling import trace_context
 
@@ -542,6 +616,14 @@ class SGNSTrainer:
             },
         )
         run.registry.attach_csv(os.path.join(export_dir, "training_log.csv"))
+        writer = None
+        if cfg.async_checkpoint:
+            from gene2vec_tpu.resilience.async_writer import (
+                AsyncCheckpointWriter,
+            )
+
+            writer = AsyncCheckpointWriter(metrics=run.registry)
+        completed = None
         # everything after Run construction runs under its finally, so a
         # failed resume still closes the run (and uninstalls the ambient
         # tracer) instead of leaking it into later runs in this process
@@ -556,6 +638,7 @@ class SGNSTrainer:
                     )
                     params = self._pad_params(params)
                 log(f"resuming from iteration {start_iter - 1}")
+                completed = start_iter - 1
             else:
                 with run.span("init_params"):
                     params = self.init()
@@ -565,6 +648,8 @@ class SGNSTrainer:
             pairs_per_epoch = self.num_batches * cfg.batch_pairs
             pairs_counter = run.registry.counter("pairs_total")
             for it in range(start_iter, cfg.num_iters + 1):
+                if preempt is not None and preempt.triggered:
+                    break  # signal landed between iterations
                 log(f"gene2vec dimension {cfg.dim} iteration {it} start")
                 t0 = time.perf_counter()
                 with trace_context(profile_dir if it == start_iter else None):
@@ -588,16 +673,38 @@ class SGNSTrainer:
                     it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt}
                 )
                 run.probe()
-                with run.span("checkpoint", iteration=it):
-                    ckpt.save_iteration(
-                        export_dir,
-                        cfg.dim,
-                        it,
-                        self._export_params(params),
-                        self.corpus.vocab,
-                        txt_output=cfg.txt_output,
-                        meta={"loss": loss, "pairs_per_sec": rate},
+                with run.span(
+                    "checkpoint", iteration=it,
+                    mode="async" if writer is not None else "sync",
+                ):
+                    self._checkpoint(
+                        writer, export_dir, it, params,
+                        self._ckpt_meta(run, it, loss, rate),
                     )
+                completed = it
+                if preempt is not None and preempt.triggered:
+                    # cooperative drain: the iteration and its checkpoint
+                    # are committed; stop here instead of starting work
+                    # the grace window cannot fit
+                    log(
+                        f"preemption requested (signal {preempt.received}); "
+                        f"drained after iteration {it}"
+                    )
+                    break
+            if writer is not None:
+                writer.close()  # surface any background write error
         finally:
+            if writer is not None:
+                # error-path cleanup: still drain staged writes (the last
+                # committed checkpoint is the resume point), but never
+                # mask the in-flight exception
+                with contextlib.suppress(Exception):
+                    writer.close()
+            if preempt is not None and preempt.triggered:
+                run.mark_interrupted(
+                    "signal",
+                    signal=preempt.received,
+                    completed_iteration=completed,
+                )
             run.close()
         return params

@@ -1,0 +1,437 @@
+#!/usr/bin/env python
+"""Chaos drill: rehearse the failure model against the real CLIs.
+
+Four phases (docs/RESILIENCE.md runbook):
+
+* **training_resume** — run the real training CLI to completion as the
+  reference, then SIGKILL a second run at a random ``iteration N done``
+  line (mid-checkpoint territory) and rerun it; the resumed run's final
+  embedding must be BIT-exact against the uninterrupted one.  A third
+  run takes SIGTERM instead and must drain: exit ``EXIT_PREEMPTED``,
+  stamp ``interrupted=true`` in its run manifest, and also resume
+  bit-exact.
+* **corruption** — truncate the newest checkpoint npz / corrupt a
+  manifest CRC and assert verified discovery falls back to the previous
+  iteration instead of surfacing the torn one.
+* **serve** — spawn the real serve CLI over a live export dir: a good
+  newer checkpoint hot-swaps in; a TORN newer checkpoint is never
+  swapped (the watcher keeps serving the last good iteration); deleting
+  the torn files mid-poll doesn't disturb the watcher; a subsequent
+  good checkpoint swaps normally.
+* **async_overhead** — train at the geometry pinned in
+  ``analysis/budgets.json`` (section ``resilience``) with
+  ``async_checkpoint`` on and assert the train loop's checkpoint span
+  costs less than ``max_overhead_fraction`` of iteration wall time.
+
+Exactly ONE JSON document goes to stdout (the machine contract);
+progress chatter goes to stderr.  Exit 0 iff every phase passed.
+
+Usage::
+
+    python scripts/chaos_drill.py                 # full drill
+    python scripts/chaos_drill.py --smoke         # CI-sized (~1 min)
+    python scripts/chaos_drill.py --out BENCH_RESILIENCE_r07.json
+    python scripts/chaos_drill.py --only training_resume,serve
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gene2vec_tpu.resilience import chaos  # noqa: E402
+from gene2vec_tpu.resilience.preempt import EXIT_PREEMPTED  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+def make_corpus(dirpath: str, vocab: int = 30, lines: int = 400,
+                seed: int = 7) -> None:
+    rng = np.random.RandomState(seed)
+    os.makedirs(dirpath, exist_ok=True)
+    rows = []
+    for _ in range(lines):
+        c = rng.randint(3)
+        a, b = rng.choice(vocab // 3, 2, replace=False) + (vocab // 3) * c
+        rows.append(f"G{a} G{b}")
+    with open(os.path.join(dirpath, "pairs.txt"), "w") as f:
+        f.write("\n".join(rows) + "\n")
+
+
+def wait_until(fn, timeout_s: float, interval_s: float = 0.1,
+               what: str = "condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(interval_s)
+    raise TimeoutError(f"{what} not reached within {timeout_s}s")
+
+
+# -- phase: training resume-equivalence -------------------------------------
+
+
+def drill_training_resume(tmp: str, iters: int, seed: int) -> dict:
+    from gene2vec_tpu.io import checkpoint as ckpt
+
+    data = os.path.join(tmp, "corpus")
+    make_corpus(data)
+    flags = dict(dim=8, iters=iters, batch_pairs=64, seed=3)
+
+    log("training reference run (uninterrupted)")
+    ref_dir = os.path.join(tmp, "train_ref")
+    r = chaos.run_cli(chaos.gene2vec_argv(data, ref_dir, **flags))
+    assert r.returncode == 0, f"reference run failed:\n{r.output[-2000:]}"
+    ref = chaos.load_table(ref_dir, 8, iters)
+
+    kill_at = int(np.random.RandomState(seed).randint(1, iters))
+    log(f"SIGKILL run at 'iteration {kill_at} done'")
+    kill_dir = os.path.join(tmp, "train_kill")
+    r = chaos.run_cli_kill_on(
+        chaos.gene2vec_argv(data, kill_dir, **flags),
+        rf"iteration {kill_at} done", sig=signal.SIGKILL,
+    )
+    assert r.returncode != 0, "SIGKILLed child reported success"
+    survivor = ckpt.latest_iteration(kill_dir, 8)
+    assert survivor <= kill_at, (
+        f"latest verified iteration {survivor} > kill point {kill_at}"
+    )
+    log(f"killed after iteration {kill_at}; verified survivor: {survivor}; "
+        "resuming")
+    r = chaos.run_cli(chaos.gene2vec_argv(data, kill_dir, **flags))
+    assert r.returncode == 0, f"resume failed:\n{r.output[-2000:]}"
+    resumed = chaos.load_table(kill_dir, 8, iters)
+    kill_exact = bool(np.array_equal(ref, resumed))
+    assert kill_exact, "SIGKILL resume diverged from the uninterrupted run"
+
+    log("SIGTERM drain run at 'iteration 1 done'")
+    term_dir = os.path.join(tmp, "train_term")
+    r = chaos.run_cli_kill_on(
+        chaos.gene2vec_argv(data, term_dir, **flags),
+        r"iteration 1 done", sig=signal.SIGTERM,
+    )
+    assert r.returncode == EXIT_PREEMPTED, (
+        f"SIGTERM drain exited {r.returncode}, expected {EXIT_PREEMPTED}:\n"
+        f"{r.output[-2000:]}"
+    )
+    with open(os.path.join(term_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest.get("interrupted") is True, "manifest not stamped"
+    r = chaos.run_cli(chaos.gene2vec_argv(data, term_dir, **flags))
+    assert r.returncode == 0, f"post-drain resume failed:\n{r.output[-2000:]}"
+    term_exact = bool(np.array_equal(ref, chaos.load_table(term_dir, 8, iters)))
+    assert term_exact, "SIGTERM resume diverged from the uninterrupted run"
+    return {
+        "iters": iters,
+        "sigkill_at_iteration": kill_at,
+        "verified_survivor_iteration": survivor,
+        "sigkill_resume_bit_exact": kill_exact,
+        "sigterm_exit_code": EXIT_PREEMPTED,
+        "sigterm_manifest_interrupted": True,
+        "sigterm_resume_bit_exact": term_exact,
+    }
+
+
+# -- phase: corruption detection --------------------------------------------
+
+
+def drill_corruption(tmp: str) -> dict:
+    from gene2vec_tpu.io import checkpoint as ckpt
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.resilience import snapshot as snap
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    d = os.path.join(tmp, "corrupt")
+    vocab = Vocab([f"G{i}" for i in range(16)], np.arange(1, 17))
+    for it in (1, 2, 3):
+        params = SGNSParams(
+            emb=np.full((16, 4), it, np.float32),
+            ctx=np.zeros((16, 4), np.float32),
+        )
+        ckpt.save_iteration(d, 4, it, params, vocab)
+
+    chaos.truncate_file(os.path.join(d, "gene2vec_dim_4_iter_3.npz"))
+    snap.clear_verify_cache()
+    after_truncate = ckpt.latest_iteration(d, 4)
+    assert after_truncate == 2, (
+        f"truncated newest not skipped: latest={after_truncate}"
+    )
+
+    chaos.corrupt_manifest_crc(os.path.join(d, "gene2vec_dim_4_iter_2"))
+    snap.clear_verify_cache()
+    after_crc = ckpt.latest_iteration(d, 4)
+    assert after_crc == 1, f"stale CRC not skipped: latest={after_crc}"
+    log("corruption: truncation and CRC rot both fall back")
+    return {
+        "truncated_newest_falls_back_to": after_truncate,
+        "corrupt_crc_falls_back_to": after_crc,
+    }
+
+
+# -- phase: serve no-garbage-swap -------------------------------------------
+
+
+def _http_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _write_iteration(export_dir: str, it: int, vocab_size: int = 16,
+                     dim: int = 4) -> str:
+    from gene2vec_tpu.io import checkpoint as ckpt
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    rng = np.random.RandomState(it)
+    vocab = Vocab([f"G{i}" for i in range(vocab_size)],
+                  np.arange(1, vocab_size + 1))
+    params = SGNSParams(
+        emb=rng.randn(vocab_size, dim).astype(np.float32),
+        ctx=np.zeros((vocab_size, dim), np.float32),
+    )
+    ckpt.save_iteration(export_dir, dim, it, params, vocab)
+    return os.path.join(export_dir, f"gene2vec_dim_{dim}_iter_{it}")
+
+
+def drill_serve(tmp: str) -> dict:
+    export_dir = os.path.join(tmp, "serve_export")
+    _write_iteration(export_dir, 1)
+
+    # stderr inherits (serve chatter joins the drill's own stderr) so a
+    # startup failure is visible, not swallowed into /dev/null
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gene2vec_tpu.cli.serve",
+         "--export-dir", export_dir, "--port", "0",
+         "--poll-interval", "0.3"],
+        stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+    )
+    try:
+        # the contract line is read with a deadline — a serve CLI that
+        # hangs before printing it must fail the drill, not wedge it
+        import queue as _queue
+        import threading
+
+        q: "_queue.Queue" = _queue.Queue()
+        assert proc.stdout is not None
+        threading.Thread(
+            target=lambda: q.put(proc.stdout.readline()), daemon=True
+        ).start()
+        try:
+            line = q.get(timeout=120.0)
+        except _queue.Empty:
+            raise TimeoutError(
+                "serve CLI printed no contract line within 120s"
+            ) from None
+        if not line:
+            raise RuntimeError(
+                f"serve CLI exited (rc={proc.poll()}) before printing "
+                "its contract line (its stderr is above)"
+            )
+        info = json.loads(line)
+        url = info["url"]
+        log(f"serve CLI up at {url} (iteration {info['iteration']})")
+
+        def iteration() -> int:
+            return _http_json(url + "/healthz")["model"]["iteration"]
+
+        assert iteration() == 1
+
+        _write_iteration(export_dir, 2)
+        wait_until(lambda: iteration() == 2, 15.0, what="hot swap to iter 2")
+        log("good checkpoint hot-swapped")
+
+        # torn newer checkpoint: staged in a side dir, truncated THERE,
+        # then moved in (npz first, manifest last) — the watched dir
+        # never holds a valid iteration 3 for even a poll cycle, so the
+        # only way it can swap in is a verification bug
+        stage = os.path.join(tmp, "serve_stage")
+        prefix3 = _write_iteration(stage, 3)
+        chaos.truncate_file(prefix3 + ".npz")
+        base3 = os.path.basename(prefix3)
+        for suffix in (".npz", ".txt", "_w2v.txt", ".MANIFEST.json"):
+            os.replace(
+                prefix3 + suffix, os.path.join(export_dir, base3 + suffix)
+            )
+        time.sleep(1.5)  # several poll cycles
+        assert iteration() == 2, "torn checkpoint was hot-swapped!"
+        log("torn checkpoint never swapped in")
+
+        # delete the torn files mid-poll; the watcher must shrug
+        chaos.delete_iteration(export_dir, 4, 3)
+        time.sleep(0.8)
+        assert iteration() == 2
+
+        _write_iteration(export_dir, 4)
+        wait_until(lambda: iteration() == 4, 15.0, what="hot swap to iter 4")
+        log("recovered with the next good checkpoint")
+        health = _http_json(url + "/healthz")
+        assert health["status"] == "ok"
+        return {
+            "hot_swap_good": True,
+            "torn_newest_never_swapped": True,
+            "delete_mid_poll_survived": True,
+            "final_iteration": 4,
+        }
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+# -- phase: async checkpoint overhead ---------------------------------------
+
+
+def drill_async_overhead(tmp: str, budget: dict) -> dict:
+    import dataclasses
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.obs.trace import read_events
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    vocab_size = int(budget["vocab"])
+    rng = np.random.RandomState(0)
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    pairs = rng.choice(
+        vocab_size, size=(int(budget["num_pairs"]), 2), p=p
+    ).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=vocab_size)
+    corpus = PairCorpus(
+        Vocab([f"G{i}" for i in range(vocab_size)], counts.astype(np.int64)),
+        pairs,
+    )
+    base = SGNSConfig(
+        dim=int(budget["dim"]), batch_pairs=int(budget["batch_pairs"]),
+        num_iters=int(budget["num_iters"]),
+        txt_output=bool(budget.get("txt_output", True)),
+    )
+
+    def overhead(async_on: bool) -> float:
+        cfg = dataclasses.replace(base, async_checkpoint=async_on)
+        d = os.path.join(tmp, f"overhead_{'async' if async_on else 'sync'}")
+        SGNSTrainer(corpus, cfg).run(d, log=lambda s: None)
+        spans = {"iteration": 0.0, "checkpoint": 0.0}
+        for e in read_events(os.path.join(d, "events.jsonl")):
+            if e.get("type") == "span_end" and e.get("name") in spans:
+                spans[e["name"]] += float(e.get("dur", 0.0))
+        return spans["checkpoint"] / max(spans["iteration"], 1e-9)
+
+    sync_frac = overhead(False)
+    async_frac = overhead(True)
+    log(f"checkpoint span / epoch wall: sync {sync_frac:.4f}, "
+        f"async {async_frac:.4f} (budget {budget['max_overhead_fraction']})")
+    assert async_frac < float(budget["max_overhead_fraction"]), (
+        f"async checkpoint overhead {async_frac:.4f} exceeds "
+        f"{budget['max_overhead_fraction']}"
+    )
+    return {
+        "geometry": {k: budget[k] for k in
+                     ("dim", "vocab", "batch_pairs", "num_pairs", "num_iters")},
+        "sync_overhead_fraction": round(sync_frac, 5),
+        "async_overhead_fraction": round(async_frac, 5),
+        "max_overhead_fraction": budget["max_overhead_fraction"],
+    }
+
+
+# -- driver ------------------------------------------------------------------
+
+
+PHASES = ("training_resume", "corruption", "serve", "async_overhead")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos_drill",
+        description="fault-injection drill for the resilience subsystem",
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized drill: fewer iterations per phase")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document to this path")
+    ap.add_argument("--only", default=None,
+                    help=f"comma-separated phases from {PHASES}")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="kill-point seed (default: derived from time)")
+    ap.add_argument("--tmp", default=None, help="work dir (default: mkdtemp)")
+    args = ap.parse_args(argv)
+
+    only = args.only.split(",") if args.only else list(PHASES)
+    unknown = [p for p in only if p not in PHASES]
+    if unknown:
+        ap.error(f"unknown phase(s) {unknown}; choose from {PHASES}")
+
+    # the async_overhead phase trains IN-PROCESS: pin the CPU backend
+    # before jax initializes, exactly like chaos.child_env does for the
+    # child phases — the session env may point at a real accelerator,
+    # and the overhead budget's reference numbers are CPU-derived
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import tempfile
+
+    from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+    tmp = args.tmp or tempfile.mkdtemp(prefix="chaos_drill_")
+    seed = args.seed if args.seed is not None else int(time.time()) % 100000
+    budget = load_budgets()["resilience"]["async_ckpt"]
+    iters = 3 if args.smoke else 5
+
+    doc = {
+        "schema": "gene2vec-tpu/chaos-drill/v1",
+        "created_unix": time.time(),
+        "host": socket.gethostname(),
+        "smoke": bool(args.smoke),
+        "seed": seed,
+        "phases": {},
+        "passed": False,
+    }
+    t0 = time.monotonic()
+    failed = None
+    for phase in only:
+        log(f"=== phase: {phase} ===")
+        try:
+            if phase == "training_resume":
+                doc["phases"][phase] = drill_training_resume(tmp, iters, seed)
+            elif phase == "corruption":
+                doc["phases"][phase] = drill_corruption(tmp)
+            elif phase == "serve":
+                doc["phases"][phase] = drill_serve(tmp)
+            elif phase == "async_overhead":
+                doc["phases"][phase] = drill_async_overhead(tmp, budget)
+        except Exception as e:
+            failed = f"{phase}: {e}"
+            doc["phases"][phase] = {"error": str(e)}
+            log(f"PHASE FAILED — {e}")
+            break
+    doc["wall_seconds"] = round(time.monotonic() - t0, 2)
+    doc["passed"] = failed is None
+    if failed:
+        doc["failed"] = failed
+
+    blob = json.dumps(doc, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+        log(f"wrote {args.out}")
+    print(blob)
+    log("DRILL PASSED" if doc["passed"] else "DRILL FAILED")
+    return 0 if doc["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

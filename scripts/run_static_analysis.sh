@@ -11,6 +11,11 @@
 #   scripts/run_static_analysis.sh                 # lint + tier-2 HLO
 #   scripts/run_static_analysis.sh --fast          # lint only (tier-1 scope)
 #   scripts/run_static_analysis.sh --with-sanitizers   # + asan,ubsan,tsan
+#   scripts/run_static_analysis.sh --with-chaos    # + the resilience chaos
+#                                                  # smoke drill (kill/resume
+#                                                  # bit-exactness, torn-export
+#                                                  # no-swap, async-ckpt
+#                                                  # budget; docs/RESILIENCE.md)
 #   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
 #                                                  # (expect intended-race
 #                                                  # reports; for auditing
@@ -24,10 +29,12 @@ cd "$(dirname "$0")/.."
 
 MODE="full"
 SAN=""
+CHAOS=0
 for arg in "$@"; do
   case "$arg" in
     --fast) MODE="fast" ;;
     --with-sanitizers) SAN="asan,ubsan,tsan" ;;
+    --with-chaos) CHAOS=1 ;;
     --tsan-raw)
       make -C native tsan
       echo "== unsuppressed TSAN Hogwild run (intended races WILL report) ==" >&2
@@ -83,4 +90,14 @@ for f in doc["findings"]:
         loc = f"{f['path']}:{f['line']}" if f.get("line") else f["path"]
         print(f"  {loc}: [{f['pass']}] {f['message']}", file=sys.stderr)
 EOF
+if [ "$rc" -ne 0 ]; then
+  exit "$rc"
+fi
+
+if [ "$CHAOS" = "1" ]; then
+  echo "== chaos smoke drill (scripts/chaos_drill.py --smoke) ==" >&2
+  CHAOS_OUT="${CHAOS_DRILL_OUT:-/tmp/chaos_drill_smoke.json}"
+  python scripts/chaos_drill.py --smoke > "$CHAOS_OUT" || rc=$?
+  echo "chaos drill: exit $rc -> $CHAOS_OUT" >&2
+fi
 exit "$rc"

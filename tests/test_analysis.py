@@ -83,6 +83,15 @@ class Trainer:
 def report(x):
     print("loss:", x)
 """,
+    "ckpt-blocking-io": """
+import os
+
+
+class Writer:
+    def submit(self, fd, payload):
+        self._queue.append(payload)
+        os.fsync(fd)
+""",
 }
 
 CLEAN_FIXTURE = """

@@ -1,0 +1,569 @@
+"""Resilience tier-1: atomic visibility, CRC rejection, preemption
+drain, the async writer, and registry fallback/quarantine.
+
+The full fault-injection drill against the real CLIs (SIGKILL at a
+random step → bit-exact resume, serve no-garbage-swap, the async
+overhead budget) is ``scripts/chaos_drill.py``, exercised here by the
+``slow``-marked test at the bottom; these tier-1 tests pin the same
+invariants in-process where they are cheap.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.data.pipeline import PairCorpus
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.resilience import chaos
+from gene2vec_tpu.resilience import snapshot as snap
+from gene2vec_tpu.resilience.async_writer import (
+    AsyncCheckpointWriter,
+    CheckpointWriteError,
+)
+from gene2vec_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
+from gene2vec_tpu.sgns.model import SGNSParams
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V, D = 12, 4
+
+
+def _vocab():
+    return Vocab([f"G{i}" for i in range(V)], np.arange(1, V + 1))
+
+
+def _save(export_dir, it, fill=None, txt=True):
+    fill = float(it) if fill is None else fill
+    params = SGNSParams(
+        emb=np.full((V, D), fill, np.float32),
+        ctx=np.zeros((V, D), np.float32),
+    )
+    return ckpt.save_iteration(
+        str(export_dir), D, it, params, _vocab(), txt_output=txt
+    )
+
+
+def _prefix(export_dir, it):
+    return os.path.join(str(export_dir), f"gene2vec_dim_{D}_iter_{it}")
+
+
+def _corpus(seed=0, vocab=24, pairs=300):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, vocab, size=(pairs, 2)).astype(np.int32)
+    counts = np.bincount(p.reshape(-1), minlength=vocab).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(vocab)], counts), p)
+
+
+# -- atomic visibility -------------------------------------------------------
+
+
+def test_atomic_savez_never_exposes_partial_file(tmp_path):
+    """A concurrent reader sees the old npz or the new npz, never a
+    prefix of the new one (write-to-temp + rename)."""
+    path = str(tmp_path / "state.npz")
+    snap.atomic_savez(path, x=np.zeros(4096, np.float32))
+    errors, torn = [], []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with np.load(path) as z:
+                    x = np.asarray(z["x"])
+                # every visible file is one writer's COMPLETE array
+                if not (x == x[0]).all():
+                    torn.append(x[0])
+            except Exception as e:  # a partial file fails to parse
+                errors.append(repr(e))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for i in range(1, 60):
+        snap.atomic_savez(path, x=np.full(4096, float(i), np.float32))
+    stop.set()
+    t.join(timeout=10)
+    assert errors == [] and torn == []
+
+
+def test_checkpoint_rewrite_visibility_under_concurrent_reader(tmp_path):
+    """save_iteration over an existing iteration never exposes a torn
+    load to a concurrent load_iteration (the registry/trainer race)."""
+    _save(tmp_path, 1, fill=0.0)
+    errors, seen = [], set()
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                params, _, meta = ckpt.load_iteration(str(tmp_path), D, 1)
+                emb = np.asarray(params.emb)
+                if not (emb == emb.flat[0]).all():
+                    errors.append("mixed fill")
+                seen.add(float(emb.flat[0]))
+            except Exception as e:
+                errors.append(repr(e))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for i in range(1, 40):
+        _save(tmp_path, 1, fill=float(i), txt=False)
+    stop.set()
+    t.join(timeout=10)
+    assert errors == []
+    assert seen  # the reader actually observed values
+
+
+# -- manifests / CRC rejection ----------------------------------------------
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    path = _save(tmp_path, 1)
+    res = snap.verify_manifest(path[: -len(".npz")])
+    assert res.ok and res.reason == "ok"
+    names = set(res.manifest["files"])
+    assert names == {
+        f"gene2vec_dim_{D}_iter_1.npz",
+        f"gene2vec_dim_{D}_iter_1.txt",
+        f"gene2vec_dim_{D}_iter_1_w2v.txt",
+        "vocab.tsv",
+    }
+    # the manifest carries the checkpoint meta (config hash / rng land
+    # here from the trainer loop)
+    assert res.manifest["iteration"] == 1 and res.manifest["dim"] == D
+
+
+def test_crc_rejection_and_fallback(tmp_path):
+    for it in (1, 2, 3):
+        _save(tmp_path, it)
+    assert ckpt.latest_iteration(str(tmp_path), D) == 3
+
+    chaos.truncate_file(_prefix(tmp_path, 3) + ".npz")
+    snap.clear_verify_cache()
+    assert not snap.verify_manifest(_prefix(tmp_path, 3))
+    assert snap.verify_manifest(_prefix(tmp_path, 3)).reason.startswith(
+        ("crc:", "size:")
+    )
+    # torn newest falls back to the previous committed iteration
+    assert ckpt.latest_iteration(str(tmp_path), D) == 2
+
+    chaos.flip_byte(_prefix(tmp_path, 2) + "_w2v.txt", offset=10)
+    snap.clear_verify_cache()
+    assert snap.verify_manifest(_prefix(tmp_path, 2)).reason.startswith("crc:")
+    assert ckpt.latest_iteration(str(tmp_path), D) == 1
+
+    # unverified discovery still sees everything (inspection tools)
+    assert ckpt.latest_iteration(str(tmp_path), D, verified_only=False) == 3
+
+
+def test_deleting_optional_text_exports_keeps_checkpoint_committed(tmp_path):
+    """The text twins are convenience artifacts: an operator reclaiming
+    space by deleting them must not un-commit the npz checkpoint (their
+    CORRUPTION while present is still detected — test_crc_rejection)."""
+    for it in (1, 2):
+        _save(tmp_path, it)
+    for it in (1, 2):
+        os.unlink(_prefix(tmp_path, it) + ".txt")
+        os.unlink(_prefix(tmp_path, it) + "_w2v.txt")
+    snap.clear_verify_cache()
+    assert snap.verify_manifest(_prefix(tmp_path, 2)).ok
+    assert ckpt.latest_iteration(str(tmp_path), D) == 2
+    # the npz itself stays load-bearing
+    os.unlink(_prefix(tmp_path, 2) + ".npz")
+    snap.clear_verify_cache()
+    assert ckpt.latest_iteration(str(tmp_path), D) == 1
+
+
+def test_missing_manifest_treated_as_uncommitted(tmp_path):
+    _save(tmp_path, 1)
+    _save(tmp_path, 2)
+    os.unlink(snap.manifest_path(_prefix(tmp_path, 2)))
+    # iteration 2 has files but no commit record → killed mid-save
+    assert ckpt.latest_iteration(str(tmp_path), D) == 1
+
+
+def test_legacy_dir_without_any_manifest_accepted(tmp_path):
+    """Pre-manifest export dirs (reference scripts) have nothing to
+    verify against and must keep working."""
+    _save(tmp_path, 1)
+    _save(tmp_path, 2)
+    for it in (1, 2):
+        os.unlink(snap.manifest_path(_prefix(tmp_path, it)))
+    assert ckpt.latest_iteration(str(tmp_path), D) == 2
+    found = list(ckpt.iter_checkpoints(str(tmp_path), verified_only=True))
+    assert [it for _, it, _ in found] == [1, 2]
+
+
+def test_manifest_expectation_is_scoped_per_dim(tmp_path):
+    """Another dim's manifests say nothing about this dim's history: a
+    legacy (manifest-less) dim-D history next to a manifested dim-8 run
+    stays discoverable."""
+    from gene2vec_tpu.io.vocab import Vocab
+
+    _save(tmp_path, 1)
+    _save(tmp_path, 2)
+    for it in (1, 2):
+        os.unlink(snap.manifest_path(_prefix(tmp_path, it)))  # legacy dim-D
+    params = SGNSParams(
+        emb=np.ones((V, 8), np.float32), ctx=np.zeros((V, 8), np.float32)
+    )
+    ckpt.save_iteration(str(tmp_path), 8, 5, params, _vocab())  # manifested
+    snap.clear_verify_cache()
+    assert ckpt.latest_iteration(str(tmp_path), D) == 2
+    assert ckpt.latest_iteration(str(tmp_path), 8) == 5
+
+
+def test_mixed_legacy_and_manifested_history_falls_back(tmp_path):
+    """Mid-run manifest adoption: legacy iterations stay usable as the
+    fallback when the newest (manifested) export rots — pre-adoption
+    history must not be orphaned by the upgrade."""
+    for it in (1, 2):
+        _save(tmp_path, it)
+        os.unlink(snap.manifest_path(_prefix(tmp_path, it)))  # legacy
+    _save(tmp_path, 3)  # manifested (post-upgrade)
+    snap.clear_verify_cache()
+    assert ckpt.latest_iteration(str(tmp_path), D) == 3
+    chaos.truncate_file(_prefix(tmp_path, 3) + ".npz")
+    snap.clear_verify_cache()
+    assert ckpt.latest_iteration(str(tmp_path), D) == 2
+
+
+def test_corrupt_manifest_crc_injector(tmp_path):
+    _save(tmp_path, 1)
+    chaos.corrupt_manifest_crc(_prefix(tmp_path, 1))
+    snap.clear_verify_cache()
+    assert snap.verify_manifest(_prefix(tmp_path, 1)).reason.startswith("crc:")
+
+
+def test_malformed_manifest_shapes_never_raise(tmp_path):
+    """Valid-JSON-wrong-shape manifests (hand-edited, corrupted) must
+    yield a falsy torn-manifest verdict, not an exception — discovery
+    is a never-raises contract."""
+    _save(tmp_path, 1)
+    mpath = snap.manifest_path(_prefix(tmp_path, 1))
+    for bad in ('{"files": ["a"]}', '{"files": {"x.npz": 123}}',
+                '{"files": null}', "[]", "{"):
+        with open(mpath, "w") as f:
+            f.write(bad)
+        snap.clear_verify_cache()
+        res = snap.verify_manifest(_prefix(tmp_path, 1))
+        assert not res and res.reason == "torn-manifest", (bad, res)
+        assert ckpt.latest_iteration(str(tmp_path), D) == 0  # skipped, no crash
+
+
+def test_verify_cache_invalidates_on_change(tmp_path):
+    _save(tmp_path, 1)
+    assert snap.verify_manifest(_prefix(tmp_path, 1)).ok
+    time.sleep(0.01)  # ensure a distinct mtime_ns on coarse filesystems
+    chaos.truncate_file(_prefix(tmp_path, 1) + ".npz")
+    assert not snap.verify_manifest(_prefix(tmp_path, 1))
+
+
+# -- registry fallback / quarantine -----------------------------------------
+
+
+def test_registry_falls_back_counts_and_quarantines(tmp_path):
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+    from gene2vec_tpu.serve.registry import ModelRegistry
+
+    metrics = MetricsRegistry()
+    _save(tmp_path, 1)
+    reg = ModelRegistry(
+        str(tmp_path), metrics=metrics,
+        retry_backoff_s=0.01, quarantine_after=2,
+    )
+    assert reg.refresh() and reg.model.iteration == 1
+
+    # iteration 2 VERIFIES (manifest restamped over the rotten bytes)
+    # but fails to load — the path CRC checking cannot catch
+    _save(tmp_path, 2)
+    chaos.truncate_file(_prefix(tmp_path, 2) + ".npz")
+    chaos.restamp_manifest(_prefix(tmp_path, 2))
+    snap.clear_verify_cache()
+
+    assert reg.refresh() is False
+    assert reg.model.iteration == 1  # last good model keeps serving
+    assert metrics.counter("model_load_failures_total").value == 1
+
+    time.sleep(0.05)  # clear the backoff window
+    assert reg.refresh() is False
+    assert metrics.counter("model_load_failures_total").value == 2
+    assert _prefix(tmp_path, 2) + ".npz" in reg.quarantined
+
+    time.sleep(0.05)
+    assert reg.refresh() is False  # quarantined: not even attempted
+    assert metrics.counter("model_load_failures_total").value == 2
+
+    _save(tmp_path, 3)
+    assert reg.refresh() and reg.model.iteration == 3
+
+
+def test_registry_backoff_suppresses_immediate_retry(tmp_path):
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    _save(tmp_path, 1)
+    reg = ModelRegistry(
+        str(tmp_path), metrics=metrics,
+        retry_backoff_s=60.0, quarantine_after=99,
+    )
+    assert reg.refresh()
+    _save(tmp_path, 2)
+    chaos.truncate_file(_prefix(tmp_path, 2) + ".npz")
+    chaos.restamp_manifest(_prefix(tmp_path, 2))
+    snap.clear_verify_cache()
+    for _ in range(5):
+        assert reg.refresh() is False
+    # one load attempt, four backoff skips
+    assert metrics.counter("model_load_failures_total").value == 1
+
+
+def test_registry_torn_export_filtered_before_load(tmp_path):
+    """A checkpoint whose manifest fails verification is filtered at
+    discovery — zero load attempts, zero failure counts."""
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+    from gene2vec_tpu.serve.registry import ModelRegistry, discover_newest
+
+    metrics = MetricsRegistry()
+    _save(tmp_path, 1)
+    _save(tmp_path, 2)
+    chaos.truncate_file(_prefix(tmp_path, 2) + ".npz")
+    snap.clear_verify_cache()
+    assert discover_newest(str(tmp_path))[1] == 1
+    reg = ModelRegistry(str(tmp_path), metrics=metrics)
+    assert reg.refresh() and reg.model.iteration == 1
+    assert metrics.counter("model_load_failures_total").value == 0
+
+
+def test_registry_quarantine_cleared_when_file_rewritten(tmp_path):
+    """A quarantine verdict applies to the bytes, not the filename: a
+    checkpoint atomically rewritten under the same name gets a fresh
+    chance."""
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+    from gene2vec_tpu.serve.registry import ModelRegistry
+
+    metrics = MetricsRegistry()
+    _save(tmp_path, 1)
+    reg = ModelRegistry(
+        str(tmp_path), metrics=metrics,
+        retry_backoff_s=0.001, quarantine_after=1,
+    )
+    assert reg.refresh()
+    _save(tmp_path, 2)
+    chaos.truncate_file(_prefix(tmp_path, 2) + ".npz")
+    chaos.restamp_manifest(_prefix(tmp_path, 2))
+    snap.clear_verify_cache()
+    assert reg.refresh() is False
+    assert _prefix(tmp_path, 2) + ".npz" in reg.quarantined
+
+    time.sleep(0.01)  # distinct mtime_ns for the rewrite
+    _save(tmp_path, 2, fill=7.0)  # training re-commits the iteration
+    snap.clear_verify_cache()
+    assert reg.refresh() is True
+    assert reg.model.iteration == 2
+    assert reg.quarantined == {}
+
+
+def test_latest_iteration_verifies_only_the_newest(tmp_path, monkeypatch):
+    """Newest-first lazy discovery: an intact newest checkpoint costs
+    ONE manifest verification, not a CRC sweep of the whole history."""
+    for it in (1, 2, 3):
+        _save(tmp_path, it)
+    calls = []
+    real = snap.verify_manifest
+
+    def counting(prefix, use_cache=True):
+        calls.append(prefix)
+        return real(prefix, use_cache=use_cache)
+
+    monkeypatch.setattr(ckpt.snap, "verify_manifest", counting)
+    assert ckpt.latest_iteration(str(tmp_path), D) == 3
+    assert len(calls) == 1 and calls[0].endswith("iter_3")
+
+
+# -- async writer ------------------------------------------------------------
+
+
+def test_async_writer_runs_jobs_in_order_and_flushes():
+    done = []
+    w = AsyncCheckpointWriter(max_pending=1)
+    for i in range(4):
+        w.submit(lambda i=i: (time.sleep(0.01), done.append(i), 128)[-1])
+    w.flush()
+    assert done == [0, 1, 2, 3]
+    w.close()
+    with pytest.raises(CheckpointWriteError):
+        w.submit(lambda: None)  # closed writers refuse work
+
+
+def test_async_writer_double_buffer_bound():
+    """At most max_pending writes outstanding: a second submit blocks
+    until the in-flight write RETIRES, so with the caller's one staged
+    copy no more than two snapshots are ever alive."""
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_pending=1)
+    t0 = time.perf_counter()
+    w.submit(lambda: gate.wait(10))  # writer idle → returns instantly
+    assert time.perf_counter() - t0 < 1.0
+    assert w.pending == 1
+    release = threading.Thread(
+        target=lambda: (time.sleep(0.2), gate.set()), daemon=True
+    )
+    release.start()
+    t0 = time.perf_counter()
+    w.submit(lambda: None)  # second: must wait for the first to retire
+    assert time.perf_counter() - t0 > 0.1
+    w.close()
+    assert w.pending == 0
+
+
+def test_async_writer_error_surfaces_on_train_thread():
+    w = AsyncCheckpointWriter()
+    w.submit(lambda: (_ for _ in ()).throw(IOError("disk full")))
+    with pytest.raises(CheckpointWriteError, match="disk full"):
+        w.flush()
+    w.close()
+
+
+def test_async_writer_metrics():
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    w = AsyncCheckpointWriter(metrics=metrics)
+    w.submit(lambda: 4096)
+    w.close()
+    assert metrics.counter("ckpt_writes_total").value == 1
+    assert metrics.counter("ckpt_bytes_total").value == 4096
+    assert metrics.histogram("ckpt_write_seconds").count == 1
+    assert metrics.gauge("ckpt_inflight").value == 0
+
+
+# -- preemption drain --------------------------------------------------------
+
+
+def test_preemption_handler_trigger_and_second_signal_semantics():
+    h = PreemptionHandler()
+    assert not h.triggered
+    h.trigger(signal.SIGTERM)
+    assert h.triggered and h.received == signal.SIGTERM
+    h.trigger(signal.SIGINT)  # first signal wins the record
+    assert h.received == signal.SIGTERM
+    assert h.wait(0.01)
+
+
+def test_sigterm_drain_in_process_resumes_bit_exact(tmp_path):
+    """Drain after iteration 1, resume, and match the uninterrupted
+    run's final embedding bit for bit on CPU — the tier-1 version of the
+    chaos drill's kill/resume contract."""
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    corpus = _corpus()
+    cfg = SGNSConfig(dim=8, num_iters=3, batch_pairs=64, seed=5)
+    ref_dir, drain_dir = str(tmp_path / "ref"), str(tmp_path / "drain")
+    SGNSTrainer(corpus, cfg).run(ref_dir, log=lambda s: None)
+    ref = chaos.load_table(ref_dir, 8, 3)
+
+    h = PreemptionHandler()
+
+    def log(msg):
+        if "iteration 1 done" in msg:
+            h.trigger(signal.SIGTERM)
+
+    SGNSTrainer(corpus, cfg).run(drain_dir, log=log, preempt=h)
+    assert ckpt.latest_iteration(drain_dir, 8) == 1  # drained, committed
+    with open(os.path.join(drain_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["interrupted"] is True
+    assert manifest["completed_iteration"] == 1
+
+    SGNSTrainer(corpus, cfg).run(drain_dir, log=lambda s: None)
+    assert np.array_equal(ref, chaos.load_table(drain_dir, 8, 3))
+
+
+def test_sigterm_drain_cli_exit_code(tmp_path):
+    """The real training CLI maps a SIGTERM drain to EXIT_PREEMPTED
+    and leaves a committed, resumable export dir."""
+    data = tmp_path / "corpus"
+    data.mkdir()
+    rng = np.random.RandomState(0)
+    lines = [f"G{a} G{b}" for a, b in rng.randint(0, 15, size=(120, 2))]
+    (data / "pairs.txt").write_text("\n".join(lines) + "\n")
+    export = str(tmp_path / "out")
+    r = chaos.run_cli_kill_on(
+        chaos.gene2vec_argv(
+            str(data), export, dim=8, iters=3, batch_pairs=32
+        ),
+        r"iteration 1 done",
+        sig=signal.SIGTERM,
+        timeout=300,
+    )
+    assert r.returncode == EXIT_PREEMPTED, r.output[-2000:]
+    assert ckpt.latest_iteration(export, 8) >= 1
+    with open(os.path.join(export, "manifest.json")) as f:
+        assert json.load(f)["interrupted"] is True
+
+
+def test_async_checkpoint_run_matches_sync(tmp_path):
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    corpus = _corpus(seed=2)
+    cfg = SGNSConfig(dim=8, num_iters=2, batch_pairs=64, seed=9)
+    sync_dir, async_dir = str(tmp_path / "s"), str(tmp_path / "a")
+    SGNSTrainer(corpus, cfg).run(sync_dir, log=lambda s: None)
+    SGNSTrainer(
+        corpus, dataclasses.replace(cfg, async_checkpoint=True)
+    ).run(async_dir, log=lambda s: None)
+    assert np.array_equal(
+        chaos.load_table(sync_dir, 8, 2), chaos.load_table(async_dir, 8, 2)
+    )
+    # every async checkpoint committed with a verifying manifest
+    for it in (1, 2):
+        assert snap.verify_manifest(
+            os.path.join(async_dir, f"gene2vec_dim_8_iter_{it}")
+        ).ok
+
+
+# -- budget wiring -----------------------------------------------------------
+
+
+def test_async_overhead_budget_entry_is_honest():
+    """The drill's overhead gate reads budgets.json; pin the contract
+    values so the <2% acceptance criterion cannot drift silently."""
+    from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+    entry = load_budgets()["resilience"]["async_ckpt"]
+    assert entry["max_overhead_fraction"] <= 0.02
+    assert entry["reference_overhead_fraction"] <= entry["max_overhead_fraction"]
+    assert entry["txt_output"] is False
+
+
+# -- the full drill ----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_drill_smoke():
+    """End-to-end fault injection against the real CLIs (SIGKILL at a
+    random step → bit-exact resume; serve no-garbage-swap; async
+    overhead budget)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_drill.py"),
+         "--smoke", "--seed", "23"],
+        capture_output=True, text=True, timeout=590,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-3000:]
+    doc = json.loads(proc.stdout)
+    assert doc["passed"] is True
+    assert set(doc["phases"]) == {
+        "training_resume", "corruption", "serve", "async_overhead"
+    }

@@ -100,9 +100,12 @@ def test_registry_hot_swap_is_atomic_under_reader(export_dir):
 
 
 def test_registry_text_format_fallback(export_dir):
-    # strip the npz checkpoints: only the reference-style text exports
-    # remain, exercising the streaming word2vec reader path
-    for p in export_dir.glob("*.npz"):
+    # strip the npz checkpoints AND their manifests: only the
+    # reference-style text exports remain (the reference scripts write
+    # neither), exercising the streaming word2vec reader path
+    for p in list(export_dir.glob("*.npz")) + list(
+        export_dir.glob("*.MANIFEST.json")
+    ):
         p.unlink()
     assert discover_newest(str(export_dir))[2].endswith("_w2v.txt")
     reg = ModelRegistry(str(export_dir))
