@@ -1,0 +1,162 @@
+"""Fleet availability budget gate: BENCH_FLEET vs budgets.json.
+
+The fleet chaos drill (``scripts/chaos_drill.py``, phase ``fleet``)
+records client-observed availability, answer-integrity counts, and
+retry amplification into ``BENCH_FLEET_r08.json``.  This pass re-checks
+that committed record against the ``fleet`` section of ``budgets.json``
+every ``cli.analyze`` run, so an availability regression — a drill
+rerun stamping worse numbers, or a budget quietly loosened — fails the
+analyzer exactly like a collective-bytes regression does.
+
+Deliberately jax-free and I/O-only (two small JSON reads): it runs in
+the default tier, not behind ``--hlo``.  A missing bench file is an
+*info* finding, not a gate — a fresh checkout must not fail lint before
+its first drill — but a bench file that exists and violates the budget
+gates hard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+BENCH_FLEET_PATH = os.path.join(REPO_ROOT, "BENCH_FLEET_r08.json")
+
+_PASS = "fleet-availability-budget"
+
+
+def fleet_budget_findings(
+    bench_path: str = BENCH_FLEET_PATH,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the recorded fleet drill results against the budget."""
+    budgets: Dict = load_budgets(budgets_path).get("fleet", {})
+    if not budgets:
+        return []
+    label = os.path.basename(bench_path)
+    if not os.path.exists(bench_path):
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no fleet bench recorded yet ({label} missing); run "
+                "`python scripts/chaos_drill.py --only fleet --fleet-out "
+                f"{label}` to stamp one"
+            ),
+        )]
+    try:
+        with open(bench_path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable fleet bench: {e}",
+        )]
+
+    findings: List[Finding] = []
+    for name, budget in budgets.items():
+        if name.startswith("_"):
+            continue
+        section = bench.get("fleet") or bench.get("phases", {}).get("fleet")
+        if not isinstance(section, dict):
+            findings.append(Finding(
+                pass_id=_PASS,
+                path=label,
+                message=(
+                    f"{label} has no 'fleet' results section to check "
+                    f"against budget {name!r}"
+                ),
+            ))
+            continue
+        findings.extend(_check_one(name, budget, section, label))
+    return findings
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _check_one(
+    name: str, budget: Dict, section: Dict, label: str
+) -> List[Finding]:
+    availability = _get(section, "availability")
+    amplification = _get(section, "retry_amplification")
+    mixed = _get(section, "mixed_iteration_answers")
+    wrong = _get(section, "wrong_answers")
+    data = {
+        "budget": name,
+        "availability": availability,
+        "min_availability": budget["min_availability"],
+        "retry_amplification": amplification,
+        "max_retry_amplification": budget["max_retry_amplification"],
+        "mixed_iteration_answers": mixed,
+        "wrong_answers": wrong,
+    }
+    # every budgeted quantity must be PRESENT: a record missing a field
+    # must gate like a violation, or dropping the key becomes the way
+    # to pass (availability is checked the same way below)
+    problems: List[str] = []
+    if availability is None:
+        problems.append("availability missing from the bench record")
+    elif availability < float(budget["min_availability"]):
+        problems.append(
+            f"availability {availability:.4f} < budget "
+            f"{budget['min_availability']}"
+        )
+    if amplification is None:
+        problems.append(
+            "retry_amplification missing from the bench record"
+        )
+    elif amplification > float(budget["max_retry_amplification"]):
+        problems.append(
+            f"retry amplification {amplification:.3f} > budget "
+            f"{budget['max_retry_amplification']} (retries are "
+            "multiplying load instead of being budgeted)"
+        )
+    # each answer-integrity count has its OWN budget key: sharing one
+    # ceiling would let loosening the mixed-answer budget silently
+    # loosen the wrong-answer gate too
+    for what, count, ceiling in (
+        ("mixed-iteration", mixed,
+         float(budget.get("max_mixed_iteration_answers", 0))),
+        ("wrong", wrong, float(budget.get("max_wrong_answers", 0))),
+    ):
+        if count is None:
+            problems.append(
+                f"{what.replace('-', '_')}_answers missing from the "
+                "bench record"
+            )
+        elif count > ceiling:
+            problems.append(
+                f"{int(count)} {what} answer(s) recorded (budget "
+                f"{int(ceiling)}) — answer integrity is broken "
+                "somewhere in the serve path"
+            )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                f"fleet drill record violates budget {name!r}: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"fleet availability {availability:.4f} within budget "
+            f"{name!r} (>= {budget['min_availability']})"
+        ),
+        data=data,
+    )]

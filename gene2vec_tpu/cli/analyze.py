@@ -141,6 +141,12 @@ def _run(args) -> int:
         findings.extend(
             check_summaries(os.path.join(REPO_ROOT, "docs"), count)
         )
+        # the fleet availability gate is two JSON reads — it rides the
+        # default tier so a regressed BENCH_FLEET record fails analyze
+        # without anyone remembering to pass a flag
+        from gene2vec_tpu.analysis.passes_fleet import fleet_budget_findings
+
+        findings.extend(fleet_budget_findings())
 
     if args.hlo:
         _pin_cpu_backend()

@@ -1,0 +1,190 @@
+"""Fleet CLI: N supervised serve replicas behind one front-door proxy.
+
+::
+
+    python -m gene2vec_tpu.cli.fleet --export-dir exports/ --replicas 3
+
+Spawns ``--replicas`` ``cli.serve`` children over the same export dir,
+health-checks and restarts them (``serve/fleet.py``), and serves the
+round-robin ``/v1/*`` proxy on ``--port``.  Emits exactly ONE JSON line
+on stdout once the front door is listening::
+
+    {"url": ..., "replicas": 3, "replica_urls": [...],
+     "replica_pids": [...], "run_dir": ...}
+
+— the same machine contract as ``cli.serve`` (``scripts/serve_loadgen``
+and ``scripts/chaos_drill.py`` parse it; the drill SIGKILLs replicas by
+the advertised pids).  Human chatter goes to stderr; every fleet session
+stamps an obs ``Run`` manifest (default run dir
+``<export_dir>/fleet_runs/<unix-ts>``) whose registry backs the front
+door's ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fleet",
+        description="Supervised multi-replica serving fleet with a "
+        "resilient front-door proxy.",
+    )
+    p.add_argument("--export-dir", required=True,
+                   help="io/checkpoint.py export dir every replica serves")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100,
+                   help="front-door port; 0 picks an ephemeral one "
+                        "(printed in the JSON status line)")
+    p.add_argument("--health-interval", type=float, default=0.5,
+                   help="seconds between replica readiness probes")
+    p.add_argument("--unhealthy-after", type=int, default=3,
+                   help="consecutive probe failures before ejection")
+    p.add_argument("--readmit-after", type=int, default=2,
+                   help="consecutive probe passes before re-admission")
+    p.add_argument("--backoff-base", type=float, default=0.5,
+                   help="restart backoff base (doubles per attempt, "
+                        "jittered)")
+    p.add_argument("--storm-max-restarts", type=int, default=5,
+                   help="restarts within --storm-window before a slot "
+                        "is abandoned")
+    p.add_argument("--storm-window", type=float, default=60.0)
+    p.add_argument("--proxy-attempts", type=int, default=3,
+                   help="front-door max attempts per request "
+                        "(failover across replicas)")
+    p.add_argument("--proxy-timeout-ms", type=float, default=5000.0,
+                   help="front-door default per-request deadline")
+    p.add_argument("--hedge", action="store_true",
+                   help="enable p95 hedging on the front-door client")
+    p.add_argument("--seed", type=int, default=None,
+                   help="restart-jitter seed (reproducible drills)")
+    p.add_argument("--run-dir", default=None,
+                   help="obs run dir (default: "
+                        "<export-dir>/fleet_runs/<unix-ts>)")
+    p.add_argument("--serve-arg", action="append", default=[],
+                   help="extra flag passed to EVERY replica's cli.serve "
+                        "(repeatable)")
+    p.add_argument("--replica-arg", action="append", default=[],
+                   metavar="IDX:FLAG",
+                   help="extra flag for ONE replica, as <index>:<flag> "
+                        "(repeatable; the drill injects faults into a "
+                        "single replica this way)")
+    return p
+
+
+def parse_replica_args(pairs: List[str]) -> dict:
+    out: dict = {}
+    for pair in pairs:
+        idx, sep, flag = pair.partition(":")
+        if not sep:
+            raise ValueError(
+                f"--replica-arg must be <index>:<flag>, got {pair!r}"
+            )
+        out.setdefault(int(idx), []).append(flag)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import random
+    import signal
+
+    from gene2vec_tpu.obs.run import Run
+    from gene2vec_tpu.serve.client import RetryPolicy
+    from gene2vec_tpu.serve.fleet import (
+        FleetConfig,
+        FleetProxy,
+        FleetSupervisor,
+    )
+
+    run_dir = args.run_dir or os.path.join(
+        args.export_dir, "fleet_runs", str(int(time.time()))
+    )
+    run = Run(run_dir, name="fleet", config=vars(args))
+
+    # installed BEFORE any replica exists: a SIGTERM during the (long,
+    # jax-importing) start window must still tear the replicas down —
+    # dying silently would orphan N serving processes
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_term)
+    supervisor = FleetSupervisor(
+        args.export_dir,
+        config=FleetConfig(
+            replicas=args.replicas,
+            health_interval_s=args.health_interval,
+            unhealthy_after=args.unhealthy_after,
+            readmit_after=args.readmit_after,
+            backoff_base_s=args.backoff_base,
+            storm_max_restarts=args.storm_max_restarts,
+            storm_window_s=args.storm_window,
+        ),
+        serve_args=args.serve_arg,
+        replica_args=parse_replica_args(args.replica_arg),
+        metrics=run.registry,
+        rng=random.Random(args.seed),
+    )
+    try:
+        supervisor.start()
+    except BaseException as e:
+        # start() already tears down its own replicas on failure; the
+        # extra stop() here is an idempotent belt for interrupt timing
+        supervisor.stop()
+        print(f"error: fleet failed to start: {e!r}", file=sys.stderr)
+        run.close()
+        return 2
+    proxy = FleetProxy(
+        supervisor,
+        metrics=run.registry,
+        policy=RetryPolicy(
+            max_attempts=args.proxy_attempts,
+            default_timeout_s=args.proxy_timeout_ms / 1000.0,
+            hedge=args.hedge,
+        ),
+    )
+    url = proxy.serve(args.host, args.port)
+    run.annotate(fleet_url=url)
+    run.event(
+        "fleet_start", url=url, replicas=args.replicas,
+        replica_urls=[r.url for r in supervisor.replicas],
+    )
+    print(
+        json.dumps(
+            {
+                "url": url,
+                "replicas": args.replicas,
+                "replica_urls": [r.url for r in supervisor.replicas],
+                "replica_pids": [r.pid for r in supervisor.replicas],
+                "run_dir": run.run_dir,
+            }
+        ),
+        flush=True,
+    )
+    print(
+        f"fleet of {args.replicas} replicas over {args.export_dir} "
+        f"fronted at {url}; run dir {run.run_dir}",
+        file=sys.stderr,
+    )
+    try:
+        while True:
+            time.sleep(3600.0)
+    except KeyboardInterrupt:
+        print("shutting down fleet", file=sys.stderr)
+    finally:
+        proxy.stop()
+        supervisor.stop()
+        run.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

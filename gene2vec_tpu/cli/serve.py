@@ -50,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 disables")
     p.add_argument("--timeout-ms", type=float, default=2000.0,
                    help="default per-request deadline")
+    p.add_argument("--read-timeout", type=float, default=10.0,
+                   help="per-connection read deadline in seconds (slow "
+                        "clients get 408 + close instead of pinning a "
+                        "handler thread)")
+    p.add_argument("--faults", default=None, metavar="JSON",
+                   help="resilience/faults.py FaultSpec as JSON — "
+                        "deterministic HTTP fault injection for drills "
+                        "(default: $GENE2VEC_TPU_FAULTS when set, else "
+                        "no injection)")
     p.add_argument("--poll-interval", type=float, default=5.0,
                    help="seconds between export-dir rescans (hot swap)")
     p.add_argument("--run-dir", default=None,
@@ -80,6 +89,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.export_dir, "serve_runs", str(int(time.time()))
     )
     run = Run(run_dir, name="serve", config=vars(args))
+    fault_injector = None
+    if args.faults is not None:
+        from gene2vec_tpu.resilience.faults import FaultInjector, FaultSpec
+
+        fault_injector = FaultInjector(FaultSpec.from_json(args.faults))
+    else:
+        from gene2vec_tpu.resilience.faults import FaultInjector
+
+        fault_injector = FaultInjector.from_env()
+    if fault_injector is not None:
+        print(
+            f"FAULT INJECTION ACTIVE: {fault_injector.spec.to_json()}",
+            file=sys.stderr,
+        )
     sharding = None
     mesh = None
     if args.shard_rows:
@@ -112,10 +135,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_queue=args.max_queue,
             cache_size=args.cache_size,
             timeout_ms=args.timeout_ms,
+            read_timeout_s=args.read_timeout,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,
         mesh=mesh,
+        fault_injector=fault_injector,
     ).start()
     server = make_server(app, args.host, args.port)
     host, port = server.server_address[:2]

@@ -9,10 +9,15 @@ Layering (docs/SERVING.md):
 * :mod:`~gene2vec_tpu.serve.batcher` — micro-batching with max-delay /
   max-batch admission, bounded-queue backpressure, deadlines, LRU;
 * :mod:`~gene2vec_tpu.serve.interaction` — GGIPNN pair scoring;
-* :mod:`~gene2vec_tpu.serve.server` — the stdlib JSON HTTP API.
+* :mod:`~gene2vec_tpu.serve.server` — the stdlib JSON HTTP API;
+* :mod:`~gene2vec_tpu.serve.client` — the resilient caller (retries
+  with deadline propagation + budgets, hedging, circuit breakers);
+* :mod:`~gene2vec_tpu.serve.fleet` — replica supervision and the
+  front-door round-robin proxy.
 
-``python -m gene2vec_tpu.cli.serve`` runs the stack;
-``scripts/serve_loadgen.py`` measures it.
+``python -m gene2vec_tpu.cli.serve`` runs one replica,
+``python -m gene2vec_tpu.cli.fleet`` a supervised fleet;
+``scripts/serve_loadgen.py`` measures either.
 """
 
 from gene2vec_tpu.serve.batcher import (
@@ -20,16 +25,30 @@ from gene2vec_tpu.serve.batcher import (
     MicroBatcher,
     RejectedError,
 )
+from gene2vec_tpu.serve.client import (
+    CircuitBreaker,
+    ClientResponse,
+    ResilientClient,
+    RetryPolicy,
+)
 from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.fleet import FleetConfig, FleetProxy, FleetSupervisor
 from gene2vec_tpu.serve.registry import LoadedModel, ModelRegistry
 from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
 
 __all__ = [
+    "CircuitBreaker",
+    "ClientResponse",
     "DeadlineExceeded",
+    "FleetConfig",
+    "FleetProxy",
+    "FleetSupervisor",
     "LoadedModel",
     "MicroBatcher",
     "ModelRegistry",
     "RejectedError",
+    "ResilientClient",
+    "RetryPolicy",
     "ServeApp",
     "ServeConfig",
     "SimilarityEngine",

@@ -1,0 +1,633 @@
+"""Replica fleet: supervision, health-based rotation, front-door proxy.
+
+One ``cli.serve`` process is a single point of failure; production
+embedding services run a *fleet* — N replicas over the same export dir
+behind a router that treats replica death, wedging, and overload as
+routine.  This module is that layer, stdlib-only like the rest of
+``serve/``:
+
+* :class:`FleetSupervisor` spawns N ``python -m gene2vec_tpu.cli.serve``
+  children over one export dir, parses each child's one-line stdout JSON
+  contract for its bound URL, and runs a monitor loop that
+
+  - **health-checks** every replica's ``/healthz`` (the *readiness*
+    probe — a replica that answers but has no model is ejected, not
+    restarted);
+  - **ejects** a replica from rotation after ``unhealthy_after``
+    consecutive probe failures and **re-admits** it after
+    ``readmit_after`` consecutive passes;
+  - **restarts** crashed or wedged replicas with jittered exponential
+    backoff, and permanently fails a slot that restarts more than
+    ``storm_max_restarts`` times within ``storm_window_s`` (a
+    restart-storm cap: a poisoned export must not grind the host with
+    fork loops);
+  - publishes fleet state via obs metrics: ``replica_up`` (gauge, in-
+    rotation count), ``replica_restarts_total`` (counter), and
+    per-replica ``replica_<i>_up`` gauges.
+
+* :class:`FleetProxy` is the front door: a ``ThreadingHTTPServer`` that
+  forwards ``/v1/*`` to the healthy set through a
+  :class:`~gene2vec_tpu.serve.client.ResilientClient` (round-robin,
+  retry-safe failover, per-replica circuit breakers, deadline
+  propagation via the body's ``timeout_ms``).  ``/healthz`` reports
+  fleet readiness (503 until at least one replica is in rotation),
+  ``/livez`` process liveness, ``/metrics`` the fleet registry.
+
+``python -m gene2vec_tpu.cli.fleet`` runs both and prints the same
+one-line stdout contract as ``cli.serve`` (plus replica facts), so
+loadgen and the chaos drill drive a fleet exactly like a single server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue as queue_mod
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Deque, Dict, List, Optional, Sequence
+from urllib.parse import urlparse
+
+from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+
+
+class ReplicaState:
+    STARTING = "starting"    # spawned, waiting for contract line / health
+    UP = "up"                # in rotation
+    EJECTED = "ejected"      # alive but failing readiness; out of rotation
+    BACKOFF = "backoff"      # dead, waiting out restart backoff
+    FAILED = "failed"        # restart storm cap hit; given up
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Supervision policy (cli/fleet.py flags)."""
+
+    replicas: int = 3
+    health_interval_s: float = 0.5
+    health_timeout_s: float = 2.0
+    unhealthy_after: int = 3     # consecutive probe failures -> eject
+    readmit_after: int = 2       # consecutive passes -> back in rotation
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    jitter_frac: float = 0.5     # uniform [1-j, 1+j] x the backoff
+    storm_window_s: float = 60.0
+    storm_max_restarts: int = 5
+    contract_timeout_s: float = 120.0  # first stdout line deadline
+
+
+class Replica:
+    """One supervised ``cli.serve`` child and its rotation state."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc: Optional[subprocess.Popen] = None
+        self.url: Optional[str] = None
+        self.state = ReplicaState.STARTING
+        self.consecutive_failures = 0
+        self.consecutive_passes = 0
+        self.restarts = 0
+        self.restart_times: Deque[float] = deque()
+        self.next_restart_at = 0.0
+        self.last_error: Optional[str] = None
+        self.spawning = False  # a respawn thread is working on this slot
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def read_contract_line(proc: subprocess.Popen, timeout_s: float) -> dict:
+    """Parse a serve-family CLI's one stdout JSON contract line under a
+    deadline — a child that wedges before printing must fail the caller,
+    not hang it (the chaos-drill lesson, now shared)."""
+    q: "queue_mod.Queue[Optional[str]]" = queue_mod.Queue()
+    assert proc.stdout is not None
+
+    def pump() -> None:
+        q.put(proc.stdout.readline())
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        line = q.get(timeout=timeout_s)
+    except queue_mod.Empty:
+        raise TimeoutError(
+            f"child pid {proc.pid} printed no contract line within "
+            f"{timeout_s}s"
+        ) from None
+    if not line:
+        raise RuntimeError(
+            f"child exited (rc={proc.poll()}) before printing its "
+            "contract line (its stderr is above)"
+        )
+    return json.loads(line)
+
+
+class FleetSupervisor:
+    """Spawns, health-checks, ejects/re-admits, and restarts N replicas.
+
+    ``serve_args`` go to every child verbatim; ``replica_args`` maps a
+    replica index to extra per-replica flags (the drill uses it to turn
+    fault injection on for exactly one replica).  ``rng`` seeds the
+    restart jitter for reproducible drills.
+    """
+
+    def __init__(
+        self,
+        export_dir: str,
+        config: FleetConfig = FleetConfig(),
+        serve_args: Sequence[str] = (),
+        replica_args: Optional[Dict[int, Sequence[str]]] = None,
+        metrics=None,
+        env: Optional[Dict[str, str]] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.export_dir = export_dir
+        self.config = config
+        self.serve_args = list(serve_args)
+        self.replica_args = {
+            int(k): list(v) for k, v in (replica_args or {}).items()
+        }
+        self.metrics = metrics
+        self.env = env
+        self._rng = rng if rng is not None else random.Random()
+        self.replicas = [Replica(i) for i in range(config.replicas)]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- metrics -----------------------------------------------------------
+
+    def _publish(self) -> None:
+        if self.metrics is None:
+            return
+        up = sum(1 for r in self.replicas if r.state == ReplicaState.UP)
+        self.metrics.gauge("replica_up").set(up)
+        for r in self.replicas:
+            self.metrics.gauge(f"replica_{r.index}_up").set(
+                1 if r.state == ReplicaState.UP else 0
+            )
+
+    def _count_restart(self) -> None:
+        if self.metrics is not None:
+            self.metrics.counter("replica_restarts_total").inc()
+
+    # -- spawning ----------------------------------------------------------
+
+    def _argv(self, index: int) -> List[str]:
+        return [
+            sys.executable, "-m", "gene2vec_tpu.cli.serve",
+            "--export-dir", self.export_dir, "--port", "0",
+            *self.serve_args, *self.replica_args.get(index, []),
+        ]
+
+    def _spawn(self, replica: Replica) -> None:
+        """Start (or restart) one replica and read its contract line.
+        Raises on a child that dies or wedges before binding — and in
+        that case KILLS the child first: a wedged-but-alive process left
+        behind would make the slot look alive forever (``r.alive``
+        gates the restart branch) while probing a stale URL."""
+        env = dict(os.environ)
+        # the contract line must not sit in a block buffer while the
+        # supervisor waits on it
+        env["PYTHONUNBUFFERED"] = "1"
+        env.update(self.env or {})
+        replica.url = None  # no probe may hit the previous incarnation
+        replica.proc = subprocess.Popen(
+            self._argv(replica.index),
+            stdout=subprocess.PIPE, stderr=None, text=True, env=env,
+        )
+        try:
+            info = read_contract_line(
+                replica.proc, self.config.contract_timeout_s
+            )
+        except Exception:
+            replica.proc.kill()
+            try:
+                replica.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+            raise
+        replica.url = info["url"].rstrip("/")
+        replica.consecutive_failures = 0
+        replica.consecutive_passes = 0
+        replica.state = ReplicaState.STARTING
+
+    def start(self) -> "FleetSupervisor":
+        """Spawn every replica, wait until each passes readiness once,
+        then start the monitor loop.  A replica that cannot start at all
+        fails ``start`` — a fleet that begins life degraded is a config
+        error, not a runtime event.  ANY startup failure (a _spawn
+        exception, a readiness timeout, a SIGTERM mid-start) tears down
+        the replicas already launched — a failed start must not orphan
+        N serving processes."""
+        try:
+            for r in self.replicas:
+                self._spawn(r)
+            deadline = time.monotonic() + self.config.contract_timeout_s
+            for r in self.replicas:
+                while time.monotonic() < deadline:
+                    if self._probe(r):
+                        r.state = ReplicaState.UP
+                        break
+                    time.sleep(0.1)
+                if r.state != ReplicaState.UP:
+                    raise TimeoutError(
+                        f"replica {r.index} ({r.url}) never became ready"
+                    )
+        except BaseException:
+            self.stop()
+            raise
+        self._publish()
+        self._stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+            self._monitor = None
+        for r in self.replicas:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.terminate()
+        for r in self.replicas:
+            if r.proc is not None:
+                try:
+                    r.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    r.proc.kill()
+                    r.proc.wait(timeout=10.0)
+
+    # -- health ------------------------------------------------------------
+
+    def _probe(self, replica: Replica) -> bool:
+        """One readiness probe.  False for connect failure, non-200, or
+        a wedged replica (read timeout) alike — rotation only cares
+        whether this replica can answer a real request right now."""
+        if replica.url is None:
+            return False
+        try:
+            with urllib.request.urlopen(
+                f"{replica.url}/healthz",
+                timeout=self.config.health_timeout_s,
+            ) as resp:
+                return resp.status == 200
+        except Exception as e:
+            replica.last_error = repr(e)[:200]
+            return False
+
+    def healthy_urls(self) -> List[str]:
+        """The current rotation — what the proxy's client routes over."""
+        with self._lock:
+            return [
+                r.url for r in self.replicas
+                if r.state == ReplicaState.UP and r.url
+            ]
+
+    def states(self) -> List[Dict]:
+        with self._lock:
+            return [
+                {
+                    "index": r.index,
+                    "state": r.state,
+                    "url": r.url,
+                    "pid": r.pid,
+                    "restarts": r.restarts,
+                    "last_error": r.last_error,
+                }
+                for r in self.replicas
+            ]
+
+    # -- the monitor loop --------------------------------------------------
+
+    def _schedule_restart(self, replica: Replica, now: float) -> None:
+        """Death observed: apply the storm cap, then pick the jittered
+        exponential backoff for the next spawn attempt."""
+        window = self.config.storm_window_s
+        while replica.restart_times and (
+            now - replica.restart_times[0] > window
+        ):
+            replica.restart_times.popleft()
+        if len(replica.restart_times) >= self.config.storm_max_restarts:
+            replica.state = ReplicaState.FAILED
+            replica.last_error = (
+                f"restart storm: {len(replica.restart_times)} restarts "
+                f"in {window:.0f}s — giving up on this slot"
+            )
+            if self.metrics is not None:
+                self.metrics.counter("replica_storm_failures_total").inc()
+            return
+        n = len(replica.restart_times)
+        backoff = min(
+            self.config.backoff_base_s * (2 ** n),
+            self.config.backoff_max_s,
+        ) * (
+            1.0 + self.config.jitter_frac * (2 * self._rng.random() - 1)
+        )
+        replica.state = ReplicaState.BACKOFF
+        replica.next_restart_at = now + backoff
+
+    def _respawn(self, replica: Replica) -> None:
+        """One restart attempt, on its own thread: a respawn blocks on
+        the child's whole startup (a jax import can take tens of
+        seconds), and running it inside the monitor loop would blind
+        supervision of every OTHER replica for that long."""
+        try:
+            if self._stop.is_set():
+                return
+            self._spawn(replica)
+            if self._stop.is_set():
+                # the fleet stopped while we were spawning: this child
+                # raced past stop()'s terminate sweep — reap it here
+                if replica.proc is not None:
+                    replica.proc.kill()
+                    replica.proc.wait(timeout=10.0)
+                return
+            replica.restarts += 1
+            self._count_restart()
+        except Exception as e:
+            replica.last_error = repr(e)[:200]
+            self._schedule_restart(replica, time.monotonic())
+        finally:
+            replica.spawning = False
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        probe_list: List[Replica] = []
+        for r in self.replicas:
+            if r.state == ReplicaState.FAILED or r.spawning:
+                continue
+            if not r.alive:
+                if r.state == ReplicaState.BACKOFF:
+                    if now >= r.next_restart_at:
+                        # the ATTEMPT counts toward the storm window —
+                        # a child that crashes before its contract line
+                        # must still grow the backoff and trip the cap,
+                        # or a bad flag becomes an eternal fork loop
+                        r.restart_times.append(now)
+                        r.spawning = True
+                        threading.Thread(
+                            target=self._respawn, args=(r,),
+                            name=f"fleet-respawn-{r.index}", daemon=True,
+                        ).start()
+                else:
+                    # freshly observed death (crash or wedge-kill)
+                    self._schedule_restart(r, now)
+                continue
+            probe_list.append(r)
+        # probes run CONCURRENTLY: one wedged replica (accepts TCP,
+        # never answers — the blackhole class) costs its own
+        # health_timeout_s, not everyone's detection cadence
+        outcomes: Dict[int, bool] = {}
+        probers = [
+            threading.Thread(
+                target=lambda r=r: outcomes.__setitem__(
+                    r.index, self._probe(r)
+                ),
+                daemon=True,
+            )
+            for r in probe_list
+        ]
+        for t in probers:
+            t.start()
+        probe_deadline = (
+            time.monotonic() + self.config.health_timeout_s + 1.0
+        )
+        for t in probers:
+            t.join(timeout=max(0.0, probe_deadline - time.monotonic()))
+        for r in probe_list:
+            # a probe thread still stuck past the deadline counts as a
+            # failed probe — exactly what a wedged replica deserves
+            ok = outcomes.get(r.index, False)
+            with self._lock:
+                if ok:
+                    r.consecutive_failures = 0
+                    r.consecutive_passes += 1
+                    if r.state in (
+                        ReplicaState.STARTING, ReplicaState.EJECTED
+                    ) and r.consecutive_passes >= self.config.readmit_after:
+                        r.state = ReplicaState.UP
+                else:
+                    r.consecutive_passes = 0
+                    r.consecutive_failures += 1
+                    if (
+                        r.state == ReplicaState.UP
+                        and r.consecutive_failures
+                        >= self.config.unhealthy_after
+                    ):
+                        r.state = ReplicaState.EJECTED
+        self._publish()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.config.health_interval_s):
+            try:
+                self._tick()
+            except Exception as e:  # supervision must outlive surprises
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "fleet_monitor_errors_total"
+                    ).inc()
+                print(f"fleet monitor error: {e!r}", file=sys.stderr)
+
+
+# -- the front-door proxy ----------------------------------------------------
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def setup(self) -> None:
+        # the front door is the ADVERTISED address: it needs the same
+        # slow-loris guard as the replicas (serve/server.py), or a
+        # stalling client pins proxy threads the replicas never see
+        self.timeout = self.server.proxy.read_timeout_s  # type: ignore[attr-defined]
+        super().setup()
+
+    def log_message(self, format: str, *args) -> None:
+        pass  # accounting lives in /metrics, like serve/server.py
+
+    def finish(self) -> None:
+        try:
+            super().finish()
+        except OSError:
+            pass
+
+    def _read_body(self, length: int) -> bytes:
+        """Bounded body read: per-recv socket timeout + a wall deadline
+        (the serve/server.py pattern — read1 so a one-byte drip cannot
+        dodge the deadline inside the buffer)."""
+        deadline = time.monotonic() + self.timeout
+        chunks = []
+        got = 0
+        try:
+            while got < length:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("body read deadline exceeded")
+                self.connection.settimeout(min(remaining, self.timeout))
+                chunk = self.rfile.read1(min(65536, length - got))
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                got += len(chunk)
+        finally:
+            try:
+                self.connection.settimeout(self.timeout)
+            except OSError:
+                pass
+        return b"".join(chunks)
+
+    def _reply_json(self, status: int, doc: dict) -> None:
+        payload = json.dumps(doc).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _forward(self, method: str, body: Optional[dict]) -> None:
+        proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
+        resp = proxy.client.request(
+            self.path, body=body, method=method,
+            timeout_s=(
+                float(body["timeout_ms"]) / 1000.0
+                if body and isinstance(body.get("timeout_ms"), (int, float))
+                else None
+            ),
+        )
+        if resp.doc is not None:
+            self._reply_json(resp.status, resp.doc)
+        elif resp.error_class == "deadline":
+            self._reply_json(
+                504, {"error": "fleet deadline exhausted before a "
+                               "replica answered"}
+            )
+        else:
+            self._reply_json(
+                502, {"error": f"no replica answered "
+                               f"({resp.error_class})"}
+            )
+
+    def do_GET(self) -> None:  # noqa: N802
+        proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
+        route = urlparse(self.path).path.rstrip("/") or "/"
+        if route == "/livez":
+            self._reply_json(200, {"status": "alive"})
+            return
+        if route == "/healthz":
+            status, doc = proxy.healthz()
+            self._reply_json(status, doc)
+            return
+        if route == "/metrics":
+            payload = proxy.metrics.prometheus_text().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if route.startswith("/v1/"):
+            self._forward("GET", None)
+            return
+        self._reply_json(404, {"error": f"no route GET {route}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        route = urlparse(self.path).path.rstrip("/") or "/"
+        if not route.startswith("/v1/"):
+            self._reply_json(404, {"error": f"no route POST {route}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self._read_body(length) if length else b"{}"
+            body = json.loads(raw.decode("utf-8")) if raw else {}
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except socket.timeout:
+            proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
+            proxy.metrics.counter("fleet_http_408_total").inc()
+            self.close_connection = True
+            try:
+                self._reply_json(
+                    408, {"error": "request body read timed out"}
+                )
+            except OSError:
+                pass
+            return
+        except (ValueError, UnicodeDecodeError) as e:
+            self._reply_json(400, {"error": f"bad JSON body: {e}"})
+            return
+        self._forward("POST", body)
+
+
+class FleetProxy:
+    """The fleet's single public address.  Owns the resilient client
+    whose target set is the supervisor's LIVE rotation (a callable, so
+    ejections and re-admissions apply to the very next request)."""
+
+    def __init__(
+        self,
+        supervisor: FleetSupervisor,
+        metrics,
+        policy: Optional[RetryPolicy] = None,
+        read_timeout_s: float = 10.0,
+    ):
+        self.supervisor = supervisor
+        self.metrics = metrics
+        self.read_timeout_s = read_timeout_s
+        self.client = ResilientClient(
+            supervisor.healthy_urls,
+            policy=policy if policy is not None else RetryPolicy(
+                max_attempts=3,
+                connect_timeout_s=1.0,
+                default_timeout_s=5.0,
+            ),
+            metrics=metrics,
+        )
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def healthz(self) -> "tuple":
+        states = self.supervisor.states()
+        up = [s for s in states if s["state"] == ReplicaState.UP]
+        doc = {
+            "status": "ok" if up else "not_ready",
+            "replicas_up": len(up),
+            "replicas": states,
+        }
+        return (200 if up else 503), doc
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Bind and serve on a daemon thread; returns the base URL."""
+        server = ThreadingHTTPServer((host, port), _ProxyHandler)
+        server.daemon_threads = True
+        server.proxy = self  # type: ignore[attr-defined]
+        self._server = server
+        self._thread = threading.Thread(
+            target=server.serve_forever, name="fleet-proxy", daemon=True
+        )
+        self._thread.start()
+        bound_host, bound_port = server.server_address[:2]
+        return f"http://{bound_host}:{bound_port}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._thread = None

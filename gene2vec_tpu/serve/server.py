@@ -9,14 +9,27 @@ Endpoints (all JSON; schema in docs/SERVING.md):
 * ``POST /v1/interaction`` — GGIPNN softmax scores for gene pairs;
 * ``GET  /v1/genes``       — a slice of the served vocab (loadgen uses
   this to draw realistic query keys);
-* ``GET  /healthz``        — served model version + queue facts;
+* ``GET  /healthz``        — **readiness**: served model version + queue
+  facts while a model is loaded, 503 ``not_ready`` until then (fleet
+  supervisors and external probes must not route to an empty replica);
+* ``GET  /livez``          — **liveness**: 200 whenever the process can
+  answer HTTP at all, model or no model;
 * ``GET  /metrics``        — the obs Prometheus registry, text format.
 
 Status mapping: queue-full backpressure -> **429**, per-request deadline
 -> **504**, unknown gene / malformed body -> **400**, no model loaded ->
-**503**.  The handler layer is a thin stdlib ``ThreadingHTTPServer``
+**503**, stalled request body (slow loris) -> **408** + connection
+close.  The handler layer is a thin stdlib ``ThreadingHTTPServer``
 shell; every route is a method on :class:`ServeApp`, which tests drive
 directly and through ephemeral-port HTTP.
+
+Every connection runs under a read deadline (``ServeConfig.
+read_timeout_s``): the socket timeout bounds each recv, and the body
+read additionally runs under a per-request wall deadline, so a client
+dripping one byte per poll cannot pin a handler thread past the
+deadline either.  Fault injection (``resilience/faults.py``) hooks the
+handler behind an explicit opt-in (``--faults`` /
+``GENE2VEC_TPU_FAULTS``) and is entirely absent otherwise.
 
 Each request runs under an obs span (``serve_request``), batches under
 ``serve_batch``/``serve_compute`` (batcher.py) — with a
@@ -29,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -68,6 +82,10 @@ class ServeConfig:
     timeout_ms: float = 2000.0
     max_k: int = 256
     max_queries_per_request: int = 64
+    # per-connection read deadline: bounds both each socket recv and the
+    # total wall time spent reading one request body (slow-loris guard;
+    # expiry -> 408 + close)
+    read_timeout_s: float = 10.0
 
 
 class ServeApp:
@@ -80,10 +98,16 @@ class ServeApp:
         metrics: Optional[MetricsRegistry] = None,
         ggipnn_checkpoint: Optional[str] = None,
         mesh=None,
+        fault_injector=None,
     ):
         self.registry = registry
         self.config = config
+        # resilience/faults.py FaultInjector — None means no fault code
+        # runs at all (the production default)
+        self.faults = fault_injector
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if self.faults is not None and self.faults.metrics is None:
+            self.faults.metrics = self.metrics
         if registry.metrics is None:
             registry.metrics = self.metrics
         if registry.loaded:
@@ -356,22 +380,42 @@ class ServeApp:
             "genes": list(model.tokens[offset : offset + limit]),
         }
 
-    def healthz(self) -> dict:
+    def livez(self) -> dict:
+        """Liveness: the process answers HTTP.  Never inspects the
+        registry — a replica mid-load (or quarantined with no fallback)
+        is alive-but-not-ready, and restarting it would only lose the
+        load progress."""
+        return {
+            "status": "alive",
+            "uptime_s": round(time.monotonic() - self._started, 3),
+        }
+
+    def healthz(self) -> Tuple[int, dict]:
+        """Readiness: 200 with model facts once a model is served; 503
+        ``not_ready`` until then, so fleet routers and external probes
+        never send traffic to an empty replica."""
+        ready = self.registry.loaded
         out = {
-            "status": "ok" if self.registry.loaded else "loading",
+            "status": "ok" if ready else "not_ready",
             "uptime_s": round(time.monotonic() - self._started, 3),
             "queue_depth": len(self.batcher._q),
             "max_queue": self.config.max_queue,
         }
-        if self.registry.loaded:
-            m = self.registry.model
-            out["model"] = {
-                "dim": m.dim,
-                "iteration": m.iteration,
-                "vocab_size": len(m),
-                "source": m.source,
-            }
-        return out
+        if not ready:
+            quarantined = getattr(self.registry, "quarantined", {})
+            out["reason"] = (
+                "every discovered checkpoint is quarantined"
+                if quarantined else "no model loaded yet"
+            )
+            return 503, out
+        m = self.registry.model
+        out["model"] = {
+            "dim": m.dim,
+            "iteration": m.iteration,
+            "vocab_size": len(m),
+            "source": m.source,
+        }
+        return 200, out
 
     def _timeout_s(self, body: dict) -> Optional[float]:
         t = body.get("timeout_ms")
@@ -394,8 +438,12 @@ class ServeApp:
         t0 = time.monotonic()
         try:
             with ambient_span("serve_request", route=route) as span:
+                if method == "GET" and route == "/livez":
+                    return 200, self.livez()
                 if method == "GET" and route == "/healthz":
-                    return 200, self.healthz()
+                    status, doc = self.healthz()
+                    span["status"] = status
+                    return status, doc
                 if method == "GET" and route == "/v1/genes":
                     return 200, self.genes(query)
                 if method == "GET" and route == "/v1/similar":
@@ -431,6 +479,21 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     app: ServeApp  # set by make_server on the server class
 
+    def setup(self) -> None:
+        # the socket timeout is the slow-loris guard's first layer: it
+        # bounds every recv (request line, headers, idle keep-alive) so
+        # a silent client can't hold a handler thread past the deadline
+        self.timeout = self.server.app.config.read_timeout_s  # type: ignore[attr-defined]
+        super().setup()
+
+    def finish(self) -> None:
+        # a connection torn down mid-reply (client gone, injected RST)
+        # must not traceback through socketserver's handle_error
+        try:
+            super().finish()
+        except OSError:
+            pass
+
     def log_message(self, format: str, *args) -> None:
         # default writes per-request lines to stderr; serve volume makes
         # that noise — request accounting lives in /metrics instead
@@ -450,9 +513,81 @@ class _Handler(BaseHTTPRequestHandler):
             "application/json",
         )
 
+    def _inject_fault(self, route: str) -> bool:
+        """Apply the configured fault decision for this request, if any.
+        Returns True when the fault terminated the request (a reply was
+        substituted, the connection was reset, or the response was
+        blackholed) — the caller must not dispatch."""
+        app = self.server.app  # type: ignore[attr-defined]
+        if app.faults is None:
+            return False
+        decision = app.faults.decide(route)
+        if decision is None:
+            return False
+        if decision.delay_s:
+            time.sleep(decision.delay_s)
+        if decision.kind is None:
+            return False  # pure added latency; proceed normally
+        self.close_connection = True
+        if decision.kind == "error":
+            self._reply_json(
+                int(decision.arg),
+                {"error": "injected fault (resilience drill)"},
+            )
+        elif decision.kind == "reset":
+            from gene2vec_tpu.resilience.faults import apply_reset
+
+            apply_reset(self.connection)
+        elif decision.kind == "blackhole":
+            # hold the socket open, answer nothing: the client's read
+            # timeout is the only way out (bounded so the drill's own
+            # handler threads drain)
+            time.sleep(decision.arg)
+        return True
+
+    def _read_body(self, length: int) -> bytes:
+        """Read exactly ``length`` body bytes under BOTH timeout layers:
+        the per-recv socket timeout (already armed in :meth:`setup`) and
+        a wall deadline of ``read_timeout_s`` for the whole body — a
+        client dripping one byte per recv window defeats the former but
+        not the latter."""
+        deadline = time.monotonic() + self.server.app.config.read_timeout_s  # type: ignore[attr-defined]
+        chunks: List[bytes] = []
+        got = 0
+        try:
+            while got < length:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout(
+                        "request body read deadline exceeded"
+                    )
+                self.connection.settimeout(min(remaining, self.timeout))
+                # read1 = at most ONE underlying recv: a client dripping
+                # single bytes returns here every drip, so the
+                # wall-deadline check above actually runs (plain read(n)
+                # loops inside the buffer until n bytes arrive and each
+                # drip resets its recv window — the deadline would never
+                # be consulted)
+                chunk = self.rfile.read1(min(65536, length - got))
+                if not chunk:
+                    break  # client closed early; json parsing reports it
+                chunks.append(chunk)
+                got += len(chunk)
+        finally:
+            # keep-alive: the NEXT request on this connection gets the
+            # full per-recv window back, not this body's leftover slice
+            try:
+                self.connection.settimeout(self.timeout)
+            except OSError:
+                pass  # connection already torn down mid-read
+        return b"".join(chunks)
+
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
         app = self.server.app  # type: ignore[attr-defined]
-        if urlparse(self.path).path.rstrip("/") == "/metrics":
+        route = urlparse(self.path).path.rstrip("/") or "/"
+        if self._inject_fault(route):
+            return
+        if route == "/metrics":
             self._reply(
                 200,
                 app.metrics.prometheus_text().encode("utf-8"),
@@ -464,12 +599,26 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         app = self.server.app  # type: ignore[attr-defined]
+        if self._inject_fault(urlparse(self.path).path.rstrip("/") or "/"):
+            return
         try:
             length = int(self.headers.get("Content-Length", "0"))
-            raw = self.rfile.read(length) if length else b"{}"
+            raw = self._read_body(length) if length else b"{}"
             body = json.loads(raw.decode("utf-8")) if raw else {}
             if not isinstance(body, dict):
                 raise ValueError("body must be a JSON object")
+        except socket.timeout:
+            # slow loris: the client stalled mid-body.  408, then close —
+            # the handler thread is unpinned and the socket reaped.
+            app.metrics.counter("serve_http_408_total").inc()
+            self.close_connection = True
+            try:
+                self._reply_json(
+                    408, {"error": "request body read timed out"}
+                )
+            except OSError:
+                pass  # client is gone too; nothing to tell it
+            return
         except (ValueError, UnicodeDecodeError) as e:
             self._reply_json(400, {"error": f"bad JSON body: {e}"})
             return

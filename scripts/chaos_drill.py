@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Chaos drill: rehearse the failure model against the real CLIs.
 
-Four phases (docs/RESILIENCE.md runbook):
+Five phases (docs/RESILIENCE.md runbook):
 
 * **training_resume** — run the real training CLI to completion as the
   reference, then SIGKILL a second run at a random ``iteration N done``
@@ -22,6 +22,17 @@ Four phases (docs/RESILIENCE.md runbook):
   ``analysis/budgets.json`` (section ``resilience``) with
   ``async_checkpoint`` on and assert the train loop's checkpoint span
   costs less than ``max_overhead_fraction`` of iteration wall time.
+* **fleet** — spawn the real ``cli.fleet`` (3 supervised replicas + the
+  resilient front-door proxy) over a live export, run closed-loop load
+  through a :class:`~gene2vec_tpu.serve.client.ResilientClient` while
+  one replica is SIGKILLed mid-run and another serves with injected
+  HTTP faults (``resilience/faults.py``: latency, 503 substitution,
+  connection resets, blackholes); assert client-observed availability
+  >= the ``fleet`` budget, ZERO answers that are wrong or mix model
+  iterations, and retry amplification within the retry budget.  Results
+  are stamped into ``BENCH_FLEET_r08.json`` via ``--fleet-out`` and
+  re-gated on every ``cli.analyze`` run
+  (``analysis/passes_fleet.py``).
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -29,9 +40,9 @@ progress chatter goes to stderr.  Exit 0 iff every phase passed.
 Usage::
 
     python scripts/chaos_drill.py                 # full drill
-    python scripts/chaos_drill.py --smoke         # CI-sized (~1 min)
+    python scripts/chaos_drill.py --smoke         # CI-sized (~2 min)
     python scripts/chaos_drill.py --out BENCH_RESILIENCE_r07.json
-    python scripts/chaos_drill.py --only training_resume,serve
+    python scripts/chaos_drill.py --only fleet --fleet-out BENCH_FLEET_r08.json
 """
 
 from __future__ import annotations
@@ -223,26 +234,11 @@ def drill_serve(tmp: str) -> dict:
     try:
         # the contract line is read with a deadline — a serve CLI that
         # hangs before printing it must fail the drill, not wedge it
-        import queue as _queue
-        import threading
+        # (serve/fleet.py read_contract_line is this exact lesson,
+        # extracted; the fleet supervisor and this drill share it)
+        from gene2vec_tpu.serve.fleet import read_contract_line
 
-        q: "_queue.Queue" = _queue.Queue()
-        assert proc.stdout is not None
-        threading.Thread(
-            target=lambda: q.put(proc.stdout.readline()), daemon=True
-        ).start()
-        try:
-            line = q.get(timeout=120.0)
-        except _queue.Empty:
-            raise TimeoutError(
-                "serve CLI printed no contract line within 120s"
-            ) from None
-        if not line:
-            raise RuntimeError(
-                f"serve CLI exited (rc={proc.poll()}) before printing "
-                "its contract line (its stderr is above)"
-            )
-        info = json.loads(line)
+        info = read_contract_line(proc, 120.0)
         url = info["url"]
         log(f"serve CLI up at {url} (iteration {info['iteration']})")
 
@@ -290,6 +286,222 @@ def drill_serve(tmp: str) -> dict:
     finally:
         proc.kill()
         proc.wait(timeout=30)
+
+
+# -- phase: fleet survives replica death + injected faults -------------------
+
+
+def _parse_prom_counters(text: str) -> dict:
+    """name -> value for the plain counter/gauge lines of a Prometheus
+    text exposition (enough to read the fleet client's retry tallies)."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    import threading
+
+    from gene2vec_tpu.resilience.faults import FaultSpec
+    from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    export_dir = os.path.join(tmp, "fleet_export")
+    _write_iteration(export_dir, 1, vocab_size=48, dim=8)
+
+    replicas = int(budget.get("replicas", 3))
+    duration_s = 8.0 if smoke else 20.0
+    workers = 4
+    # the faulty replica: enough injected trouble to matter, spread over
+    # every fault class the injector has; deterministic per drill seed
+    faults = FaultSpec(
+        seed=seed,
+        latency_p=0.25, latency_ms=80.0,
+        error_p=0.15, error_status=503,
+        reset_p=0.05,
+        blackhole_p=0.03, blackhole_hold_s=1.5,
+    )
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir, "--replicas", str(replicas),
+        "--port", "0", "--health-interval", "0.25",
+        "--backoff-base", "0.3", "--proxy-timeout-ms", "4000",
+        "--seed", str(seed),
+        "--replica-arg", "1:--faults", "--replica-arg",
+        f"1:{faults.to_json()}",
+    ]
+    log(f"spawning fleet: {replicas} replicas, faults on replica 1")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        log(f"fleet front door at {url}; replica pids "
+            f"{info['replica_pids']}")
+
+        client = ResilientClient(
+            [url],
+            RetryPolicy(
+                max_attempts=3, default_timeout_s=6.0,
+                read_timeout_s=6.0,
+            ),
+        )
+        # pre-chaos reference answers: every response during chaos must
+        # match one of these EXACTLY (same neighbors, same iteration) —
+        # "zero wrong or cross-iteration answers" is checked per request
+        query_genes = [f"G{i}" for i in range(8)]
+        reference = {}
+        for g in query_genes:
+            r = client.request(
+                "/v1/similar", {"genes": [g], "k": 4}, timeout_s=10.0
+            )
+            assert r.ok, f"reference query failed: {r.error_class}"
+            reference[g] = (
+                r.doc["model"]["iteration"],
+                tuple(n["gene"] for n in r.doc["results"][0]["neighbors"]),
+            )
+
+        counts = {"ok": 0, "failed": 0, "wrong": 0, "mixed": 0,
+                  "attempts": 0, "retries": 0}
+        lock = threading.Lock()
+        stop_at = time.monotonic() + duration_s
+
+        def worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while time.monotonic() < stop_at:
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                r = client.request(
+                    "/v1/similar", {"genes": [g], "k": 4}, timeout_s=6.0
+                )
+                with lock:
+                    counts["attempts"] += r.attempts
+                    counts["retries"] += r.retries
+                    if not r.ok:
+                        counts["failed"] += 1
+                        continue
+                    it = r.doc["model"]["iteration"]
+                    got = tuple(
+                        n["gene"]
+                        for n in r.doc["results"][0]["neighbors"]
+                    )
+                    ref_it, ref_neighbors = reference[g]
+                    if it != ref_it:
+                        counts["mixed"] += 1
+                    elif got != ref_neighbors:
+                        counts["wrong"] += 1
+                    else:
+                        counts["ok"] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+
+        # one third in: SIGKILL a healthy replica (index 0; index 1 is
+        # the fault-injected one and stays up, misbehaving)
+        time.sleep(duration_s / 3.0)
+        victim = info["replica_pids"][0]
+        log(f"SIGKILL replica 0 (pid {victim}) mid-load")
+        os.kill(victim, signal.SIGKILL)
+
+        for t in threads:
+            t.join(timeout=duration_s + 30.0)
+
+        total = (counts["ok"] + counts["failed"] + counts["wrong"]
+                 + counts["mixed"])
+        availability = counts["ok"] / max(total, 1)
+        # replica-level attempts = front-door requests + its internal
+        # retries/hedges (from the fleet /metrics registry); drill-level
+        # attempts already count our own client's retry fan-out
+        prom = _parse_prom_counters(
+            urllib.request.urlopen(url + "/metrics", timeout=10.0)
+            .read().decode("utf-8")
+        )
+        proxy_retries = prom.get("fleet_client_retries_total", 0.0)
+        proxy_hedges = prom.get("fleet_client_hedges_total", 0.0)
+        amplification = (
+            (counts["attempts"] + proxy_retries + proxy_hedges)
+            / max(total, 1)
+        )
+        # the respawn is a fresh jax import — under the load the drill
+        # itself just generated it can outlast the measurement window,
+        # so WAIT for supervision to land rather than asserting on a
+        # race (the availability numbers above are already final)
+        def _restarts() -> int:
+            health = _http_json(url + "/healthz", timeout=10.0)
+            return sum(r["restarts"] for r in health["replicas"])
+
+        try:
+            restarts = wait_until(
+                lambda: _restarts() or None, 90.0, interval_s=0.5,
+                what="supervisor restarting the SIGKILLed replica",
+            )
+        except TimeoutError:
+            restarts = 0
+        result = {
+            "replicas": replicas,
+            "duration_s": duration_s,
+            "workers": workers,
+            "requests": total,
+            "ok": counts["ok"],
+            "failed": counts["failed"],
+            "wrong_answers": counts["wrong"],
+            "mixed_iteration_answers": counts["mixed"],
+            "availability": round(availability, 5),
+            "drill_client_retries": counts["retries"],
+            "proxy_retries": int(proxy_retries),
+            "retry_amplification": round(amplification, 4),
+            "replica_restarts": restarts,
+            "faults_spec": faults.to_json(),
+            "sigkilled_replica": 0,
+            "budget": {k: v for k, v in budget.items()
+                       if not k.startswith("_")},
+        }
+        log(f"fleet: availability {availability:.4f} over {total} "
+            f"requests ({counts['failed']} failed), amplification "
+            f"{amplification:.3f}, {restarts} restart(s)")
+        assert total >= workers * duration_s, (
+            f"suspiciously few requests completed ({total}) — the load "
+            "loop itself wedged"
+        )
+        assert counts["mixed"] == 0, (
+            f"{counts['mixed']} answers mixed model iterations"
+        )
+        assert counts["wrong"] == 0, (
+            f"{counts['wrong']} answers diverged from the pre-chaos "
+            "reference"
+        )
+        assert availability >= float(budget["min_availability"]), (
+            f"availability {availability:.4f} below budget "
+            f"{budget['min_availability']}"
+        )
+        assert amplification <= float(budget["max_retry_amplification"]), (
+            f"retry amplification {amplification:.3f} exceeds budget "
+            f"{budget['max_retry_amplification']}"
+        )
+        assert restarts >= 1, (
+            "the SIGKILLed replica was never restarted — supervision "
+            "is not working"
+        )
+        return result
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
 
 
 # -- phase: async checkpoint overhead ---------------------------------------
@@ -352,7 +564,8 @@ def drill_async_overhead(tmp: str, budget: dict) -> dict:
 # -- driver ------------------------------------------------------------------
 
 
-PHASES = ("training_resume", "corruption", "serve", "async_overhead")
+PHASES = ("training_resume", "corruption", "serve", "async_overhead",
+          "fleet")
 
 
 def main(argv=None) -> int:
@@ -364,6 +577,11 @@ def main(argv=None) -> int:
                     help="CI-sized drill: fewer iterations per phase")
     ap.add_argument("--out", default=None,
                     help="also write the JSON document to this path")
+    ap.add_argument("--fleet-out", default=None, metavar="PATH",
+                    help="also write the fleet phase's results (plus "
+                         "budget) as a standalone bench document, e.g. "
+                         "BENCH_FLEET_r08.json — the record "
+                         "analysis/passes_fleet.py gates on")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -388,7 +606,9 @@ def main(argv=None) -> int:
 
     tmp = args.tmp or tempfile.mkdtemp(prefix="chaos_drill_")
     seed = args.seed if args.seed is not None else int(time.time()) % 100000
-    budget = load_budgets()["resilience"]["async_ckpt"]
+    budgets = load_budgets()
+    budget = budgets["resilience"]["async_ckpt"]
+    fleet_budget = budgets["fleet"]["chaos"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -413,6 +633,10 @@ def main(argv=None) -> int:
                 doc["phases"][phase] = drill_serve(tmp)
             elif phase == "async_overhead":
                 doc["phases"][phase] = drill_async_overhead(tmp, budget)
+            elif phase == "fleet":
+                doc["phases"][phase] = drill_fleet(
+                    tmp, args.smoke, fleet_budget, seed
+                )
         except Exception as e:
             failed = f"{phase}: {e}"
             doc["phases"][phase] = {"error": str(e)}
@@ -428,6 +652,20 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(blob + "\n")
         log(f"wrote {args.out}")
+    if args.fleet_out and "fleet" in doc["phases"]:
+        fleet_doc = {
+            "schema": "gene2vec-tpu/bench-fleet/v1",
+            "bench": "fleet_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["fleet"],
+            "fleet": doc["phases"]["fleet"],
+        }
+        with open(args.fleet_out, "w") as f:
+            f.write(json.dumps(fleet_doc, indent=1) + "\n")
+        log(f"wrote {args.fleet_out}")
     print(blob)
     log("DRILL PASSED" if doc["passed"] else "DRILL FAILED")
     return 0 if doc["passed"] else 1

@@ -15,7 +15,13 @@
 #                                                  # smoke drill (kill/resume
 #                                                  # bit-exactness, torn-export
 #                                                  # no-swap, async-ckpt
-#                                                  # budget; docs/RESILIENCE.md)
+#                                                  # budget, AND the fleet
+#                                                  # smoke: 3 replicas, one
+#                                                  # SIGKILLed + one fault-
+#                                                  # injected under closed-loop
+#                                                  # load, availability gated
+#                                                  # by budgets.json "fleet";
+#                                                  # docs/RESILIENCE.md)
 #   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
 #                                                  # (expect intended-race
 #                                                  # reports; for auditing
@@ -95,9 +101,15 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 if [ "$CHAOS" = "1" ]; then
-  echo "== chaos smoke drill (scripts/chaos_drill.py --smoke) ==" >&2
+  echo "== chaos smoke drill (scripts/chaos_drill.py --smoke; incl. the" >&2
+  echo "   fleet phase: replica kill + fault injection under load) ==" >&2
   CHAOS_OUT="${CHAOS_DRILL_OUT:-/tmp/chaos_drill_smoke.json}"
-  python scripts/chaos_drill.py --smoke > "$CHAOS_OUT" || rc=$?
-  echo "chaos drill: exit $rc -> $CHAOS_OUT" >&2
+  # the fleet results also land in a standalone bench document so the
+  # analyzer's fleet-availability gate can be refreshed from CI runs
+  # (committed BENCH_FLEET_r08.json comes from the full, non-smoke drill)
+  FLEET_OUT="${FLEET_DRILL_OUT:-/tmp/chaos_drill_fleet_smoke.json}"
+  python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
+    > "$CHAOS_OUT" || rc=$?
+  echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT)" >&2
 fi
 exit "$rc"

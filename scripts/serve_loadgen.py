@@ -22,9 +22,15 @@ Two loops:
   back-to-back), the classic saturation-throughput measurement.
 
 Per level: p50/p99/mean latency over successful requests, achieved
-throughput, and the rejected (429) / expired (504) / error counts.  The
-JSON document goes to ``--output`` and stdout (the product — progress
-chatter is stderr-only, matching the repo's stdout discipline).
+throughput, and a full **error-class breakdown** — 429 (backpressure)
+vs 503 (not ready) vs 504 (deadline) vs transport (connect/read
+failure) vs other HTTP — so an availability claim is auditable down to
+*why* requests failed.  With ``--resilient`` every request goes through
+:class:`gene2vec_tpu.serve.client.ResilientClient` (retries, breakers,
+optional ``--hedge``) and each level additionally reports retry/hedge
+counts and the attempt amplification factor.  The JSON document goes to
+``--output`` and stdout (the product — progress chatter is stderr-only,
+matching the repo's stdout discipline).
 """
 
 from __future__ import annotations
@@ -41,6 +47,12 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+# --resilient imports gene2vec_tpu.serve.client; make `python
+# scripts/serve_loadgen.py` work from anywhere, like chaos_drill.py
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 
 def _http_json(
     url: str, body: Optional[dict] = None, timeout: float = 10.0
@@ -56,31 +68,48 @@ def _http_json(
 
 
 class _Stats:
-    """Thread-safe request accounting for one load level."""
+    """Thread-safe request accounting for one load level, bucketed by
+    error class (429 vs 503 vs 504 vs transport vs other) plus the
+    resilient-client retry/hedge tallies when that path is active."""
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.latencies_ms: List[float] = []
         self.ok = 0
-        self.rejected = 0
-        self.expired = 0
-        self.errors = 0
+        self.rejected = 0          # 429: explicit backpressure
+        self.not_ready = 0         # 503: no model / replica down
+        self.expired = 0           # 504: deadline (queue or compute)
+        self.transport = 0         # connect refused/reset, read timeout
+        self.other_http = 0        # 400s, 500s, anything else
+        self.retries = 0
+        self.hedges = 0
+        self.attempts = 0
 
-    def record(self, status: int, latency_ms: float) -> None:
+    def record(self, status: int, latency_ms: float,
+               retries: int = 0, hedged: bool = False,
+               attempts: int = 1) -> None:
         with self.lock:
+            self.retries += retries
+            self.hedges += int(hedged)
+            self.attempts += attempts
             if status == 200:
                 self.ok += 1
                 self.latencies_ms.append(latency_ms)
             elif status == 429:
                 self.rejected += 1
+            elif status == 503:
+                self.not_ready += 1
             elif status == 504:
                 self.expired += 1
+            elif status <= 0:
+                self.transport += 1
             else:
-                self.errors += 1
+                self.other_http += 1
 
     @property
     def total(self) -> int:
-        return self.ok + self.rejected + self.expired + self.errors
+        return (self.ok + self.rejected + self.not_ready + self.expired
+                + self.transport + self.other_http)
 
 
 def _percentile(sorted_values: List[float], q: float) -> Optional[float]:
@@ -91,8 +120,24 @@ def _percentile(sorted_values: List[float], q: float) -> Optional[float]:
 
 
 def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
-                 stats: _Stats, timeout_s: float) -> None:
+                 stats: _Stats, timeout_s: float,
+                 client=None) -> None:
     body = {"genes": [rng.choice(genes)], "k": k}
+    if client is not None:
+        # the resilient path: retries/hedging under one deadline, with
+        # per-request attempt accounting for the amplification report
+        r = client.request("/v1/similar", body, timeout_s=timeout_s)
+        status = r.status
+        if status == 0:
+            # no HTTP status reached the caller: bucket the client's own
+            # deadline exhaustion with the 504s, transport trouble apart
+            status = 504 if r.error_class == "deadline" else -1
+        stats.record(
+            status,
+            r.latency_s * 1000.0,
+            retries=r.retries, hedged=r.hedged, attempts=r.attempts,
+        )
+        return
     t0 = time.monotonic()
     try:
         req = urllib.request.Request(
@@ -112,7 +157,8 @@ def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
 
 
 def run_open_level(url: str, genes: List[str], k: int, rps: float,
-                   duration_s: float, seed: int, timeout_s: float) -> _Stats:
+                   duration_s: float, seed: int, timeout_s: float,
+                   client=None) -> _Stats:
     """Fixed-schedule arrivals at ``rps`` for ``duration_s``; each
     arrival gets its own thread so a slow/queued response never delays
     the next arrival (that is what makes the loop open)."""
@@ -129,7 +175,7 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
             time.sleep(delay)
         t = threading.Thread(
             target=_one_request,
-            args=(url, genes, k, rng, stats, timeout_s),
+            args=(url, genes, k, rng, stats, timeout_s, client),
             daemon=True,
         )
         t.start()
@@ -142,7 +188,7 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
 
 def run_closed_level(url: str, genes: List[str], k: int, workers: int,
                      duration_s: float, seed: int,
-                     timeout_s: float) -> _Stats:
+                     timeout_s: float, client=None) -> _Stats:
     """N workers firing back-to-back until the clock runs out."""
     stats = _Stats()
     stop = time.monotonic() + duration_s
@@ -150,7 +196,7 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
     def loop(worker_seed: int) -> None:
         rng = random.Random(worker_seed)
         while time.monotonic() < stop:
-            _one_request(url, genes, k, rng, stats, timeout_s)
+            _one_request(url, genes, k, rng, stats, timeout_s, client)
 
     t_start = time.monotonic()
     threads = [
@@ -165,16 +211,22 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
     return stats
 
 
-def summarize(level: float, stats: _Stats, mode: str) -> Dict:
+def summarize(level: float, stats: _Stats, mode: str,
+              resilient: bool = False) -> Dict:
     lat = sorted(stats.latencies_ms)
     wall = getattr(stats, "wall_s", 1.0) or 1.0
-    return {
+    row = {
         ("offered_rps" if mode == "open" else "concurrency"): level,
         "requests": stats.total,
         "ok": stats.ok,
         "rejected_429": stats.rejected,
+        "not_ready_503": stats.not_ready,
         "expired_504": stats.expired,
-        "errors": stats.errors,
+        "transport_errors": stats.transport,
+        "other_http_errors": stats.other_http,
+        "availability": round(
+            stats.ok / stats.total, 4
+        ) if stats.total else None,
         "achieved_rps": round(stats.ok / wall, 2),
         "rejection_rate": round(
             stats.rejected / stats.total, 4
@@ -184,6 +236,14 @@ def summarize(level: float, stats: _Stats, mode: str) -> Dict:
         "mean_ms": round(sum(lat) / len(lat), 3) if lat else None,
         "wall_s": round(wall, 3),
     }
+    if resilient:
+        row["retries"] = stats.retries
+        row["hedges"] = stats.hedges
+        row["attempts"] = stats.attempts
+        row["attempt_amplification"] = round(
+            stats.attempts / stats.total, 4
+        ) if stats.total else None
+    return row
 
 
 def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
@@ -230,6 +290,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="distinct query genes sampled from /v1/genes")
     ap.add_argument("--timeout", type=float, default=10.0,
                     help="client-side socket timeout (s)")
+    ap.add_argument("--resilient", action="store_true",
+                    help="route through gene2vec_tpu.serve.client."
+                         "ResilientClient (retries + breakers; reports "
+                         "retry/hedge counts per level)")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="resilient client max attempts per request")
+    ap.add_argument("--hedge", action="store_true",
+                    help="enable p95 hedging on the resilient client")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--warmup", type=int, default=64,
                     help="largest warm-up burst; concurrent bursts of "
@@ -253,7 +321,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             url = args.url.rstrip("/")
 
-        health = _http_json(f"{url}/healthz", timeout=args.timeout)
+        try:
+            health = _http_json(f"{url}/healthz", timeout=args.timeout)
+        except urllib.error.HTTPError as e:
+            # readiness probes 503 until a model is served (or a fleet
+            # has a replica in rotation) — report it, don't traceback
+            print(
+                f"error: {url}/healthz returned {e.code} — the server "
+                "is not ready (no model loaded / no replica in rotation)",
+                file=sys.stderr,
+            )
+            e.close()
+            return 2
         genes_doc = _http_json(
             f"{url}/v1/genes?limit={args.num_genes}", timeout=args.timeout
         )
@@ -262,6 +341,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("error: server reports an empty vocab", file=sys.stderr)
             return 2
 
+        client = None
+        if args.resilient:
+            from gene2vec_tpu.serve.client import (
+                ResilientClient,
+                RetryPolicy,
+            )
+
+            client = ResilientClient(
+                [url],
+                RetryPolicy(
+                    max_attempts=args.retries,
+                    read_timeout_s=args.timeout,
+                    default_timeout_s=args.timeout,
+                    hedge=args.hedge,
+                ),
+                rng=random.Random(args.seed),
+            )
+
         rng = random.Random(args.seed)
         burst = 1
         while burst <= max(1, args.warmup):
@@ -269,7 +366,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             threads = [
                 threading.Thread(
                     target=_one_request,
-                    args=(url, genes, args.k, rng, stats, args.timeout),
+                    args=(url, genes, args.k, rng, stats, args.timeout,
+                          client),
                     daemon=True,
                 )
                 for _ in range(burst)
@@ -288,14 +386,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.mode == "open":
                 stats = run_open_level(
                     url, genes, args.k, level, args.duration, args.seed,
-                    args.timeout,
+                    args.timeout, client,
                 )
             else:
                 stats = run_closed_level(
                     url, genes, args.k, int(level), args.duration,
-                    args.seed, args.timeout,
+                    args.seed, args.timeout, client,
                 )
-            row = summarize(level, stats, args.mode)
+            row = summarize(level, stats, args.mode, args.resilient)
             print(f"  -> {json.dumps(row)}", file=sys.stderr)
             results.append(row)
 
@@ -306,8 +404,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "duration_s": args.duration,
             "num_query_genes": len(genes),
             "server": health.get("model", {}),
+            "resilient": bool(args.resilient),
             "levels": results,
         }
+        if client is not None:
+            doc["client_stats"] = dict(client.stats)
         with open(args.output, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
             f.write("\n")

@@ -1,0 +1,690 @@
+"""Fleet tier: resilient client state machines, fault injection, server
+read deadlines, and replica supervision.
+
+Everything here is tier-1 fast: the client/breaker/budget tests drive
+the state machines with injected clocks and transports (no sleeps), the
+supervisor tests run against a jax-free stub replica executable (spawn
+cost ~100 ms), and the only real sleeps are a few-ms drips in the
+slow-loris test."""
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.obs.registry import MetricsRegistry
+from gene2vec_tpu.resilience.faults import (
+    Decision,
+    FaultInjector,
+    FaultSpec,
+    slow_loris,
+)
+from gene2vec_tpu.serve.client import (
+    BreakerState,
+    CircuitBreaker,
+    ClientResponse,
+    ResilientClient,
+    RetryPolicy,
+    TokenBucket,
+    _classify,
+)
+from gene2vec_tpu.serve.fleet import (
+    FleetConfig,
+    FleetProxy,
+    FleetSupervisor,
+    ReplicaState,
+    read_contract_line,
+)
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.t += s
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_opens_after_consecutive_failures():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                       clock=clock)
+    assert b.state == BreakerState.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == BreakerState.CLOSED  # not yet
+    b.record_success()  # CONSECUTIVE failures only
+    b.record_failure()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == BreakerState.OPEN
+    assert not b.allow()
+
+
+def test_breaker_half_open_single_probe_and_close():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                       half_open_successes=2, clock=clock)
+    b.record_failure()
+    assert b.state == BreakerState.OPEN
+    clock.t += 5.0
+    assert b.state == BreakerState.HALF_OPEN
+    assert b.allow()
+    assert not b.allow()  # one probe in flight at a time
+    b.record_success()
+    assert b.state == BreakerState.HALF_OPEN  # needs 2 successes
+    assert b.allow()
+    b.record_success()
+    assert b.state == BreakerState.CLOSED
+
+
+def test_breaker_probe_failure_reopens_with_fresh_window():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                       clock=clock)
+    b.record_failure()
+    clock.t += 5.0
+    assert b.allow()  # the half-open probe
+    b.record_failure()
+    assert b.state == BreakerState.OPEN
+    clock.t += 4.9  # window restarts at the probe failure
+    assert b.state == BreakerState.OPEN
+    clock.t += 0.2
+    assert b.state == BreakerState.HALF_OPEN
+
+
+def test_breaker_cancel_releases_probe_slot():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                       clock=clock)
+    b.record_failure()
+    clock.t += 1.0
+    assert b.allow()
+    b.cancel()  # abandoned before I/O — the slot must come back
+    assert b.allow()
+
+
+# -- token bucket ------------------------------------------------------------
+
+
+def test_token_bucket_exhausts_and_earns():
+    tb = TokenBucket(ratio=0.5, burst=2.0)
+    assert tb.spend() and tb.spend()
+    assert not tb.spend()  # empty
+    tb.earn()  # +0.5
+    assert not tb.spend()
+    tb.earn()  # 1.0
+    assert tb.spend()
+    for _ in range(100):
+        tb.earn()
+    assert tb.tokens == pytest.approx(2.0)  # capped at burst
+
+
+# -- retry classification ----------------------------------------------------
+
+
+@pytest.mark.parametrize("status,doc,cls,safe", [
+    (200, None, "ok", False),
+    (429, None, "http_429", False),
+    (400, None, "http_4xx", False),
+    (503, None, "http_503", True),
+    (504, {"error": "expired in queue"}, "http_504", True),
+    (504, {"error": "no result within 1.0s"}, "http_504", False),
+    (500, None, "http_500", True),
+])
+def test_classify(status, doc, cls, safe):
+    assert _classify(status, doc) == (cls, safe)
+
+
+# -- resilient client --------------------------------------------------------
+
+
+def _client(transport, clock, targets=("http://a", "http://b"), **kw):
+    policy = RetryPolicy(**kw)
+    return ResilientClient(
+        list(targets), policy, transport=transport, clock=clock,
+        sleep=clock.sleep,
+    )
+
+
+def test_client_fails_over_and_propagates_shrinking_deadline():
+    clock = FakeClock()
+    seen = []
+
+    def transport(base, method, path, body, ct, rt):
+        at = clock.t
+        clock.t += 0.2
+        seen.append((base, json.loads(body)["timeout_ms"], at))
+        if base == "http://a":
+            raise ConnectionRefusedError()
+        return 200, json.dumps({"hello": 1}).encode()
+
+    c = _client(transport, clock, max_attempts=3, backoff_base_s=0.0)
+    r = c.request("/v1/similar", {"genes": ["G0"]}, timeout_s=1.0)
+    assert r.ok and r.retries == 1 and r.target == "http://b"
+    # every attempt's propagated budget == what was left at launch, so
+    # it shrinks monotonically and never exceeds the caller's deadline
+    assert seen[0][1] == pytest.approx(1000.0)
+    assert seen[1][1] == pytest.approx(800.0)
+    for _, timeout_ms, at in seen:
+        assert timeout_ms / 1000.0 <= (1.0 - at) + 1e-9
+
+
+def test_client_never_launches_attempt_past_deadline():
+    clock = FakeClock()
+    launches = []
+
+    def transport(base, method, path, body, ct, rt):
+        launches.append(clock.t)
+        clock.t += 0.6  # each attempt eats most of the budget
+        raise ConnectionRefusedError()
+
+    c = _client(transport, clock, max_attempts=10, backoff_base_s=0.0)
+    r = c.request("/v1/similar", {"genes": ["G0"]}, timeout_s=1.0)
+    assert not r.ok
+    assert all(t < 1.0 for t in launches)
+    assert clock.t <= 1.0 + 0.6  # the in-flight attempt may finish late
+
+
+@pytest.mark.parametrize("status,retriable", [
+    (400, False), (429, False), (503, True),
+])
+def test_client_retries_only_retry_safe_statuses(status, retriable):
+    clock = FakeClock()
+    calls = []
+
+    def transport(base, *a, **kw):
+        calls.append(base)
+        clock.t += 0.01
+        return status, json.dumps({"error": "x"}).encode()
+
+    c = _client(transport, clock, max_attempts=3, backoff_base_s=0.0)
+    r = c.request("/v1/similar", {"genes": ["G0"]}, timeout_s=5.0)
+    assert r.status == status
+    assert len(calls) == (3 if retriable else 1)
+
+
+def test_client_retries_queue_expired_504_but_not_compute_504():
+    clock = FakeClock()
+    calls = []
+
+    def queue_504(base, *a, **kw):
+        calls.append(base)
+        clock.t += 0.01
+        return 504, json.dumps({"error": "expired in queue"}).encode()
+
+    c = _client(queue_504, clock, max_attempts=2, backoff_base_s=0.0)
+    assert c.request("/x", {"a": 1}, timeout_s=5.0).status == 504
+    assert len(calls) == 2
+
+    calls.clear()
+
+    def compute_504(base, *a, **kw):
+        calls.append(base)
+        clock.t += 0.01
+        return 504, json.dumps({"error": "no result within 2.0s"}).encode()
+
+    c2 = _client(compute_504, clock, max_attempts=2, backoff_base_s=0.0)
+    assert c2.request("/x", {"a": 1}, timeout_s=5.0).status == 504
+    assert len(calls) == 1  # the work may have completed: don't retry
+
+
+def test_client_retry_budget_bounds_amplification():
+    clock = FakeClock()
+
+    def refuse(base, *a, **kw):
+        clock.t += 0.001
+        raise ConnectionRefusedError()
+
+    c = _client(
+        refuse, clock, targets=("http://a",), max_attempts=5,
+        retry_budget_ratio=0.0, retry_budget_burst=3.0,
+        backoff_base_s=0.0, breaker_failure_threshold=10_000,
+    )
+    attempts = sum(
+        c.request("/x", {"a": 1}, timeout_s=5.0).attempts
+        for _ in range(10)
+    )
+    # 10 primaries + exactly burst=3 retries, ever — outage amplification
+    # is bounded by the budget, not by max_attempts
+    assert attempts == 13
+    assert c.stats["budget_exhausted"] >= 1
+
+
+def test_client_backoff_jitter_within_bounds():
+    clock = FakeClock()
+
+    def refuse(base, *a, **kw):
+        clock.t += 0.001
+        raise ConnectionRefusedError()
+
+    c = _client(
+        refuse, clock, targets=("http://a",), max_attempts=4,
+        backoff_base_s=0.1, backoff_max_s=10.0, jitter_frac=0.5,
+        breaker_failure_threshold=10_000,
+    )
+    c.request("/x", {"a": 1}, timeout_s=100.0)
+    assert len(clock.sleeps) == 3
+    for i, s in enumerate(clock.sleeps):
+        base = 0.1 * (2 ** i)
+        assert base * 0.5 <= s <= base * 1.5  # jitter never leaves ±50%
+
+
+def test_client_all_breakers_open_fails_fast_as_503():
+    clock = FakeClock()
+
+    def refuse(base, *a, **kw):
+        clock.t += 0.001
+        raise ConnectionRefusedError()
+
+    c = _client(
+        refuse, clock, targets=("http://a",), max_attempts=1,
+        breaker_failure_threshold=2, breaker_reset_timeout_s=60.0,
+    )
+    c.request("/x", {"a": 1}, timeout_s=1.0)
+    c.request("/x", {"a": 1}, timeout_s=1.0)
+    r = c.request("/x", {"a": 1}, timeout_s=1.0)
+    assert r.status == 503 and not r.ok
+    assert c.stats["breaker_rejections"] == 1
+    assert c.breaker("http://a").state == BreakerState.OPEN
+
+
+def test_client_hedges_at_p95_and_first_answer_wins():
+    # real (few-ms) sleeps: hedging genuinely races two threads
+    slow, fast = "http://slow", "http://fast"
+
+    def transport(base, method, path, body, ct, rt):
+        time.sleep(0.25 if base == slow else 0.005)
+        return 200, json.dumps({"from": base}).encode()
+
+    c = ResilientClient(
+        [slow, fast],
+        RetryPolicy(hedge=True, hedge_min_samples=4, max_attempts=2),
+        transport=transport,
+    )
+    for _ in range(6):  # seed the p95 estimate
+        c._record_latency(0.01)
+    r = c.request("/x", {"a": 1}, timeout_s=5.0)
+    assert r.ok and r.hedged
+    assert r.doc["from"] == fast
+    assert r.latency_s < 0.2  # did NOT wait for the slow primary
+    assert c.stats["hedges"] == 1
+
+
+# -- fault injection ---------------------------------------------------------
+
+
+def test_fault_spec_json_round_trip_and_unknown_field():
+    spec = FaultSpec(seed=3, latency_p=0.5, latency_ms=10.0)
+    assert FaultSpec.from_json(spec.to_json()) == spec
+    with pytest.raises(ValueError, match="unknown fault spec"):
+        FaultSpec.from_json('{"nope": 1}')
+
+
+def test_fault_injector_is_deterministic_and_route_scoped():
+    spec = FaultSpec(seed=11, latency_p=0.3, latency_ms=5.0,
+                     error_p=0.2, reset_p=0.1, blackhole_p=0.05)
+    a, b = FaultInjector(spec), FaultInjector(spec)
+    for _ in range(50):
+        assert a.decide("/healthz") is None  # outside route_prefix
+    seq_a = [a.decide("/v1/similar") for _ in range(200)]
+    seq_b = [b.decide("/v1/similar") for _ in range(200)]
+    assert seq_a == seq_b  # same seed, same request order -> same faults
+    kinds = {d.kind for d in seq_a if d is not None}
+    assert {"error", "reset", "blackhole"} <= kinds
+    assert a.decisions == b.decisions
+    assert sum(a.decisions.values()) >= 200
+
+
+def test_fault_injector_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("GENE2VEC_TPU_FAULTS", raising=False)
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("GENE2VEC_TPU_FAULTS", '{"seed": 5, "error_p": 1.0}')
+    inj = FaultInjector.from_env()
+    assert inj is not None
+    d = inj.decide("/v1/x")
+    assert d == Decision(delay_s=0.0, kind="error", arg=503.0)
+
+
+# -- server read deadline + readiness (needs a real served app) --------------
+
+
+@pytest.fixture
+def tiny_app(tmp_path):
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_server,
+    )
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    rng = np.random.RandomState(0)
+    vocab = Vocab([f"G{i}" for i in range(8)], np.arange(8, 0, -1))
+    save_iteration(
+        str(tmp_path), 4, 1,
+        SGNSParams(emb=rng.randn(8, 4).astype(np.float32),
+                   ctx=np.zeros((8, 4), np.float32)),
+        vocab,
+    )
+    reg = ModelRegistry(str(tmp_path))
+    app = ServeApp(reg, ServeConfig(read_timeout_s=0.5))
+    server = make_server(app, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield app, reg, server
+    server.shutdown()
+    server.server_close()
+    app.stop()
+
+
+def test_healthz_not_ready_until_loaded_and_livez_always(tiny_app):
+    app, reg, _ = tiny_app
+    status, doc = app.handle("GET", "/healthz", None)
+    assert status == 503 and doc["status"] == "not_ready"
+    assert app.handle("GET", "/livez", None)[0] == 200
+    assert reg.refresh()
+    status, doc = app.handle("GET", "/healthz", None)
+    assert status == 200 and doc["status"] == "ok"
+    assert doc["model"]["iteration"] == 1
+
+
+def test_slow_loris_gets_408_and_thread_is_unpinned(tiny_app):
+    app, reg, server = tiny_app
+    reg.refresh()
+    app.batcher.start()
+    host, port = server.server_address[:2]
+    status, held = slow_loris(
+        host, port, drip_bytes=1, drip_interval_s=0.05, duration_s=5.0,
+    )
+    assert status == 408
+    assert held < 2.0  # ~read_timeout_s (0.5), NOT the loris duration
+    assert app.metrics.counter("serve_http_408_total").value >= 1
+    # the handler thread is free again: a normal request still answers
+    url = f"http://{host}:{port}"
+    with urllib.request.urlopen(f"{url}/healthz", timeout=5.0) as r:
+        assert r.status == 200
+
+
+def test_injected_reset_surfaces_as_transport_error(tiny_app):
+    app, reg, server = tiny_app
+    reg.refresh()
+    app.batcher.start()
+    app.faults = FaultInjector(FaultSpec(seed=0, reset_p=1.0))
+    host, port = server.server_address[:2]
+    clockless = ResilientClient(
+        [f"http://{host}:{port}"], RetryPolicy(max_attempts=1),
+    )
+    r = clockless.request("/v1/genes?limit=2")
+    assert r.error_class == "transport"
+    app.faults = None
+
+
+# -- supervisor over a stub replica (jax-free, ~100ms spawns) ----------------
+
+
+STUB = r"""
+import json, os, sys, threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+unready_flag = sys.argv[1]
+die_flag = sys.argv[2]
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def do_GET(self):
+        if os.path.exists(die_flag):
+            os._exit(9)
+        ready = not os.path.exists(unready_flag)
+        payload = json.dumps(
+            {"status": "ok" if ready else "not_ready"}
+        ).encode()
+        self.send_response(200 if ready else 503)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        self.rfile.read(n)
+        payload = json.dumps({"pid": os.getpid()}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+srv = HTTPServer(("127.0.0.1", 0), H)
+print(json.dumps({"url": f"http://127.0.0.1:{srv.server_address[1]}"}),
+      flush=True)
+srv.serve_forever()
+"""
+
+
+class StubSupervisor(FleetSupervisor):
+    """FleetSupervisor whose replicas are the stub above — supervision
+    semantics (restart, backoff, ejection, storm cap) without paying a
+    jax import per spawn."""
+
+    def __init__(self, tmp, **kw):
+        self._stub = os.path.join(tmp, "stub_replica.py")
+        with open(self._stub, "w") as f:
+            f.write(STUB)
+        self.unready_flag = os.path.join(tmp, "unready")
+        self.die_flag = os.path.join(tmp, "die")
+        super().__init__(tmp, **kw)
+
+    def _argv(self, index):
+        return [sys.executable, self._stub, self.unready_flag,
+                self.die_flag]
+
+
+FAST = dict(
+    health_interval_s=0.05, health_timeout_s=1.0, unhealthy_after=2,
+    readmit_after=2, backoff_base_s=0.05, backoff_max_s=0.2,
+    contract_timeout_s=20.0,
+)
+
+
+def _wait(predicate, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def test_supervisor_restarts_sigkilled_replica(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=1, **FAST),
+    )
+    sup.start()
+    try:
+        assert len(sup.healthy_urls()) == 1
+        old_pid = sup.replicas[0].pid
+        os.kill(old_pid, signal.SIGKILL)
+        _wait(
+            lambda: sup.replicas[0].restarts >= 1
+            and sup.replicas[0].state == ReplicaState.UP,
+            what="restart after SIGKILL",
+        )
+        assert sup.replicas[0].pid != old_pid
+        assert sup.healthy_urls()  # back in rotation
+    finally:
+        sup.stop()
+
+
+def test_supervisor_ejects_unready_and_readmits(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=1, **FAST),
+    )
+    sup.start()
+    try:
+        open(sup.unready_flag, "w").close()
+        _wait(
+            lambda: sup.replicas[0].state == ReplicaState.EJECTED,
+            what="ejection on failing readiness",
+        )
+        assert sup.healthy_urls() == []
+        assert sup.replicas[0].alive  # ejected, NOT restarted
+        os.unlink(sup.unready_flag)
+        _wait(
+            lambda: sup.replicas[0].state == ReplicaState.UP,
+            what="re-admission after consecutive passes",
+        )
+        assert len(sup.healthy_urls()) == 1
+    finally:
+        sup.stop()
+
+
+def test_supervisor_storm_cap_gives_up(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path),
+        config=FleetConfig(
+            replicas=1, storm_max_restarts=2, storm_window_s=60.0, **FAST
+        ),
+    )
+    sup.start()
+    try:
+        # every probe now kills the stub: a crash loop
+        open(sup.die_flag, "w").close()
+        _wait(
+            lambda: sup.replicas[0].state == ReplicaState.FAILED,
+            what="storm cap abandoning the slot",
+        )
+        assert sup.replicas[0].restarts <= 3
+        assert "storm" in sup.replicas[0].last_error
+        assert sup.healthy_urls() == []
+    finally:
+        sup.stop()
+
+
+def test_supervisor_storm_cap_covers_precontract_crashes(tmp_path):
+    """A replica whose respawns die BEFORE printing a contract line
+    (bad flag, import error) must still trip the storm cap — the
+    attempt, not the successful spawn, feeds the window."""
+    sup = StubSupervisor(
+        str(tmp_path),
+        config=FleetConfig(
+            replicas=1, storm_max_restarts=2, storm_window_s=60.0,
+            **{**FAST, "contract_timeout_s": 5.0},
+        ),
+    )
+    sup.start()
+    try:
+        # swap the stub for an instant-exit script, then kill the live
+        # replica: every respawn from here dies pre-contract
+        with open(sup._stub, "w") as f:
+            f.write("import sys; sys.exit(3)\n")
+        os.kill(sup.replicas[0].pid, signal.SIGKILL)
+        _wait(
+            lambda: sup.replicas[0].state == ReplicaState.FAILED,
+            what="storm cap on pre-contract crash loop",
+        )
+        assert sup.replicas[0].restarts == 0  # none ever succeeded
+        assert "storm" in sup.replicas[0].last_error
+    finally:
+        sup.stop()
+
+
+def test_proxy_reaps_slow_loris_with_408(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=1, **FAST),
+    )
+    sup.start()
+    proxy = FleetProxy(sup, metrics=MetricsRegistry(), read_timeout_s=0.5)
+    url = proxy.serve("127.0.0.1", 0)
+    try:
+        host, port = url.split("//")[1].split(":")
+        status, held = slow_loris(
+            host, int(port), drip_bytes=1, drip_interval_s=0.05,
+            duration_s=5.0,
+        )
+        assert status == 408
+        assert held < 2.0
+        assert proxy.metrics.counter("fleet_http_408_total").value >= 1
+    finally:
+        proxy.stop()
+        sup.stop()
+
+
+def test_supervisor_jittered_backoff_bounds(tmp_path):
+    import random
+
+    sup = StubSupervisor(
+        str(tmp_path),
+        config=FleetConfig(
+            replicas=1, backoff_base_s=1.0, backoff_max_s=64.0,
+            jitter_frac=0.5, **{k: v for k, v in FAST.items()
+                                if "backoff" not in k},
+        ),
+        rng=random.Random(0),
+    )
+    r = sup.replicas[0]
+    now = 100.0
+    delays = []
+    for n in range(4):
+        r.restart_times.clear()
+        r.restart_times.extend([now] * n)  # n recent restarts
+        sup._schedule_restart(r, now)
+        delays.append(r.next_restart_at - now)
+    for n, d in enumerate(delays):
+        base = 1.0 * (2 ** n)
+        assert base * 0.5 <= d <= base * 1.5
+
+
+def test_read_contract_line_times_out_on_silent_child(tmp_path):
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        with pytest.raises(TimeoutError, match="contract line"):
+            read_contract_line(proc, timeout_s=0.3)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_proxy_routes_and_reports_fleet_health(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=2, **FAST),
+    )
+    sup.start()
+    proxy = FleetProxy(sup, metrics=MetricsRegistry())
+    url = proxy.serve("127.0.0.1", 0)
+    try:
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5.0) as r:
+            doc = json.loads(r.read())
+        assert r.status == 200 and doc["replicas_up"] == 2
+        req = urllib.request.Request(
+            f"{url}/v1/similar", data=b'{"genes": ["G0"]}',
+            headers={"Content-Type": "application/json"},
+        )
+        pids = set()
+        for _ in range(4):  # round-robin spreads over both stubs
+            with urllib.request.urlopen(req, timeout=5.0) as r:
+                pids.add(json.loads(r.read())["pid"])
+        assert len(pids) == 2
+    finally:
+        proxy.stop()
+        sup.stop()
