@@ -498,6 +498,100 @@ class CkptBlockingIOPass(Pass):
                     )
 
 
+class SpanHygienePass(Pass):
+    """Tracing-span discipline for the obs layer:
+
+    * **no span enter/exit inside jitted/traced code** — a span context
+      manager opening inside a traced function runs at TRACE time, not
+      execution time: the recorded duration is compilation, every
+      execution after the first records nothing, and the file write is
+      a host side effect inside a trace (the same class of bug as
+      host-sync-in-jit);
+    * **no span left unclosed on early return** — ``ambient_span(...)``
+      / ``<tracer>.span(...)`` are context managers; calling one
+      without ``with`` (an expression statement, an assignment) never
+      runs ``__exit__`` on an early return or exception, leaving an
+      unterminated ``span_start`` in the timeline and a corrupted
+      parent stack for every later span on that thread.  The one
+      sanctioned non-``with`` form is ``return <span call>`` — the
+      thin-wrapper pattern (``Run.span``) hands the unopened manager to
+      a caller who ``with``-s it.
+
+    The ``.span`` attribute form is only checked in modules that import
+    ``gene2vec_tpu.obs`` (a regex ``m.span()`` in unrelated code must
+    not trip it); the distinctive ``ambient_span`` name is always
+    checked.  ``hop_span`` is a plain function, not a manager, and is
+    exempt.
+    """
+
+    id = "span-hygiene"
+    title = "obs span misuse (span in traced code / span not closed)"
+
+    def _is_span_call(self, node: ast.Call, imports: Dict[str, str],
+                      attr_form_ok: bool) -> bool:
+        fn = node.func
+        chain = chain_of(fn)
+        if chain is not None:
+            resolved = resolve_chain(chain, imports)
+            if chain == "ambient_span" or resolved.endswith(
+                ".ambient_span"
+            ):
+                return True
+        if attr_form_ok and isinstance(fn, ast.Attribute):
+            return fn.attr == "span"
+        return False
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        imports = mod.imports()
+        uses_obs = any(
+            v == "gene2vec_tpu.obs" or v.startswith("gene2vec_tpu.obs.")
+            for v in imports.values()
+        )
+        traced = traced_functions(mod)
+        traced_nodes: Set[int] = set()
+        for tf in traced:
+            for node in _iter_own_body(tf.node):
+                traced_nodes.add(id(node))
+                if isinstance(node, ast.Call) and self._is_span_call(
+                    node, imports, attr_form_ok=uses_obs
+                ):
+                    yield self.finding(
+                        mod, node,
+                        f"span enter/exit inside traced function "
+                        f"'{tf.name}' (traced via {tf.reason}): the span "
+                        "runs at trace time and its file write is a host "
+                        "side effect inside the compiled program — time "
+                        "the call site instead",
+                    )
+        if not uses_obs:
+            return
+        # rule 2: span context managers must be entered via `with` (or
+        # returned by a thin wrapper); anything else leaks the span on
+        # early return.  Traced bodies are rule 1's jurisdiction.
+        allowed: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    allowed.add(id(item.context_expr))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                allowed.add(id(node.value))
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and id(node) not in allowed
+                and id(node) not in traced_nodes
+                and self._is_span_call(node, imports, attr_form_ok=True)
+            ):
+                yield self.finding(
+                    mod, node,
+                    "span context manager created without `with`: on an "
+                    "early return or exception __exit__ never runs, "
+                    "leaving an unterminated span_start and a corrupted "
+                    "parent stack; use `with ... span(...)` (or return "
+                    "it from a thin wrapper)",
+                )
+
+
 ALL_PASSES = (
     BarePrintPass(),
     HostSyncInJitPass(),
@@ -506,4 +600,5 @@ ALL_PASSES = (
     JitRecompileHazardPass(),
     MissingDonatePass(),
     CkptBlockingIOPass(),
+    SpanHygienePass(),
 )

@@ -1,0 +1,169 @@
+"""Tracing-overhead budget gate: BENCH_OBS vs budgets.json ``obs``.
+
+``scripts/serve_loadgen.py --trace-overhead`` measures the p50 latency
+of requests carrying a sampled ``traceparent`` header against identical
+requests with no header, at the offered load pinned in the ``obs``
+section of ``budgets.json``, and stamps the comparison into
+``BENCH_OBS_r09.json``.  This pass re-checks that committed record on
+every ``cli.analyze`` run — tracing that quietly grows past its
+overhead ceiling fails the analyzer exactly like a collective-bytes or
+fleet-availability regression does.
+
+Deliberately jax-free and I/O-only (two small JSON reads): it runs in
+the default tier.  A missing bench file is an *info* finding (a fresh
+checkout must not fail lint before its first bench); a record that
+exists and violates — or omits — a budgeted quantity gates hard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+BENCH_OBS_PATH = os.path.join(REPO_ROOT, "BENCH_OBS_r09.json")
+
+_PASS = "obs-trace-overhead-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def obs_budget_findings(
+    bench_path: str = BENCH_OBS_PATH,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the recorded trace-overhead results against the budget."""
+    budgets: Dict = load_budgets(budgets_path).get("obs", {})
+    if not budgets:
+        return []
+    label = os.path.basename(bench_path)
+    if not os.path.exists(bench_path):
+        # the hint must reproduce the PINNED recipe exactly — loadgen
+        # defaults differ, and _check_one gates on a recipe match, so a
+        # hint without these flags would produce a failing record
+        b = budgets.get("trace_overhead", {})
+        recipe = (
+            f"--levels {b.get('rps', 50):g} "
+            f"--duration {b.get('duration_s', 4):g} "
+            f"--overhead-rounds {b.get('rounds', 5):g}"
+        )
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no tracing-overhead bench recorded yet ({label} "
+                "missing); run `python scripts/serve_loadgen.py --spawn "
+                f"<export_dir> --trace-overhead {recipe} --output "
+                f"{label}` to stamp one"
+            ),
+        )]
+    try:
+        with open(bench_path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable tracing bench: {e}",
+        )]
+
+    findings: List[Finding] = []
+    for name, budget in budgets.items():
+        if name.startswith("_"):
+            continue
+        section = bench.get("trace_overhead")
+        if not isinstance(section, dict):
+            findings.append(Finding(
+                pass_id=_PASS,
+                path=label,
+                message=(
+                    f"{label} has no 'trace_overhead' section to check "
+                    f"against budget {name!r}"
+                ),
+            ))
+            continue
+        findings.extend(_check_one(name, budget, section, label))
+    return findings
+
+
+def _check_one(
+    name: str, budget: Dict, section: Dict, label: str
+) -> List[Finding]:
+    p50_untraced = _get(section, "p50_untraced_ms")
+    p50_traced = _get(section, "p50_traced_ms")
+    regression = _get(section, "regression_frac")
+    rps = _get(section, "rps")
+    ceiling = float(budget["max_p50_regression_frac"])
+    data = {
+        "budget": name,
+        "p50_untraced_ms": p50_untraced,
+        "p50_traced_ms": p50_traced,
+        "regression_frac": regression,
+        "rps": rps,
+        "budget_rps": budget.get("rps"),
+        "max_p50_regression_frac": ceiling,
+    }
+    # every budgeted quantity must be PRESENT: a record missing a field
+    # must gate like a violation, or dropping the key becomes the way
+    # to pass (the passes_fleet lesson)
+    problems: List[str] = []
+    for key, value in (
+        ("p50_untraced_ms", p50_untraced),
+        ("p50_traced_ms", p50_traced),
+        ("regression_frac", regression),
+        ("rps", rps),
+    ):
+        if value is None:
+            problems.append(f"{key} missing from the bench record")
+    # the budget pins the MEASUREMENT RECIPE, not just the load level:
+    # a one-tiny-window record on this high-variance host would pass a
+    # 2% gate by luck, so duration/rounds must match the pinned values
+    for key in ("rps", "duration_s", "rounds"):
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(section, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"bench measured with {key}={measured:g} but the "
+                f"budget pins {key}={pinned:g} — re-run with the "
+                "budgeted recipe"
+            )
+    if regression is not None and regression > ceiling:
+        problems.append(
+            f"traced-vs-untraced p50 regression {regression:.4f} > "
+            f"budget {ceiling} (tracing overhead grew past its "
+            "ceiling)"
+        )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                f"tracing-overhead record violates budget {name!r}: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"traced-vs-untraced p50 regression {regression:+.4f} at "
+            f"{rps:g} rps within budget {name!r} (<= {ceiling})"
+        ),
+        data=data,
+    )]

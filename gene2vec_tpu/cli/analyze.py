@@ -147,6 +147,11 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_fleet import fleet_budget_findings
 
         findings.extend(fleet_budget_findings())
+        # same shape for the tracing-overhead budget (BENCH_OBS vs the
+        # budgets.json "obs" section)
+        from gene2vec_tpu.analysis.passes_obs import obs_budget_findings
+
+        findings.extend(obs_budget_findings())
 
     if args.hlo:
         _pin_cpu_backend()

@@ -63,6 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="front-door default per-request deadline")
     p.add_argument("--hedge", action="store_true",
                    help="enable p95 hedging on the front-door client")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   help="proxy-originated root-trace sampling rate for "
+                        "requests without a traceparent header (0..1; "
+                        "sampled client contexts always propagate)")
+    p.add_argument("--scrape-interval", type=float, default=2.0,
+                   help="seconds between replica /metrics scrapes for "
+                        "the merged /metrics/fleet view (0 disables "
+                        "aggregation)")
     p.add_argument("--seed", type=int, default=None,
                    help="restart-jitter seed (reproducible drills)")
     p.add_argument("--run-dir", default=None,
@@ -150,6 +158,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             default_timeout_s=args.proxy_timeout_ms / 1000.0,
             hedge=args.hedge,
         ),
+        trace_sample=args.trace_sample,
+        scrape_interval_s=args.scrape_interval,
+        telemetry_csv=os.path.join(run.run_dir, "fleet_telemetry.csv"),
+        flight_dir=run.run_dir,
     )
     url = proxy.serve(args.host, args.port)
     run.annotate(fleet_url=url)

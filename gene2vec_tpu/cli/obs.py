@@ -5,7 +5,13 @@
   holds the standard artifacts (``manifest.json`` + ``events.jsonl``,
   written by every trainer's ``run()`` and by ``bench.py``);
 * ``python -m gene2vec_tpu.cli.obs list <root>`` — find observed run
-  directories under a root.
+  directories under a root;
+* ``python -m gene2vec_tpu.cli.obs trace <run_dir> <trace_id>`` —
+  reassemble one distributed trace from every ``events.jsonl`` and
+  flight-recorder dump under ``run_dir`` (pass a fleet export dir to
+  cover the proxy's run AND every replica's) and render the
+  cross-process tree: proxy hop → client attempts (retries/hedges) →
+  replica request → batcher item → compute subtree.
 
 Schema and run-dir layout: docs/OBSERVABILITY.md.
 """
@@ -34,6 +40,17 @@ def build_parser() -> argparse.ArgumentParser:
                      "the human-readable report")
     ls = sub.add_parser("list", help="find observed run dirs under a root")
     ls.add_argument("root", nargs="?", default=".")
+    tr = sub.add_parser(
+        "trace",
+        help="reassemble one distributed trace across every "
+             "events.jsonl / flight dump under a directory",
+    )
+    tr.add_argument("run_dir", help="directory tree to scan (a fleet "
+                    "export dir covers the proxy and all replicas)")
+    tr.add_argument("trace_id", help="32-hex trace id (from loadgen "
+                    "--trace-sample, a ClientResponse, or a flight dump)")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the reassembled tree as JSON")
     return p
 
 
@@ -45,6 +62,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         for d in report.find_runs(args.root):
             print(d)
         return 0
+
+    if args.command == "trace":
+        from gene2vec_tpu.obs import flight
+
+        if not os.path.isdir(args.run_dir):
+            print(f"obs trace: {args.run_dir} is not a directory",
+                  file=sys.stderr)
+            return 2
+        doc = flight.collect_trace(args.run_dir, args.trace_id)
+        if args.json:
+            print(json.dumps(doc, indent=1, default=str))
+        else:
+            print(flight.format_trace(doc))
+        # exit 1 when the trace is entirely absent, so drills/scripts
+        # can assert "reassembly found something" without parsing
+        return 0 if (doc["roots"] or doc["flight"]) else 1
 
     run_dir = args.run_dir
     if not os.path.isdir(run_dir):

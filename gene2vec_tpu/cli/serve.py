@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-connection read deadline in seconds (slow "
                         "clients get 408 + close instead of pinning a "
                         "handler thread)")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   help="root-trace sampling rate for requests without "
+                        "a traceparent header (0..1; propagated sampled "
+                        "contexts are always honored; "
+                        "docs/OBSERVABILITY.md#distributed-tracing)")
     p.add_argument("--faults", default=None, metavar="JSON",
                    help="resilience/faults.py FaultSpec as JSON — "
                         "deterministic HTTP fault injection for drills "
@@ -136,12 +141,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             cache_size=args.cache_size,
             timeout_ms=args.timeout_ms,
             read_timeout_s=args.read_timeout,
+            trace_sample=args.trace_sample,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,
         mesh=mesh,
         fault_injector=fault_injector,
     ).start()
+    # flight recorder: 5xx bursts dump into the run dir automatically;
+    # SIGQUIT dumps on demand (kill -QUIT <pid> during an incident)
+    app.flight_dir = run.run_dir
+
+    import signal
+
+    def _on_sigquit(signum, frame):
+        try:
+            path = app.flight.dump(run.run_dir, "sigquit")
+            print(f"flight recorder dumped to {path}", file=sys.stderr)
+        except Exception as e:
+            print(f"flight dump failed: {e!r}", file=sys.stderr)
+
+    try:
+        signal.signal(signal.SIGQUIT, _on_sigquit)
+    except (ValueError, AttributeError, OSError):
+        pass  # non-main thread or platform without SIGQUIT
     server = make_server(app, args.host, args.port)
     host, port = server.server_address[:2]
     url = f"http://{host}:{port}"

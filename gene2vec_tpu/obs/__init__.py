@@ -15,7 +15,16 @@ the four trainers:
 * :mod:`gene2vec_tpu.obs.run` — the per-run orchestrator: writes
   ``manifest.json`` (config hash, git sha, backend, versions, argv) at
   run start and flags steps exceeding a rolling p99×3 budget as
-  ``stall`` events.
+  ``stall`` events;
+* :mod:`gene2vec_tpu.obs.tracecontext` — W3C-traceparent-style
+  distributed trace context (trace/span ids + sampled bit) propagated
+  as an HTTP header across the serving fleet;
+* :mod:`gene2vec_tpu.obs.aggregate` — fleet telemetry aggregator: the
+  proxy scrapes every replica's ``/metrics`` and serves the merged
+  SLO view at ``/metrics/fleet``;
+* :mod:`gene2vec_tpu.obs.flight` — bounded per-process flight recorder
+  (dumped on SIGQUIT / 5xx bursts) and the cross-process trace
+  reassembly behind ``cli.obs trace``.
 
 Every trainer's ``run(export_dir)`` writes ``manifest.json`` +
 ``events.jsonl`` into its export/run directory;

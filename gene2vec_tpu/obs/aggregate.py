@@ -1,0 +1,447 @@
+"""Fleet telemetry aggregation: scrape every replica, serve one view.
+
+A fleet of N replicas exports N separate ``/metrics`` expositions;
+nothing autoscaling (ROADMAP item 4) can act on lives in any single one
+of them.  :class:`FleetAggregator` runs inside the front-door proxy
+process, periodically scrapes every live replica's ``/metrics`` plus
+the proxy's own registry, and maintains a merged **fleet-level view**
+served at ``/metrics/fleet``:
+
+* ``fleet_availability`` — ok / total over the proxy's forwarded
+  responses (the client-observed number, not a replica's self-report);
+* ``fleet_route_p50_seconds{route=...}`` / ``fleet_route_p99_seconds``
+  — per-route latency quantiles estimated from the replicas' merged
+  ``serve_route_seconds`` histogram buckets (bucket upper bounds, so
+  estimates are conservative);
+* ``fleet_queue_depth`` — Σ replica ``serve_queue_depth``;
+* ``fleet_rejection_rate`` — Σ ``serve_rejected_total`` / Σ
+  ``serve_requests_total``;
+* raw sums (``fleet_requests``, ``fleet_rejected``,
+  ``fleet_ok``, ``fleet_responses``) so dashboards and the
+  chaos drill can do exact delta math across a load window — monotone
+  series (counters, histogram buckets) are accumulated per replica
+  with reset detection, so a replica dying or restarting with zeroed
+  counters never makes a fleet sum go backward;
+* scrape health: ``fleet_replicas_scraped``,
+  ``fleet_scrape_errors_total``.
+
+Every scrape also appends one CSV row (``fleet_telemetry.csv`` in the
+fleet run dir) through the registry's CSV sink, so the load-signal
+history survives the process.
+
+The Prometheus text parser here is the escape-aware inverse of
+``obs/registry.py``'s exposition (label values may contain ``\\``,
+``"``, and newlines); ``tests/test_tracing.py`` round-trips them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from gene2vec_tpu.obs.registry import MetricsRegistry, unescape_label_value
+
+#: canonical label-set key: sorted (k, v) tuples
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    """One exposition line: ``name{labels} value``."""
+
+    name: str
+    labels: LabelKey
+    value: float
+
+    def label(self, key: str) -> Optional[str]:
+        for k, v in self.labels:
+            if k == key:
+                return v
+        return None
+
+
+def _parse_labels(body: str) -> Optional[LabelKey]:
+    """Parse the inside of ``{...}`` respecting escaped quotes; None on
+    malformed input (a scrape must never crash the aggregator)."""
+    labels: List[Tuple[str, str]] = []
+    i = 0
+    n = len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0:
+            return None
+        key = body[i:eq].strip().strip(",").strip()
+        if not key:
+            return None
+        j = eq + 1
+        if j >= n or body[j] != '"':
+            return None
+        j += 1
+        raw: List[str] = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                raw.append(body[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            raw.append(c)
+            j += 1
+        if j >= n:
+            return None  # unterminated value
+        labels.append((key, unescape_label_value("".join(raw))))
+        i = j + 1
+    return tuple(sorted(labels))
+
+
+def parse_prometheus(text: str) -> List[Sample]:
+    """Parse a text exposition into samples, skipping comments and any
+    malformed line (tolerant by design: one bad line must not discard a
+    replica's whole scrape)."""
+    out: List[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "{" in line:
+            brace = line.index("{")
+            name = line[:brace]
+            end = line.rfind("}")
+            if end < brace:
+                continue
+            labels = _parse_labels(line[brace + 1:end])
+            if labels is None:
+                continue
+            rest = line[end + 1:].strip()
+        else:
+            name, _, rest = line.partition(" ")
+            labels = ()
+            rest = rest.strip()
+        if not name or not rest:
+            continue
+        value_str = rest.split()[0]
+        try:
+            value = float(value_str.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        out.append(Sample(name, labels, value))
+    return out
+
+
+def merge_samples(
+    scrapes: Sequence[Sequence[Sample]],
+) -> Dict[Tuple[str, LabelKey], float]:
+    """Sum samples across replicas by (name, label set) — the right
+    merge for counters, cumulative histogram buckets, and additive
+    gauges like queue depth."""
+    merged: Dict[Tuple[str, LabelKey], float] = {}
+    for samples in scrapes:
+        for s in samples:
+            key = (s.name, s.labels)
+            merged[key] = merged.get(key, 0.0) + s.value
+    return merged
+
+
+def histogram_quantile(
+    merged: Dict[Tuple[str, LabelKey], float],
+    name: str,
+    labels: LabelKey,
+    q: float,
+) -> Optional[float]:
+    """Quantile estimate from merged cumulative ``<name>_bucket``
+    samples matching ``labels`` (+ their ``le``): the smallest bucket
+    upper bound whose cumulative count covers ``q`` of observations.
+    A quantile landing in the ``+Inf`` bucket SATURATES to the largest
+    finite bucket bound — a truthful "at least this" that keeps the
+    fleet gauges moving during exactly the overload they exist to
+    expose (skipping the update would freeze them at the pre-overload
+    value).  None when the histogram is empty or absent."""
+    buckets: List[Tuple[float, float]] = []
+    for (n, lk), value in merged.items():
+        if n != f"{name}_bucket":
+            continue
+        le = None
+        rest = []
+        for k, v in lk:
+            if k == "le":
+                le = v
+            else:
+                rest.append((k, v))
+        if le is None or tuple(sorted(rest)) != labels:
+            continue
+        try:
+            buckets.append((float(le.replace("+Inf", "inf")), value))
+        except ValueError:
+            continue
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    finite = [le for le, _ in buckets if math.isfinite(le)]
+    target = q * total
+    for le, cum in buckets:
+        if cum >= target:
+            if math.isfinite(le):
+                return le
+            break
+    return max(finite) if finite else None
+
+
+def histogram_routes(
+    merged: Dict[Tuple[str, LabelKey], float], name: str
+) -> List[LabelKey]:
+    """Distinct non-``le`` label sets present for ``<name>_bucket``."""
+    seen = set()
+    for (n, lk), _ in merged.items():
+        if n != f"{name}_bucket":
+            continue
+        rest = tuple(sorted((k, v) for k, v in lk if k != "le"))
+        seen.add(rest)
+    return sorted(seen)
+
+
+def _default_fetch(url: str, timeout_s: float) -> str:
+    with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as r:
+        return r.read().decode("utf-8")
+
+
+class FleetAggregator:
+    """Periodic scraper + merged fleet-level metrics view.
+
+    ``targets`` is a list of replica base URLs or a zero-arg callable
+    returning the current list (the supervisor's live set).
+    ``proxy_registry`` is the front door's own registry — the source of
+    the client-observed availability counters.  ``fetch`` and ``clock``
+    are injectable for tests.
+    """
+
+    #: replica histogram whose buckets back the per-route quantiles
+    ROUTE_HISTOGRAM = "serve_route_seconds"
+
+    def __init__(
+        self,
+        targets: Union[Sequence[str], Callable[[], Sequence[str]]],
+        proxy_registry: Optional[MetricsRegistry] = None,
+        interval_s: float = 2.0,
+        csv_path: Optional[str] = None,
+        fetch: Callable[[str, float], str] = _default_fetch,
+        timeout_s: float = 2.0,
+    ):
+        self._targets = targets
+        self.proxy_registry = proxy_registry
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self._fetch = fetch
+        #: the merged fleet-level registry served at /metrics/fleet
+        self.view = MetricsRegistry()
+        if csv_path:
+            self.view.attach_csv(csv_path)
+        self._scrapes = 0
+        # per-(target, series) monotone-counter state: (last_raw,
+        # accumulated).  A replica that dies keeps its accumulated
+        # contribution, and one that restarts (counters back at 0) is
+        # detected by raw < last and resumes accumulating — so the
+        # fleet sums never go backward and window delta math stays
+        # honest across exactly the SIGKILL the fleet exists to absorb.
+        # Targets that leave the target LIST (a dead replica respawns
+        # on a fresh ephemeral port; its old URL never returns) are
+        # retired: their accumulation folds into _retired, bounding
+        # per-target state in a long-lived proxy.  A scrape FAILURE is
+        # not retirement — a blackholed replica stays listed and keeps
+        # its live state.
+        self._counter_state: Dict[
+            Tuple[str, str, LabelKey], Tuple[float, float]
+        ] = {}
+        self._retired: Dict[Tuple[str, LabelKey], float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def targets(self) -> List[str]:
+        t = self._targets() if callable(self._targets) else self._targets
+        return [u.rstrip("/") for u in t]
+
+    @staticmethod
+    def _monotone(name: str) -> bool:
+        """Series that only ever grow on a live replica: counters and
+        cumulative histogram components.  These are retained across
+        replica death/restart; gauges (queue depth) are live-only."""
+        return name.endswith(("_total", "_bucket", "_count", "_sum"))
+
+    def _accumulate(self, target: str, samples: List[Sample]) -> None:
+        for s in samples:
+            if not self._monotone(s.name):
+                continue
+            key = (target, s.name, s.labels)
+            last, acc = self._counter_state.get(key, (0.0, 0.0))
+            inc = s.value - last if s.value >= last else s.value
+            self._counter_state[key] = (s.value, acc + inc)
+
+    # -- one scrape --------------------------------------------------------
+
+    def scrape_once(self) -> Dict[str, float]:
+        """Scrape every target, merge, refresh the view, append the CSV
+        row.  Returns the headline values (tests assert on them).
+
+        Targets are fetched CONCURRENTLY: one wedged/blackholed replica
+        costs its own timeout, not everyone's scrape cadence (the same
+        lesson the fleet supervisor's health probes learned)."""
+        target_list = self.targets()
+        results: Dict[str, List[Sample]] = {}
+
+        def one(url: str) -> None:
+            try:
+                results[url] = parse_prometheus(
+                    self._fetch(url, self.timeout_s)
+                )
+            except Exception:
+                pass  # absent from results -> counted as a scrape error
+
+        fetchers = [
+            threading.Thread(
+                target=lambda u=u: one(u), daemon=True
+            )
+            for u in target_list
+        ]
+        for t in fetchers:
+            t.start()
+        deadline = time.monotonic() + self.timeout_s + 1.0
+        for t in fetchers:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        ok_targets = 0
+        scrapes: List[List[Sample]] = []
+        with self._lock:
+            for url in target_list:
+                samples = results.get(url)
+                if samples is None:
+                    # fetch raised or is still stuck past the deadline
+                    self.view.counter(
+                        "fleet_scrape_errors_total",
+                        "replica /metrics scrapes that failed",
+                    ).inc()
+                    continue
+                ok_targets += 1
+                scrapes.append(samples)
+                self._accumulate(url, samples)
+            # fold state for targets no longer LISTED into the retired
+            # baseline (caveat: a target re-listed later under the SAME
+            # url restarts from its current raw value — supervisor
+            # fleets never reuse urls, and static target lists never
+            # unlist, so neither path double-counts in practice)
+            current = set(target_list)
+            for key in [
+                k for k in self._counter_state if k[0] not in current
+            ]:
+                _target, name, labels = key
+                _last, acc = self._counter_state.pop(key)
+                rkey = (name, labels)
+                self._retired[rkey] = self._retired.get(rkey, 0.0) + acc
+            # monotone series come from the RETAINED accumulation (dead
+            # replicas keep their history); live-only series merge from
+            # this round's successful scrapes
+            merged = {
+                key: value
+                for key, value in merge_samples(scrapes).items()
+                if not self._monotone(key[0])
+            }
+            for (
+                (_target, name, labels), (_last, acc)
+            ) in self._counter_state.items():
+                key = (name, labels)
+                merged[key] = merged.get(key, 0.0) + acc
+            for rkey, acc in self._retired.items():
+                merged[rkey] = merged.get(rkey, 0.0) + acc
+
+        def msum(name: str) -> float:
+            return sum(
+                v for (n, _), v in merged.items() if n == name
+            )
+
+        requests = msum("serve_requests_total")
+        rejected = msum("serve_rejected_total")
+        queue_depth = msum("serve_queue_depth")
+        rejection_rate = (rejected / requests) if requests > 0 else 0.0
+
+        ok_total = total = 0.0
+        if self.proxy_registry is not None:
+            ok_total = self.proxy_registry.counter(
+                "fleet_proxy_ok_total"
+            ).value
+            total = self.proxy_registry.counter(
+                "fleet_proxy_responses_total"
+            ).value
+        availability = (ok_total / total) if total > 0 else 1.0
+
+        with self._lock:
+            self._scrapes += 1
+            v = self.view
+            v.gauge("fleet_replicas_scraped").set(ok_targets)
+            v.gauge("fleet_queue_depth").set(queue_depth)
+            v.gauge("fleet_requests").set(requests)
+            v.gauge("fleet_rejected").set(rejected)
+            v.gauge("fleet_rejection_rate").set(rejection_rate)
+            v.gauge("fleet_ok").set(ok_total)
+            v.gauge("fleet_responses").set(total)
+            v.gauge("fleet_availability").set(availability)
+            v.gauge("fleet_last_scrape_unix").set(time.time())
+            for labels in histogram_routes(merged, self.ROUTE_HISTOGRAM):
+                label_dict = dict(labels)
+                for gauge_name, q in (
+                    ("fleet_route_p50_seconds", 0.50),
+                    ("fleet_route_p99_seconds", 0.99),
+                ):
+                    quant = histogram_quantile(
+                        merged, self.ROUTE_HISTOGRAM, labels, q
+                    )
+                    if quant is not None and math.isfinite(quant):
+                        v.gauge(gauge_name, labels=label_dict).set(quant)
+            headline = {
+                "fleet_availability": availability,
+                "fleet_queue_depth": queue_depth,
+                "fleet_rejection_rate": rejection_rate,
+                "fleet_replicas_scraped": float(ok_targets),
+                "fleet_requests": requests,
+                "fleet_rejected": rejected,
+            }
+            # CSV history: one row per scrape through the standard sink
+            v.log_row(self._scrapes, headline)
+        return headline
+
+    def fleet_text(self) -> str:
+        """The ``/metrics/fleet`` exposition."""
+        with self._lock:
+            return self.view.prometheus_text()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FleetAggregator":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="fleet-aggregator", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:
+                # aggregation must outlive surprises; the error counter
+                # above records per-target trouble, this guards the rest
+                self.view.counter("fleet_scrape_errors_total").inc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.view.close()

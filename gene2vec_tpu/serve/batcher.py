@@ -30,7 +30,8 @@ import threading
 import time
 from typing import Any, Callable, Hashable, List, Optional, Tuple
 
-from gene2vec_tpu.obs.trace import ambient_span
+from gene2vec_tpu.obs import flight, tracecontext
+from gene2vec_tpu.obs.trace import ambient_span, hop_span
 
 
 class RejectedError(RuntimeError):
@@ -42,15 +43,25 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("item", "k", "deadline", "event", "result", "error")
+    __slots__ = ("item", "k", "deadline", "event", "result", "error",
+                 "ctx", "t0", "wait_s", "compute_s", "batch_n")
 
-    def __init__(self, item: Any, k: int, deadline: float):
+    def __init__(self, item: Any, k: int, deadline: float,
+                 t0: float = 0.0):
         self.item = item
         self.k = k
         self.deadline = deadline
         self.event = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        # distributed-tracing ticket state: the submitting request's
+        # trace context (captured on the handler thread) plus the
+        # queue-wait / compute timings the worker fills in
+        self.ctx = tracecontext.current()
+        self.t0 = t0
+        self.wait_s: Optional[float] = None
+        self.compute_s: Optional[float] = None
+        self.batch_n: Optional[int] = None
 
 
 class LRUCache:
@@ -112,6 +123,14 @@ class Ticket:
         if self._pending.error is not None:
             raise self._pending.error
         b._observe("serve_request_seconds", time.monotonic() - self._t0)
+        # ticket timings flow into the request's flight-recorder hop
+        # sink (get() runs on the submitting handler thread)
+        if self._pending.wait_s is not None:
+            flight.add_hop("queue_wait_s", self._pending.wait_s)
+        if self._pending.compute_s is not None:
+            flight.add_hop("compute_s", self._pending.compute_s)
+        if self._pending.batch_n is not None:
+            flight.add_hop("batch", self._pending.batch_n)
         if self._cache_key is not None:
             b.cache.put(self._cache_key, self._pending.result)
         return self._pending.result
@@ -204,12 +223,17 @@ class MicroBatcher:
             hit = self.cache.get(cache_key)
             if hit is not None:
                 self._count("serve_cache_hits_total")
+                ctx = tracecontext.current()
+                if ctx is not None and ctx.sampled:
+                    # a cached answer skips batcher+engine entirely —
+                    # record the hop so the trace doesn't dead-end
+                    hop_span("cache_hit", ctx.child(), dur=0.0)
                 return Ticket(self, None, None, 0.0, cached=hit)
         timeout_s = (
             self.default_timeout_s if timeout_s is None else float(timeout_s)
         )
         t0 = time.monotonic()
-        pending = _Pending(item, int(k), t0 + timeout_s)
+        pending = _Pending(item, int(k), t0 + timeout_s, t0=t0)
         with self._cv:
             if self._worker is None:
                 raise RuntimeError("MicroBatcher not started")
@@ -284,13 +308,39 @@ class MicroBatcher:
                 continue
             self._observe("serve_batch_size", len(live))
             k_max = max(p.k for p in live)
+            for p in live:
+                p.wait_s = now - p.t0
+                p.batch_n = len(live)
+            traced = [
+                p for p in live if p.ctx is not None and p.ctx.sampled
+            ]
             try:
                 with ambient_span(
                     "serve_batch", size=len(live), k=k_max
                 ) as span:
+                    t_c0 = time.monotonic()
                     with ambient_span("serve_compute"):
                         results = self.compute([p.item for p in live], k_max)
+                    compute_s = time.monotonic() - t_c0
                     span["ok"] = True
+                    for p in live:
+                        p.compute_s = compute_s
+                    if traced:
+                        # the batch serves many traces at once: record
+                        # which (bounded), and give each sampled item
+                        # its own hop — emitted INSIDE the serve_batch
+                        # span so the hop's process-local `span` field
+                        # links the compute subtree per trace
+                        span["traces"] = sorted(
+                            {p.ctx.trace_id for p in traced}
+                        )[:8]
+                        for p in traced:
+                            hop_span(
+                                "batch_item", p.ctx.child(),
+                                dur=compute_s,
+                                queue_wait_s=round(p.wait_s, 6),
+                                batch=len(live), k=k_max,
+                            )
                 if len(results) != len(live):
                     raise RuntimeError(
                         f"compute returned {len(results)} results for "

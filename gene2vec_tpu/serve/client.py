@@ -34,6 +34,14 @@ budgets + circuit breaking, the TF-Serving / finagle shape):
   rotation) → ``half-open`` after ``reset_timeout_s`` (ONE probe
   request is let through) → ``closed`` again on success.  A dead
   replica costs one connect timeout per reset window, not per request.
+* **Trace propagation** — every attempt carries a ``traceparent``
+  header (``obs/tracecontext.py``): the ambient request context when
+  one is installed (the fleet proxy installs the caller's), else a
+  fresh root sampled at ``trace_sample``.  Each retry and hedge is its
+  own child span of the logical request, so attempt amplification is
+  visible per-trace; the terminal :class:`ClientResponse` carries the
+  ``trace_id`` for slow-request reporting (loadgen
+  ``--trace-sample``).
 
 Everything is stdlib (``http.client``); tests drive the state machines
 with injected clocks and transports — no real sleeps.
@@ -50,6 +58,10 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 from urllib.parse import urlparse
+
+from gene2vec_tpu.obs import tracecontext
+from gene2vec_tpu.obs.trace import hop_span
+from gene2vec_tpu.obs.tracecontext import TRACEPARENT_HEADER, TraceContext
 
 __all__ = [
     "BreakerState",
@@ -83,6 +95,12 @@ class RetryPolicy:
     breaker_failure_threshold: int = 5
     breaker_reset_timeout_s: float = 5.0
     breaker_half_open_successes: int = 2
+    #: root-trace sampling rate for requests arriving WITHOUT an
+    #: ambient context: selected requests get a sampled root,
+    #: unselected ones get NO context (no header — the downstream
+    #: sampler stays free to act); propagated contexts always pass
+    #: through regardless
+    trace_sample: float = 0.0
 
 
 # -- token-bucket retry budget -----------------------------------------------
@@ -240,6 +258,7 @@ class ClientResponse:
     hedged: bool
     target: Optional[str]
     latency_s: float
+    trace_id: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -277,11 +296,13 @@ def _default_transport(
     body: Optional[bytes],
     connect_timeout_s: float,
     read_timeout_s: float,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Tuple[int, bytes]:
     """One HTTP exchange with SEPARATE connect and read deadlines.
     Raises ``OSError`` (incl. ``ConnectionRefusedError``/``Reset``) or
     ``socket.timeout`` on transport failure; HTTP errors return
-    normally as (status, payload)."""
+    normally as (status, payload).  ``headers`` are per-attempt extras
+    (the traceparent header)."""
     u = urlparse(base_url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port, timeout=connect_timeout_s
@@ -290,8 +311,9 @@ def _default_transport(
         conn.connect()
         if conn.sock is not None:
             conn.sock.settimeout(read_timeout_s)
-        headers = {"Content-Type": "application/json"} if body else {}
-        conn.request(method, path, body=body, headers=headers)
+        all_headers = {"Content-Type": "application/json"} if body else {}
+        all_headers.update(headers or {})
+        conn.request(method, path, body=body, headers=all_headers)
         resp = conn.getresponse()
         return resp.status, resp.read()
     finally:
@@ -427,11 +449,16 @@ class ResilientClient:
         path: str,
         body: Optional[dict],
         deadline: float,
+        base_ctx: Optional[TraceContext] = None,
+        hedge: bool = False,
     ) -> Tuple[str, int, Optional[dict], str, bool]:
         """(error_class, status, doc, target, retry_safe); records
         breaker + latency.  The remaining budget is propagated INTO the
         body's ``timeout_ms`` so the server's own deadline machinery
-        never works past the caller's."""
+        never works past the caller's.  Each attempt derives its OWN
+        child span of ``base_ctx`` and advertises it in the
+        ``traceparent`` header — the downstream handler parents to this
+        attempt, and retries/hedges show up as sibling spans."""
         remaining = deadline - self._clock()
         breaker = self.breaker(target)
         if remaining <= 0:
@@ -439,12 +466,18 @@ class ResilientClient:
             # I/O will happen; give any probe slot back without a verdict
             breaker.cancel()
             return "deadline", 0, None, target, False
+        ctx = base_ctx.child() if base_ctx is not None else None
+        headers = (
+            {TRACEPARENT_HEADER: ctx.to_header()} if ctx is not None
+            else None
+        )
         payload: Optional[bytes] = None
         if body is not None:
             shrunk = dict(body)
             shrunk["timeout_ms"] = max(1.0, remaining * 1000.0)
             payload = json.dumps(shrunk).encode("utf-8")
         t0 = self._clock()
+        t0_wall = time.time()
         try:
             status, raw = self._transport(
                 target,
@@ -453,9 +486,15 @@ class ResilientClient:
                 payload,
                 min(self.policy.connect_timeout_s, remaining),
                 min(self.policy.read_timeout_s, remaining),
+                headers,
             )
         except (OSError, http.client.HTTPException):
             breaker.record_failure()
+            hop_span(
+                "client_attempt", ctx, dur=self._clock() - t0,
+                wall=t0_wall, target=target, status=0,
+                error_class="transport", hedge=hedge,
+            )
             return "transport", 0, None, target, True
         try:
             doc = json.loads(raw.decode("utf-8")) if raw else None
@@ -471,6 +510,11 @@ class ResilientClient:
             breaker.record_success()
         else:
             breaker.record_failure()
+        hop_span(
+            "client_attempt", ctx, dur=self._clock() - t0, wall=t0_wall,
+            target=target, status=status, error_class=error_class,
+            hedge=hedge,
+        )
         return error_class, status, doc, target, retry_safe
 
     # -- the public call ---------------------------------------------------
@@ -491,6 +535,20 @@ class ResilientClient:
             self.policy.default_timeout_s if timeout_s is None
             else float(timeout_s)
         )
+        # the logical request's trace context: the ambient one when the
+        # caller (fleet proxy handler) installed it, else a fresh
+        # SAMPLED root for requests selected at trace_sample.  An
+        # unselected request gets NO context at all (the Sampler
+        # contract): no id minting, no header — and crucially no
+        # unsampled header reaching the replica, which would suppress
+        # its own head sampling for all of this client's traffic.
+        base_ctx = tracecontext.current()
+        if (
+            base_ctx is None
+            and self.policy.trace_sample > 0
+            and self._rng.random() < self.policy.trace_sample
+        ):
+            base_ctx = tracecontext.new_trace(sampled=True)
         t_start = self._clock()
         deadline = t_start + timeout_s
         self._count("requests")
@@ -510,7 +568,7 @@ class ResilientClient:
                 self._count("deadline_exhausted")
                 return self._done(
                     "deadline", 0, None, attempts, retries, hedged,
-                    last[3], t_start,
+                    last[3], t_start, base_ctx,
                 )
             target = self._pick(tried)
             if target is None:
@@ -518,7 +576,7 @@ class ResilientClient:
                 return self._done(
                     "breaker_open", 503,
                     {"error": "every replica's circuit breaker is open"},
-                    attempts, retries, hedged, None, t_start,
+                    attempts, retries, hedged, None, t_start, base_ctx,
                 )
             attempts += 1
             if target not in tried:
@@ -530,14 +588,14 @@ class ResilientClient:
             if hedge_after is not None and hedge_after < remaining:
                 outcome, was_hedge = self._attempt_hedged(
                     target, method, path, body, deadline, hedge_after,
-                    tried,
+                    tried, base_ctx,
                 )
                 if was_hedge:
                     hedged = True
                     attempts += 1
             else:
                 outcome = self._attempt(
-                    target, method, path, body, deadline
+                    target, method, path, body, deadline, base_ctx
                 )
             last = outcome
             error_class, status, doc, _target, retry_safe = outcome
@@ -546,7 +604,7 @@ class ResilientClient:
             if error_class == "ok" or not retry_safe:
                 return self._done(
                     error_class, status, doc, attempts, retries, hedged,
-                    outcome[3], t_start,
+                    outcome[3], t_start, base_ctx,
                 )
             if attempts >= self.policy.max_attempts:
                 break
@@ -572,7 +630,7 @@ class ResilientClient:
             self._count("deadline_exhausted")
         return self._done(
             error_class, status, doc, attempts, retries, hedged, target,
-            t_start,
+            t_start, base_ctx,
         )
 
     def _attempt_hedged(
@@ -584,6 +642,7 @@ class ResilientClient:
         deadline: float,
         hedge_after_s: float,
         tried: List[str],
+        base_ctx: Optional[TraceContext] = None,
     ) -> Tuple[Tuple[str, int, Optional[dict], str, bool], bool]:
         """Primary attempt + one hedge fired at the p95 mark: whichever
         concludes first wins; a hedge is paid from the retry budget and
@@ -592,8 +651,10 @@ class ResilientClient:
             queue_mod.Queue()
         )
 
-        def run(t: str) -> None:
-            results.put(self._attempt(t, method, path, body, deadline))
+        def run(t: str, is_hedge: bool = False) -> None:
+            results.put(self._attempt(
+                t, method, path, body, deadline, base_ctx, hedge=is_hedge
+            ))
 
         threading.Thread(target=run, args=(target,), daemon=True).start()
         try:
@@ -615,7 +676,7 @@ class ResilientClient:
         if hedge_target not in tried:
             tried.append(hedge_target)
         threading.Thread(
-            target=run, args=(hedge_target,), daemon=True
+            target=run, args=(hedge_target, True), daemon=True
         ).start()
         # first FINAL outcome wins; a failed first arrival falls through
         # to the second (both are within the same deadline)
@@ -643,6 +704,7 @@ class ResilientClient:
         hedged: bool,
         target: Optional[str],
         t_start: float,
+        base_ctx: Optional[TraceContext] = None,
     ) -> ClientResponse:
         if error_class == "breaker_open":
             error_class = "http_503"
@@ -655,4 +717,5 @@ class ResilientClient:
             hedged=hedged,
             target=target,
             latency_s=self._clock() - t_start,
+            trace_id=base_ctx.trace_id if base_ctx is not None else None,
         )

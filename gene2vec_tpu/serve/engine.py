@@ -23,6 +23,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gene2vec_tpu.obs.trace import ambient_span
+
 
 def next_pow2(n: int) -> int:
     """Smallest power of two >= n (n >= 1)."""
@@ -161,11 +163,17 @@ class SimilarityEngine:
             int(valid) if valid is not None and valid < int(unit.shape[0])
             else None
         )
-        scores, idx = self._topk_fn(unit, jnp.asarray(queries), kb, valid_arg)
-        return (
-            np.asarray(scores)[:n, :k],
-            np.asarray(idx)[:n, :k],
-        )
+        # one span per BATCH (host-side wrapper, never inside the trace);
+        # the device->host copies below force the async dispatch, so the
+        # span covers real compute, and it nests under serve_compute in
+        # the worker thread — cli.obs trace links it to each batch_item
+        with ambient_span("engine_topk", batch=b, k=kb):
+            scores, idx = self._topk_fn(
+                unit, jnp.asarray(queries), kb, valid_arg
+            )
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+        return scores[:n, :k], idx[:n, :k]
 
     def similar_batch(
         self,

@@ -56,7 +56,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Sequence
 from urllib.parse import urlparse
 
+from gene2vec_tpu.obs import tracecontext
+from gene2vec_tpu.obs.aggregate import FleetAggregator
+from gene2vec_tpu.obs.flight import FlightRecorder
+from gene2vec_tpu.obs.trace import ambient_span
+from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
 from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+# the proxy labels per-route latency over the same /v1 surface the
+# replicas label (one dependency-light constant, so the allowlists
+# cannot drift and the proxy never imports the serving stack);
+# everything else is "other" — no label cardinality from garbage paths
+from gene2vec_tpu.serve.routes import V1_ROUTES as _PROXY_ROUTES
 
 
 class ReplicaState:
@@ -300,6 +310,14 @@ class FleetSupervisor:
                 if r.state == ReplicaState.UP and r.url
             ]
 
+    def live_urls(self) -> List[str]:
+        """Every replica that is alive with a bound URL — the telemetry
+        scrape set.  Wider than the rotation on purpose: an EJECTED
+        replica's queue depth and error counters are exactly what the
+        fleet view must not lose sight of."""
+        with self._lock:
+            return [r.url for r in self.replicas if r.alive and r.url]
+
     def states(self) -> List[Dict]:
         with self._lock:
             return [
@@ -503,26 +521,50 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 
     def _forward(self, method: str, body: Optional[dict]) -> None:
         proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
-        resp = proxy.client.request(
-            self.path, body=body, method=method,
-            timeout_s=(
-                float(body["timeout_ms"]) / 1000.0
-                if body and isinstance(body.get("timeout_ms"), (int, float))
-                else None
-            ),
+        route = urlparse(self.path).path.rstrip("/") or "/"
+        # the proxy is the fleet's trace ingress: honor a propagated
+        # context (child it), else maybe start a root; the resilient
+        # client below picks the installed context up as its base, so
+        # every replica attempt becomes a child span of this hop
+        incoming = TraceContext.from_header(
+            self.headers.get("traceparent")
         )
+        ctx = incoming.child() if incoming is not None else (
+            proxy.sampler.maybe_new_trace()
+            if proxy.sampler is not None else None
+        )
+        t0 = time.monotonic()
+        with tracecontext.use(ctx):
+            with ambient_span("proxy_request", route=route) as span:
+                resp = proxy.client.request(
+                    self.path, body=body, method=method,
+                    timeout_s=(
+                        float(body["timeout_ms"]) / 1000.0
+                        if body
+                        and isinstance(
+                            body.get("timeout_ms"), (int, float)
+                        )
+                        else None
+                    ),
+                )
+                span["attempts"] = resp.attempts
         if resp.doc is not None:
-            self._reply_json(resp.status, resp.doc)
+            status, doc = resp.status, resp.doc
         elif resp.error_class == "deadline":
-            self._reply_json(
-                504, {"error": "fleet deadline exhausted before a "
-                               "replica answered"}
-            )
+            status, doc = 504, {
+                "error": "fleet deadline exhausted before a replica "
+                         "answered"
+            }
         else:
-            self._reply_json(
-                502, {"error": f"no replica answered "
-                               f"({resp.error_class})"}
-            )
+            status, doc = 502, {
+                "error": f"no replica answered ({resp.error_class})"
+            }
+        # account BEFORE the reply write can raise: a client gone mid-
+        # reply (broken pipe during an incident) must still count in
+        # the availability view and the flight ring
+        proxy.account(route, status, time.monotonic() - t0,
+                      ctx.trace_id if ctx is not None else None)
+        self._reply_json(status, doc)
 
     def do_GET(self) -> None:  # noqa: N802
         proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
@@ -536,6 +578,23 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             return
         if route == "/metrics":
             payload = proxy.metrics.prometheus_text().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        if route == "/metrics/fleet":
+            # the merged fleet-level SLO view (docs/OBSERVABILITY.md):
+            # availability, per-route p50/p99, total queue depth,
+            # rejection rate — the autoscaling inputs, one scrape
+            if proxy.aggregator is None:
+                self._reply_json(
+                    404, {"error": "fleet aggregation disabled "
+                                   "(--scrape-interval 0)"}
+                )
+                return
+            payload = proxy.aggregator.fleet_text().encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(payload)))
@@ -586,6 +645,10 @@ class FleetProxy:
         metrics,
         policy: Optional[RetryPolicy] = None,
         read_timeout_s: float = 10.0,
+        trace_sample: float = 0.0,
+        scrape_interval_s: float = 2.0,
+        telemetry_csv: Optional[str] = None,
+        flight_dir: Optional[str] = None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
@@ -599,8 +662,41 @@ class FleetProxy:
             ),
             metrics=metrics,
         )
+        self.sampler = Sampler(trace_sample) if trace_sample > 0 else None
+        # the telemetry plane: scrape every LIVE replica (not just the
+        # rotation) + this registry's own availability counters
+        self.aggregator: Optional[FleetAggregator] = (
+            FleetAggregator(
+                supervisor.live_urls,
+                proxy_registry=metrics,
+                interval_s=scrape_interval_s,
+                csv_path=telemetry_csv,
+            )
+            if scrape_interval_s > 0 else None
+        )
+        self.flight = FlightRecorder()
+        self.flight_dir = flight_dir
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def account(self, route: str, status: int, dur_s: float,
+                trace_id: Optional[str]) -> None:
+        """Per-forwarded-response bookkeeping: the availability
+        counters the aggregator reads, the per-route latency series,
+        and the proxy's flight-recorder ring."""
+        self.metrics.counter("fleet_proxy_responses_total").inc()
+        if 200 <= status < 300:
+            self.metrics.counter("fleet_proxy_ok_total").inc()
+        label = route if route in _PROXY_ROUTES else "other"
+        self.metrics.histogram(
+            "fleet_proxy_seconds", labels={"route": label}
+        ).observe(dur_s)
+        burst = self.flight.record(route, status, dur_s, trace_id=trace_id)
+        if burst and self.flight_dir:
+            try:
+                self.flight.dump(self.flight_dir, "5xx-burst")
+            except OSError:
+                pass
 
     def healthz(self) -> "tuple":
         states = self.supervisor.states()
@@ -622,10 +718,14 @@ class FleetProxy:
             target=server.serve_forever, name="fleet-proxy", daemon=True
         )
         self._thread.start()
+        if self.aggregator is not None:
+            self.aggregator.start()
         bound_host, bound_port = server.server_address[:2]
         return f"http://{bound_host}:{bound_port}"
 
     def stop(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.stop()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

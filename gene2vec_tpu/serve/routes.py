@@ -1,0 +1,14 @@
+"""The public /v1 route surface, as one dependency-light constant.
+
+Both the replica server (``serve/server.py``) and the front-door proxy
+(``serve/fleet.py``) label per-route latency over this exact set, so
+the two allowlists cannot drift — and the proxy process (which never
+loads a model) can import it without pulling numpy and the whole
+serving stack.
+"""
+
+from __future__ import annotations
+
+V1_ROUTES = frozenset((
+    "/v1/genes", "/v1/similar", "/v1/embedding", "/v1/interaction",
+))

@@ -51,8 +51,13 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from gene2vec_tpu.obs import flight as flight_mod
+from gene2vec_tpu.obs import tracecontext
+from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.registry import MetricsRegistry
 from gene2vec_tpu.obs.trace import ambient_span
+from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
+from gene2vec_tpu.serve.routes import V1_ROUTES
 from gene2vec_tpu.serve.batcher import (
     DeadlineExceeded,
     MicroBatcher,
@@ -86,6 +91,21 @@ class ServeConfig:
     # total wall time spent reading one request body (slow-loris guard;
     # expiry -> 408 + close)
     read_timeout_s: float = 10.0
+    # root-trace sampling rate for requests WITHOUT a traceparent
+    # header (0 = trace only when the caller propagates a sampled
+    # context; sampled callers are always honored)
+    trace_sample: float = 0.0
+
+
+#: routes whose latency gets its own labeled histogram series; anything
+#: else collapses into "other" so garbage paths can't mint label sets
+_KNOWN_ROUTES = V1_ROUTES | frozenset((
+    "/", "/livez", "/healthz", "/metrics",
+))
+
+#: powers-of-two seconds buckets, 0.5 ms .. ~8 s: fine enough that the
+#: fleet aggregator's bucket-edge p50/p99 estimates are within 2x
+_ROUTE_BUCKETS = tuple(0.0005 * (2 ** e) for e in range(15))
 
 
 class ServeApp:
@@ -135,6 +155,17 @@ class ServeApp:
         self._scorer: Optional[InteractionScorer] = None
         self._scorer_lock = threading.Lock()
         self._started = time.monotonic()
+        # head sampler for headerless traffic; propagated sampled
+        # contexts bypass it (the root already decided)
+        self.sampler = (
+            Sampler(config.trace_sample) if config.trace_sample > 0
+            else None
+        )
+        # always-on bounded ring of recent requests; cli/serve.py sets
+        # flight_dir (the run dir) and installs the SIGQUIT dump — a
+        # 5xx burst dumps from the handler path below
+        self.flight = FlightRecorder()
+        self.flight_dir: Optional[str] = None
 
     def start(self) -> "ServeApp":
         self.batcher.start()
@@ -427,51 +458,90 @@ class ServeApp:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _dispatch(
+        self, method: str, route: str, query: Dict[str, List[str]],
+        body: Optional[dict],
+    ) -> Tuple[int, dict]:
+        if method == "GET" and route == "/livez":
+            return 200, self.livez()
+        if method == "GET" and route == "/healthz":
+            status, doc = self.healthz()
+            return status, doc
+        if method == "GET" and route == "/v1/genes":
+            return 200, self.genes(query)
+        if method == "GET" and route == "/v1/similar":
+            gene = query.get("gene", [None])[0]
+            if gene is None:
+                raise ApiError(400, "missing ?gene= parameter")
+            k = self._int_param(query, "k", 10)
+            return 200, self.similar({"genes": [gene], "k": k})
+        if method == "POST" and route == "/v1/similar":
+            return 200, self.similar(body or {})
+        if method == "POST" and route == "/v1/embedding":
+            return 200, self.embedding(body or {})
+        if method == "POST" and route == "/v1/interaction":
+            return 200, self.interaction(body or {})
+        return 404, {"error": f"no route {method} {route}"}
+
     def handle(
-        self, method: str, path: str, body: Optional[dict]
+        self, method: str, path: str, body: Optional[dict],
+        traceparent: Optional[str] = None,
     ) -> Tuple[int, dict]:
         """(status, payload) for one request.  ``/metrics`` is the only
-        non-JSON route and is dispatched by the handler directly."""
+        non-JSON route and is dispatched by the handler directly.
+
+        ``traceparent`` is the caller's propagated trace context: a
+        sampled one makes this request (and its batcher/engine hops) a
+        child span of the sender's attempt; without one, the server's
+        own sampler may start a root.  Untraced requests pay one header
+        parse and nothing else."""
         url = urlparse(path)
         route = url.path.rstrip("/") or "/"
         query = parse_qs(url.query)
+        incoming = TraceContext.from_header(traceparent)
+        ctx = incoming.child() if incoming is not None else (
+            self.sampler.maybe_new_trace()
+            if self.sampler is not None else None
+        )
         t0 = time.monotonic()
+        status = 500
+        hops: Dict[str, float] = {}
         try:
-            with ambient_span("serve_request", route=route) as span:
-                if method == "GET" and route == "/livez":
-                    return 200, self.livez()
-                if method == "GET" and route == "/healthz":
-                    status, doc = self.healthz()
+            with tracecontext.use(ctx), flight_mod.collect_hops() as hops:
+                with ambient_span("serve_request", route=route) as span:
+                    status, doc = self._dispatch(method, route, query, body)
                     span["status"] = status
-                    return status, doc
-                if method == "GET" and route == "/v1/genes":
-                    return 200, self.genes(query)
-                if method == "GET" and route == "/v1/similar":
-                    gene = query.get("gene", [None])[0]
-                    if gene is None:
-                        raise ApiError(400, "missing ?gene= parameter")
-                    k = self._int_param(query, "k", 10)
-                    return 200, self.similar({"genes": [gene], "k": k})
-                if method == "POST" and route == "/v1/similar":
-                    return 200, self.similar(body or {})
-                if method == "POST" and route == "/v1/embedding":
-                    return 200, self.embedding(body or {})
-                if method == "POST" and route == "/v1/interaction":
-                    return 200, self.interaction(body or {})
-                span["status"] = 404
-                return 404, {"error": f"no route {method} {route}"}
+            return status, doc
         except ApiError as e:
             self.metrics.counter(
                 f"serve_http_{e.status}_total"
             ).inc()
+            status = e.status
             return e.status, {"error": str(e)}
         except Exception as e:  # route crash -> 500, server stays up
             self.metrics.counter("serve_http_500_total").inc()
+            status = 500
             return 500, {"error": f"internal error: {e!r}"}
         finally:
-            self.metrics.histogram("serve_handle_seconds").observe(
-                time.monotonic() - t0
+            dur = time.monotonic() - t0
+            self.metrics.histogram("serve_handle_seconds").observe(dur)
+            self.metrics.histogram(
+                "serve_route_seconds",
+                buckets=_ROUTE_BUCKETS,
+                labels={
+                    "route": route if route in _KNOWN_ROUTES else "other"
+                },
+            ).observe(dur)
+            burst = self.flight.record(
+                route, status, dur,
+                trace_id=ctx.trace_id if ctx is not None else None,
+                hops=hops,
             )
+            if burst and self.flight_dir:
+                try:
+                    self.flight.dump(self.flight_dir, "5xx-burst")
+                except OSError:
+                    pass  # a full disk must not take the handler down
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -594,7 +664,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4",
             )
             return
-        status, doc = app.handle("GET", self.path, None)
+        status, doc = app.handle(
+            "GET", self.path, None,
+            traceparent=self.headers.get("traceparent"),
+        )
         self._reply_json(status, doc)
 
     def do_POST(self) -> None:  # noqa: N802
@@ -622,7 +695,10 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, UnicodeDecodeError) as e:
             self._reply_json(400, {"error": f"bad JSON body: {e}"})
             return
-        status, doc = app.handle("POST", self.path, body)
+        status, doc = app.handle(
+            "POST", self.path, body,
+            traceparent=self.headers.get("traceparent"),
+        )
         self._reply_json(status, doc)
 
 

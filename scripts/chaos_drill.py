@@ -306,6 +306,58 @@ def _parse_prom_counters(text: str) -> dict:
     return out
 
 
+def _parse_fleet_view(url: str) -> dict:
+    """``/metrics/fleet`` → {(name, labels): value} via the escape-aware
+    parser the aggregator itself uses."""
+    from gene2vec_tpu.obs.aggregate import parse_prometheus
+
+    text = (
+        urllib.request.urlopen(url + "/metrics/fleet", timeout=10.0)
+        .read().decode("utf-8")
+    )
+    return {(s.name, s.labels): s.value for s in parse_prometheus(text)}
+
+
+def _trace_tree_facts(doc: dict) -> "tuple":
+    """(node name set, client_attempt count) over the reassembled tree
+    including process-local compute subtrees."""
+    names = set()
+    attempts = 0
+
+    def walk(node: dict) -> None:
+        nonlocal attempts
+        if node.get("name"):
+            names.add(node["name"])
+            if node["name"] == "client_attempt":
+                attempts += 1
+        for sub in node.get("process_spans", []):
+            walk(sub)
+        for child in node.get("children", []):
+            walk(child)
+
+    for root in doc.get("roots", []):
+        walk(root)
+    return names, attempts
+
+
+def _find_cross_process_trace(export_dir: str, candidates) -> "tuple":
+    """First candidate trace id whose reassembled tree spans the whole
+    pipeline (proxy → ≥2 client attempts, i.e. a retried/failed-over
+    request → replica → batcher → engine)."""
+    from gene2vec_tpu.obs import flight as flight_mod
+
+    for tid in candidates:
+        doc = flight_mod.collect_trace(export_dir, tid)
+        names, n_attempts = _trace_tree_facts(doc)
+        if (
+            {"proxy_request", "serve_request", "batch_item",
+             "engine_topk"} <= names
+            and n_attempts >= 2
+        ):
+            return tid, names, n_attempts
+    return None, set(), 0
+
+
 def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
     import threading
 
@@ -333,7 +385,14 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
         "--export-dir", export_dir, "--replicas", str(replicas),
         "--port", "0", "--health-interval", "0.25",
         "--backoff-base", "0.3", "--proxy-timeout-ms", "4000",
+        "--scrape-interval", "0.5",
         "--seed", str(seed),
+        # no LRU on the replicas: the drill's 8-gene keyspace would be
+        # fully cached after warmup, and a cached answer never touches
+        # the batcher/engine — the cross-process trace this phase must
+        # reassemble (and the availability gate should cover the whole
+        # pipeline, not the cache)
+        "--serve-arg=--cache-size", "--serve-arg=0",
         "--replica-arg", "1:--faults", "--replica-arg",
         f"1:{faults.to_json()}",
     ]
@@ -348,11 +407,14 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
         log(f"fleet front door at {url}; replica pids "
             f"{info['replica_pids']}")
 
+        # every drill request is a SAMPLED trace root: the proxy and
+        # replicas honor the propagated context, so cross-process
+        # reassembly below has the full span pipeline to work with
         client = ResilientClient(
             [url],
             RetryPolicy(
                 max_attempts=3, default_timeout_s=6.0,
-                read_timeout_s=6.0,
+                read_timeout_s=6.0, trace_sample=1.0,
             ),
         )
         # pre-chaos reference answers: every response during chaos must
@@ -370,8 +432,27 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
                 tuple(n["gene"] for n in r.doc["results"][0]["neighbors"]),
             )
 
+        # fleet-view snapshot BEFORE the load window: the availability/
+        # rejection numbers /metrics/fleet reports during chaos must be
+        # reconcilable with the drill's own counts by delta math
+        def _settled_view() -> dict:
+            last = None
+            for _ in range(30):
+                view = _parse_fleet_view(url)
+                key = (view.get(("fleet_responses", ())),
+                       view.get(("fleet_requests", ())))
+                if last is not None and key == last:
+                    return view
+                last = key
+                time.sleep(0.6)
+            return view
+
+        pre_view = _settled_view()
+
         counts = {"ok": 0, "failed": 0, "wrong": 0, "mixed": 0,
-                  "attempts": 0, "retries": 0}
+                  "attempts": 0, "retries": 0, "rejected": 0}
+        ok_latencies = []
+        trace_log = []  # (monotonic, trace_id, retries, ok)
         lock = threading.Lock()
         stop_at = time.monotonic() + duration_s
 
@@ -385,9 +466,15 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
                 with lock:
                     counts["attempts"] += r.attempts
                     counts["retries"] += r.retries
+                    trace_log.append(
+                        (time.monotonic(), r.trace_id, r.retries, r.ok)
+                    )
+                    if r.error_class == "http_429":
+                        counts["rejected"] += 1
                     if not r.ok:
                         counts["failed"] += 1
                         continue
+                    ok_latencies.append(r.latency_s)
                     it = r.doc["model"]["iteration"]
                     got = tuple(
                         n["gene"]
@@ -414,6 +501,7 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
         victim = info["replica_pids"][0]
         log(f"SIGKILL replica 0 (pid {victim}) mid-load")
         os.kill(victim, signal.SIGKILL)
+        t_kill = time.monotonic()
 
         for t in threads:
             t.join(timeout=duration_s + 30.0)
@@ -433,6 +521,115 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
         amplification = (
             (counts["attempts"] + proxy_retries + proxy_hedges)
             / max(total, 1)
+        )
+
+        # --- the fleet SLO plane must agree with what we measured ----
+        # traffic has stopped, so the aggregator's counters converge;
+        # compare by DELTA across the load window.  Per-exchange vs
+        # per-logical-request bookkeeping: the proxy counts one
+        # response per drill-client ATTEMPT, and only a terminal
+        # attempt can be 2xx, so ok≈(ok+wrong+mixed), total≈attempts.
+        post_view = _settled_view()
+
+        def _delta(name: str) -> float:
+            return (post_view.get((name, ()), 0.0)
+                    - pre_view.get((name, ()), 0.0))
+
+        resp_delta = _delta("fleet_responses")
+        ok_delta = _delta("fleet_ok")
+        fleet_availability = ok_delta / max(resp_delta, 1.0)
+        measured_attempt_av = (
+            (counts["ok"] + counts["wrong"] + counts["mixed"])
+            / max(counts["attempts"], 1)
+        )
+        fleet_rejection_rate = post_view.get(
+            ("fleet_rejection_rate", ()), 0.0
+        )
+        measured_rejection_rate = counts["rejected"] / max(total, 1)
+        fleet_queue_depth = post_view.get(("fleet_queue_depth", ()))
+        route_labels = (("route", "/v1/similar"),)
+        fleet_p50 = post_view.get(
+            ("fleet_route_p50_seconds", route_labels)
+        )
+        fleet_p99 = post_view.get(
+            ("fleet_route_p99_seconds", route_labels)
+        )
+        ok_latencies.sort()
+        drill_p99 = (
+            ok_latencies[min(len(ok_latencies) - 1,
+                             int(0.99 * len(ok_latencies)))]
+            if ok_latencies else None
+        )
+        log(
+            f"fleet view: availability {fleet_availability:.4f} "
+            f"(drill attempt-level {measured_attempt_av:.4f}), "
+            f"/v1/similar p50/p99 {fleet_p50}/{fleet_p99}s "
+            f"(drill client p99 {drill_p99}), queue depth "
+            f"{fleet_queue_depth}, rejection {fleet_rejection_rate:.4f}"
+        )
+        assert resp_delta > 0, "/metrics/fleet saw none of the load"
+        assert abs(fleet_availability - measured_attempt_av) <= 0.05, (
+            f"/metrics/fleet availability {fleet_availability:.4f} "
+            f"disagrees with the drill's measured "
+            f"{measured_attempt_av:.4f}"
+        )
+        assert abs(
+            fleet_rejection_rate - measured_rejection_rate
+        ) <= 0.05, (
+            f"/metrics/fleet rejection rate {fleet_rejection_rate:.4f} "
+            f"disagrees with measured {measured_rejection_rate:.4f}"
+        )
+        assert fleet_queue_depth is not None and fleet_queue_depth >= 0, (
+            "fleet_queue_depth missing from /metrics/fleet"
+        )
+        assert fleet_p50 is not None and fleet_p99 is not None, (
+            "per-route p50/p99 missing from /metrics/fleet"
+        )
+        # replica-side handle time must sit below the client-observed
+        # tail (which adds proxy+retries); bucket edges round UP <= 2x
+        assert drill_p99 is None or fleet_p99 <= max(
+            4.0 * drill_p99, 1.0
+        ), (
+            f"fleet p99 {fleet_p99}s implausible vs drill-observed "
+            f"{drill_p99}s"
+        )
+
+        # --- cross-process trace reassembly for a SIGKILL-affected
+        # request: an ok answer shortly after the kill whose tree shows
+        # the proxy failing over (>= 2 client attempts) down to the
+        # engine.  Reassembled in-process to pick a candidate, then
+        # re-rendered through the real CLI (the operator's tool).
+        time.sleep(1.0)  # let the last events.jsonl appends land
+        candidates = [
+            tid for (ts, tid, _retries, ok) in trace_log
+            if ok and tid and ts >= t_kill
+        ][:40]
+        trace_id, names, n_attempts = _find_cross_process_trace(
+            export_dir, candidates
+        )
+        assert trace_id is not None, (
+            f"no post-SIGKILL request reassembled into a full "
+            f"proxy→attempts→replica→batcher→engine trace "
+            f"({len(candidates)} candidates tried)"
+        )
+        cli = subprocess.run(
+            [sys.executable, "-m", "gene2vec_tpu.cli.obs", "trace",
+             export_dir, trace_id],
+            capture_output=True, text=True, timeout=120,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        assert cli.returncode == 0, (
+            f"cli.obs trace failed (rc={cli.returncode}):\n{cli.stderr}"
+        )
+        for needle in ("proxy_request", "client_attempt",
+                       "serve_request", "batch_item", "engine_topk"):
+            assert needle in cli.stdout, (
+                f"cli.obs trace output missing {needle!r}:\n{cli.stdout}"
+            )
+        log(
+            f"trace {trace_id} reassembled end-to-end via cli.obs "
+            f"trace ({n_attempts} client attempts, hops: "
+            f"{sorted(names)})"
         )
         # the respawn is a fresh jax import — under the load the drill
         # itself just generated it can outlast the measurement window,
@@ -463,6 +660,14 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
             "proxy_retries": int(proxy_retries),
             "retry_amplification": round(amplification, 4),
             "replica_restarts": restarts,
+            "fleet_view_availability": round(fleet_availability, 5),
+            "fleet_view_matches_measured": True,
+            "fleet_route_p50_s": fleet_p50,
+            "fleet_route_p99_s": fleet_p99,
+            "fleet_queue_depth": fleet_queue_depth,
+            "fleet_rejection_rate": round(fleet_rejection_rate, 5),
+            "reassembled_trace_id": trace_id,
+            "reassembled_trace_client_attempts": n_attempts,
             "faults_spec": faults.to_json(),
             "sigkilled_replica": 0,
             "budget": {k: v for k, v in budget.items()

@@ -6,7 +6,11 @@
 # GGIPNN train step, and the serve/ top-k engine (host-callback + dtype
 # + bucketed jit-cache-stability via `--hlo hot`; the row-sharded
 # engine's per-query collective-bytes ceiling via `--hlo budgets`,
-# budgets.json section "serve").
+# budgets.json section "serve").  The default tier also runs the
+# span-hygiene pass (no obs span enter/exit inside jitted/traced code,
+# no span context manager left unclosed on early return) and the
+# committed-bench budget gates: fleet availability (BENCH_FLEET vs
+# budgets.json "fleet") and tracing overhead (BENCH_OBS vs "obs").
 #
 #   scripts/run_static_analysis.sh                 # lint + tier-2 HLO
 #   scripts/run_static_analysis.sh --fast          # lint only (tier-1 scope)

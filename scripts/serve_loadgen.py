@@ -31,6 +31,20 @@ optional ``--hedge``) and each level additionally reports retry/hedge
 counts and the attempt amplification factor.  The JSON document goes to
 ``--output`` and stdout (the product — progress chatter is stderr-only,
 matching the repo's stdout discipline).
+
+Tracing hooks (docs/OBSERVABILITY.md#distributed-tracing):
+
+* ``--trace-sample N`` — every request carries a SAMPLED traceparent
+  root, and each level's row reports the trace ids of its N slowest
+  requests (``slowest_traces``), so a bench regression comes with
+  directly inspectable traces: ``python -m gene2vec_tpu.cli.obs trace
+  <export_dir> <trace_id>``;
+* ``--trace-overhead`` — the budgets.json ``obs`` gate's measurement:
+  one level run twice per round (no header vs sampled header) with the
+  arm order alternating per round; each arm's estimate is the MEDIAN
+  of its per-window p50s, compared into a ``trace_overhead`` section
+  (``BENCH_OBS_r09.json``; ``analysis/passes_obs.py`` re-gates the
+  committed record).
 """
 
 from __future__ import annotations
@@ -52,6 +66,9 @@ from typing import Dict, List, Optional
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+
+from gene2vec_tpu.obs import tracecontext  # noqa: E402
+from gene2vec_tpu.obs.tracecontext import TRACEPARENT_HEADER  # noqa: E402
 
 
 def _http_json(
@@ -84,14 +101,17 @@ class _Stats:
         self.retries = 0
         self.hedges = 0
         self.attempts = 0
+        self.traces: List[tuple] = []  # (latency_ms, status, trace_id)
 
     def record(self, status: int, latency_ms: float,
                retries: int = 0, hedged: bool = False,
-               attempts: int = 1) -> None:
+               attempts: int = 1, trace_id: Optional[str] = None) -> None:
         with self.lock:
             self.retries += retries
             self.hedges += int(hedged)
             self.attempts += attempts
+            if trace_id is not None:
+                self.traces.append((latency_ms, status, trace_id))
             if status == 200:
                 self.ok += 1
                 self.latencies_ms.append(latency_ms)
@@ -121,12 +141,17 @@ def _percentile(sorted_values: List[float], q: float) -> Optional[float]:
 
 def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
                  stats: _Stats, timeout_s: float,
-                 client=None) -> None:
+                 client=None, trace: bool = False) -> None:
     body = {"genes": [rng.choice(genes)], "k": k}
+    # when tracing, THIS request is a sampled trace root: the resilient
+    # client adopts it as the ambient base (child span per attempt), the
+    # plain path sends it as the traceparent header directly
+    ctx = tracecontext.new_trace(sampled=True) if trace else None
     if client is not None:
         # the resilient path: retries/hedging under one deadline, with
         # per-request attempt accounting for the amplification report
-        r = client.request("/v1/similar", body, timeout_s=timeout_s)
+        with tracecontext.use(ctx):
+            r = client.request("/v1/similar", body, timeout_s=timeout_s)
         status = r.status
         if status == 0:
             # no HTTP status reached the caller: bucket the client's own
@@ -136,14 +161,18 @@ def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
             status,
             r.latency_s * 1000.0,
             retries=r.retries, hedged=r.hedged, attempts=r.attempts,
+            trace_id=r.trace_id if trace else None,
         )
         return
     t0 = time.monotonic()
     try:
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            headers[TRACEPARENT_HEADER] = ctx.to_header()
         req = urllib.request.Request(
             f"{url}/v1/similar",
             data=json.dumps(body).encode("utf-8"),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         with urllib.request.urlopen(req, timeout=timeout_s):
             pass
@@ -153,12 +182,15 @@ def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
         e.close()
     except Exception:
         status = -1
-    stats.record(status, (time.monotonic() - t0) * 1000.0)
+    stats.record(
+        status, (time.monotonic() - t0) * 1000.0,
+        trace_id=ctx.trace_id if ctx is not None else None,
+    )
 
 
 def run_open_level(url: str, genes: List[str], k: int, rps: float,
                    duration_s: float, seed: int, timeout_s: float,
-                   client=None) -> _Stats:
+                   client=None, trace: bool = False) -> _Stats:
     """Fixed-schedule arrivals at ``rps`` for ``duration_s``; each
     arrival gets its own thread so a slow/queued response never delays
     the next arrival (that is what makes the loop open)."""
@@ -175,7 +207,7 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
             time.sleep(delay)
         t = threading.Thread(
             target=_one_request,
-            args=(url, genes, k, rng, stats, timeout_s, client),
+            args=(url, genes, k, rng, stats, timeout_s, client, trace),
             daemon=True,
         )
         t.start()
@@ -188,7 +220,8 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
 
 def run_closed_level(url: str, genes: List[str], k: int, workers: int,
                      duration_s: float, seed: int,
-                     timeout_s: float, client=None) -> _Stats:
+                     timeout_s: float, client=None,
+                     trace: bool = False) -> _Stats:
     """N workers firing back-to-back until the clock runs out."""
     stats = _Stats()
     stop = time.monotonic() + duration_s
@@ -196,7 +229,8 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
     def loop(worker_seed: int) -> None:
         rng = random.Random(worker_seed)
         while time.monotonic() < stop:
-            _one_request(url, genes, k, rng, stats, timeout_s, client)
+            _one_request(url, genes, k, rng, stats, timeout_s, client,
+                         trace)
 
     t_start = time.monotonic()
     threads = [
@@ -212,7 +246,7 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
 
 
 def summarize(level: float, stats: _Stats, mode: str,
-              resilient: bool = False) -> Dict:
+              resilient: bool = False, trace_sample: int = 0) -> Dict:
     lat = sorted(stats.latencies_ms)
     wall = getattr(stats, "wall_s", 1.0) or 1.0
     row = {
@@ -243,6 +277,15 @@ def summarize(level: float, stats: _Stats, mode: str,
         row["attempt_amplification"] = round(
             stats.attempts / stats.total, 4
         ) if stats.total else None
+    if trace_sample > 0 and stats.traces:
+        # the N slowest requests, with the trace ids to go look at:
+        # `python -m gene2vec_tpu.cli.obs trace <run_dir> <trace_id>`
+        slowest = sorted(stats.traces, reverse=True)[:trace_sample]
+        row["slowest_traces"] = [
+            {"latency_ms": round(lat, 3), "status": status,
+             "trace_id": tid}
+            for lat, status, tid in slowest
+        ]
     return row
 
 
@@ -298,6 +341,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="resilient client max attempts per request")
     ap.add_argument("--hedge", action="store_true",
                     help="enable p95 hedging on the resilient client")
+    ap.add_argument("--trace-sample", type=int, default=0, metavar="N",
+                    help="send a sampled traceparent root on EVERY "
+                         "request and report the N slowest requests' "
+                         "trace ids per level")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="measure traced-vs-untraced p50 at ONE level "
+                         "(interleaved arms; emits the trace_overhead "
+                         "section analysis/passes_obs.py gates)")
+    ap.add_argument("--overhead-rounds", type=int, default=3,
+                    help="untraced/traced round pairs for "
+                         "--trace-overhead")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--warmup", type=int, default=64,
                     help="largest warm-up burst; concurrent bursts of "
@@ -379,34 +433,108 @@ def main(argv: Optional[List[str]] = None) -> int:
             burst *= 2
 
         levels = [float(x) for x in args.levels.split(",") if x]
-        results = []
-        for level in levels:
-            print(f"level {level:g} ({args.mode}) for "
-                  f"{args.duration:g}s ...", file=sys.stderr)
+        trace_all = args.trace_sample > 0
+
+        def run_level(level: float, trace: bool) -> _Stats:
             if args.mode == "open":
-                stats = run_open_level(
+                return run_open_level(
                     url, genes, args.k, level, args.duration, args.seed,
-                    args.timeout, client,
+                    args.timeout, client, trace=trace,
                 )
-            else:
-                stats = run_closed_level(
-                    url, genes, args.k, int(level), args.duration,
-                    args.seed, args.timeout, client,
-                )
-            row = summarize(level, stats, args.mode, args.resilient)
-            print(f"  -> {json.dumps(row)}", file=sys.stderr)
-            results.append(row)
+            return run_closed_level(
+                url, genes, args.k, int(level), args.duration,
+                args.seed, args.timeout, client, trace=trace,
+            )
+
+        results = []
+        overhead = None
+        if args.trace_overhead:
+            if len(levels) != 1:
+                print("error: --trace-overhead needs exactly one level "
+                      "(the budgeted rps)", file=sys.stderr)
+                return 2
+            level = levels[0]
+            # one discarded window at the measured level first: the
+            # burst warmup above compiles the small batch buckets, but
+            # the first sustained window still pays cold caches, and
+            # whichever arm ran first would eat that bias
+            print(f"overhead warm window level {level:g} ...",
+                  file=sys.stderr)
+            run_level(level, False)
+            # per-arm estimate = MEDIAN of per-window p50s, arms
+            # alternating order each round: this host's window p50s
+            # swing several-x between identical windows (a single
+            # melted window poisons pooled latencies), and the median
+            # over windows shrugs off the outliers both arms suffer
+            window_p50s = {False: [], True: []}
+            n_per_arm = {False: 0, True: 0}
+            for rnd in range(max(1, args.overhead_rounds)):
+                order = (False, True) if rnd % 2 == 0 else (True, False)
+                for traced in order:
+                    arm = "traced" if traced else "untraced"
+                    print(f"overhead round {rnd + 1}/"
+                          f"{args.overhead_rounds} {arm} level "
+                          f"{level:g} ...", file=sys.stderr)
+                    stats = run_level(level, traced)
+                    w50 = _percentile(sorted(stats.latencies_ms), 0.50)
+                    if w50 is not None:
+                        window_p50s[traced].append(w50)
+                        n_per_arm[traced] += len(stats.latencies_ms)
+                    row = summarize(level, stats, args.mode,
+                                    args.resilient)
+                    row["arm"] = arm
+                    row["round"] = rnd + 1
+                    results.append(row)
+
+            p50_u = _percentile(sorted(window_p50s[False]), 0.50)
+            p50_t = _percentile(sorted(window_p50s[True]), 0.50)
+            if not p50_u or p50_t is None:
+                print("error: no successful requests in an arm — "
+                      "overhead is unmeasurable", file=sys.stderr)
+                return 2
+            overhead = {
+                "rps": level,
+                "mode": args.mode,
+                "duration_s": args.duration,
+                "rounds": args.overhead_rounds,
+                "n_untraced": n_per_arm[False],
+                "n_traced": n_per_arm[True],
+                "window_p50s_untraced_ms": [
+                    round(v, 3) for v in window_p50s[False]
+                ],
+                "window_p50s_traced_ms": [
+                    round(v, 3) for v in window_p50s[True]
+                ],
+                "p50_untraced_ms": round(p50_u, 3),
+                "p50_traced_ms": round(p50_t, 3),
+                "regression_frac": round((p50_t - p50_u) / p50_u, 4),
+            }
+            print(f"trace overhead: {json.dumps(overhead)}",
+                  file=sys.stderr)
+        else:
+            for level in levels:
+                print(f"level {level:g} ({args.mode}) for "
+                      f"{args.duration:g}s ...", file=sys.stderr)
+                stats = run_level(level, trace_all)
+                row = summarize(level, stats, args.mode, args.resilient,
+                                trace_sample=args.trace_sample)
+                print(f"  -> {json.dumps(row)}", file=sys.stderr)
+                results.append(row)
 
         doc = {
-            "bench": "serve_loadgen",
+            "bench": ("trace_overhead" if args.trace_overhead
+                      else "serve_loadgen"),
             "mode": args.mode,
             "k": args.k,
             "duration_s": args.duration,
             "num_query_genes": len(genes),
             "server": health.get("model", {}),
             "resilient": bool(args.resilient),
+            "trace_sample": args.trace_sample,
             "levels": results,
         }
+        if overhead is not None:
+            doc["trace_overhead"] = overhead
         if client is not None:
             doc["client_stats"] = dict(client.stats)
         with open(args.output, "w", encoding="utf-8") as f:

@@ -92,6 +92,17 @@ class Writer:
         self._queue.append(payload)
         os.fsync(fd)
 """,
+    "span-hygiene": """
+import jax
+
+from gene2vec_tpu.obs.trace import ambient_span
+
+
+@jax.jit
+def score(x):
+    with ambient_span("inner"):
+        return x * 2
+""",
 }
 
 CLEAN_FIXTURE = """
@@ -165,6 +176,49 @@ def test_inline_disable_pragma(tmp_path):
     assert [f.pass_id for f in run_ast_passes(files=[str(path)])] == [
         "missing-donate"
     ]
+
+
+def test_span_hygiene_unclosed_span(tmp_path):
+    """Rule 2: a span context manager created outside `with` leaks on
+    early return; the thin-wrapper `return <span call>` form (Run.span)
+    and normal `with` usage stay clean.  A regex m.span() in a module
+    that does NOT import obs is never flagged."""
+    src = """
+import sys
+
+from gene2vec_tpu.obs.trace import ambient_span
+
+
+def leaky():
+    span = ambient_span("phase")
+    return span.__enter__()
+
+
+def wrapper():
+    return ambient_span("ok")
+
+
+def fine():
+    with ambient_span("good"):
+        print("x", file=sys.stderr)
+"""
+    path = tmp_path / "spans.py"
+    path.write_text(src)
+    fs = run_ast_passes(files=[str(path)], select=["span-hygiene"])
+    assert len(fs) == 1, [f.format() for f in fs]
+    assert "without `with`" in fs[0].message
+
+    # no obs import => the .span attribute form is out of scope
+    path2 = tmp_path / "regex_user.py"
+    path2.write_text(
+        "import re\n"
+        "def find(text):\n"
+        "    m = re.search('x', text)\n"
+        "    return m.span()\n"
+    )
+    assert run_ast_passes(
+        files=[str(path2)], select=["span-hygiene"]
+    ) == []
 
 
 def test_clean_fixture_zero_findings(tmp_path):

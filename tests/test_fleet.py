@@ -166,7 +166,7 @@ def test_client_fails_over_and_propagates_shrinking_deadline():
     clock = FakeClock()
     seen = []
 
-    def transport(base, method, path, body, ct, rt):
+    def transport(base, method, path, body, ct, rt, headers=None):
         at = clock.t
         clock.t += 0.2
         seen.append((base, json.loads(body)["timeout_ms"], at))
@@ -189,7 +189,7 @@ def test_client_never_launches_attempt_past_deadline():
     clock = FakeClock()
     launches = []
 
-    def transport(base, method, path, body, ct, rt):
+    def transport(base, method, path, body, ct, rt, headers=None):
         launches.append(clock.t)
         clock.t += 0.6  # each attempt eats most of the budget
         raise ConnectionRefusedError()
@@ -308,7 +308,7 @@ def test_client_hedges_at_p95_and_first_answer_wins():
     # real (few-ms) sleeps: hedging genuinely races two threads
     slow, fast = "http://slow", "http://fast"
 
-    def transport(base, method, path, body, ct, rt):
+    def transport(base, method, path, body, ct, rt, headers=None):
         time.sleep(0.25 if base == slow else 0.005)
         return 200, json.dumps({"from": base}).encode()
 

@@ -1,0 +1,695 @@
+"""Distributed tracing, fleet telemetry aggregation, and the flight
+recorder (docs/OBSERVABILITY.md#distributed-tracing).
+
+Covers the cross-process trace plane end to end at tier-1 scale:
+traceparent parsing/propagation, tracer stamping, retry/hedge span
+lineage through the resilient client (fake clock + fake transport),
+batcher ticket hops, HTTP round trip into a real in-process ServeApp,
+reassembly + ``cli.obs trace``, Prometheus label escaping round trips,
+the registry cardinality cap, and the aggregator's merged fleet view.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.obs import flight as flight_mod
+from gene2vec_tpu.obs import tracecontext as tc
+from gene2vec_tpu.obs.aggregate import (
+    FleetAggregator,
+    histogram_quantile,
+    merge_samples,
+    parse_prometheus,
+)
+from gene2vec_tpu.obs.flight import FlightRecorder, collect_trace
+from gene2vec_tpu.obs.registry import MetricsRegistry
+from gene2vec_tpu.obs.trace import (
+    Tracer,
+    hop_span,
+    read_events,
+    set_tracer,
+)
+from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+
+
+# -- trace context -----------------------------------------------------------
+
+
+def test_traceparent_header_round_trip():
+    ctx = tc.new_trace()
+    back = tc.TraceContext.from_header(ctx.to_header())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled
+    off = tc.TraceContext(ctx.trace_id, ctx.span_id, sampled=False)
+    assert tc.TraceContext.from_header(off.to_header()).sampled is False
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-abc-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",     # invalid version
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",     # non-hex
+])
+def test_traceparent_rejects_malformed(bad):
+    assert tc.TraceContext.from_header(bad) is None
+
+
+def test_child_lineage_and_thread_local_use():
+    root = tc.new_trace()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    assert tc.current() is None
+    with tc.use(root):
+        assert tc.current() is root
+        with tc.use(child):
+            assert tc.current() is child
+        assert tc.current() is root
+    assert tc.current() is None
+    with tc.use(None):
+        assert tc.current() is None
+
+
+def test_sampler_rates():
+    assert tc.Sampler(0.0).maybe_new_trace() is None
+    ctx = tc.Sampler(1.0).maybe_new_trace()
+    assert ctx is not None and ctx.sampled
+
+
+# -- tracer stamping ---------------------------------------------------------
+
+
+def test_tracer_stamps_sampled_context(tmp_path):
+    t = Tracer(str(tmp_path / "events.jsonl"))
+    ctx = tc.new_trace()
+    with tc.use(ctx):
+        with t.span("serve_request", route="/x"):
+            t.event("inner")
+    unsampled = tc.TraceContext("a" * 32, "b" * 16, sampled=False)
+    with tc.use(unsampled):
+        t.event("dark")
+    t.close()
+    events = read_events(str(tmp_path / "events.jsonl"))
+    spans = [e for e in events if e["name"] == "serve_request"]
+    assert spans and all(e["trace"] == ctx.trace_id for e in spans)
+    assert all(e["tsid"] == ctx.span_id for e in spans)
+    inner = next(e for e in events if e["name"] == "inner")
+    assert inner["trace"] == ctx.trace_id
+    dark = next(e for e in events if e["name"] == "dark")
+    assert "trace" not in dark
+
+
+def test_hop_span_links_process_local_parent(tmp_path):
+    t = Tracer(str(tmp_path / "events.jsonl"))
+    set_tracer(t)
+    try:
+        root = tc.new_trace()
+        hop = root.child()
+        with t.span("serve_batch"):
+            hop_span("batch_item", hop, dur=0.01, queue_wait_s=0.002)
+    finally:
+        set_tracer(None)
+        t.close()
+    events = read_events(str(tmp_path / "events.jsonl"))
+    batch_start = next(
+        e for e in events
+        if e["name"] == "serve_batch" and e["type"] == "span_start"
+    )
+    item = next(e for e in events if e["name"] == "batch_item")
+    assert item["trace"] == root.trace_id
+    assert item["tsid"] == hop.span_id
+    assert item["tpid"] == root.span_id
+    assert item["span"] == batch_start["span"]  # process-local link
+    # no tracer installed -> silently free
+    hop_span("batch_item", hop, dur=0.01)
+
+
+# -- resilient client propagation (retries / hedges) -------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _client(transport, clock, policy=None, targets=("http://a", "http://b")):
+    return ResilientClient(
+        list(targets),
+        policy or RetryPolicy(
+            max_attempts=3, default_timeout_s=5.0, backoff_base_s=0.0,
+            trace_sample=1.0,
+        ),
+        transport=transport,
+        clock=clock,
+        sleep=lambda s: None,
+    )
+
+
+def test_every_attempt_shares_trace_with_distinct_child_spans():
+    clock = FakeClock()
+    seen = []
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        seen.append((base, dict(headers or {})))
+        if len(seen) < 3:
+            raise ConnectionRefusedError("down")
+        return 200, json.dumps({"ok": True}).encode()
+
+    c = _client(transport, clock)
+    r = c.request("/v1/similar", {"genes": ["G1"]})
+    assert r.ok and r.attempts == 3 and r.retries == 2
+    assert r.trace_id is not None
+    parsed = [
+        tc.TraceContext.from_header(h["traceparent"]) for _, h in seen
+    ]
+    assert all(p is not None for p in parsed)
+    # one trace id across every attempt of the logical request...
+    assert {p.trace_id for p in parsed} == {r.trace_id}
+    # ...but each attempt is its own span
+    assert len({p.span_id for p in parsed}) == 3
+    assert all(p.sampled for p in parsed)
+
+
+def test_ambient_context_wins_over_client_sampling():
+    clock = FakeClock()
+    seen = []
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        seen.append(dict(headers or {}))
+        return 200, b"{}"
+
+    c = _client(transport, clock)
+    root = tc.new_trace()
+    with tc.use(root):
+        r = c.request("/v1/similar", {"genes": ["G1"]})
+    assert r.trace_id == root.trace_id
+    p = tc.TraceContext.from_header(seen[0]["traceparent"])
+    assert p.trace_id == root.trace_id
+    assert p.span_id != root.span_id  # the attempt is a CHILD span
+
+
+def test_trace_sample_zero_sends_no_header():
+    clock = FakeClock()
+    seen = []
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        seen.append(headers)
+        return 200, b"{}"
+
+    c = _client(
+        transport, clock,
+        policy=RetryPolicy(max_attempts=2, default_timeout_s=5.0),
+    )
+    r = c.request("/v1/similar", {"genes": ["G1"]})
+    assert r.ok and r.trace_id is None
+    assert seen == [None]
+    # an UNSELECTED request under partial sampling also gets no
+    # context at all — no header, so the replica's own sampler stays
+    # free to act (an unsampled header would suppress it)
+    import random as random_mod
+
+    class FixedRng(random_mod.Random):
+        def random(self):
+            return 0.9  # above the 0.5 rate -> not selected
+
+    c2 = ResilientClient(
+        ["http://a"],
+        RetryPolicy(max_attempts=2, default_timeout_s=5.0,
+                    trace_sample=0.5),
+        transport=transport, clock=clock, sleep=lambda s: None,
+        rng=FixedRng(),
+    )
+    r2 = c2.request("/v1/similar", {"genes": ["G1"]})
+    assert r2.ok and r2.trace_id is None
+    assert seen[-1] is None
+
+
+def test_hedged_attempt_parents_to_same_request():
+    """The hedge fires on a different replica while the primary stalls;
+    both attempts must be sibling child spans of one request root."""
+    headers_by_target = {}
+    release = threading.Event()
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        headers_by_target.setdefault(base, []).append(
+            dict(headers or {})
+        )
+        if base == "http://a":
+            release.wait(5.0)  # the slow primary
+        return 200, json.dumps({"from": base}).encode()
+
+    c = ResilientClient(
+        ["http://a", "http://b"],
+        RetryPolicy(
+            max_attempts=3, default_timeout_s=5.0, hedge=True,
+            hedge_min_samples=4, trace_sample=1.0,
+        ),
+        transport=transport,
+    )
+    c._latencies = [0.01] * 8  # warm the p95 estimate
+    try:
+        r = c.request("/v1/similar", {"genes": ["G1"]}, timeout_s=5.0)
+    finally:
+        release.set()
+    assert r.ok and r.hedged
+    assert set(headers_by_target) == {"http://a", "http://b"}
+    primary = tc.TraceContext.from_header(
+        headers_by_target["http://a"][0]["traceparent"]
+    )
+    hedge = tc.TraceContext.from_header(
+        headers_by_target["http://b"][0]["traceparent"]
+    )
+    assert primary.trace_id == hedge.trace_id == r.trace_id
+    assert primary.span_id != hedge.span_id
+
+
+# -- batcher ticket hops -----------------------------------------------------
+
+
+def test_batcher_emits_batch_item_hops(tmp_path):
+    from gene2vec_tpu.serve.batcher import MicroBatcher
+
+    t = Tracer(str(tmp_path / "events.jsonl"))
+    set_tracer(t)
+    try:
+        b = MicroBatcher(
+            lambda items, k: [i * 2 for i in items],
+            max_batch=4, max_delay_s=0.01, max_queue=16,
+        ).start()
+        ctx = tc.new_trace()
+        with tc.use(ctx), flight_mod.collect_hops() as hops:
+            assert b.submit(21, 1) == 42
+        b.stop()
+    finally:
+        set_tracer(None)
+        t.close()
+    # the ticket deposited its timings into the request's hop sink
+    assert "queue_wait_s" in hops and "compute_s" in hops
+    events = read_events(str(tmp_path / "events.jsonl"))
+    item = next(e for e in events if e["name"] == "batch_item")
+    assert item["trace"] == ctx.trace_id
+    assert item["tpid"] == ctx.span_id
+    assert item["attrs"]["batch"] == 1
+    assert item["attrs"]["queue_wait_s"] >= 0
+    batch = next(
+        e for e in events
+        if e["name"] == "serve_batch" and e["type"] == "span_end"
+    )
+    assert batch["attrs"]["traces"] == [ctx.trace_id]
+    assert item["span"] == batch["span"]
+
+
+# -- HTTP round trip + reassembly -------------------------------------------
+
+
+@pytest.fixture
+def traced_serving(tmp_path):
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_server,
+    )
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    V, D = 12, 4
+    rng = np.random.RandomState(0)
+    export = tmp_path / "exports"
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(V, D).astype(np.float32)),
+        ctx=jnp.asarray(np.zeros((V, D), np.float32)),
+    )
+    save_iteration(str(export), D, 1, params, vocab)
+
+    run_dir = tmp_path / "run"
+    tracer = Tracer(str(run_dir / "events.jsonl"))
+    set_tracer(tracer)
+    reg = ModelRegistry(str(export))
+    assert reg.refresh()
+    app = ServeApp(
+        reg, ServeConfig(max_batch=8, max_delay_ms=2.0, max_queue=16)
+    ).start()
+    app.flight_dir = str(run_dir)
+    server = make_server(app, "127.0.0.1", 0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url, app, str(run_dir)
+    server.shutdown()
+    server.server_close()
+    app.stop()
+    set_tracer(None)
+    tracer.close()
+
+
+def test_http_request_joins_propagated_trace(traced_serving):
+    url, app, run_dir = traced_serving
+    sender = tc.new_trace()          # pretend we are a proxy attempt
+    req = urllib.request.Request(
+        f"{url}/v1/similar",
+        data=json.dumps({"genes": ["G1"], "k": 3}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": sender.to_header(),
+        },
+    )
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        assert r.status == 200
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    sreq = next(
+        e for e in events
+        if e["name"] == "serve_request" and e["type"] == "span_end"
+        and e.get("trace") == sender.trace_id
+    )
+    assert sreq["tpid"] == sender.span_id     # child of the sender hop
+    item = next(
+        e for e in events
+        if e["name"] == "batch_item"
+        and e.get("trace") == sender.trace_id
+    )
+    assert item["tpid"] == sreq["tsid"]       # child of the replica hop
+    # reassembly: serve_request -> batch_item -> compute subtree
+    doc = collect_trace(run_dir, sender.trace_id)
+    assert doc["roots"] and doc["roots"][0]["name"] == "serve_request"
+    children = doc["roots"][0]["children"]
+    assert children and children[0]["name"] == "batch_item"
+    sub_names = set()
+
+    def walk(n):
+        sub_names.add(n["name"])
+        for s in n.get("process_spans", []) + n.get("children", []):
+            walk(s)
+
+    walk(doc["roots"][0])
+    assert {"serve_request", "batch_item", "serve_batch",
+            "engine_topk"} <= sub_names
+    # flight recorder saw the request with its hop timings
+    rec = next(
+        r for r in app.flight.snapshot()
+        if r.get("trace") == sender.trace_id
+    )
+    assert rec["route"] == "/v1/similar" and rec["status"] == 200
+    assert "queue_wait_s" in rec["hops"]
+
+
+def test_untraced_request_stays_dark(traced_serving):
+    url, app, run_dir = traced_serving
+    req = urllib.request.Request(
+        f"{url}/v1/similar",
+        data=json.dumps({"genes": ["G2"], "k": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10.0) as r:
+        assert r.status == 200
+    events = read_events(os.path.join(run_dir, "events.jsonl"))
+    g2 = [
+        e for e in events
+        if e.get("type") == "span_end" and e.get("name") == "serve_request"
+    ]
+    assert all("trace" not in e for e in g2)
+
+
+def test_obs_trace_cli(traced_serving, capsys):
+    from gene2vec_tpu.cli import obs as obs_cli
+
+    url, app, run_dir = traced_serving
+    sender = tc.new_trace()
+    req = urllib.request.Request(
+        f"{url}/v1/similar",
+        data=json.dumps({"genes": ["G3"], "k": 2}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": sender.to_header(),
+        },
+    )
+    with urllib.request.urlopen(req, timeout=10.0):
+        pass
+    assert obs_cli.main(["trace", run_dir, sender.trace_id]) == 0
+    out = capsys.readouterr().out
+    assert "serve_request" in out and "batch_item" in out
+    assert "engine_topk" in out
+    # JSON mode parses; unknown trace exits 1
+    assert obs_cli.main(
+        ["trace", "--json", run_dir, sender.trace_id]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["trace_id"] == sender.trace_id
+    assert obs_cli.main(["trace", run_dir, "f" * 32]) == 1
+    capsys.readouterr()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_ring_and_burst(tmp_path):
+    clk = FakeClock()
+    fr = FlightRecorder(
+        capacity=4, burst_threshold=3, burst_window_s=5.0, clock=clk
+    )
+    for i in range(6):
+        assert fr.record(f"/r{i}", 200, 0.01) is False
+    assert len(fr.snapshot()) == 4  # bounded
+    assert fr.record("/x", 500, 0.01) is False
+    clk.t += 1
+    assert fr.record("/x", 503, 0.01) is False
+    clk.t += 1
+    assert fr.record("/x", 500, 0.01) is True     # 3 in window -> dump
+    assert fr.record("/x", 500, 0.01) is False    # rate-limited
+    clk.t += 6.0
+    fr.record("/x", 500, 0.01)
+    fr.record("/x", 500, 0.01)
+    assert fr.record("/x", 500, 0.01) is True     # new window
+    path = fr.dump(str(tmp_path), "test")
+    doc = json.load(open(path))
+    assert doc["reason"] == "test" and len(doc["records"]) == 4
+    # dumps feed reassembly
+    fr2 = FlightRecorder()
+    fr2.record("/v1/similar", 200, 0.02, trace_id="ab" * 16,
+               hops={"queue_wait_s": 0.001})
+    fr2.dump(str(tmp_path), "test2")
+    out = collect_trace(str(tmp_path), "ab" * 16)
+    assert out["flight"] and out["flight"][0]["route"] == "/v1/similar"
+
+
+# -- registry escaping / cardinality (satellites) ----------------------------
+
+
+def test_label_escaping_round_trips_through_parser():
+    r = MetricsRegistry()
+    nasty = 'back\\slash "quoted"\nnewline'
+    r.counter("esc_total", labels={"route": nasty}).inc(5)
+    text = r.prometheus_text()
+    assert "\n\n" not in text.strip()  # the newline was escaped
+    samples = parse_prometheus(text)
+    s = next(s for s in samples if s.name == "esc_total")
+    assert dict(s.labels)["route"] == nasty
+    assert s.value == 5.0
+
+
+def test_labeled_series_share_one_type_line():
+    r = MetricsRegistry()
+    r.counter("routes_total", labels={"route": "/a"}).inc(1)
+    r.counter("routes_total", labels={"route": "/b"}).inc(2)
+    r.counter("routes_total").inc(4)
+    text = r.prometheus_text()
+    assert text.count("# TYPE routes_total counter") == 1
+    assert 'routes_total{route="/a"} 1' in text
+    assert 'routes_total{route="/b"} 2' in text
+    assert "routes_total 4" in text.splitlines()
+    with pytest.raises(TypeError):
+        r.gauge("routes_total", labels={"route": "/c"})
+
+
+def test_label_cardinality_cap_warns_then_drops(capsys):
+    r = MetricsRegistry(max_label_sets=4)
+    for i in range(10):
+        r.counter("per_gene_total", labels={"gene": f"G{i}"}).inc()
+    text = r.prometheus_text()
+    assert text.count("per_gene_total{") == 4
+    dropped = r.counter("metrics_dropped_labels_total").value
+    assert dropped == 6
+    assert "cardinality cap" in capsys.readouterr().err
+    # dropped updates keep working against the shared overflow series
+    inst = r.counter("per_gene_total", labels={"gene": "G99"})
+    inst.inc(5)
+    assert "G99" not in r.prometheus_text()
+    # histograms capped the same way
+    r2 = MetricsRegistry(max_label_sets=2)
+    for i in range(5):
+        r2.histogram("lat_seconds", labels={"t": str(i)}).observe(0.1)
+    assert r2.prometheus_text().count("lat_seconds_count{") == 2
+
+
+# -- aggregator --------------------------------------------------------------
+
+
+def _replica_text(requests, rejected, depth, route_ms):
+    r = MetricsRegistry()
+    r.counter("serve_requests_total").inc(requests)
+    r.counter("serve_rejected_total").inc(rejected)
+    r.gauge("serve_queue_depth").set(depth)
+    h = r.histogram(
+        "serve_route_seconds",
+        buckets=tuple(0.0005 * (2 ** e) for e in range(15)),
+        labels={"route": "/v1/similar"},
+    )
+    for ms in route_ms:
+        h.observe(ms / 1000.0)
+    return r.prometheus_text()
+
+
+def test_aggregator_merges_replicas_and_derives_slos(tmp_path):
+    texts = {
+        "http://r0": _replica_text(100, 5, 3, [2.0] * 90 + [40.0] * 10),
+        "http://r1": _replica_text(50, 0, 1, [2.0] * 50),
+    }
+    proxy = MetricsRegistry()
+    proxy.counter("fleet_proxy_responses_total").inc(140)
+    proxy.counter("fleet_proxy_ok_total").inc(133)
+    csv_path = str(tmp_path / "telemetry.csv")
+    agg = FleetAggregator(
+        lambda: list(texts) + ["http://dead"],
+        proxy_registry=proxy,
+        csv_path=csv_path,
+        fetch=lambda url, t: texts[url],  # KeyError for dead -> error
+    )
+    headline = agg.scrape_once()
+    assert headline["fleet_replicas_scraped"] == 2
+    assert headline["fleet_queue_depth"] == 4
+    assert headline["fleet_requests"] == 150
+    assert headline["fleet_rejected"] == 5
+    assert headline["fleet_rejection_rate"] == pytest.approx(5 / 150)
+    assert headline["fleet_availability"] == pytest.approx(133 / 140)
+    text = agg.fleet_text()
+    samples = {(s.name, s.labels): s.value for s in parse_prometheus(text)}
+    assert samples[("fleet_scrape_errors_total", ())] == 1
+    p50 = samples[
+        ("fleet_route_p50_seconds", (("route", "/v1/similar"),))
+    ]
+    p99 = samples[
+        ("fleet_route_p99_seconds", (("route", "/v1/similar"),))
+    ]
+    # 140/150 observations at 2ms, tail at 40ms: p50 lands in a small
+    # bucket, p99 in a large one (bucket edges, so conservative)
+    assert p50 <= 0.01 < p99 <= 0.128
+    agg.view.close()
+    rows = open(csv_path).read().splitlines()
+    assert len(rows) == 2 and "fleet_availability" in rows[0]
+
+
+def test_aggregator_retains_counters_across_death_and_restart(tmp_path):
+    """Monotone series never go backward: a SIGKILLed replica keeps its
+    accumulated contribution, and a restarted one (counters reset to 0)
+    resumes accumulating instead of subtracting."""
+    texts = {"http://r0": _replica_text(100, 5, 3, [2.0])}
+    targets = ["http://r0"]
+    agg = FleetAggregator(
+        lambda: list(targets),
+        fetch=lambda url, t: texts[url],
+    )
+    h = agg.scrape_once()
+    assert h["fleet_requests"] == 100
+    # replica dies: no scrape target, but its history stays
+    targets.clear()
+    h = agg.scrape_once()
+    assert h["fleet_requests"] == 100
+    assert h["fleet_queue_depth"] == 0  # gauges are live-only
+    # replica restarts with zeroed counters: 10 NEW requests accumulate
+    texts["http://r0"] = _replica_text(10, 0, 1, [2.0])
+    targets.append("http://r0")
+    h = agg.scrape_once()
+    assert h["fleet_requests"] == 110
+    assert h["fleet_rejected"] == 5
+    assert h["fleet_queue_depth"] == 1
+    agg.view.close()
+
+
+def test_histogram_quantile_and_parser_edges():
+    merged = merge_samples([parse_prometheus(
+        'h_bucket{le="0.1"} 50\nh_bucket{le="1"} 99\n'
+        'h_bucket{le="+Inf"} 100\nh_sum 12\nh_count 100\n'
+    )])
+    assert histogram_quantile(merged, "h", (), 0.50) == 0.1
+    assert histogram_quantile(merged, "h", (), 0.99) == 1.0
+    # a quantile landing in +Inf saturates to the top FINITE bound —
+    # the gauge keeps moving during overload instead of freezing stale
+    assert histogram_quantile(merged, "h", (), 0.999) == 1.0
+    assert histogram_quantile(merged, "missing", (), 0.5) is None
+    # malformed lines are skipped, not fatal
+    assert parse_prometheus('broken{le="x" 1\n# comment\nok 2\n') == [
+        parse_prometheus("ok 2")[0]
+    ]
+
+
+def test_obs_trace_overhead_budget_gate(tmp_path):
+    """analysis/passes_obs.py: missing bench = info, a record that
+    violates — or omits — a budgeted field gates, a clean record is
+    info (the passes_fleet contract, for the obs budget)."""
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_obs import obs_budget_findings
+
+    missing = obs_budget_findings(
+        bench_path=str(tmp_path / "absent.json")
+    )
+    assert [f.severity for f in missing] == ["info"]
+
+    ok_section = {
+        "rps": 50, "duration_s": 4, "rounds": 5,
+        "p50_untraced_ms": 10.0, "p50_traced_ms": 10.1,
+        "regression_frac": 0.01,
+    }
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"trace_overhead": ok_section}))
+    fs = obs_budget_findings(bench_path=str(good))
+    assert gating(fs) == [], [f.format() for f in fs]
+
+    for doc in (
+        {"trace_overhead": {**ok_section,  # over budget
+                            "regression_frac": 0.10}},
+        {"trace_overhead": {**ok_section, "rps": 5}},  # wrong load
+        {"trace_overhead": {**ok_section,  # shrunken recipe
+                            "duration_s": 0.5, "rounds": 1}},
+        {"trace_overhead": {  # dropped the budgeted key
+            k: v for k, v in ok_section.items()
+            if k != "regression_frac"
+        }},
+        {},  # no section at all
+    ):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(doc))
+        assert gating(obs_budget_findings(bench_path=str(bad))), doc
+
+
+def test_aggregator_background_loop(tmp_path):
+    texts = {"http://r0": _replica_text(10, 0, 0, [1.0])}
+    agg = FleetAggregator(
+        ["http://r0"],
+        interval_s=0.05,
+        fetch=lambda url, t: texts[url],
+    )
+    agg.start()
+    deadline = time.monotonic() + 5.0
+    try:
+        while time.monotonic() < deadline:
+            if ("fleet_requests 10"
+                    in agg.fleet_text()):
+                break
+            time.sleep(0.02)
+        assert "fleet_requests 10" in agg.fleet_text()
+    finally:
+        agg.stop()
