@@ -73,7 +73,23 @@ def synth_corpus(vocab_size: int, num_pairs: int, seed: int = 0):
 _LAST_RATES: list = []  # per-epoch rates of the most recent _steady_rate
 
 
-def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
+def _bench_timeline():
+    """Module-level phase timeline shared by every in-process
+    ``_steady_rate`` call.  Disabled until main() enables it, so the
+    dedicated-process probes (which import bench and call _steady_rate
+    directly) pay nothing; main() flushes it into the bench run dir."""
+    global _TIMELINE
+    if _TIMELINE is None:
+        from gene2vec_tpu.obs.timeline import PhaseTimeline
+
+        _TIMELINE = PhaseTimeline(enabled=False)
+    return _TIMELINE
+
+
+_TIMELINE = None
+
+
+def _steady_rate(trainer, warmup: int = 2, timed: int = 3, timeline=None) -> float:
     """Steady-state epoch throughput: warmup epochs excluded, each timed
     epoch synced via a scalar transfer, MEDIAN of the timed epochs returned
     (round-2 advisor: best-of-N is the most flattering defensible statistic;
@@ -85,6 +101,7 @@ def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
     drift)."""
     import jax
 
+    tl = timeline if timeline is not None else _bench_timeline()
     params = trainer.init()
     key = jax.random.PRNGKey(0)
     for w in range(warmup):
@@ -94,8 +111,12 @@ def _steady_rate(trainer, warmup: int = 2, timed: int = 3) -> float:
     rates = []
     for e in range(timed):
         t0 = time.perf_counter()
-        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, 100 + e))
-        float(loss)
+        with tl.phase("dispatch", step=e):
+            params, loss = trainer.train_epoch(
+                params, jax.random.fold_in(key, 100 + e)
+            )
+        with tl.phase("compute", step=e):
+            float(loss)
         dt = time.perf_counter() - t0
         rates.append(pairs_per_epoch / dt)
     log(
@@ -462,6 +483,89 @@ def _ggipnn_rate_impl(n_pairs: int, batch: int) -> float:
     return num_batches * batch / dt
 
 
+def bench_stamp(doc: dict) -> dict:
+    """Stamp provenance into a bench JSON: ``schema_version`` + the
+    producing command + creation time, so the ledger
+    (gene2vec_tpu/obs/ledger.py) can tell a freshly produced record
+    from a legacy unstamped artifact and reproduce it."""
+    doc.setdefault("schema_version", 1)
+    doc.setdefault("command", " ".join([sys.executable, *sys.argv]))
+    doc.setdefault("created_unix", time.time())
+    return doc
+
+
+def timeline_overhead(
+    dim: int, vocab: int, num_pairs: int, batch_pairs: int, rounds: int,
+    epochs_per_window: int = 2,
+) -> dict:
+    """Timeline-on vs timeline-off SGNS throughput at the pinned
+    BENCH_PERF recipe (budgets.json "perf", section
+    ``timeline_overhead``).
+
+    One trainer, warmed once; then ``rounds`` window pairs with
+    ALTERNATING arm order (the BENCH_OBS lesson: this host's window
+    rates swing several percent between identical windows, so each
+    arm's estimate is the MEDIAN of its per-window rates).  The ON arm
+    runs the exact per-epoch instrumentation the trainers use
+    (``tl.phase("dispatch")`` + ``tl.phase("compute")``); the OFF arm
+    runs the same wrappers disabled — precisely the
+    ``SGNSConfig.timeline`` toggle's two states."""
+    import jax
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.obs.timeline import PhaseTimeline
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    corpus = synth_corpus(vocab, num_pairs)
+    trainer = SGNSTrainer(
+        corpus, SGNSConfig(dim=dim, batch_pairs=batch_pairs)
+    )
+    params = trainer.init()
+    key = jax.random.PRNGKey(0)
+    for w in range(2):  # epoch 1 compiles, epoch 2 pays the relayout
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, w))
+        float(loss)
+    pairs_per_epoch = trainer.num_batches * trainer.config.batch_pairs
+    arms = {False: PhaseTimeline(enabled=False), True: PhaseTimeline()}
+    rates: dict = {False: [], True: []}
+    e = 0
+    for r in range(rounds):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for arm in order:
+            tl = arms[arm]
+            t0 = time.perf_counter()
+            for _ in range(epochs_per_window):
+                with tl.phase("dispatch", step=e):
+                    params, loss = trainer.train_epoch(
+                        params, jax.random.fold_in(key, 100 + e)
+                    )
+                with tl.phase("compute", step=e):
+                    float(loss)
+                e += 1
+            dt = time.perf_counter() - t0
+            rates[arm].append(pairs_per_epoch * epochs_per_window / dt)
+    off = float(np.median(rates[False]))
+    on = float(np.median(rates[True]))
+    doc = {
+        "bench": "timeline_overhead",
+        "recipe": {
+            "dim": dim, "vocab": vocab, "num_pairs": num_pairs,
+            "batch_pairs": batch_pairs, "rounds": rounds,
+            "epochs_per_window": epochs_per_window,
+        },
+        "window_rates_off": [round(v, 1) for v in rates[False]],
+        "window_rates_on": [round(v, 1) for v in rates[True]],
+        "rate_timeline_off": round(off, 1),
+        "rate_timeline_on": round(on, 1),
+        "regression_frac": round((off - on) / off, 4) if off > 0 else None,
+    }
+    log(
+        f"timeline overhead: off {off:,.0f} on {on:,.0f} pairs/s, "
+        f"regression {doc['regression_frac']}"
+    )
+    return bench_stamp(doc)
+
+
 def quality_gate(dim: int, batch_pairs: int, data_dir: str) -> dict:
     """Verify the HEADLINE configuration learns before any throughput is
     reported (VERDICT round-2 item 3: a flat-loss run must not produce a
@@ -582,7 +686,34 @@ def main() -> None:
                     "events.jsonl + metrics.prom; summarize with "
                     "`python -m gene2vec_tpu.cli.obs report`); default "
                     "runs/bench_<unix-ts> next to this script")
+    ap.add_argument("--timeline-overhead", action="store_true",
+                    help="measure timeline-on vs timeline-off SGNS "
+                    "throughput at the recipe pinned in budgets.json "
+                    "'perf' and write --perf-out (the BENCH_PERF "
+                    "artifact analysis/passes_perf.py gates); skips "
+                    "the normal bench pipeline")
+    ap.add_argument("--perf-out", default="BENCH_PERF_r10.json",
+                    help="output path for --timeline-overhead")
     args = ap.parse_args()
+
+    if args.timeline_overhead:
+        from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+        recipe = load_budgets().get("perf", {}).get("timeline_overhead", {})
+        doc = timeline_overhead(
+            dim=int(recipe.get("dim", 64)),
+            vocab=int(recipe.get("vocab", 2048)),
+            num_pairs=int(recipe.get("num_pairs", 65536)),
+            batch_pairs=int(recipe.get("batch_pairs", 2048)),
+            rounds=int(recipe.get("rounds", 5)),
+            epochs_per_window=int(recipe.get("epochs_per_window", 2)),
+        )
+        with open(args.perf_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        log(f"wrote {args.perf_out}")
+        print(json.dumps(doc))
+        return
 
     from gene2vec_tpu.obs.run import Run
 
@@ -594,6 +725,11 @@ def main() -> None:
     # below must see an untouched chip; backend facts are annotated after
     # this process first initializes jax anyway.
     run = Run(run_dir, name="bench", config=vars(args), probe_devices=False)
+    # in-process _steady_rate calls record dispatch/compute phases into
+    # the module timeline, flushed into the run dir at exit (the
+    # dedicated-process probes keep it disabled in their interpreter)
+    tl = _bench_timeline()
+    tl.enabled = True
     try:
         log(f"observed run dir: {run_dir}")
 
@@ -718,11 +854,11 @@ def main() -> None:
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "BENCH_EXTRA.json"), "w"
                 ) as f:
-                    json.dump(secondary, f, indent=1)
+                    json.dump(bench_stamp(dict(secondary)), f, indent=1)
             except OSError as e:
                 log(f"could not write BENCH_EXTRA.json: {e}")
 
-        result = {
+        result = bench_stamp({
             "metric": "sgns_pairs_per_sec",
             "value": round(tpu_rate, 1),
             "unit": "pairs/s",
@@ -738,7 +874,7 @@ def main() -> None:
             "platform": mesh_info["platform"],
             "devices": mesh_info["devices"],
             "mesh": mesh_info["mesh"],
-        }
+        })
         if quality:
             result["quality"] = quality
         if secondary:
@@ -754,6 +890,12 @@ def main() -> None:
         # error exits (device-count SystemExit, probe failures) must
         # still terminate the observed run — run_end + metrics.prom —
         # exactly like the trainers' try/finally
+        import contextlib as _ctx
+
+        with _ctx.suppress(Exception):
+            from gene2vec_tpu.obs.timeline import TIMELINE_NAME
+
+            tl.flush(os.path.join(run_dir, TIMELINE_NAME))
         run.close()
 
 

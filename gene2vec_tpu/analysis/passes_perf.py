@@ -1,0 +1,258 @@
+"""Perf-plane budget gates: timeline overhead + bench-trajectory
+regressions vs budgets.json ``perf``.
+
+Two checks, both jax-free and I/O-only so they ride the DEFAULT
+``cli.analyze`` tier (the passes_fleet / passes_obs shape):
+
+1. **Timeline overhead** — ``python bench.py --timeline-overhead``
+   measures timeline-on vs timeline-off SGNS throughput at the recipe
+   pinned in ``perf.timeline_overhead`` and stamps
+   ``BENCH_PERF_r10.json``; this pass re-checks the committed record.
+   A missing bench is an *info* finding (a fresh checkout must not
+   fail lint before its first bench); a record that exists and
+   violates — or omits — a budgeted quantity, or was measured with a
+   different recipe, gates hard (the passes_obs recipe-pinning
+   lesson: a lucky tiny window must not pass a 2% gate).
+
+2. **Trajectory regressions** — the unified bench ledger
+   (:mod:`gene2vec_tpu.obs.ledger`) ingests every root bench artifact
+   and ``perf.regression`` rules compare each configured metric's
+   newest point against the median of its trailing window.  A
+   detected regression is an error finding; short series and clean
+   series are informational.
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (the planted-
+regression fixtures and CI sandboxes point it at a staged directory).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+PERF_ROOT_ENV = "GENE2VEC_TPU_PERF_ROOT"
+BENCH_PERF_NAME = "BENCH_PERF_r10.json"
+
+_PASS_OVERHEAD = "perf-timeline-overhead-budget"
+_PASS_REGRESSION = "perf-ledger-regression"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def perf_root() -> str:
+    return os.environ.get(PERF_ROOT_ENV) or REPO_ROOT
+
+
+def perf_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """All perf-plane findings: overhead gate + trajectory regressions."""
+    budgets: Dict = load_budgets(budgets_path).get("perf", {})
+    if not budgets:
+        return []
+    root = root or perf_root()
+    findings: List[Finding] = []
+    overhead_budget = budgets.get("timeline_overhead")
+    if isinstance(overhead_budget, dict):
+        findings.extend(_overhead_findings(root, overhead_budget))
+    regression_rules = budgets.get("regression")
+    if isinstance(regression_rules, dict):
+        findings.extend(_regression_findings(root, regression_rules))
+    return findings
+
+
+# -- timeline overhead -------------------------------------------------------
+
+
+def _newest_perf_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_PERF_r*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties) — the gate must follow the round
+    convention like the ledger does, not pin one filename forever."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        if ledger.match_family(name) and name.startswith("BENCH_PERF"):
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime, path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def _overhead_findings(root: str, budget: Dict) -> List[Finding]:
+    path = _newest_perf_bench(root) or os.path.join(root, BENCH_PERF_NAME)
+    label = os.path.basename(path)
+    if not os.path.exists(path):
+        return [Finding(
+            pass_id=_PASS_OVERHEAD,
+            severity="info",
+            path=label,
+            message=(
+                f"no timeline-overhead bench recorded yet ({label} "
+                "missing); run `python bench.py --timeline-overhead` "
+                "(it reads the pinned recipe from budgets.json 'perf') "
+                "to stamp one"
+            ),
+        )]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS_OVERHEAD,
+            path=label,
+            message=f"unreadable timeline-overhead bench: {e}",
+        )]
+
+    ceiling = float(budget["max_overhead_fraction"])
+    regression = _get(bench, "regression_frac")
+    recipe = bench.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    data: Dict = {
+        "regression_frac": regression,
+        "max_overhead_fraction": ceiling,
+        "recipe": recipe,
+    }
+    problems: List[str] = []
+    # every budgeted quantity must be PRESENT — dropping the key must
+    # gate like a violation (the passes_fleet lesson)
+    for key, value in (
+        ("regression_frac", regression),
+        ("rate_timeline_on", _get(bench, "rate_timeline_on")),
+        ("rate_timeline_off", _get(bench, "rate_timeline_off")),
+    ):
+        if value is None:
+            problems.append(f"{key} missing from the bench record")
+    # the budget pins the MEASUREMENT RECIPE: geometry, rounds AND
+    # window length must match, or a lucky tiny window passes the 2%
+    # gate by variance
+    for key in ("dim", "vocab", "num_pairs", "batch_pairs", "rounds",
+                "epochs_per_window"):
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(recipe, key)
+        data[f"budget_{key}"] = pinned
+        if measured is None:
+            problems.append(f"recipe.{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"bench measured with {key}={measured:g} but the budget "
+                f"pins {key}={pinned:g} — re-run `python bench.py "
+                "--timeline-overhead`"
+            )
+    if regression is not None and regression > ceiling:
+        problems.append(
+            f"timeline-on vs timeline-off throughput regression "
+            f"{regression:.4f} > budget {ceiling} (step-phase "
+            "instrumentation grew past its ceiling)"
+        )
+    if problems:
+        return [Finding(
+            pass_id=_PASS_OVERHEAD,
+            path=label,
+            message=(
+                "timeline-overhead record violates the perf budget: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS_OVERHEAD,
+        severity="info",
+        path=label,
+        message=(
+            f"timeline-on vs timeline-off throughput regression "
+            f"{regression:+.4f} within budget (<= {ceiling})"
+        ),
+        data=data,
+    )]
+
+
+# -- ledger trajectory regressions -------------------------------------------
+
+
+def _regression_findings(root: str, rules: Dict) -> List[Finding]:
+    from gene2vec_tpu.obs import ledger
+
+    records = ledger.ingest_root(root)
+    findings: List[Finding] = []
+    if not records:
+        return [Finding(
+            pass_id=_PASS_REGRESSION,
+            severity="info",
+            path=os.path.basename(root) or root,
+            message=(
+                f"no bench artifacts found under {root}; the trajectory "
+                "gate has nothing to check (run the benches in "
+                "docs/BENCHMARKS.md to populate it)"
+            ),
+        )]
+    broken = [r for r in records if r.get("error")]
+    for rec in broken:
+        # an unreadable artifact silently drops its series point — the
+        # exact blind spot this gate exists to prevent
+        findings.append(Finding(
+            pass_id=_PASS_REGRESSION,
+            path=rec["source"],
+            message=f"bench artifact failed to ingest: {rec['error']}",
+        ))
+    for ev in ledger.detect_regressions(records, rules):
+        label = ev.get("newest_source") or ev["metric"]
+        if ev.get("skipped"):
+            findings.append(Finding(
+                pass_id=_PASS_REGRESSION,
+                severity="info",
+                path=ev["metric"],
+                message=(
+                    f"trajectory gate for {ev['metric']!r} skipped: "
+                    f"{ev['skipped']}"
+                ),
+                data=ev,
+            ))
+        elif ev["regressed"]:
+            findings.append(Finding(
+                pass_id=_PASS_REGRESSION,
+                path=label,
+                message=(
+                    f"bench trajectory REGRESSION in {ev['metric']!r}: "
+                    f"newest {ev['newest_value']:g} vs trailing-window "
+                    f"median {ev['band_median']:g} is "
+                    f"{ev['regression_frac']:.2%} worse (max "
+                    f"{ev['max_regression_frac']:g}); if intentional, "
+                    "re-baseline per docs/BENCHMARKS.md"
+                ),
+                data=ev,
+            ))
+        else:
+            findings.append(Finding(
+                pass_id=_PASS_REGRESSION,
+                severity="info",
+                path=label,
+                message=(
+                    f"{ev['metric']}: newest {ev.get('newest_value')} vs "
+                    f"band median {ev.get('band_median')} within "
+                    f"max_regression_frac {ev['max_regression_frac']:g}"
+                ),
+                data=ev,
+            ))
+    return findings

@@ -152,6 +152,11 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_obs import obs_budget_findings
 
         findings.extend(obs_budget_findings())
+        # ... and the perf plane: timeline-overhead budget (BENCH_PERF
+        # vs "perf") + the unified-ledger trajectory regression rules
+        from gene2vec_tpu.analysis.passes_perf import perf_findings
+
+        findings.extend(perf_findings())
 
     if args.hlo:
         _pin_cpu_backend()

@@ -11,7 +11,19 @@
   flight-recorder dump under ``run_dir`` (pass a fleet export dir to
   cover the proxy's run AND every replica's) and render the
   cross-process tree: proxy hop → client attempts (retries/hedges) →
-  replica request → batcher item → compute subtree.
+  replica request → batcher item → compute subtree;
+* ``python -m gene2vec_tpu.cli.obs timeline <run_dir> [--out f]`` —
+  export every ``timeline.jsonl`` phase record AND ``events.jsonl``
+  span/hop record under ``run_dir`` as one Chrome-trace-event JSON,
+  loadable in Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing`` — train step-phase swimlanes and serve request
+  traces in one viewer;
+* ``python -m gene2vec_tpu.cli.obs ledger [root]`` — ingest every
+  root bench artifact through the per-family adapters
+  (gene2vec_tpu/obs/ledger.py, docs/BENCHMARKS.md) into the unified
+  ledger; ``--out/--csv`` persist it, ``--check`` exits 1 when the
+  trailing-window regression rules (budgets.json ``perf.regression``)
+  fire.
 
 Schema and run-dir layout: docs/OBSERVABILITY.md.
 """
@@ -51,7 +63,100 @@ def build_parser() -> argparse.ArgumentParser:
                     "--trace-sample, a ClientResponse, or a flight dump)")
     tr.add_argument("--json", action="store_true",
                     help="emit the reassembled tree as JSON")
+    tml = sub.add_parser(
+        "timeline",
+        help="export timeline.jsonl + events.jsonl under a run dir as "
+             "Perfetto-loadable Chrome trace JSON",
+    )
+    tml.add_argument("run_dir", help="run directory tree to scan")
+    tml.add_argument("--out", default=None,
+                     help="output path (default <run_dir>/trace.json; "
+                     "'-' writes the document to stdout)")
+    led = sub.add_parser(
+        "ledger",
+        help="unified bench ledger over the root bench artifacts",
+    )
+    led.add_argument("root", nargs="?", default=".",
+                     help="directory holding the BENCH_*/MULTICHIP_*/... "
+                     "artifacts (default: cwd)")
+    led.add_argument("--out", default=None, metavar="JSONL",
+                     help="write the ledger records as JSON lines")
+    led.add_argument("--csv", default=None, metavar="CSV",
+                     help="write the ledger as CSV")
+    led.add_argument("--json", action="store_true",
+                     help="emit records + regression evaluations as one "
+                     "JSON document on stdout")
+    led.add_argument("--check", action="store_true",
+                     help="run the budgets.json perf.regression rules and "
+                     "exit 1 on any detected regression")
     return p
+
+
+def _ledger(args) -> int:
+    from gene2vec_tpu.obs import ledger
+
+    if not os.path.isdir(args.root):
+        print(f"obs ledger: {args.root} is not a directory", file=sys.stderr)
+        return 2
+    records = ledger.ingest_root(args.root)
+    evaluations = []
+    if args.check or args.json:
+        from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+        rules = load_budgets().get("perf", {}).get("regression", {})
+        evaluations = ledger.detect_regressions(records, rules)
+    if args.out:
+        ledger.write_jsonl(records, args.out)
+        print(f"wrote {args.out} ({len(records)} records)", file=sys.stderr)
+    if args.csv:
+        ledger.write_csv(records, args.csv)
+        print(f"wrote {args.csv}", file=sys.stderr)
+
+    regressed = [e for e in evaluations if e.get("regressed")]
+    if args.json:
+        print(json.dumps(
+            {"schema": ledger.SCHEMA, "records": records,
+             "regressions": evaluations},
+            indent=1, default=str,
+        ))
+    else:
+        fmt = "{:<14} {:<28} {:>5} {:<7} {}"
+        print(fmt.format("family", "source", "round", "legacy", "headline"))
+        for rec in records:
+            headline = rec.get("headline_metric")
+            value = (rec.get("metrics") or {}).get(headline)
+            shown = (
+                f"{headline}={value:g}" if value is not None
+                else rec.get("error") or "(no headline)"
+            )
+            print(fmt.format(
+                rec["family"], rec["source"],
+                rec["round"] if rec["round"] is not None else "-",
+                "legacy" if rec.get("legacy_unstamped") else "",
+                shown,
+            ))
+        for ev in evaluations:
+            if ev.get("skipped"):
+                continue
+            state = "REGRESSED" if ev["regressed"] else "ok"
+            print(
+                f"regression[{ev['metric']}]: {state} newest "
+                f"{ev.get('newest_value')} vs band median "
+                f"{ev.get('band_median')} "
+                f"(frac {ev.get('regression_frac')}, max "
+                f"{ev['max_regression_frac']})"
+            )
+    if args.check and regressed:
+        for ev in regressed:
+            print(
+                f"obs ledger: REGRESSION {ev['metric']}: newest "
+                f"{ev.get('newest_value')} vs band median "
+                f"{ev.get('band_median')} exceeds max_regression_frac "
+                f"{ev['max_regression_frac']:g}",
+                file=sys.stderr,
+            )
+        return 1
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -78,6 +183,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         # exit 1 when the trace is entirely absent, so drills/scripts
         # can assert "reassembly found something" without parsing
         return 0 if (doc["roots"] or doc["flight"]) else 1
+
+    if args.command == "timeline":
+        from gene2vec_tpu.obs import timeline as timeline_mod
+
+        if not os.path.isdir(args.run_dir):
+            print(f"obs timeline: {args.run_dir} is not a directory",
+                  file=sys.stderr)
+            return 2
+        doc = timeline_mod.collect_run(args.run_dir)
+        n = len(doc["traceEvents"])
+        if not n:
+            print(
+                f"obs timeline: no timeline.jsonl/events.jsonl records "
+                f"under {args.run_dir}",
+                file=sys.stderr,
+            )
+            return 1
+        if args.out == "-":
+            json.dump(doc, sys.stdout)
+            print()
+            return 0
+        out = args.out or os.path.join(args.run_dir, "trace.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        # one machine-readable product line: where the trace went and
+        # which phase tracks it contains
+        print(json.dumps({
+            "out": os.path.abspath(out),
+            "trace_events": n,
+            "phase_tracks": doc["otherData"]["phase_tracks"],
+        }))
+        return 0
+
+    if args.command == "ledger":
+        return _ledger(args)
 
     run_dir = args.run_dir
     if not os.path.isdir(run_dir):

@@ -194,6 +194,10 @@ class SGNSConfig:
                                    # trainer only; the CPU oracle backends
                                    # ignore it (their epochs are host-bound
                                    # anyway).
+    timeline: bool = True          # per-iteration phase timeline (obs/
+                                   # timeline.py) written to timeline.jsonl;
+                                   # overhead gated <= 2% by budgets.json
+                                   # "perf" (BENCH_PERF_r10.json)
 
     # parallelism
     data_axis: str = "data"

@@ -176,6 +176,7 @@ class GGIPNNTrainer:
         checkpoint_fn: Optional[Callable[[int, dict], None]] = None,
         run=None,
         preempt=None,
+        timeline=None,
     ) -> Tuple[dict, optax.OptState]:
         """Train.  With ``run`` (a :class:`~gene2vec_tpu.models.ggipnn_obs.
         GGIPNNRun`) the reference's observed step loop runs regardless of
@@ -188,7 +189,17 @@ class GGIPNNTrainer:
         loop cooperatively: the in-flight step finishes, a final
         checkpoint is forced through ``checkpoint_fn``/``run`` so no
         progress past the last cadence checkpoint is lost, and the
-        partially trained state returns (docs/RESILIENCE.md)."""
+        partially trained state returns (docs/RESILIENCE.md).
+
+        ``timeline`` (an :class:`~gene2vec_tpu.obs.timeline.
+        PhaseTimeline`) records per-step host_ingest / dispatch /
+        compute phases on the observed step loop; the caller owns the
+        flush (run_classification writes it to the run dir)."""
+        from gene2vec_tpu.obs.timeline import PhaseTimeline
+
+        tl = timeline if timeline is not None else PhaseTimeline(
+            enabled=False
+        )
         cfg = self.config
         params, opt_state = getattr(self, "_state", (None, None))
         if params is None:
@@ -205,20 +216,25 @@ class GGIPNNTrainer:
         nx = x_train.shape[1]
         for batch in batch_iter(stacked, cfg.batch_size, cfg.num_epochs, seed=cfg.seed):
             t0 = time.perf_counter()
-            bx = jnp.asarray(batch[:, :nx].astype(np.int32))
-            by = jnp.asarray(batch[:, nx:].astype(np.float32))
-            key, sub = jax.random.split(key)
+            step_no = self._step + 1
+            with tl.phase("host_ingest", step=step_no):
+                bx = jnp.asarray(batch[:, :nx].astype(np.int32))
+                by = jnp.asarray(batch[:, nx:].astype(np.float32))
+                key, sub = jax.random.split(key)
             if run is not None:
-                params, opt_state, loss, acc, grads = self.train_step_grads(
-                    params, opt_state, bx, by, sub
-                )
+                with tl.phase("dispatch", step=step_no):
+                    params, opt_state, loss, acc, grads = (
+                        self.train_step_grads(params, opt_state, bx, by, sub)
+                    )
             else:
-                params, opt_state, loss, acc = self.train_step(
-                    params, opt_state, bx, by, sub
-                )
+                with tl.phase("dispatch", step=step_no):
+                    params, opt_state, loss, acc = self.train_step(
+                        params, opt_state, bx, by, sub
+                    )
             self._step += 1
             if run is not None:
-                loss_f, acc_f = float(loss), float(acc)  # blocks the step
+                with tl.phase("compute", step=step_no):
+                    loss_f, acc_f = float(loss), float(acc)  # blocks the step
                 # span-free watchdog feed: per-batch spans would write
                 # thousands of records; stalls still surface as events
                 run.obs.record_step(
@@ -392,20 +408,26 @@ def run_classification(
     params, opt_state = trainer.init_state(pretrained_emb_path=emb_path)
     trainer._state = (params, opt_state)
     run = None
+    tl = None
     if run_dir is not None:
         from gene2vec_tpu.models.ggipnn_obs import GGIPNNRun
+        from gene2vec_tpu.obs.timeline import PhaseTimeline
 
         run = GGIPNNRun(run_dir, config=config)
+        tl = PhaseTimeline()
         log(f"Writing to {run.out_dir}")
     def drained() -> bool:
         return preempt is not None and preempt.triggered
 
+    import time as _time
+
+    wall_t0 = _time.perf_counter()
     try:
         if run is not None:
             with run.obs.span("fit", train_examples=len(enc["train"][0])):
                 params, _ = trainer.fit(
                     *enc["train"], *enc["valid"], log=log, run=run,
-                    preempt=preempt,
+                    preempt=preempt, timeline=tl,
                 )
             if drained():
                 # the grace window is for draining, not for a full
@@ -429,6 +451,31 @@ def run_classification(
         if run is not None:
             if preempt is not None and preempt.triggered:
                 run.obs.mark_interrupted("signal", signal=preempt.received)
+            # timeline + goodput residue, never masking the in-flight
+            # exception (the SGNS trainer's discipline)
+            import contextlib
+            with contextlib.suppress(Exception):
+                from gene2vec_tpu.obs import goodput
+                from gene2vec_tpu.obs.timeline import TIMELINE_NAME
+
+                import os as _os
+
+                wall_s = _time.perf_counter() - wall_t0
+                preempted_s = 0.0
+                if (
+                    preempt is not None and preempt.triggered
+                    and preempt.received_wall is not None
+                ):
+                    preempted_s = min(
+                        max(_time.time() - preempt.received_wall, 0.0),
+                        wall_s,
+                    )
+                tl.flush(_os.path.join(run.out_dir, TIMELINE_NAME))
+                goodput.stamp(run.obs, goodput.summarize(
+                    tl.records(), wall_s,
+                    pairs_total=trainer._step * config.batch_size,
+                    preempted_s=preempted_s,
+                ))
             run.close()
     if "accuracy" in result:
         log(f"test accuracy: {result['accuracy']:.4f}")

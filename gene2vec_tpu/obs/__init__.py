@@ -24,7 +24,19 @@ the four trainers:
   SLO view at ``/metrics/fleet``;
 * :mod:`gene2vec_tpu.obs.flight` — bounded per-process flight recorder
   (dumped on SIGQUIT / 5xx bursts) and the cross-process trace
-  reassembly behind ``cli.obs trace``.
+  reassembly behind ``cli.obs trace``;
+* :mod:`gene2vec_tpu.obs.timeline` — per-step phase timeline
+  (host_ingest / dispatch / compute / ckpt_stage) into a bounded ring,
+  flushed to ``timeline.jsonl`` and exported as Perfetto-loadable
+  Chrome trace JSON via ``cli.obs timeline``;
+* :mod:`gene2vec_tpu.obs.goodput` — goodput accounting: run wall time
+  classified into compute / input-stall / checkpoint / preempted
+  buckets (summing exactly to wall), achieved-vs-peak pairs/s, stamped
+  into the run manifest and ``metrics.prom``;
+* :mod:`gene2vec_tpu.obs.ledger` — the unified bench ledger: every
+  root bench artifact adapted into one record schema, trailing-window
+  regression detection (``cli.obs ledger``, gated by
+  ``analysis/passes_perf.py``; docs/BENCHMARKS.md).
 
 Every trainer's ``run(export_dir)`` writes ``manifest.json`` +
 ``events.jsonl`` into its export/run directory;

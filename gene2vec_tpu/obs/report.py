@@ -96,6 +96,7 @@ def summarize(run_dir: str) -> Dict:
     walls = [e["wall"] for e in events if "wall" in e]
     processes = sorted({e.get("pid") for e in events if e.get("pid")})
     return {
+        "goodput": manifest.get("goodput"),
         "run_dir": os.path.abspath(run_dir),
         "name": manifest.get("name"),
         "config_hash": manifest.get("config_hash"),
@@ -156,6 +157,26 @@ def format_report(run_dir: str) -> str:
             f"({s['pairs_total']:,.0f} pairs in {_fmt_s(train_s)} of "
             f"training spans)"
         )
+    if s.get("goodput"):
+        g = s["goodput"]
+        lines.append("")
+        fr = g.get("fractions") or {}
+        lines.append(
+            "goodput: "
+            + "  ".join(
+                f"{b} {100 * fr.get(b, 0.0):.1f}%"
+                for b in ("compute", "input_stall", "checkpoint",
+                          "preempted", "other")
+            )
+        )
+        achieved = g.get("achieved_pairs_per_sec")
+        peak_rate = g.get("peak_pairs_per_sec")
+        if achieved is not None and peak_rate:
+            lines.append(
+                f"  achieved {achieved:,.0f} pairs/s vs peak "
+                f"{peak_rate:,.0f} (utilization "
+                f"{g.get('utilization', 0) or 0:.1%})"
+            )
     if s["peak"]:
         lines.append("")
         for k in sorted(s["peak"]):

@@ -41,6 +41,9 @@ class PreemptionHandler:
     ):
         self.signals = signals
         self.received: Optional[int] = None
+        #: wall clock (time.time()) when the first signal landed — the
+        #: goodput "preempted" bucket measures the drain tail from here
+        self.received_wall: Optional[float] = None
         self._event = threading.Event()
         self._prev: Dict[int, object] = {}
 
@@ -82,6 +85,9 @@ class PreemptionHandler:
         the test/embedder entry point)."""
         if self.received is None:
             self.received = signum
+            import time
+
+            self.received_wall = time.time()
         self._event.set()
 
     # -- polling -----------------------------------------------------------

@@ -422,7 +422,11 @@ class CBOWHSTrainer:
         loop at the next iteration boundary after a SIGTERM/SIGINT and
         stamps the run manifest ``interrupted=true``
         (docs/RESILIENCE.md)."""
+        import contextlib
+
+        from gene2vec_tpu.obs import goodput
         from gene2vec_tpu.obs.run import Run
+        from gene2vec_tpu.obs.timeline import TIMELINE_NAME, PhaseTimeline
 
         cfg = self.config
         run = Run(
@@ -435,6 +439,12 @@ class CBOWHSTrainer:
             },
         )
         run.registry.attach_csv(os.path.join(export_dir, "training_log.csv"))
+        # per-iteration phase timeline + goodput, same wiring as the SGNS
+        # trainer (obs/timeline.py, obs/goodput.py)
+        tl = PhaseTimeline(enabled=cfg.timeline)
+        wall_t0 = time.perf_counter()
+        pairs_done = 0.0
+        best_rate = 0.0
         # everything after Run construction runs under its finally, so a
         # failed resume (e.g. the hs_dense_depth mismatch below) still
         # closes the run instead of leaking the ambient tracer
@@ -472,18 +482,23 @@ class CBOWHSTrainer:
                 if preempt is not None and preempt.triggered:
                     break
                 t0 = time.perf_counter()
+                with tl.phase("host_ingest", step=it):
+                    epoch_key = jax.random.fold_in(root_key, it)
                 with run.step(
                     "iteration", iteration=it, pairs=pairs_per_epoch
                 ) as span_out:
-                    params, loss = self.train_epoch(
-                        params, jax.random.fold_in(root_key, it)
-                    )
-                    loss = float(loss)
+                    with tl.phase("dispatch", step=it):
+                        params, loss = self.train_epoch(params, epoch_key)
+                    with tl.phase("compute", step=it):
+                        loss = float(loss)
                     span_out["loss"] = loss
                 dt = time.perf_counter() - t0
                 rate = pairs_per_epoch / dt if dt > 0 else float("inf")
                 self.timer.record(pairs_per_epoch, dt)
                 pairs_counter.inc(pairs_per_epoch)
+                pairs_done += pairs_per_epoch
+                if dt > 0 and it != start_iter:
+                    best_rate = max(best_rate, rate)
                 log(
                     f"gene2vec [{cfg.objective}] dimension {cfg.dim} iteration "
                     f"{it} done: loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
@@ -492,7 +507,9 @@ class CBOWHSTrainer:
                     it, {"loss": loss, "pairs_per_sec": rate, "seconds": dt}
                 )
                 run.probe()
-                with run.span("checkpoint", iteration=it):
+                with run.span("checkpoint", iteration=it), tl.phase(
+                    "ckpt_stage", step=it
+                ):
                     ckpt.save_iteration(
                         export_dir, cfg.dim, it, params, self.corpus.vocab,
                         txt_output=cfg.txt_output,
@@ -513,6 +530,23 @@ class CBOWHSTrainer:
         finally:
             if preempt is not None and preempt.triggered:
                 run.mark_interrupted("signal", signal=preempt.received)
+            # observability residue must never mask the in-flight error
+            with contextlib.suppress(Exception):
+                wall_s = time.perf_counter() - wall_t0
+                preempted_s = 0.0
+                if (
+                    preempt is not None and preempt.triggered
+                    and preempt.received_wall is not None
+                ):
+                    preempted_s = min(
+                        max(time.time() - preempt.received_wall, 0.0), wall_s
+                    )
+                tl.flush(os.path.join(run.run_dir, TIMELINE_NAME))
+                goodput.stamp(run, goodput.summarize(
+                    tl.records(), wall_s, pairs_total=pairs_done,
+                    peak_pairs_per_sec=best_rate or None,
+                    preempted_s=preempted_s,
+                ))
             run.close()
         return params
 

@@ -602,7 +602,9 @@ class SGNSTrainer:
         """
         import contextlib
 
+        from gene2vec_tpu.obs import goodput
         from gene2vec_tpu.obs.run import Run
+        from gene2vec_tpu.obs.timeline import TIMELINE_NAME, PhaseTimeline
         from gene2vec_tpu.utils.profiling import trace_context
 
         cfg = self.config
@@ -623,6 +625,14 @@ class SGNSTrainer:
             )
 
             writer = AsyncCheckpointWriter(metrics=run.registry)
+        # step-phase timeline (obs/timeline.py): per-iteration host /
+        # dispatch / compute / checkpoint-staging breakdown into a
+        # bounded ring, flushed to timeline.jsonl at run close and
+        # classified into goodput buckets for the manifest
+        tl = PhaseTimeline(enabled=cfg.timeline)
+        wall_t0 = time.perf_counter()
+        pairs_done = 0.0
+        best_rate = 0.0
         completed = None
         # everything after Run construction runs under its finally, so a
         # failed resume still closes the run (and uninstalls the ambient
@@ -652,19 +662,27 @@ class SGNSTrainer:
                     break  # signal landed between iterations
                 log(f"gene2vec dimension {cfg.dim} iteration {it} start")
                 t0 = time.perf_counter()
+                with tl.phase("host_ingest", step=it):
+                    epoch_key = jax.random.fold_in(root_key, it)
                 with trace_context(profile_dir if it == start_iter else None):
                     with run.step(
                         "iteration", iteration=it, pairs=pairs_per_epoch
                     ) as span_out:
-                        params, loss = self.train_epoch(
-                            params, jax.random.fold_in(root_key, it)
-                        )
-                        loss = float(loss)  # blocks until the epoch finishes
+                        with tl.phase("dispatch", step=it):
+                            params, loss = self.train_epoch(
+                                params, epoch_key
+                            )
+                        with tl.phase("compute", step=it):
+                            loss = float(loss)  # blocks until epoch finishes
                         span_out["loss"] = loss
                 dt = time.perf_counter() - t0
                 rate = pairs_per_epoch / dt if dt > 0 else float("inf")
                 self.timer.record(pairs_per_epoch, dt)
                 pairs_counter.inc(pairs_per_epoch)
+                pairs_done += pairs_per_epoch
+                if dt > 0 and it != start_iter:
+                    # peak excludes the compile/relayout first iteration
+                    best_rate = max(best_rate, rate)
                 log(
                     f"gene2vec dimension {cfg.dim} iteration {it} done: "
                     f"loss={loss:.4f} {rate:,.0f} pairs/s ({dt:.2f}s)"
@@ -677,10 +695,11 @@ class SGNSTrainer:
                     "checkpoint", iteration=it,
                     mode="async" if writer is not None else "sync",
                 ):
-                    self._checkpoint(
-                        writer, export_dir, it, params,
-                        self._ckpt_meta(run, it, loss, rate),
-                    )
+                    with tl.phase("ckpt_stage", step=it):
+                        self._checkpoint(
+                            writer, export_dir, it, params,
+                            self._ckpt_meta(run, it, loss, rate),
+                        )
                 completed = it
                 if preempt is not None and preempt.triggered:
                     # cooperative drain: the iteration and its checkpoint
@@ -706,5 +725,24 @@ class SGNSTrainer:
                     signal=preempt.received,
                     completed_iteration=completed,
                 )
+            # goodput + timeline are observability residue — they must
+            # never mask the in-flight exception (same discipline as the
+            # writer drain above)
+            with contextlib.suppress(Exception):
+                wall_s = time.perf_counter() - wall_t0
+                preempted_s = 0.0
+                if (
+                    preempt is not None and preempt.triggered
+                    and preempt.received_wall is not None
+                ):
+                    preempted_s = min(
+                        max(time.time() - preempt.received_wall, 0.0), wall_s
+                    )
+                tl.flush(os.path.join(run.run_dir, TIMELINE_NAME))
+                goodput.stamp(run, goodput.summarize(
+                    tl.records(), wall_s, pairs_total=pairs_done,
+                    peak_pairs_per_sec=best_rate or None,
+                    preempted_s=preempted_s,
+                ))
             run.close()
         return params

@@ -818,6 +818,9 @@ def main(argv=None) -> int:
 
     doc = {
         "schema": "gene2vec-tpu/chaos-drill/v1",
+        # provenance stamp (ledger contract, docs/BENCHMARKS.md)
+        "schema_version": 1,
+        "command": " ".join([sys.executable, *sys.argv]),
         "created_unix": time.time(),
         "host": socket.gethostname(),
         "smoke": bool(args.smoke),
@@ -860,6 +863,8 @@ def main(argv=None) -> int:
     if args.fleet_out and "fleet" in doc["phases"]:
         fleet_doc = {
             "schema": "gene2vec-tpu/bench-fleet/v1",
+            "schema_version": 1,
+            "command": doc["command"],
             "bench": "fleet_chaos_drill",
             "created_unix": doc["created_unix"],
             "host": doc["host"],

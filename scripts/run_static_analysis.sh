@@ -10,7 +10,11 @@
 # span-hygiene pass (no obs span enter/exit inside jitted/traced code,
 # no span context manager left unclosed on early return) and the
 # committed-bench budget gates: fleet availability (BENCH_FLEET vs
-# budgets.json "fleet") and tracing overhead (BENCH_OBS vs "obs").
+# budgets.json "fleet"), tracing overhead (BENCH_OBS vs "obs"), and the
+# perf plane (BENCH_PERF timeline overhead + unified-ledger trajectory
+# regressions vs "perf"; docs/BENCHMARKS.md).  The ledger ingest +
+# regression check also runs standalone below so its rendered
+# trajectory lands in the CI log.
 #
 #   scripts/run_static_analysis.sh                 # lint + tier-2 HLO
 #   scripts/run_static_analysis.sh --fast          # lint only (tier-1 scope)
@@ -100,6 +104,21 @@ for f in doc["findings"]:
         loc = f"{f['path']}:{f['line']}" if f.get("line") else f["path"]
         print(f"  {loc}: [{f['pass']}] {f['message']}", file=sys.stderr)
 EOF
+if [ "$rc" -ne 0 ]; then
+  exit "$rc"
+fi
+
+# Unified bench ledger: ingest every root bench artifact and run the
+# trailing-window regression rules (budgets.json "perf").  The analyzer
+# above already gates on the same rules (passes_perf rides the default
+# tier); this standalone run renders the full trajectory into the CI
+# log and persists the ledger for tooling.
+echo "== bench ledger (ingest + regression check) ==" >&2
+LEDGER_OUT="${LEDGER_OUT:-/tmp/bench_ledger.jsonl}"
+LEDGER_CSV="${LEDGER_CSV:-/tmp/bench_ledger.csv}"
+python -m gene2vec_tpu.cli.obs ledger --check \
+  --out "$LEDGER_OUT" --csv "$LEDGER_CSV" >&2 || rc=$?
+echo "ledger: exit $rc -> $LEDGER_OUT / $LEDGER_CSV" >&2
 if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi

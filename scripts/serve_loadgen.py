@@ -522,6 +522,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 results.append(row)
 
         doc = {
+            # provenance stamp (ledger contract, docs/BENCHMARKS.md):
+            # adapters treat records without schema_version as legacy
+            "schema_version": 1,
+            "command": " ".join([sys.executable, *sys.argv]),
+            "created_unix": time.time(),
             "bench": ("trace_overhead" if args.trace_overhead
                       else "serve_loadgen"),
             "mode": args.mode,

@@ -1,0 +1,595 @@
+"""Training performance plane: step-phase timeline ring + Perfetto
+export, goodput bucket arithmetic, the unified bench ledger adapters,
+and the perf regression gate (docs/OBSERVABILITY.md "Training timeline
+& goodput", docs/BENCHMARKS.md)."""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from gene2vec_tpu.obs import goodput, ledger
+from gene2vec_tpu.obs.timeline import (
+    TIMELINE_NAME,
+    PhaseTimeline,
+    chrome_trace,
+    collect_run,
+    read_timeline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- timeline ring ----------------------------------------------------------
+
+
+def test_ring_bounds_and_drop_accounting():
+    tl = PhaseTimeline(capacity=8)
+    for i in range(20):
+        tl.add("dispatch", 0.01, step=i)
+    assert len(tl) == 8
+    assert tl.dropped == 12
+    # newest kept, oldest evicted
+    steps = [r["step"] for r in tl.records()]
+    assert steps == list(range(12, 20))
+
+
+def test_disabled_timeline_is_a_noop(tmp_path):
+    tl = PhaseTimeline(enabled=False)
+    with tl.phase("dispatch", step=0):
+        pass
+    tl.add("compute", 0.5)
+    assert len(tl) == 0
+    assert tl.flush(str(tmp_path / TIMELINE_NAME)) == 0
+    assert not (tmp_path / TIMELINE_NAME).exists()
+
+
+def test_phase_context_records_duration_and_attrs():
+    tl = PhaseTimeline()
+    with tl.phase("compute", step=3, mode="sync"):
+        pass
+    (rec,) = tl.records()
+    assert rec["name"] == "compute"
+    assert rec["step"] == 3
+    assert rec["mode"] == "sync"
+    assert rec["dur"] >= 0
+    assert rec["pid"] == os.getpid()
+
+
+def test_flush_and_read_round_trip(tmp_path):
+    tl = PhaseTimeline(capacity=4)
+    for i in range(6):
+        tl.add("dispatch", 0.01, step=i, wall=100.0 + i)
+    path = str(tmp_path / TIMELINE_NAME)
+    assert tl.flush(path) == 4
+    records = read_timeline(path)
+    assert [r["step"] for r in records] == [2, 3, 4, 5]
+    # the meta header records the truncation
+    with open(path) as f:
+        meta = json.loads(f.readline())
+    assert meta["type"] == "timeline_meta"
+    assert meta["dropped"] == 2
+
+
+def test_invalid_capacity_rejected():
+    with pytest.raises(ValueError):
+        PhaseTimeline(capacity=0)
+
+
+# -- Chrome trace export ----------------------------------------------------
+
+
+def test_chrome_trace_valid_and_phase_tracks():
+    tl = PhaseTimeline()
+    for step in range(3):
+        for name in ("host_ingest", "dispatch", "compute"):
+            tl.add(name, 0.01, step=step, wall=1000.0 + step)
+    spans = [
+        {"type": "span_end", "name": "iteration", "wall": 1003.0,
+         "dur": 1.0, "pid": 42, "tid": 7, "attrs": {"loss": 1.0}},
+        {"type": "span_end", "name": "batch_item", "wall": 1003.5,
+         "dur": 0.1, "pid": 43, "tid": 8, "hop": True, "trace": "ab" * 16},
+        {"type": "event", "name": "probe", "wall": 1004.0, "pid": 42,
+         "tid": 7, "attrs": {"rss": 1}},
+    ]
+    doc = chrome_trace(tl.records(), spans)
+    # loads↔dumps round trip: Perfetto parses standard JSON
+    doc2 = json.loads(json.dumps(doc))
+    events = doc2["traceEvents"]
+    assert events, "no events emitted"
+    for e in events:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    # >= 3 distinct phase tracks, each with thread_name metadata
+    assert set(doc2["otherData"]["phase_tracks"]) == {
+        "host_ingest", "dispatch", "compute",
+    }
+    thread_names = {
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"phase:host_ingest", "phase:dispatch",
+            "phase:compute"} <= thread_names
+    # the hop record kept its category and trace id
+    hop = [e for e in events if e.get("cat") == "hop"]
+    assert hop and hop[0]["args"]["trace"] == "ab" * 16
+    # instant event for the probe
+    assert any(e["ph"] == "i" and e["name"] == "probe" for e in events)
+
+
+def test_collect_run_merges_timeline_and_events(tmp_path):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    tl = PhaseTimeline()
+    tl.add("dispatch", 0.5, step=1, wall=100.0)
+    tl.flush(str(run_dir / TIMELINE_NAME))
+    (run_dir / "events.jsonl").write_text(json.dumps({
+        "type": "span_end", "name": "iteration", "wall": 101.0,
+        "dur": 0.9, "pid": 1, "tid": 1,
+    }) + "\n")
+    (run_dir / "manifest.json").write_text(json.dumps({
+        "name": "sgns", "pid": 1,
+    }))
+    doc = collect_run(str(run_dir))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"dispatch", "iteration"} <= names
+    # manifest-derived process label
+    labels = [
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    ]
+    assert any("sgns" in v for v in labels)
+
+
+def test_timeline_cli_round_trip(tmp_path):
+    from gene2vec_tpu.cli.obs import main as obs_main
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    tl = PhaseTimeline()
+    for name in ("host_ingest", "dispatch", "compute"):
+        tl.add(name, 0.01, step=0)
+    tl.flush(str(run_dir / TIMELINE_NAME))
+    out = tmp_path / "trace.json"
+    assert obs_main(["timeline", str(run_dir), "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert len(doc["otherData"]["phase_tracks"]) >= 3
+    # empty dir exits 1 (nothing to export), bad dir exits 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs_main(["timeline", str(empty)]) == 1
+    assert obs_main(["timeline", str(tmp_path / "absent")]) == 2
+
+
+# -- goodput ----------------------------------------------------------------
+
+
+def test_goodput_buckets_sum_to_wall():
+    records = [
+        {"name": "dispatch", "dur": 2.0},
+        {"name": "compute", "dur": 5.0},
+        {"name": "host_ingest", "dur": 1.0},
+        {"name": "ckpt_stage", "dur": 0.5},
+        {"name": "unknown_phase", "dur": 99.0},  # unattributed
+    ]
+    buckets = goodput.classify(records, wall_s=10.0, preempted_s=0.5)
+    assert buckets["compute"] == 7.0
+    assert buckets["input_stall"] == 1.0
+    assert buckets["checkpoint"] == 0.5
+    assert buckets["preempted"] == 0.5
+    assert abs(sum(buckets.values()) - 10.0) < 1e-9
+    assert buckets["other"] == pytest.approx(1.0)
+
+
+def test_goodput_overlapping_phases_scale_down():
+    # instrumented time exceeding the wall clock must not report a sum
+    # that disagrees with the clock
+    records = [{"name": "compute", "dur": 8.0},
+               {"name": "host_ingest", "dur": 4.0}]
+    buckets = goodput.classify(records, wall_s=6.0)
+    assert abs(sum(buckets.values()) - 6.0) < 1e-9
+    assert buckets["compute"] == pytest.approx(4.0)
+    assert buckets["input_stall"] == pytest.approx(2.0)
+    assert buckets["other"] == pytest.approx(0.0)
+
+
+def test_goodput_summary_and_utilization():
+    records = [{"name": "compute", "dur": 8.0}]
+    s = goodput.summarize(
+        records, wall_s=10.0, pairs_total=1000.0, peak_pairs_per_sec=200.0,
+    )
+    assert s["achieved_pairs_per_sec"] == 100.0
+    assert s["utilization"] == pytest.approx(0.5)
+    assert abs(sum(s["buckets_s"].values()) - 10.0) < 1e-6
+    # peak falls back to pairs-over-compute-seconds when not supplied
+    s2 = goodput.summarize(records, wall_s=10.0, pairs_total=1000.0)
+    assert s2["peak_pairs_per_sec"] == pytest.approx(125.0)
+
+
+def test_goodput_stamp_into_manifest_and_metrics(tmp_path):
+    from gene2vec_tpu.obs.run import Run
+
+    run = Run(str(tmp_path), name="t", probe_devices=False)
+    s = goodput.summarize(
+        [{"name": "compute", "dur": 1.0}], wall_s=2.0, pairs_total=10.0,
+    )
+    goodput.stamp(run, s)
+    run.close()
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    assert manifest["goodput"]["fractions"]["compute"] == pytest.approx(0.5)
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "goodput_compute_fraction" in prom
+    assert "achieved_pairs_per_sec" in prom
+    # the report surfaces it (text and --json)
+    from gene2vec_tpu.obs import report
+
+    assert report.summarize(str(tmp_path))["goodput"] == manifest["goodput"]
+    assert "goodput:" in report.format_report(str(tmp_path))
+
+
+# -- ledger adapters over the real root artifacts ---------------------------
+
+_ARTIFACT_GLOBS = (
+    "BENCH_*.json", "MULTICHIP_*.json", "MESH_SANITY_*.json",
+    "INTRINSIC_*.json", "REAL_AUC.json",
+)
+
+
+def _real_artifacts():
+    out = []
+    for pattern in _ARTIFACT_GLOBS:
+        out.extend(sorted(glob.glob(os.path.join(REPO, pattern))))
+    return out
+
+
+def test_ledger_ingests_every_real_root_artifact(tmp_path):
+    """Acceptance: cli.obs ledger ingests every existing root bench
+    artifact without error (copies, so the test never depends on cwd)."""
+    sources = _real_artifacts()
+    assert len(sources) >= 10, "root artifact set shrank unexpectedly"
+    for p in sources:
+        shutil.copy(p, tmp_path / os.path.basename(p))
+    records = ledger.ingest_root(str(tmp_path))
+    ingested = {r["source"] for r in records}
+    expected = {
+        os.path.basename(p) for p in sources
+        if ledger.match_family(os.path.basename(p))
+    }
+    # every family-matched artifact produced a record, none errored
+    assert ingested == expected
+    errors = [(r["source"], r["error"]) for r in records if r.get("error")]
+    assert errors == []
+    # each record carries a resolvable headline metric
+    for r in records:
+        assert r["headline_metric"], r["source"]
+        assert r["headline_metric"] in r["metrics"], r["source"]
+    # pre-stamp artifacts are marked legacy — visibly, never silently
+    legacy = {r["source"] for r in records if r["legacy_unstamped"]}
+    assert "BENCH_r01.json" in legacy
+    # the sgns headline series is complete r01..r05
+    series = ledger.series(records, "sgns_pairs_per_sec")
+    assert [s for s, _ in series][:5] == [
+        f"BENCH_r0{i}.json" for i in range(1, 6)
+    ]
+
+
+def test_ledger_unreadable_artifact_yields_error_record(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    (rec,) = ledger.ingest_root(str(tmp_path))
+    assert rec["error"]
+    assert rec["metrics"] == {}
+
+
+def test_ledger_jsonl_and_csv_outputs(tmp_path):
+    for p in _real_artifacts():
+        shutil.copy(p, tmp_path / os.path.basename(p))
+    records = ledger.ingest_root(str(tmp_path))
+    jl = tmp_path / "ledger.jsonl"
+    cv = tmp_path / "ledger.csv"
+    ledger.write_jsonl(records, str(jl))
+    ledger.write_csv(records, str(cv))
+    lines = jl.read_text().strip().splitlines()
+    assert len(lines) == len(records)
+    assert all(json.loads(ln)["schema"] == ledger.SCHEMA for ln in lines)
+    header = cv.read_text().splitlines()[0].split(",")
+    assert {"family", "source", "round", "headline_metric"} <= set(header)
+    assert "sgns_pairs_per_sec" in header
+
+
+# -- regression detection ----------------------------------------------------
+
+
+def _fake_bench(value, rc=0):
+    return {
+        "n": 1, "cmd": "python bench.py", "rc": rc, "tail": "",
+        "parsed": {"metric": "sgns_pairs_per_sec", "value": value,
+                   "unit": "pairs/s"},
+    }
+
+
+_RULES = {
+    "window": 4, "min_points": 3,
+    "metrics": {"sgns_pairs_per_sec": {
+        "direction": "higher", "max_regression_frac": 0.3,
+    }},
+}
+
+
+def _plant(tmp_path, values):
+    for i, v in enumerate(values, start=1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_fake_bench(v))
+        )
+    return ledger.ingest_root(str(tmp_path))
+
+
+def test_regression_detection_median_of_band(tmp_path):
+    # healthy trajectory: no regression
+    records = _plant(tmp_path, [4e6, 5e6, 6e6, 6.5e6])
+    (ev,) = ledger.detect_regressions(records, _RULES)
+    assert not ev["regressed"]
+    # planted collapse: newest far below the trailing-band median
+    records = _plant(tmp_path, [4e6, 5e6, 6e6, 6.5e6, 2e6])
+    (ev,) = ledger.detect_regressions(records, _RULES)
+    assert ev["regressed"]
+    assert ev["newest_source"] == "BENCH_r05.json"
+    assert ev["band_median"] == pytest.approx(5.5e6)
+    # one outlier round in the BAND cannot fake a regression (median,
+    # not mean): same healthy newest, one garbage point in history
+    records = _plant(tmp_path, [4e6, 0.1e6, 6e6, 6.5e6, 6.2e6])
+    (ev,) = ledger.detect_regressions(records, _RULES)
+    assert not ev["regressed"]
+
+
+def test_regression_short_series_skipped(tmp_path):
+    records = _plant(tmp_path, [4e6, 5e6])
+    (ev,) = ledger.detect_regressions(records, _RULES)
+    assert not ev["regressed"]
+    assert "skipped" in ev
+
+
+def test_lower_is_better_direction(tmp_path):
+    rules = {
+        "window": 4, "min_points": 3,
+        "metrics": {"serve_p50_ms_min_load": {
+            "direction": "lower", "max_regression_frac": 0.5,
+        }},
+    }
+
+    def serve_doc(p50):
+        return {"bench": "serve_loadgen", "levels": [
+            {"offered_rps": 50.0, "p50_ms": p50, "p99_ms": p50 * 3,
+             "rejection_rate": 0.0, "errors": 0},
+        ]}
+
+    for i, p50 in enumerate([20.0, 22.0, 21.0, 80.0], start=1):
+        (tmp_path / f"BENCH_SERVE_r{i:02d}.json").write_text(
+            json.dumps(serve_doc(p50))
+        )
+    records = ledger.ingest_root(str(tmp_path))
+    (ev,) = ledger.detect_regressions(records, rules)
+    assert ev["regressed"]  # 80ms vs median 21ms: latency exploded
+
+
+# -- the perf gate (passes_perf + cli.analyze) -------------------------------
+
+
+def _stage_perf_root(tmp_path, values, with_perf_bench=True):
+    root = tmp_path / "perf_root"
+    root.mkdir(exist_ok=True)
+    for i, v in enumerate(values, start=1):
+        (root / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_fake_bench(v))
+        )
+    if with_perf_bench:
+        shutil.copy(
+            os.path.join(REPO, "BENCH_PERF_r10.json"),
+            root / "BENCH_PERF_r10.json",
+        )
+    return str(root)
+
+
+def test_passes_perf_planted_regression_fires_exactly_once(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_perf import perf_findings
+
+    # clean trajectory: nothing gates
+    clean = _stage_perf_root(tmp_path, [4e6, 5e6, 6e6, 6.5e6])
+    assert gating(perf_findings(root=clean)) == []
+    # planted collapse: exactly ONE gating finding, from the regression
+    # pass, naming the regressed artifact
+    bad = _stage_perf_root(tmp_path, [4e6, 5e6, 6e6, 6.5e6, 2e6])
+    gate = gating(perf_findings(root=bad))
+    assert len(gate) == 1, [f.format() for f in gate]
+    assert gate[0].pass_id == "perf-ledger-regression"
+    assert gate[0].path == "BENCH_r05.json"
+    assert "sgns_pairs_per_sec" in gate[0].message
+
+
+def test_passes_perf_timeline_overhead_gate(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_hlo import load_budgets
+    from gene2vec_tpu.analysis.passes_perf import (
+        BENCH_PERF_NAME,
+        perf_findings,
+    )
+
+    budget = load_budgets()["perf"]["timeline_overhead"]
+    recipe = {k: budget[k] for k in
+              ("dim", "vocab", "num_pairs", "batch_pairs", "rounds",
+               "epochs_per_window")}
+    root = tmp_path / "root"
+    root.mkdir()
+    # missing bench: info only
+    fs = perf_findings(root=str(root))
+    assert gating(fs) == []
+    assert any(f.pass_id == "perf-timeline-overhead-budget"
+               and f.severity == "info" for f in fs)
+    ok = {
+        "bench": "timeline_overhead", "recipe": recipe,
+        "rate_timeline_off": 100.0, "rate_timeline_on": 99.5,
+        "regression_frac": 0.005,
+    }
+    (root / BENCH_PERF_NAME).write_text(json.dumps(ok))
+    assert gating(perf_findings(root=str(root))) == []
+    for doc in (
+        {**ok, "regression_frac": 0.10},              # over budget
+        {**ok, "recipe": {**recipe, "rounds": 1}},    # shrunken recipe
+        {**ok, "recipe": {**recipe,                   # half-length windows
+                          "epochs_per_window": 1}},
+        {k: v for k, v in ok.items()
+         if k != "regression_frac"},                  # dropped key
+        {**ok, "recipe": {}},                         # recipe gone
+    ):
+        (root / BENCH_PERF_NAME).write_text(json.dumps(doc))
+        gate = gating(perf_findings(root=str(root)))
+        assert len(gate) == 1, doc
+        assert gate[0].pass_id == "perf-timeline-overhead-budget"
+    # the gate follows the round convention: a NEWER violating record
+    # (r11) must win over the stale clean r10
+    (root / BENCH_PERF_NAME).write_text(json.dumps(ok))
+    (root / "BENCH_PERF_r11.json").write_text(json.dumps(
+        {**ok, "regression_frac": 0.10}
+    ))
+    gate = gating(perf_findings(root=str(root)))
+    assert len(gate) == 1
+    assert gate[0].path == "BENCH_PERF_r11.json"
+
+
+def test_analyze_cli_exits_1_on_planted_regression(tmp_path):
+    """Acceptance: a planted throughput regression fails the DEFAULT
+    cli.analyze tier (and the clean staged root passes it)."""
+    bad = _stage_perf_root(tmp_path, [4e6, 5e6, 6e6, 6.5e6, 2e6])
+    env = {**os.environ, "GENE2VEC_TPU_PERF_ROOT": bad}
+    proc = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    fired = [f for f in doc["findings"]
+             if f["pass"] == "perf-ledger-regression"
+             and f["severity"] != "info"]
+    assert len(fired) == 1
+
+
+def test_obs_ledger_cli_check(tmp_path):
+    from gene2vec_tpu.cli.obs import main as obs_main
+
+    root = _stage_perf_root(tmp_path, [4e6, 5e6, 6e6, 6.5e6])
+    out = tmp_path / "ledger.jsonl"
+    assert obs_main(
+        ["ledger", root, "--check", "--out", str(out)]
+    ) == 0
+    assert out.exists()
+    bad = _stage_perf_root(tmp_path, [4e6, 5e6, 6e6, 6.5e6, 2e6])
+    assert obs_main(["ledger", bad, "--check"]) == 1
+
+
+# -- provenance stamps -------------------------------------------------------
+
+
+def test_bench_stamp_and_adapter_provenance(tmp_path):
+    sys.path.insert(0, REPO)
+    try:
+        from bench import bench_stamp
+    finally:
+        sys.path.pop(0)
+    doc = bench_stamp({"metric": "sgns_pairs_per_sec", "value": 1.0})
+    assert doc["schema_version"] == 1
+    assert "command" in doc and "created_unix" in doc
+    # a stamped artifact is NOT legacy, and its producer is recorded
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        **_fake_bench(5e6), "schema_version": 1,
+        "command": "python bench.py",
+    }))
+    (rec,) = ledger.ingest_root(str(tmp_path))
+    assert rec["legacy_unstamped"] is False
+    assert rec["producer"] == "python bench.py"
+    # the BENCH_r* driver wrapper stores bench's stdout doc under
+    # "parsed" — stamps must survive the wrapping
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 1, "cmd": "driver", "rc": 0, "tail": "",
+        "parsed": {**_fake_bench(6e6)["parsed"],
+                   **bench_stamp({})},
+    }))
+    rec2 = [r for r in ledger.ingest_root(str(tmp_path))
+            if r["source"] == "BENCH_r02.json"][0]
+    assert rec2["legacy_unstamped"] is False
+    assert rec2["producer"]
+    assert rec2["created_unix"] == pytest.approx(
+        json.loads((tmp_path / "BENCH_r02.json").read_text())
+        ["parsed"]["created_unix"]
+    )
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_corpus():
+    import numpy as np
+
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io.vocab import Vocab
+
+    rng = np.random.RandomState(0)
+    pairs = rng.randint(0, 64, (800, 2)).astype(np.int32)
+    counts = np.bincount(pairs.reshape(-1), minlength=64).astype(np.int64)
+    return PairCorpus(Vocab([f"G{i}" for i in range(64)], counts), pairs)
+
+
+def test_sgns_run_writes_timeline_and_goodput(tmp_path, tiny_corpus):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    export = tmp_path / "export"
+    trainer = SGNSTrainer(tiny_corpus, SGNSConfig(
+        dim=8, batch_pairs=256, num_iters=2, txt_output=False,
+    ))
+    trainer.run(str(export), log=lambda m: None)
+    records = read_timeline(str(export / TIMELINE_NAME))
+    phases = {r["name"] for r in records}
+    assert {"host_ingest", "dispatch", "compute", "ckpt_stage"} <= phases
+    manifest = json.loads((export / "manifest.json").read_text())
+    g = manifest["goodput"]
+    assert abs(sum(g["buckets_s"].values()) - g["wall_s"]) < 1e-3
+    assert g["pairs_total"] > 0
+    prom = (export / "metrics.prom").read_text()
+    assert "goodput_compute_fraction" in prom
+
+
+def test_sgns_run_timeline_off_writes_nothing(tmp_path, tiny_corpus):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    export = tmp_path / "export_off"
+    trainer = SGNSTrainer(tiny_corpus, SGNSConfig(
+        dim=8, batch_pairs=256, num_iters=1, txt_output=False,
+        timeline=False,
+    ))
+    trainer.run(str(export), log=lambda m: None)
+    assert not (export / TIMELINE_NAME).exists()
+    # goodput still stamps (wall + pairs are timeline-independent)
+    manifest = json.loads((export / "manifest.json").read_text())
+    assert manifest["goodput"]["pairs_total"] > 0
+
+
+def test_cbow_hs_run_writes_timeline(tmp_path, tiny_corpus):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.sgns.cbow_hs import CBOWHSTrainer
+
+    export = tmp_path / "export_hs"
+    trainer = CBOWHSTrainer(tiny_corpus, SGNSConfig(
+        dim=8, batch_pairs=256, num_iters=1, objective="cbow_hs",
+        txt_output=False,
+    ))
+    trainer.run(str(export), log=lambda m: None)
+    records = read_timeline(str(export / TIMELINE_NAME))
+    assert {"dispatch", "compute"} <= {r["name"] for r in records}
+    assert "goodput" in json.loads((export / "manifest.json").read_text())
