@@ -592,6 +592,91 @@ class SpanHygienePass(Pass):
                 )
 
 
+#: event-loop callback naming convention (serve/eventloop.py: the
+#: selector dispatch targets `_on_accept`/`_on_readable`/...); signal
+#: handlers (`_on_term`, `_on_sigquit`) share the convention and the
+#: same no-blocking discipline applies to them
+_EVENTLOOP_CALLBACK = re.compile(r"^_?on_[a-z0-9_]+$")
+
+#: attribute calls that block (or can block) the calling thread on
+#: socket I/O — inside a loop callback these stall EVERY connection
+_EVENTLOOP_BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "makefile"}
+
+
+class EventLoopBlockingPass(Pass):
+    """No blocking calls inside event-loop callbacks.
+
+    The serve front end (``serve/eventloop.py``) runs every
+    connection's protocol work on one selector loop; its dispatch
+    targets follow the ``on_*``/``_on_*`` naming convention.  A
+    ``time.sleep``, a blocking ``socket.recv``/``sendall``, or a
+    ``json.dumps`` of a response body inside one of those callbacks
+    stalls EVERY connection on the loop for the duration — the exact
+    head-of-line blocking the event loop exists to remove, and
+    invisible under single-connection tests.  Raw socket I/O belongs in
+    the non-blocking ``_fill``/``_flush`` I/O-path helpers (which this
+    pass does not scan — they are not callbacks), sleeps belong on
+    worker-pool threads, and response bodies are pre-encoded off-loop
+    (the zero-copy contract: a hot response is reused bytes, never a
+    per-request ``json.dumps``).
+
+    The name scope is a heuristic (signal handlers like ``_on_term``
+    share the convention — and the same discipline); a legitimate
+    blocking call in an ``on_*`` function is silenced at the site with
+    ``# graftcheck: disable=event-loop-blocking``, never by weakening
+    the pass."""
+
+    id = "event-loop-blocking"
+    title = "blocking call inside an event-loop callback"
+
+    def run(self, mod: ModuleSource) -> Iterator[Finding]:
+        imports = mod.imports()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _EVENTLOOP_CALLBACK.match(node.name):
+                continue
+            for sub in _iter_own_body(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _EVENTLOOP_BLOCKING_ATTRS
+                ):
+                    yield self.finding(
+                        mod, sub,
+                        f".{fn.attr}() inside event-loop callback "
+                        f"'{node.name}' can block the loop thread, "
+                        "stalling every connection; raw socket I/O "
+                        "belongs in the non-blocking _fill/_flush "
+                        "I/O-path helpers",
+                    )
+                    continue
+                chain = chain_of(fn)
+                if chain is None:
+                    continue
+                resolved = resolve_chain(chain, imports)
+                if resolved == "time.sleep":
+                    yield self.finding(
+                        mod, sub,
+                        f"time.sleep(...) inside event-loop callback "
+                        f"'{node.name}' stalls every connection on "
+                        "this loop; sleeps (fault delays, backoff) "
+                        "belong on worker-pool threads",
+                    )
+                elif resolved in ("json.dumps", "json.dump"):
+                    yield self.finding(
+                        mod, sub,
+                        f"{chain}(...) inside event-loop callback "
+                        f"'{node.name}' serializes a body on the loop "
+                        "thread; pre-encode responses off-loop and "
+                        "hand the loop reusable bytes (the zero-copy "
+                        "contract)",
+                    )
+
+
 ALL_PASSES = (
     BarePrintPass(),
     HostSyncInJitPass(),
@@ -601,4 +686,5 @@ ALL_PASSES = (
     MissingDonatePass(),
     CkptBlockingIOPass(),
     SpanHygienePass(),
+    EventLoopBlockingPass(),
 )

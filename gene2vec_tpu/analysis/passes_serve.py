@@ -1,0 +1,258 @@
+"""Serve capacity budget gate: BENCH_SERVE vs budgets.json ``serve``.
+
+``scripts/serve_loadgen.py`` stamps a ``capacity`` section (highest
+offered level that sustained its load under the pinned latency/
+availability criteria) and — with ``--fleet N`` — a ``fleet_capacity``
+section into each ``BENCH_SERVE_r*.json``.  This pass re-checks the
+NEWEST committed record against the ``capacity_rps`` entry of the
+``serve`` budgets section every ``cli.analyze`` run, so a front-end
+capacity regression (a rerun stamping worse numbers, a budget quietly
+loosened, a bench re-measured off-recipe) fails the analyzer exactly
+like a collective-bytes regression does.
+
+Rules (the passes_fleet / passes_perf shape — jax-free, I/O-only, so
+it rides the DEFAULT tier):
+
+* no ``BENCH_SERVE_r*`` artifact at all → *info* (a fresh checkout
+  must not fail lint before its first bench);
+* newest artifact missing the ``capacity`` section → gating error
+  (it was produced by a pre-capacity loadgen — re-run the bench);
+* the budget pins the **measurement recipe** (mode, method, k,
+  duration, query-gene count, p99/availability criteria): a record
+  measured differently gates hard — a lucky 1-second window must not
+  pass a capacity gate by variance;
+* ``capacity.sustained_rps`` below ``min_capacity_rps`` (and, when
+  pinned, ``fleet_capacity.sustained_rps`` below
+  ``min_fleet_capacity_rps``, or any fleet-phase wrong/mixed-iteration
+  answer) gates hard; a missing budgeted quantity gates like a
+  violation — dropping the key must never be the way to pass.
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (shared with
+``passes_perf`` so staged fixture dirs work uniformly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.passes_perf import perf_root
+
+_PASS = "serve-capacity-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_serve_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_SERVE_*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties) — the gate follows the round
+    convention like the ledger does."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched is not None and matched[0] == "serve_loadgen":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime,
+                               path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def serve_capacity_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the newest committed serve bench against ``capacity_rps``."""
+    budget = load_budgets(budgets_path).get("serve", {}).get(
+        "capacity_rps"
+    )
+    if not isinstance(budget, dict):
+        return []
+    root = root or perf_root()
+    path = _newest_serve_bench(root)
+    if path is None:
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path="BENCH_SERVE",
+            message=(
+                "no serve bench recorded yet (BENCH_SERVE_r*.json "
+                "missing); run `python scripts/serve_loadgen.py "
+                "--spawn <export>` per docs/BENCHMARKS.md to stamp one"
+            ),
+        )]
+    label = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable serve bench: {e}",
+        )]
+
+    problems: List[str] = []
+    data: Dict = {"budget": "capacity_rps"}
+
+    # the budget pins the MEASUREMENT RECIPE — a record measured with a
+    # different method/duration/criteria is not comparable
+    recipe = budget.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    for key in ("mode", "method"):
+        pinned = recipe.get(key)
+        if pinned is None:
+            continue
+        measured = bench.get(key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured != pinned:
+            problems.append(
+                f"bench measured with {key}={measured!r} but the "
+                f"budget pins {key}={pinned!r} — re-run the capacity "
+                "bench per docs/BENCHMARKS.md"
+            )
+    for key, bench_key in (
+        ("k", "k"),
+        ("duration_s", "duration_s"),
+        ("num_query_genes", "num_query_genes"),
+    ):
+        pinned = _get(recipe, key)
+        if pinned is None:
+            continue
+        measured = _get(bench, bench_key)
+        data[f"budget_{key}"] = pinned
+        data[bench_key] = measured
+        if measured is None:
+            problems.append(f"{bench_key} missing from the bench record")
+        elif key == "duration_s":
+            if measured < pinned:
+                problems.append(
+                    f"bench windows are {measured:g}s but the budget "
+                    f"pins >= {pinned:g}s per level"
+                )
+        elif measured != pinned:
+            problems.append(
+                f"bench measured with {bench_key}={measured:g} but the "
+                f"budget pins {pinned:g}"
+            )
+
+    def check_capacity(section_name: str, min_key: str) -> None:
+        floor = _get(budget, min_key)
+        if floor is None:
+            return
+        section = bench.get(section_name)
+        if not isinstance(section, dict):
+            problems.append(
+                f"{section_name} section missing from the bench record "
+                "(pre-capacity loadgen output? re-run the bench)"
+            )
+            return
+        sustained = _get(section, "sustained_rps")
+        data[f"{section_name}_sustained_rps"] = sustained
+        data[min_key] = floor
+        if sustained is None:
+            problems.append(
+                f"{section_name}.sustained_rps missing from the bench "
+                "record"
+            )
+        elif sustained < floor:
+            problems.append(
+                f"{section_name}.sustained_rps {sustained:g} < budget "
+                f"{floor:g} (the front end lost capacity)"
+            )
+        # the criteria the verdict was computed under must match the
+        # budget's — loosening them in the loadgen flags must not pass
+        for crit_key, direction in (
+            ("p99_budget_ms", "max"), ("min_availability", "min"),
+        ):
+            pinned = _get(budget, crit_key)
+            if pinned is None:
+                continue
+            measured = _get(section, crit_key)
+            if measured is None:
+                problems.append(
+                    f"{section_name}.{crit_key} missing from the bench "
+                    "record"
+                )
+            elif (direction == "max" and measured > pinned) or (
+                direction == "min" and measured < pinned
+            ):
+                problems.append(
+                    f"{section_name} verdict computed under "
+                    f"{crit_key}={measured:g}, looser than the "
+                    f"budget's {pinned:g}"
+                )
+
+    check_capacity("capacity", "min_capacity_rps")
+    check_capacity("fleet_capacity", "min_fleet_capacity_rps")
+
+    # fleet-phase answer integrity: zero wrong or mixed-iteration
+    # answers across every fleet level (only checked when the budget
+    # demands a fleet phase at all)
+    if _get(budget, "min_fleet_capacity_rps") is not None:
+        fleet_levels = bench.get("fleet_levels")
+        if not isinstance(fleet_levels, list) or not fleet_levels:
+            problems.append(
+                "fleet_levels missing from the bench record (run the "
+                "bench with --fleet/--verify)"
+            )
+        else:
+            for row in fleet_levels:
+                if not isinstance(row, dict):
+                    continue
+                for key in ("wrong_answers", "mixed_iteration_answers"):
+                    count = _get(row, key)
+                    if count is None:
+                        problems.append(
+                            f"fleet level {row.get('offered_rps')}: "
+                            f"{key} missing (run with --verify)"
+                        )
+                    elif count > 0:
+                        problems.append(
+                            f"fleet level {row.get('offered_rps')}: "
+                            f"{int(count)} {key.replace('_', ' ')} — "
+                            "answer integrity is broken in the serve "
+                            "path"
+                        )
+
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "serve capacity record violates budget 'capacity_rps': "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"serve capacity "
+            f"{data.get('capacity_sustained_rps'):g} rps (fleet "
+            f"{data.get('fleet_capacity_sustained_rps', 0) or 0:g} rps) "
+            "within budget 'capacity_rps'"
+        ),
+        data=data,
+    )]

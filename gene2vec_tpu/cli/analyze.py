@@ -157,6 +157,14 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_perf import perf_findings
 
         findings.extend(perf_findings())
+        # ... and the serve front-end capacity gate (BENCH_SERVE's
+        # capacity/fleet_capacity sections vs budgets.json
+        # "serve.capacity_rps", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_serve import (
+            serve_capacity_findings,
+        )
+
+        findings.extend(serve_capacity_findings())
 
     if args.hlo:
         _pin_cpu_backend()

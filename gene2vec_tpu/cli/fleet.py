@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(failover across replicas)")
     p.add_argument("--proxy-timeout-ms", type=float, default=5000.0,
                    help="front-door default per-request deadline")
+    p.add_argument("--proxy-workers", type=int, default=16,
+                   help="front-door forwarding worker pool size "
+                        "(each forward blocks on a replica round "
+                        "trip); saturation answers 429")
+    p.add_argument("--proxy-acceptors", type=int, default=1,
+                   help="front-door acceptor event loops (> 1 uses "
+                        "SO_REUSEPORT)")
     p.add_argument("--hedge", action="store_true",
                    help="enable p95 hedging on the front-door client")
     p.add_argument("--trace-sample", type=float, default=0.0,
@@ -162,6 +169,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         scrape_interval_s=args.scrape_interval,
         telemetry_csv=os.path.join(run.run_dir, "fleet_telemetry.csv"),
         flight_dir=run.run_dir,
+        proxy_workers=args.proxy_workers,
+        acceptors=args.proxy_acceptors,
     )
     url = proxy.serve(args.host, args.port)
     run.annotate(fleet_url=url)

@@ -51,9 +51,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout-ms", type=float, default=2000.0,
                    help="default per-request deadline")
     p.add_argument("--read-timeout", type=float, default=10.0,
-                   help="per-connection read deadline in seconds (slow "
-                        "clients get 408 + close instead of pinning a "
-                        "handler thread)")
+                   help="per-request read deadline in seconds (slow "
+                        "clients get 408 + close instead of pinning "
+                        "front-end state)")
+    p.add_argument("--idle-timeout", type=float, default=30.0,
+                   help="keep-alive connections idle longer than this "
+                        "are closed")
+    p.add_argument("--max-conn-requests", type=int, default=0,
+                   help="requests served per keep-alive connection "
+                        "before it is closed (0 = unbounded)")
+    p.add_argument("--acceptors", type=int, default=1,
+                   help="acceptor event loops; > 1 binds SO_REUSEPORT "
+                        "listening sockets so the kernel spreads "
+                        "connections across loops")
+    p.add_argument("--http-workers", type=int, default=8,
+                   help="bounded worker pool for the full-dispatch "
+                        "path (POSTs, traced/fault-injected requests); "
+                        "saturation answers 429")
     p.add_argument("--trace-sample", type=float, default=0.0,
                    help="root-trace sampling rate for requests without "
                         "a traceparent header (0..1; propagated sampled "
@@ -142,6 +156,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             timeout_ms=args.timeout_ms,
             read_timeout_s=args.read_timeout,
             trace_sample=args.trace_sample,
+            idle_timeout_s=args.idle_timeout,
+            max_conn_requests=args.max_conn_requests,
+            acceptors=args.acceptors,
+            http_workers=args.http_workers,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,

@@ -136,6 +136,23 @@ def _adapt_serve(doc: Dict) -> Tuple[Dict[str, float], str]:
             if clean:
                 _put(m, "serve_clean_capacity_rps",
                      clean[-1].get("offered_rps"))
+    # capacity sections (schema_version >= 2; serve_loadgen --method/
+    # --fleet era).  Older records (BENCH_SERVE_r06) predate the
+    # capacity verdict entirely — they simply contribute no point to
+    # the serve_capacity_rps series ("pre-capacity legacy"), which the
+    # trajectory rules treat as a shorter series, never an error.
+    capacity = doc.get("capacity")
+    if isinstance(capacity, dict):
+        _put(m, "serve_capacity_rps", capacity.get("sustained_rps"))
+        _put(m, "serve_capacity_p99_ms", capacity.get("p99_ms"))
+    else:
+        _put(m, "serve_pre_capacity_legacy", True)
+    fleet_capacity = doc.get("fleet_capacity")
+    if isinstance(fleet_capacity, dict):
+        _put(m, "serve_fleet_capacity_rps",
+             fleet_capacity.get("sustained_rps"))
+        _put(m, "serve_fleet_capacity_p99_ms",
+             fleet_capacity.get("p99_ms"))
     return m, "serve_p50_ms_min_load"
 
 

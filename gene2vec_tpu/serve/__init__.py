@@ -9,7 +9,11 @@ Layering (docs/SERVING.md):
 * :mod:`~gene2vec_tpu.serve.batcher` — micro-batching with max-delay /
   max-batch admission, bounded-queue backpressure, deadlines, LRU;
 * :mod:`~gene2vec_tpu.serve.interaction` — GGIPNN pair scoring;
-* :mod:`~gene2vec_tpu.serve.server` — the stdlib JSON HTTP API;
+* :mod:`~gene2vec_tpu.serve.eventloop` — the non-blocking HTTP/1.1
+  front end (selectors event loop, keep-alive, zero-copy writes,
+  optional SO_REUSEPORT multi-acceptor);
+* :mod:`~gene2vec_tpu.serve.server` — the JSON route layer + the
+  event-loop adapter (response-bytes cache, coalesced GETs);
 * :mod:`~gene2vec_tpu.serve.client` — the resilient caller (retries
   with deadline propagation + budgets, hedging, circuit breakers);
 * :mod:`~gene2vec_tpu.serve.fleet` — replica supervision and the
@@ -32,6 +36,10 @@ from gene2vec_tpu.serve.client import (
     RetryPolicy,
 )
 from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.eventloop import (
+    EventLoopConfig,
+    EventLoopHTTPServer,
+)
 from gene2vec_tpu.serve.fleet import FleetConfig, FleetProxy, FleetSupervisor
 from gene2vec_tpu.serve.registry import LoadedModel, ModelRegistry
 from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
@@ -40,6 +48,8 @@ __all__ = [
     "CircuitBreaker",
     "ClientResponse",
     "DeadlineExceeded",
+    "EventLoopConfig",
+    "EventLoopHTTPServer",
     "FleetConfig",
     "FleetProxy",
     "FleetSupervisor",

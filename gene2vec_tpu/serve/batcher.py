@@ -44,10 +44,11 @@ class DeadlineExceeded(RuntimeError):
 
 class _Pending:
     __slots__ = ("item", "k", "deadline", "event", "result", "error",
-                 "ctx", "t0", "wait_s", "compute_s", "batch_n")
+                 "ctx", "t0", "wait_s", "compute_s", "batch_n",
+                 "on_done", "cache_key")
 
     def __init__(self, item: Any, k: int, deadline: float,
-                 t0: float = 0.0):
+                 t0: float = 0.0, on_done=None, cache_key=None):
         self.item = item
         self.k = k
         self.deadline = deadline
@@ -62,6 +63,13 @@ class _Pending:
         self.wait_s: Optional[float] = None
         self.compute_s: Optional[float] = None
         self.batch_n: Optional[int] = None
+        # completion callback (event-loop coalescing path): invoked by
+        # the worker thread as ``on_done(result, error)`` AFTER the
+        # result/error fields settle and the event is set — so a
+        # non-blocking front end gets its answer without parking a
+        # thread on Ticket.get
+        self.on_done = on_done
+        self.cache_key = cache_key
 
 
 class LRUCache:
@@ -131,8 +139,7 @@ class Ticket:
             flight.add_hop("compute_s", self._pending.compute_s)
         if self._pending.batch_n is not None:
             flight.add_hop("batch", self._pending.batch_n)
-        if self._cache_key is not None:
-            b.cache.put(self._cache_key, self._pending.result)
+        # the worker already cached successful results (_settle)
         return self._pending.result
 
 
@@ -210,10 +217,18 @@ class MicroBatcher:
         k: int,
         cache_key: Optional[Hashable] = None,
         timeout_s: Optional[float] = None,
+        on_done: Optional[Callable[[Any, Optional[BaseException]], None]]
+        = None,
     ) -> "Ticket":
         """Enqueue one request and return a :class:`Ticket` immediately
         (so a multi-query HTTP request lands all its queries in the same
         batch window before blocking on any of them).
+
+        ``on_done(result, error)`` — when given — is invoked by the
+        worker thread once the request settles (result, per-batch
+        failure, or expired-in-queue), so non-blocking callers (the
+        event-loop front end's coalesced GETs) never park a thread on
+        :meth:`Ticket.get`.  A cache hit invokes it synchronously.
 
         Raises :class:`RejectedError` right here when the queue is full
         — backpressure is decided at admission, never deferred.
@@ -228,12 +243,15 @@ class MicroBatcher:
                     # a cached answer skips batcher+engine entirely —
                     # record the hop so the trace doesn't dead-end
                     hop_span("cache_hit", ctx.child(), dur=0.0)
+                if on_done is not None:
+                    on_done(hit, None)
                 return Ticket(self, None, None, 0.0, cached=hit)
         timeout_s = (
             self.default_timeout_s if timeout_s is None else float(timeout_s)
         )
         t0 = time.monotonic()
-        pending = _Pending(item, int(k), t0 + timeout_s, t0=t0)
+        pending = _Pending(item, int(k), t0 + timeout_s, t0=t0,
+                           on_done=on_done, cache_key=cache_key)
         with self._cv:
             if self._worker is None:
                 raise RuntimeError("MicroBatcher not started")
@@ -285,6 +303,19 @@ class MicroBatcher:
             self._gauge_depth()
             return batch
 
+    def _settle(self, p: _Pending) -> None:
+        """Publish one request's outcome: cache successful results,
+        release the waiter, fire the completion callback.  Runs on the
+        worker thread for every non-cache-hit request exactly once."""
+        if p.error is None and p.cache_key is not None:
+            self.cache.put(p.cache_key, p.result)
+        p.event.set()
+        if p.on_done is not None:
+            try:
+                p.on_done(p.result, p.error)
+            except Exception:  # a callback bug must not kill the worker
+                self._count("serve_callback_errors_total")
+
     def _run(self) -> None:
         while True:
             batch = self._gather()
@@ -300,7 +331,7 @@ class MicroBatcher:
                     # (submit() already returned DeadlineExceeded; this
                     # keeps the slot from consuming batch capacity)
                     p.error = DeadlineExceeded("expired in queue")
-                    p.event.set()
+                    self._settle(p)
                     self._count("serve_expired_in_queue_total")
                 else:
                     live.append(p)
@@ -348,9 +379,10 @@ class MicroBatcher:
                     )
                 for p, r in zip(live, results):
                     p.result = r
-                    p.event.set()
+                    self._settle(p)
             except BaseException as e:  # noqa: BLE001 — failures propagate per request
                 for p in live:
-                    p.error = e
-                    p.event.set()
+                    if not p.event.is_set():
+                        p.error = e
+                        self._settle(p)
                 self._count("serve_batch_errors_total")

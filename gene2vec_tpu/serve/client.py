@@ -65,6 +65,7 @@ from gene2vec_tpu.obs.tracecontext import TRACEPARENT_HEADER, TraceContext
 
 __all__ = [
     "BreakerState",
+    "PooledTransport",
     "CircuitBreaker",
     "ClientResponse",
     "ResilientClient",
@@ -240,7 +241,6 @@ class CircuitBreaker:
 # -- one attempt's outcome ---------------------------------------------------
 
 
-@dataclasses.dataclass
 class ClientResponse:
     """Terminal outcome of one logical request (after retries/hedging).
 
@@ -248,21 +248,65 @@ class ClientResponse:
     failure); ``error_class`` is the loadgen-facing bucket: ``ok``,
     ``http_4xx``, ``http_429``, ``http_503``, ``http_504``,
     ``transport``, or ``deadline`` (the client's own budget ran out
-    before any attempt could conclude)."""
+    before any attempt could conclude).
 
-    status: int
-    doc: Optional[dict]
-    error_class: str
-    attempts: int
-    retries: int
-    hedged: bool
-    target: Optional[str]
-    latency_s: float
-    trace_id: Optional[str] = None
+    ``raw`` is the response body bytes when an attempt concluded over
+    HTTP.  Successful bodies are **not** parsed by the client — the
+    fleet proxy forwards ``raw`` verbatim (zero-copy passthrough) —
+    and :attr:`doc` parses lazily on first access for callers that do
+    want the document (the chaos drill's answer verification)."""
+
+    __slots__ = ("status", "_doc", "raw", "error_class", "attempts",
+                 "retries", "hedged", "target", "latency_s", "trace_id",
+                 "_parsed")
+
+    def __init__(
+        self,
+        status: int,
+        doc: Optional[dict] = None,
+        error_class: str = "ok",
+        attempts: int = 0,
+        retries: int = 0,
+        hedged: bool = False,
+        target: Optional[str] = None,
+        latency_s: float = 0.0,
+        trace_id: Optional[str] = None,
+        raw: Optional[bytes] = None,
+    ):
+        self.status = status
+        self._doc = doc
+        self.raw = raw
+        self.error_class = error_class
+        self.attempts = attempts
+        self.retries = retries
+        self.hedged = hedged
+        self.target = target
+        self.latency_s = latency_s
+        self.trace_id = trace_id
+        self._parsed = doc is not None
+
+    @property
+    def doc(self) -> Optional[dict]:
+        if not self._parsed:
+            self._parsed = True
+            if self.raw:
+                try:
+                    parsed = json.loads(self.raw.decode("utf-8"))
+                    self._doc = parsed if isinstance(parsed, dict) else None
+                except (ValueError, UnicodeDecodeError):
+                    self._doc = None
+        return self._doc
 
     @property
     def ok(self) -> bool:
         return self.error_class == "ok"
+
+    def __repr__(self) -> str:  # debugging/tests
+        return (
+            f"ClientResponse(status={self.status}, "
+            f"error_class={self.error_class!r}, "
+            f"attempts={self.attempts}, target={self.target!r})"
+        )
 
 
 def _classify(status: int, doc: Optional[dict]) -> Tuple[str, bool]:
@@ -298,11 +342,14 @@ def _default_transport(
     read_timeout_s: float,
     headers: Optional[Dict[str, str]] = None,
 ) -> Tuple[int, bytes]:
-    """One HTTP exchange with SEPARATE connect and read deadlines.
-    Raises ``OSError`` (incl. ``ConnectionRefusedError``/``Reset``) or
-    ``socket.timeout`` on transport failure; HTTP errors return
-    normally as (status, payload).  ``headers`` are per-attempt extras
-    (the traceparent header)."""
+    """One single-shot HTTP exchange with SEPARATE connect and read
+    deadlines (one TCP connection per call — the pre-keep-alive
+    transport, kept for callers that want connection-per-request
+    semantics).  Raises ``OSError`` (incl.
+    ``ConnectionRefusedError``/``Reset``) or ``socket.timeout`` on
+    transport failure; HTTP errors return normally as (status,
+    payload).  ``headers`` are per-attempt extras (the traceparent
+    header)."""
     u = urlparse(base_url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port, timeout=connect_timeout_s
@@ -318,6 +365,100 @@ def _default_transport(
         return resp.status, resp.read()
     finally:
         conn.close()
+
+
+class PooledTransport:
+    """Keep-alive transport: a bounded stack of persistent
+    ``http.client`` connections per replica URL, shared by every
+    thread using one client.
+
+    Reuse rules: a connection goes back to its pool only after a fully
+    read response that did not advertise ``Connection: close``; ANY
+    transport error closes and discards the connection (never pooled
+    poisoned).  A **reused** connection that fails before yielding a
+    response gets ONE internal retry on a fresh connection — the
+    server reaping an idle keep-alive connection between requests (its
+    idle timeout, its request cap) is routine, not a replica failure,
+    and must not surface as a transport error to the retry machinery.
+    A failure on a *fresh* connection propagates: that IS a replica
+    failure and the caller's breaker needs to see it.
+    """
+
+    def __init__(self, max_per_target: int = 8):
+        self.max_per_target = max_per_target
+        self._pools: Dict[str, List[http.client.HTTPConnection]] = {}
+        self._lock = threading.Lock()
+        #: observability for the loadgen report: TCP connections dialed
+        #: and stale-reuse internal retries
+        self.connections_opened = 0
+        self.stale_retries = 0
+
+    def _get(self, base_url: str) -> Optional[http.client.HTTPConnection]:
+        with self._lock:
+            pool = self._pools.get(base_url)
+            return pool.pop() if pool else None
+
+    def _put(self, base_url: str,
+             conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            pool = self._pools.setdefault(base_url, [])
+            if len(pool) < self.max_per_target:
+                pool.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            pools, self._pools = self._pools, {}
+        for pool in pools.values():
+            for conn in pool:
+                conn.close()
+
+    def __call__(
+        self,
+        base_url: str,
+        method: str,
+        path: str,
+        body: Optional[bytes],
+        connect_timeout_s: float,
+        read_timeout_s: float,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> Tuple[int, bytes]:
+        all_headers = {"Content-Type": "application/json"} if body else {}
+        all_headers.update(headers or {})
+        last_exc: Optional[BaseException] = None
+        for attempt in (0, 1):
+            conn = self._get(base_url) if attempt == 0 else None
+            reused = conn is not None
+            if conn is None:
+                u = urlparse(base_url)
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port, timeout=connect_timeout_s
+                )
+                with self._lock:
+                    self.connections_opened += 1
+            try:
+                if conn.sock is None:
+                    conn.connect()
+                conn.sock.settimeout(read_timeout_s)
+                conn.request(method, path, body=body, headers=all_headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._put(base_url, conn)
+                return status, payload
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                last_exc = e
+                if not reused:
+                    raise
+                with self._lock:
+                    self.stale_retries += 1
+                # fall through: one fresh-connection retry
+        raise last_exc  # type: ignore[misc]  # pragma: no cover
 
 
 # -- the client --------------------------------------------------------------
@@ -342,7 +483,7 @@ class ResilientClient:
         targets: Union[Sequence[str], Callable[[], Sequence[str]]],
         policy: RetryPolicy = RetryPolicy(),
         metrics=None,
-        transport: Callable = _default_transport,
+        transport: Optional[Callable] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
@@ -350,7 +491,12 @@ class ResilientClient:
         self._targets = targets
         self.policy = policy
         self.metrics = metrics
-        self._transport = transport
+        # default: per-client keep-alive pools (PooledTransport) — one
+        # TCP dial per replica per concurrent stream, not per attempt;
+        # tests inject fake transports through this same seam
+        self._transport = (
+            transport if transport is not None else PooledTransport()
+        )
         self._clock = clock
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
@@ -451,8 +597,8 @@ class ResilientClient:
         deadline: float,
         base_ctx: Optional[TraceContext] = None,
         hedge: bool = False,
-    ) -> Tuple[str, int, Optional[dict], str, bool]:
-        """(error_class, status, doc, target, retry_safe); records
+    ) -> Tuple[str, int, Optional[dict], str, bool, Optional[bytes]]:
+        """(error_class, status, doc, target, retry_safe, raw); records
         breaker + latency.  The remaining budget is propagated INTO the
         body's ``timeout_ms`` so the server's own deadline machinery
         never works past the caller's.  Each attempt derives its OWN
@@ -465,7 +611,7 @@ class ResilientClient:
             # the breaker admitted this attempt (allow() in _pick) but no
             # I/O will happen; give any probe slot back without a verdict
             breaker.cancel()
-            return "deadline", 0, None, target, False
+            return "deadline", 0, None, target, False, None
         ctx = base_ctx.child() if base_ctx is not None else None
         headers = (
             {TRACEPARENT_HEADER: ctx.to_header()} if ctx is not None
@@ -495,11 +641,16 @@ class ResilientClient:
                 wall=t0_wall, target=target, status=0,
                 error_class="transport", hedge=hedge,
             )
-            return "transport", 0, None, target, True
-        try:
-            doc = json.loads(raw.decode("utf-8")) if raw else None
-        except (ValueError, UnicodeDecodeError):
-            doc = None
+            return "transport", 0, None, target, True, None
+        # successful bodies stay UNPARSED (ClientResponse.doc parses
+        # lazily; the fleet proxy forwards the raw bytes) — only error
+        # statuses need the document for retry-safety classification
+        doc: Optional[dict] = None
+        if not 200 <= status < 300:
+            try:
+                doc = json.loads(raw.decode("utf-8")) if raw else None
+            except (ValueError, UnicodeDecodeError):
+                doc = None
         error_class, retry_safe = _classify(status, doc)
         if error_class == "ok":
             breaker.record_success()
@@ -515,7 +666,7 @@ class ResilientClient:
             target=target, status=status, error_class=error_class,
             hedge=hedge,
         )
-        return error_class, status, doc, target, retry_safe
+        return error_class, status, doc, target, retry_safe, raw
 
     # -- the public call ---------------------------------------------------
 
@@ -558,9 +709,7 @@ class ResilientClient:
         attempts = 0
         retries = 0
         hedged = False
-        last: Tuple[str, int, Optional[dict], Optional[str], bool] = (
-            "transport", 0, None, None, True
-        )
+        last: Tuple = ("transport", 0, None, None, True, None)
 
         while attempts < self.policy.max_attempts:
             remaining = deadline - self._clock()
@@ -598,13 +747,13 @@ class ResilientClient:
                     target, method, path, body, deadline, base_ctx
                 )
             last = outcome
-            error_class, status, doc, _target, retry_safe = outcome
+            error_class, status, doc, _target, retry_safe, raw = outcome
             if error_class == "deadline":
                 break  # the budget is gone; looping would only burn a token
             if error_class == "ok" or not retry_safe:
                 return self._done(
                     error_class, status, doc, attempts, retries, hedged,
-                    outcome[3], t_start, base_ctx,
+                    outcome[3], t_start, base_ctx, raw=raw,
                 )
             if attempts >= self.policy.max_attempts:
                 break
@@ -625,12 +774,12 @@ class ResilientClient:
             if backoff > 0:
                 self._sleep(backoff)
 
-        error_class, status, doc, target, _safe = last
+        error_class, status, doc, target, _safe, raw = last
         if error_class == "deadline":
             self._count("deadline_exhausted")
         return self._done(
             error_class, status, doc, attempts, retries, hedged, target,
-            t_start, base_ctx,
+            t_start, base_ctx, raw=raw,
         )
 
     def _attempt_hedged(
@@ -643,13 +792,11 @@ class ResilientClient:
         hedge_after_s: float,
         tried: List[str],
         base_ctx: Optional[TraceContext] = None,
-    ) -> Tuple[Tuple[str, int, Optional[dict], str, bool], bool]:
+    ) -> Tuple[Tuple, bool]:
         """Primary attempt + one hedge fired at the p95 mark: whichever
         concludes first wins; a hedge is paid from the retry budget and
         targets a different replica.  Returns (outcome, hedge_fired)."""
-        results: "queue_mod.Queue[Tuple[str, int, Optional[dict], str, bool]]" = (
-            queue_mod.Queue()
-        )
+        results: "queue_mod.Queue[Tuple]" = queue_mod.Queue()
 
         def run(t: str, is_hedge: bool = False) -> None:
             results.put(self._attempt(
@@ -671,7 +818,7 @@ class ResilientClient:
             try:
                 return results.get(timeout=remaining), False
             except queue_mod.Empty:
-                return ("deadline", 0, None, target, False), False
+                return ("deadline", 0, None, target, False, None), False
         self._count("hedges")
         if hedge_target not in tried:
             tried.append(hedge_target)
@@ -684,7 +831,7 @@ class ResilientClient:
         try:
             first = results.get(timeout=remaining)
         except queue_mod.Empty:
-            return ("deadline", 0, None, target, False), True
+            return ("deadline", 0, None, target, False, None), True
         if first[0] == "ok":
             return first, True
         remaining = max(0.05, deadline - self._clock())
@@ -705,6 +852,7 @@ class ResilientClient:
         target: Optional[str],
         t_start: float,
         base_ctx: Optional[TraceContext] = None,
+        raw: Optional[bytes] = None,
     ) -> ClientResponse:
         if error_class == "breaker_open":
             error_class = "http_503"
@@ -718,4 +866,5 @@ class ResilientClient:
             target=target,
             latency_s=self._clock() - t_start,
             trace_id=base_ctx.trace_id if base_ctx is not None else None,
+            raw=raw,
         )

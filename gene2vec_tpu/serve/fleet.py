@@ -45,14 +45,12 @@ import json
 import os
 import queue as queue_mod
 import random
-import socket
 import subprocess
 import sys
 import threading
 import time
 import urllib.request
 from collections import deque
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Deque, Dict, List, Optional, Sequence
 from urllib.parse import urlparse
 
@@ -62,6 +60,15 @@ from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
 from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+from gene2vec_tpu.serve.eventloop import (
+    ConnHandle,
+    EventLoopConfig,
+    EventLoopHTTPServer,
+    HandlerPool,
+    HTTPRequest,
+    Response,
+    parse_json_body,
+)
 # the proxy labels per-route latency over the same /v1 surface the
 # replicas label (one dependency-light constant, so the allowlists
 # cannot drift and the proxy never imports the serving stack);
@@ -467,67 +474,102 @@ class FleetSupervisor:
 # -- the front-door proxy ----------------------------------------------------
 
 
-class _ProxyHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
+_LIVEZ_BODY = b'{"status": "alive"}'
+_POOL_FULL_BODY = b'{"error": "proxy handler pool saturated; shed load"}'
+_DEADLINE_BODY = (
+    b'{"error": "fleet deadline exhausted before a replica answered"}'
+)
+_PROM_CT = b"text/plain; version=0.0.4"
 
-    def setup(self) -> None:
-        # the front door is the ADVERTISED address: it needs the same
-        # slow-loris guard as the replicas (serve/server.py), or a
-        # stalling client pins proxy threads the replicas never see
-        self.timeout = self.server.proxy.read_timeout_s  # type: ignore[attr-defined]
-        super().setup()
 
-    def log_message(self, format: str, *args) -> None:
-        pass  # accounting lives in /metrics, like serve/server.py
+class _ProxyAdapter:
+    """Event-loop handler for the front door.  ``/livez`` answers
+    inline from the loop; everything else runs on a bounded worker
+    pool because forwarding blocks on replica round trips.  Successful
+    replica responses pass through as **raw bytes** (the resilient
+    client no longer parses 2xx bodies), so the proxy adds routing +
+    resilience, not a JSON decode/encode cycle per request."""
 
-    def finish(self) -> None:
-        try:
-            super().finish()
-        except OSError:
-            pass
+    def __init__(self, proxy: "FleetProxy", workers: int,
+                 max_queue: int = 2048):
+        self.proxy = proxy
+        self.pool = HandlerPool(workers, max_queue, name="fleet-proxy")
 
-    def _read_body(self, length: int) -> bytes:
-        """Bounded body read: per-recv socket timeout + a wall deadline
-        (the serve/server.py pattern — read1 so a one-byte drip cannot
-        dodge the deadline inside the buffer)."""
-        deadline = time.monotonic() + self.timeout
-        chunks = []
-        got = 0
-        try:
-            while got < length:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise socket.timeout("body read deadline exceeded")
-                self.connection.settimeout(min(remaining, self.timeout))
-                chunk = self.rfile.read1(min(65536, length - got))
-                if not chunk:
-                    break
-                chunks.append(chunk)
-                got += len(chunk)
-        finally:
-            try:
-                self.connection.settimeout(self.timeout)
-            except OSError:
-                pass
-        return b"".join(chunks)
+    def close(self) -> None:
+        self.pool.stop()
 
-    def _reply_json(self, status: int, doc: dict) -> None:
-        payload = json.dumps(doc).encode("utf-8")
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
+    def account_protocol_error(self, status: int) -> None:
+        """Loop-generated 400/408 responses (malformed request line,
+        slow-loris reap) keep the proxy's error counters."""
+        self.proxy.metrics.counter(f"fleet_http_{status}_total").inc()
 
-    def _forward(self, method: str, body: Optional[dict]) -> None:
-        proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
-        route = urlparse(self.path).path.rstrip("/") or "/"
+    def __call__(self, req: HTTPRequest,
+                 peer: ConnHandle) -> Optional[Response]:
+        if req.method == "GET" and req.target in ("/livez", "/livez/"):
+            return Response(200, _LIVEZ_BODY)
+        if not self.pool.submit(lambda: self._run(req, peer)):
+            self.proxy.metrics.counter("fleet_http_429_total").inc()
+            return Response(429, _POOL_FULL_BODY)
+        return None
+
+    # -- worker-pool side --------------------------------------------------
+
+    def _run(self, req: HTTPRequest, peer: ConnHandle) -> None:
+        proxy = self.proxy
+        route = urlparse(req.target).path.rstrip("/") or "/"
+        if req.method == "GET" and route == "/healthz":
+            status, doc = proxy.healthz()
+            peer.respond(Response(
+                status, json.dumps(doc).encode("utf-8")
+            ))
+            return
+        if req.method == "GET" and route == "/metrics":
+            peer.respond(Response(
+                200, proxy.metrics.prometheus_text().encode("utf-8"),
+                _PROM_CT,
+            ))
+            return
+        if req.method == "GET" and route == "/metrics/fleet":
+            # the merged fleet-level SLO view (docs/OBSERVABILITY.md):
+            # availability, per-route p50/p99, total queue depth,
+            # rejection rate — the autoscaling inputs, one scrape
+            if proxy.aggregator is None:
+                peer.respond(Response(
+                    404,
+                    b'{"error": "fleet aggregation disabled '
+                    b'(--scrape-interval 0)"}',
+                ))
+                return
+            peer.respond(Response(
+                200, proxy.aggregator.fleet_text().encode("utf-8"),
+                _PROM_CT,
+            ))
+            return
+        if not route.startswith("/v1/"):
+            peer.respond(Response(
+                404,
+                json.dumps(
+                    {"error": f"no route {req.method} {route}"}
+                ).encode("utf-8"),
+            ))
+            return
+        body: Optional[dict] = None
+        if req.method == "POST":
+            body, err = parse_json_body(req)
+            if err is not None:
+                peer.respond(err)
+                return
+        self._forward(req, peer, route, body)
+
+    def _forward(self, req: HTTPRequest, peer: ConnHandle, route: str,
+                 body: Optional[dict]) -> None:
+        proxy = self.proxy
         # the proxy is the fleet's trace ingress: honor a propagated
         # context (child it), else maybe start a root; the resilient
         # client below picks the installed context up as its base, so
         # every replica attempt becomes a child span of this hop
         incoming = TraceContext.from_header(
-            self.headers.get("traceparent")
+            req.headers.get("traceparent")
         )
         ctx = incoming.child() if incoming is not None else (
             proxy.sampler.maybe_new_trace()
@@ -537,7 +579,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         with tracecontext.use(ctx):
             with ambient_span("proxy_request", route=route) as span:
                 resp = proxy.client.request(
-                    self.path, body=body, method=method,
+                    req.target, body=body, method=req.method,
                     timeout_s=(
                         float(body["timeout_ms"]) / 1000.0
                         if body
@@ -548,90 +590,27 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                     ),
                 )
                 span["attempts"] = resp.attempts
-        if resp.doc is not None:
-            status, doc = resp.status, resp.doc
+        if resp.ok and resp.raw is not None:
+            # zero-copy passthrough: the replica's encoded body goes to
+            # the client verbatim — no parse, no re-serialization
+            status, payload = resp.status, resp.raw
+        elif resp.doc is not None:
+            status, payload = resp.status, (
+                resp.raw if resp.raw else
+                json.dumps(resp.doc).encode("utf-8")
+            )
         elif resp.error_class == "deadline":
-            status, doc = 504, {
-                "error": "fleet deadline exhausted before a replica "
-                         "answered"
-            }
+            status, payload = 504, _DEADLINE_BODY
         else:
-            status, doc = 502, {
-                "error": f"no replica answered ({resp.error_class})"
-            }
-        # account BEFORE the reply write can raise: a client gone mid-
+            status, payload = 502, json.dumps(
+                {"error": f"no replica answered ({resp.error_class})"}
+            ).encode("utf-8")
+        # account BEFORE the reply write can fail: a client gone mid-
         # reply (broken pipe during an incident) must still count in
         # the availability view and the flight ring
         proxy.account(route, status, time.monotonic() - t0,
                       ctx.trace_id if ctx is not None else None)
-        self._reply_json(status, doc)
-
-    def do_GET(self) -> None:  # noqa: N802
-        proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
-        route = urlparse(self.path).path.rstrip("/") or "/"
-        if route == "/livez":
-            self._reply_json(200, {"status": "alive"})
-            return
-        if route == "/healthz":
-            status, doc = proxy.healthz()
-            self._reply_json(status, doc)
-            return
-        if route == "/metrics":
-            payload = proxy.metrics.prometheus_text().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
-        if route == "/metrics/fleet":
-            # the merged fleet-level SLO view (docs/OBSERVABILITY.md):
-            # availability, per-route p50/p99, total queue depth,
-            # rejection rate — the autoscaling inputs, one scrape
-            if proxy.aggregator is None:
-                self._reply_json(
-                    404, {"error": "fleet aggregation disabled "
-                                   "(--scrape-interval 0)"}
-                )
-                return
-            payload = proxy.aggregator.fleet_text().encode("utf-8")
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
-        if route.startswith("/v1/"):
-            self._forward("GET", None)
-            return
-        self._reply_json(404, {"error": f"no route GET {route}"})
-
-    def do_POST(self) -> None:  # noqa: N802
-        route = urlparse(self.path).path.rstrip("/") or "/"
-        if not route.startswith("/v1/"):
-            self._reply_json(404, {"error": f"no route POST {route}"})
-            return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            raw = self._read_body(length) if length else b"{}"
-            body = json.loads(raw.decode("utf-8")) if raw else {}
-            if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
-        except socket.timeout:
-            proxy: "FleetProxy" = self.server.proxy  # type: ignore[attr-defined]
-            proxy.metrics.counter("fleet_http_408_total").inc()
-            self.close_connection = True
-            try:
-                self._reply_json(
-                    408, {"error": "request body read timed out"}
-                )
-            except OSError:
-                pass
-            return
-        except (ValueError, UnicodeDecodeError) as e:
-            self._reply_json(400, {"error": f"bad JSON body: {e}"})
-            return
-        self._forward("POST", body)
+        peer.respond(Response(status, payload))
 
 
 class FleetProxy:
@@ -649,10 +628,16 @@ class FleetProxy:
         scrape_interval_s: float = 2.0,
         telemetry_csv: Optional[str] = None,
         flight_dir: Optional[str] = None,
+        proxy_workers: int = 16,
+        idle_timeout_s: float = 30.0,
+        acceptors: int = 1,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
         self.read_timeout_s = read_timeout_s
+        self.proxy_workers = proxy_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.acceptors = acceptors
         self.client = ResilientClient(
             supervisor.healthy_urls,
             policy=policy if policy is not None else RetryPolicy(
@@ -676,7 +661,7 @@ class FleetProxy:
         )
         self.flight = FlightRecorder()
         self.flight_dir = flight_dir
-        self._server: Optional[ThreadingHTTPServer] = None
+        self._server: Optional[EventLoopHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def account(self, route: str, status: int, dur_s: float,
@@ -709,10 +694,20 @@ class FleetProxy:
         return (200 if up else 503), doc
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
-        """Bind and serve on a daemon thread; returns the base URL."""
-        server = ThreadingHTTPServer((host, port), _ProxyHandler)
-        server.daemon_threads = True
-        server.proxy = self  # type: ignore[attr-defined]
+        """Bind the event-loop front end and serve on a daemon thread;
+        returns the base URL."""
+        adapter = _ProxyAdapter(self, workers=self.proxy_workers)
+        server = EventLoopHTTPServer(
+            adapter,
+            host,
+            port,
+            config=EventLoopConfig(
+                read_timeout_s=self.read_timeout_s,
+                idle_timeout_s=self.idle_timeout_s,
+                acceptors=self.acceptors,
+            ),
+            on_protocol_error=adapter.account_protocol_error,
+        )
         self._server = server
         self._thread = threading.Thread(
             target=server.serve_forever, name="fleet-proxy", daemon=True

@@ -19,17 +19,31 @@ Endpoints (all JSON; schema in docs/SERVING.md):
 Status mapping: queue-full backpressure -> **429**, per-request deadline
 -> **504**, unknown gene / malformed body -> **400**, no model loaded ->
 **503**, stalled request body (slow loris) -> **408** + connection
-close.  The handler layer is a thin stdlib ``ThreadingHTTPServer``
-shell; every route is a method on :class:`ServeApp`, which tests drive
-directly and through ephemeral-port HTTP.
+close.  The front end is the non-blocking event loop in
+``serve/eventloop.py`` (keep-alive, read deadlines, optional
+SO_REUSEPORT multi-acceptor); every route is a method on
+:class:`ServeApp`, which tests drive directly and through
+ephemeral-port HTTP.
 
-Every connection runs under a read deadline (``ServeConfig.
-read_timeout_s``): the socket timeout bounds each recv, and the body
-read additionally runs under a per-request wall deadline, so a client
-dripping one byte per poll cannot pin a handler thread past the
-deadline either.  Fault injection (``resilience/faults.py``) hooks the
-handler behind an explicit opt-in (``--faults`` /
-``GENE2VEC_TPU_FAULTS``) and is entirely absent otherwise.
+The hot read path — ``GET /v1/similar?gene=...&k=...`` with no
+traceparent and no fault injection — is served from the event loop
+itself: a bounded LRU of **pre-serialized response bodies** keyed by
+``(model version, gene, k)`` answers repeats with a single scatter-
+gather write of reused bytes (no JSON assembly, no handler thread),
+and concurrent identical misses **coalesce** onto one batcher ticket
+(one engine slot per hot gene regardless of fan-in).  Everything else
+— POSTs, traced requests, fault-injected replicas, error shapes —
+runs the full :meth:`ServeApp.handle` pipeline on a bounded worker
+pool with semantics identical to the old threaded front end.
+
+Every connection runs under the event loop's read deadline
+(``ServeConfig.read_timeout_s``): once a request's first byte arrives
+the whole request must arrive within the window or the loop answers
+408 and closes, so a client dripping one byte per poll cannot pin
+anything past the deadline.  Fault injection
+(``resilience/faults.py``) hooks the dispatch behind an explicit
+opt-in (``--faults`` / ``GENE2VEC_TPU_FAULTS``) and is entirely absent
+otherwise.
 
 Each request runs under an obs span (``serve_request``), batches under
 ``serve_batch``/``serve_compute`` (batcher.py) — with a
@@ -42,12 +56,10 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import socket
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote_plus, urlparse
 
 import numpy as np
 
@@ -60,10 +72,20 @@ from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
 from gene2vec_tpu.serve.routes import V1_ROUTES
 from gene2vec_tpu.serve.batcher import (
     DeadlineExceeded,
+    LRUCache,
     MicroBatcher,
     RejectedError,
 )
 from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.eventloop import (
+    ConnHandle,
+    EventLoopConfig,
+    EventLoopHTTPServer,
+    HandlerPool,
+    HTTPRequest,
+    Response,
+    parse_json_body,
+)
 from gene2vec_tpu.serve.interaction import InteractionScorer
 from gene2vec_tpu.serve.registry import ModelRegistry
 
@@ -87,14 +109,26 @@ class ServeConfig:
     timeout_ms: float = 2000.0
     max_k: int = 256
     max_queries_per_request: int = 64
-    # per-connection read deadline: bounds both each socket recv and the
-    # total wall time spent reading one request body (slow-loris guard;
-    # expiry -> 408 + close)
+    # per-request read deadline: once the first byte of a request has
+    # arrived the WHOLE request must arrive within this window
+    # (slow-loris guard; expiry -> 408 + close)
     read_timeout_s: float = 10.0
     # root-trace sampling rate for requests WITHOUT a traceparent
     # header (0 = trace only when the caller propagates a sampled
     # context; sampled callers are always honored)
     trace_sample: float = 0.0
+    # -- event-loop front end (serve/eventloop.py) ------------------------
+    # keep-alive connections idle longer than this are closed
+    idle_timeout_s: float = 30.0
+    # requests served per connection before the front end closes it
+    # (0 = unbounded); bounds per-connection state lifetime
+    max_conn_requests: int = 0
+    # acceptor event loops; > 1 enables SO_REUSEPORT multi-acceptor
+    acceptors: int = 1
+    # bounded worker pool for the full-dispatch path (POSTs, traced or
+    # fault-injected requests); saturation answers 429
+    http_workers: int = 8
+    http_queue: int = 512
 
 
 #: routes whose latency gets its own labeled histogram series; anything
@@ -166,6 +200,15 @@ class ServeApp:
         # 5xx burst dumps from the handler path below
         self.flight = FlightRecorder()
         self.flight_dir: Optional[str] = None
+        # -- event-loop hot path state ---------------------------------
+        # pre-serialized response bodies keyed (model version, gene, k):
+        # a hot GET is answered with reused bytes, no JSON assembly; a
+        # hot swap invalidates naturally (new version => new keys)
+        self.response_cache = LRUCache(config.cache_size)
+        # coalescing table for concurrent identical GETs: key -> list of
+        # (peer, deadline, t0) waiting on ONE batcher ticket
+        self._coalesce: Dict[tuple, list] = {}
+        self._coalesce_lock = threading.Lock()
 
     def start(self) -> "ServeApp":
         self.batcher.start()
@@ -544,173 +587,312 @@ class ServeApp:
                     pass  # a full disk must not take the handler down
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # one keep-alive friendly protocol version; loadgen reuses sockets
-    protocol_version = "HTTP/1.1"
-    app: ServeApp  # set by make_server on the server class
+#: pre-encoded front-end bodies (the event loop never runs json.dumps)
+_POOL_FULL_BODY = b'{"error": "handler pool saturated; shed load"}'
+_DEADLINE_BODY = b'{"error": "request deadline exceeded"}'
 
-    def setup(self) -> None:
-        # the socket timeout is the slow-loris guard's first layer: it
-        # bounds every recv (request line, headers, idle keep-alive) so
-        # a silent client can't hold a handler thread past the deadline
-        self.timeout = self.server.app.config.read_timeout_s  # type: ignore[attr-defined]
-        super().setup()
 
-    def finish(self) -> None:
-        # a connection torn down mid-reply (client gone, injected RST)
-        # must not traceback through socketserver's handle_error
-        try:
-            super().finish()
-        except OSError:
-            pass
+class ServeAdapter:
+    """The event-loop handler for one :class:`ServeApp`.
 
-    def log_message(self, format: str, *args) -> None:
-        # default writes per-request lines to stderr; serve volume makes
-        # that noise — request accounting lives in /metrics instead
-        pass
+    Called on the loop thread for every parsed request.  The hot read
+    path (untraced, fault-free ``GET /v1/similar``) is answered inline
+    from the response-bytes cache or coalesced onto one batcher ticket;
+    everything else defers to the bounded worker pool, which runs the
+    unchanged :meth:`ServeApp.handle` pipeline (spans, flight recorder,
+    status mapping, fault injection)."""
 
-    def _reply(self, status: int, payload: bytes, content_type: str) -> None:
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
-
-    def _reply_json(self, status: int, doc: dict) -> None:
-        self._reply(
-            status,
-            json.dumps(doc).encode("utf-8"),
-            "application/json",
+    def __init__(self, app: ServeApp):
+        self.app = app
+        self.pool = HandlerPool(
+            app.config.http_workers, app.config.http_queue,
+            name="serve-http",
+        )
+        self._queue_full_body = (
+            b'{"error": "queue full (%d waiting requests)"}'
+            % app.config.max_queue
         )
 
-    def _inject_fault(self, route: str) -> bool:
-        """Apply the configured fault decision for this request, if any.
-        Returns True when the fault terminated the request (a reply was
-        substituted, the connection was reset, or the response was
-        blackholed) — the caller must not dispatch."""
-        app = self.server.app  # type: ignore[attr-defined]
-        if app.faults is None:
-            return False
-        decision = app.faults.decide(route)
+    def close(self) -> None:
+        self.pool.stop()
+
+    # -- accounting (hot path only; ServeApp.handle does its own) ---------
+
+    def _account(self, route: str, status: int, dur: float) -> None:
+        app = self.app
+        app.metrics.histogram("serve_handle_seconds").observe(dur)
+        app.metrics.histogram(
+            "serve_route_seconds",
+            buckets=_ROUTE_BUCKETS,
+            labels={
+                "route": route if route in _KNOWN_ROUTES else "other"
+            },
+        ).observe(dur)
+        if status >= 400:
+            app.metrics.counter(f"serve_http_{status}_total").inc()
+        burst = app.flight.record(route, status, dur)
+        if burst and app.flight_dir:
+            try:
+                app.flight.dump(app.flight_dir, "5xx-burst")
+            except OSError:
+                pass
+
+    def account_protocol_error(self, status: int) -> None:
+        """Loop-generated 400/408 responses (malformed request line,
+        slow-loris reap) keep their counters."""
+        self.app.metrics.counter(f"serve_http_{status}_total").inc()
+
+    # -- entry point (loop thread) ----------------------------------------
+
+    def __call__(self, req: HTTPRequest,
+                 peer: ConnHandle) -> Optional[Response]:
+        app = self.app
+        if (
+            req.method == "GET"
+            and app.faults is None
+            and app.sampler is None
+            and "traceparent" not in req.headers
+            and req.target.startswith("/v1/similar?")
+        ):
+            out = self._similar_get_fast(req, peer)
+            if out is not _SLOW_PATH:
+                return out
+        if not self.pool.submit(lambda: self._run_full(req, peer)):
+            self.app.metrics.counter("serve_http_429_total").inc()
+            return Response(429, _POOL_FULL_BODY)
+        return None
+
+    # -- the full pipeline (worker pool thread) ----------------------------
+
+    def _run_full(self, req: HTTPRequest, peer: ConnHandle) -> None:
+        app = self.app
+        route = urlparse(req.target).path.rstrip("/") or "/"
+        if app.faults is not None and self._apply_fault(req, peer, route):
+            return
+        if req.method == "GET" and route == "/metrics":
+            peer.respond(Response(
+                200,
+                app.metrics.prometheus_text().encode("utf-8"),
+                b"text/plain; version=0.0.4",
+            ))
+            return
+        if req.method not in ("GET", "POST"):
+            peer.respond(Response(
+                404,
+                json.dumps(
+                    {"error": f"no route {req.method} {route}"}
+                ).encode("utf-8"),
+            ))
+            return
+        body: Optional[dict] = None
+        if req.method == "POST":
+            body, err = parse_json_body(req)
+            if err is not None:
+                peer.respond(err)
+                return
+        status, doc = app.handle(
+            req.method, req.target, body,
+            traceparent=req.headers.get("traceparent"),
+        )
+        peer.respond(Response(
+            status, json.dumps(doc).encode("utf-8")
+        ))
+
+    def _apply_fault(self, req: HTTPRequest, peer: ConnHandle,
+                     route: str) -> bool:
+        """Port of the threaded front end's fault hook: True when the
+        fault terminated the request.  Runs on a pool thread, so the
+        delay/blackhole sleeps never touch the event loop."""
+        decision = self.app.faults.decide(route)
         if decision is None:
             return False
         if decision.delay_s:
             time.sleep(decision.delay_s)
         if decision.kind is None:
             return False  # pure added latency; proceed normally
-        self.close_connection = True
         if decision.kind == "error":
-            self._reply_json(
+            peer.respond(Response(
                 int(decision.arg),
-                {"error": "injected fault (resilience drill)"},
-            )
+                b'{"error": "injected fault (resilience drill)"}',
+                close=True,
+            ))
         elif decision.kind == "reset":
-            from gene2vec_tpu.resilience.faults import apply_reset
-
-            apply_reset(self.connection)
+            peer.reset()
         elif decision.kind == "blackhole":
-            # hold the socket open, answer nothing: the client's read
-            # timeout is the only way out (bounded so the drill's own
-            # handler threads drain)
+            # hold the socket open, answer nothing; the client's read
+            # timeout is the only way out (bounded so pool threads
+            # drain)
             time.sleep(decision.arg)
+            peer.close()
         return True
 
-    def _read_body(self, length: int) -> bytes:
-        """Read exactly ``length`` body bytes under BOTH timeout layers:
-        the per-recv socket timeout (already armed in :meth:`setup`) and
-        a wall deadline of ``read_timeout_s`` for the whole body — a
-        client dripping one byte per recv window defeats the former but
-        not the latter."""
-        deadline = time.monotonic() + self.server.app.config.read_timeout_s  # type: ignore[attr-defined]
-        chunks: List[bytes] = []
-        got = 0
+    # -- the hot read path (loop thread; must never block) -----------------
+
+    def _similar_get_fast(self, req: HTTPRequest, peer: ConnHandle):
+        """``GET /v1/similar?gene=...&k=...`` without the full pipeline:
+        response-bytes cache hit -> reused bytes; miss -> coalesce onto
+        one batcher ticket.  Returns ``_SLOW_PATH`` for anything the
+        fast path cannot answer with identical semantics (unknown
+        params, bad k, unknown gene, no model) so the full pipeline
+        produces its exact error shapes."""
+        app = self.app
+        gene: Optional[str] = None
+        k = 10
         try:
-            while got < length:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise socket.timeout(
-                        "request body read deadline exceeded"
+            for part in req.target[len("/v1/similar?"):].split("&"):
+                name, sep, value = part.partition("=")
+                if not sep:
+                    return _SLOW_PATH
+                if name == "gene":
+                    gene = (
+                        unquote_plus(value)
+                        if "%" in value or "+" in value else value
                     )
-                self.connection.settimeout(min(remaining, self.timeout))
-                # read1 = at most ONE underlying recv: a client dripping
-                # single bytes returns here every drip, so the
-                # wall-deadline check above actually runs (plain read(n)
-                # loops inside the buffer until n bytes arrive and each
-                # drip resets its recv window — the deadline would never
-                # be consulted)
-                chunk = self.rfile.read1(min(65536, length - got))
-                if not chunk:
-                    break  # client closed early; json parsing reports it
-                chunks.append(chunk)
-                got += len(chunk)
-        finally:
-            # keep-alive: the NEXT request on this connection gets the
-            # full per-recv window back, not this body's leftover slice
-            try:
-                self.connection.settimeout(self.timeout)
-            except OSError:
-                pass  # connection already torn down mid-read
-        return b"".join(chunks)
+                elif name == "k":
+                    k = int(value)
+                else:
+                    return _SLOW_PATH
+        except ValueError:
+            return _SLOW_PATH
+        if gene is None or not 1 <= k <= app.config.max_k:
+            return _SLOW_PATH
+        registry = app.registry
+        if not registry.loaded:
+            return _SLOW_PATH  # 503 with the registry's own message
+        model = registry.model
+        t0 = time.monotonic()
+        key = (model.version, gene, k)
+        body = app.response_cache.get(key)
+        if body is not None:
+            app.metrics.counter("serve_response_cache_hits_total").inc()
+            self._account("/v1/similar", 200, time.monotonic() - t0)
+            return Response(200, body)
+        if gene not in model.index:
+            return _SLOW_PATH  # 400 with the canonical unknown-gene text
+        deadline = t0 + app.config.timeout_ms / 1000.0
+        with app._coalesce_lock:
+            waiters = app._coalesce.get(key)
+            if waiters is not None:
+                # someone is already computing this exact answer: join
+                # their ticket — a hot gene costs ONE engine slot no
+                # matter the fan-in
+                waiters.append((peer, deadline, t0))
+                app.metrics.counter("serve_coalesced_total").inc()
+                return None
+            app._coalesce[key] = [(peer, deadline, t0)]
+        # submit_async invokes on_done SYNCHRONOUSLY on a batcher-LRU
+        # cache hit — that would run the response encode on the loop
+        # thread (the exact blocking the event-loop contract forbids),
+        # so a completion firing before submit_async returns is bounced
+        # onto the worker pool instead
+        in_submit = [True]
 
-    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
-        app = self.server.app  # type: ignore[attr-defined]
-        route = urlparse(self.path).path.rstrip("/") or "/"
-        if self._inject_fault(route):
-            return
-        if route == "/metrics":
-            self._reply(
-                200,
-                app.metrics.prometheus_text().encode("utf-8"),
-                "text/plain; version=0.0.4",
-            )
-            return
-        status, doc = app.handle(
-            "GET", self.path, None,
-            traceparent=self.headers.get("traceparent"),
-        )
-        self._reply_json(status, doc)
+        def done(result, error):
+            if in_submit[0]:
+                if not self.pool.submit(
+                    lambda: self._finish_similar_get(
+                        key, model, gene, result, error
+                    )
+                ):
+                    self._fail_coalesced(
+                        key, 429, _POOL_FULL_BODY
+                    )
+                return
+            self._finish_similar_get(key, model, gene, result, error)
 
-    def do_POST(self) -> None:  # noqa: N802
-        app = self.server.app  # type: ignore[attr-defined]
-        if self._inject_fault(urlparse(self.path).path.rstrip("/") or "/"):
-            return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            raw = self._read_body(length) if length else b"{}"
-            body = json.loads(raw.decode("utf-8")) if raw else {}
-            if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
-        except socket.timeout:
-            # slow loris: the client stalled mid-body.  408, then close —
-            # the handler thread is unpinned and the socket reaped.
-            app.metrics.counter("serve_http_408_total").inc()
-            self.close_connection = True
-            try:
-                self._reply_json(
-                    408, {"error": "request body read timed out"}
-                )
-            except OSError:
-                pass  # client is gone too; nothing to tell it
-            return
-        except (ValueError, UnicodeDecodeError) as e:
-            self._reply_json(400, {"error": f"bad JSON body: {e}"})
-            return
-        status, doc = app.handle(
-            "POST", self.path, body,
-            traceparent=self.headers.get("traceparent"),
-        )
-        self._reply_json(status, doc)
+            app.batcher.submit_async(
+                {"gene": gene, "k": k}, k,
+                cache_key=(model.version, "similar", gene, k),
+                timeout_s=app.config.timeout_ms / 1000.0,
+                on_done=done,
+            )
+        except (RejectedError, RuntimeError):
+            # queue full (or batcher not started): fail everyone waiting
+            # on this key with explicit backpressure (_account owns the
+            # 429 counter — one increment per rejected request)
+            self._fail_coalesced(key, 429, self._queue_full_body)
+        in_submit[0] = False
+        return None
+
+    def _fail_coalesced(self, key, status: int, body: bytes) -> None:
+        """Fail every waiter coalesced on ``key`` with one pre-encoded
+        error body (thread-safe)."""
+        with self.app._coalesce_lock:
+            waiters = self.app._coalesce.pop(key, [])
+        now = time.monotonic()
+        for w_peer, _dl, w_t0 in waiters:
+            w_peer.respond(Response(status, body))
+            self._account("/v1/similar", status, now - w_t0)
+
+    def _finish_similar_get(self, key, model, gene: str,
+                            result, error) -> None:
+        """Batcher completion (worker thread): build + cache the
+        response bytes ONCE, then fan out to every coalesced waiter."""
+        app = self.app
+        with app._coalesce_lock:
+            waiters = app._coalesce.pop(key, [])
+        now = time.monotonic()
+        status = 200
+        if error is not None:
+            if isinstance(error, DeadlineExceeded):
+                status, body = 504, json.dumps(
+                    {"error": str(error)}
+                ).encode("utf-8")
+            else:
+                status, body = 500, json.dumps(
+                    {"error": f"internal error: {error!r}"}
+                ).encode("utf-8")
+        elif isinstance(result, dict) and "error" in result:
+            status, body = 400, json.dumps(
+                {"error": result["error"]}
+            ).encode("utf-8")
+        else:
+            doc = {
+                "model": {
+                    "dim": model.dim, "iteration": model.iteration,
+                },
+                "results": [
+                    {"query": gene, "neighbors": result["neighbors"]}
+                ],
+            }
+            body = json.dumps(doc).encode("utf-8")
+            app.response_cache.put(key, body)
+        for peer, w_deadline, w_t0 in waiters:
+            if status == 200 and now > w_deadline:
+                # this waiter's own deadline passed mid-compute: the
+                # batcher contract says it gets a 504, not a late answer
+                app.metrics.counter("serve_deadline_expired_total").inc()
+                peer.respond(Response(504, _DEADLINE_BODY))
+                self._account("/v1/similar", 504, now - w_t0)
+            else:
+                peer.respond(Response(status, body))
+                self._account("/v1/similar", status, now - w_t0)
+
+
+#: sentinel: the fast path punts this request to the full pipeline
+_SLOW_PATH = object()
 
 
 def make_server(
     app: ServeApp, host: str = "127.0.0.1", port: int = 0
-) -> ThreadingHTTPServer:
-    """A ``ThreadingHTTPServer`` bound to (host, port) — port 0 picks an
-    ephemeral one (``server.server_address[1]`` has it).  The caller owns
-    the serve loop (``serve_forever`` on a thread for tests, blocking in
-    cli/serve.py) and shutdown ordering: ``server.shutdown()`` then
-    ``app.stop()``."""
-    server = ThreadingHTTPServer((host, port), _Handler)
-    server.daemon_threads = True
-    server.app = app  # type: ignore[attr-defined]
-    return server
+) -> EventLoopHTTPServer:
+    """The event-loop front end bound to (host, port) — port 0 picks an
+    ephemeral one (``server.server_address[1]`` has it).  The caller
+    owns the serve loop (``serve_forever`` on a thread for tests,
+    blocking in cli/serve.py) and shutdown ordering:
+    ``server.shutdown()`` then ``app.stop()``."""
+    adapter = ServeAdapter(app)
+    cfg = app.config
+    return EventLoopHTTPServer(
+        adapter,
+        host,
+        port,
+        config=EventLoopConfig(
+            read_timeout_s=cfg.read_timeout_s,
+            idle_timeout_s=cfg.idle_timeout_s,
+            max_conn_requests=cfg.max_conn_requests,
+            acceptors=cfg.acceptors,
+        ),
+        on_protocol_error=adapter.account_protocol_error,
+    )

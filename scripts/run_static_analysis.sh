@@ -134,5 +134,44 @@ if [ "$CHAOS" = "1" ]; then
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT)" >&2
+  if [ "$rc" -ne 0 ]; then
+    exit "$rc"
+  fi
+
+  # Serve capacity smoke: the event-loop front end must sustain a
+  # REDUCED rps level (CI hosts are noisy; the full 600+ rps gate runs
+  # against the committed BENCH_SERVE record via cli.analyze above).
+  # Same recipe shape as the committed bench — open-loop GET, keep-
+  # alive, capacity verdict at p99 <= 50 ms — just a smaller level and
+  # window, asserted directly by the loadgen's exit code.
+  echo "== serve capacity smoke (event-loop front end, reduced rps) ==" >&2
+  SMOKE_EXPORT="${CAPACITY_SMOKE_EXPORT:-/tmp/capacity_smoke_export}"
+  SMOKE_OUT="${CAPACITY_SMOKE_OUT:-/tmp/capacity_smoke.json}"
+  SMOKE_RPS="${CAPACITY_SMOKE_RPS:-120}"
+  JAX_PLATFORMS=cpu python - "$SMOKE_EXPORT" <<'EOF'
+import os, sys
+import numpy as np
+import jax.numpy as jnp
+from gene2vec_tpu.io.checkpoint import save_iteration
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns.model import SGNSParams
+d = sys.argv[1]
+os.makedirs(d, exist_ok=True)
+V, D = 512, 16
+rng = np.random.RandomState(0)
+save_iteration(
+    d, D, 1,
+    SGNSParams(emb=jnp.asarray(rng.randn(V, D).astype(np.float32)),
+               ctx=jnp.asarray(np.zeros((V, D), np.float32))),
+    Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1)),
+)
+print(f"capacity smoke export ready: {d}", file=sys.stderr)
+EOF
+  JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \
+    --spawn "$SMOKE_EXPORT" --method get --mode open \
+    --levels "$SMOKE_RPS" --duration 3 --num-genes 64 \
+    --assert-capacity "$SMOKE_RPS" \
+    --output "$SMOKE_OUT" > /dev/null || rc=$?
+  echo "capacity smoke: exit $rc -> $SMOKE_OUT" >&2
 fi
 exit "$rc"

@@ -1,57 +1,83 @@
 #!/usr/bin/env python
 """Load generator for the serve subsystem: latency/throughput/rejection
-curves vs offered load.
+curves vs offered load, with keep-alive connection reuse.
 
 ::
 
     # against a running server
     python scripts/serve_loadgen.py --url http://127.0.0.1:8000 \
         --mode open --levels 50,200,800 --duration 5 \
-        --output BENCH_SERVE_r06.json
+        --output BENCH_SERVE_r11.json
 
     # spawn `python -m gene2vec_tpu.cli.serve` on an export dir first
     python scripts/serve_loadgen.py --spawn exports/ --levels 50,200,800
 
+    # + a 3-replica fleet phase through the front-door proxy
+    python scripts/serve_loadgen.py --spawn exports/ --fleet 3 \
+        --fleet-levels 500,1000 --verify
+
 Two loops:
 
 * **open** — ``--levels`` are offered request rates (rps); arrivals are
-  paced on a fixed schedule regardless of completions, so queue growth /
-  backpressure at overload is visible (429s count into
-  ``rejection_rate``, they never stall the clock);
+  paced on a fixed schedule regardless of completions and handed to a
+  pool of sender workers (``--open-workers``), each holding ONE
+  persistent keep-alive connection.  Latency is measured from the
+  *scheduled* arrival time, so local queueing under overload counts
+  against the server exactly like remote queueing does;
 * **closed** — ``--levels`` are concurrency (N workers firing
-  back-to-back), the classic saturation-throughput measurement.
+  back-to-back on persistent connections), the classic saturation-
+  throughput measurement.
+
+Connection reuse is the point: the pre-keep-alive loadgen paid a TCP
+handshake per request, so the bench measured connection setup, not the
+server (BENCH_SERVE_r06's 150-rps knee was substantially the
+front-end's thread-per-connection cost — see docs/SERVING.md).  Every
+level now reports ``connections_opened`` next to its attempt counts so
+a reuse regression is visible in the record.
 
 Per level: p50/p99/mean latency over successful requests, achieved
-throughput, and a full **error-class breakdown** — 429 (backpressure)
-vs 503 (not ready) vs 504 (deadline) vs transport (connect/read
-failure) vs other HTTP — so an availability claim is auditable down to
-*why* requests failed.  With ``--resilient`` every request goes through
+throughput, availability, and a full **error-class breakdown** — 429
+(backpressure) vs 503 (not ready) vs 504 (deadline) vs transport vs
+other HTTP.  ``--method get`` exercises the event-loop front end's hot
+read path (``GET /v1/similar?gene=...`` — response-bytes cache +
+request coalescing); the default ``post`` exercises the full dispatch
+pipeline.  ``--verify`` fetches a reference answer per query gene
+before each phase and checks every 200 response against it, counting
+``wrong_answers`` and ``mixed_iteration_answers`` (the fleet-phase
+integrity gate).  With ``--resilient`` every request goes through
 :class:`gene2vec_tpu.serve.client.ResilientClient` (retries, breakers,
-optional ``--hedge``) and each level additionally reports retry/hedge
-counts and the attempt amplification factor.  The JSON document goes to
-``--output`` and stdout (the product — progress chatter is stderr-only,
-matching the repo's stdout discipline).
+optional ``--hedge``, pooled keep-alive transport) and each level
+additionally reports retry/hedge counts and attempt amplification.
+
+The document ends with a ``capacity`` section — the highest level that
+sustained offered load under the latency/availability criteria
+(``--capacity-p99-ms``, ``--capacity-availability``) — which
+``analysis/passes_serve.py`` gates against budgets.json ``serve.
+capacity_rps``.  ``--assert-capacity RPS`` (and
+``--assert-fleet-capacity RPS``) turn a shortfall into exit 1 for
+CI smokes.  The JSON goes to ``--output`` and stdout (the product —
+progress chatter is stderr-only, matching the repo's stdout
+discipline).
 
 Tracing hooks (docs/OBSERVABILITY.md#distributed-tracing):
 
 * ``--trace-sample N`` — every request carries a SAMPLED traceparent
   root, and each level's row reports the trace ids of its N slowest
-  requests (``slowest_traces``), so a bench regression comes with
-  directly inspectable traces: ``python -m gene2vec_tpu.cli.obs trace
-  <export_dir> <trace_id>``;
+  requests (``slowest_traces``);
 * ``--trace-overhead`` — the budgets.json ``obs`` gate's measurement:
   one level run twice per round (no header vs sampled header) with the
   arm order alternating per round; each arm's estimate is the MEDIAN
-  of its per-window p50s, compared into a ``trace_overhead`` section
-  (``BENCH_OBS_r09.json``; ``analysis/passes_obs.py`` re-gates the
-  committed record).
+  of its per-window p50s (``BENCH_OBS_r09.json``;
+  ``analysis/passes_obs.py`` re-gates the committed record).
 """
 
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
+import queue as queue_mod
 import random
 import subprocess
 import sys
@@ -59,7 +85,8 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import quote, urlparse
 
 # --resilient imports gene2vec_tpu.serve.client; make `python
 # scripts/serve_loadgen.py` work from anywhere, like chaos_drill.py
@@ -87,7 +114,8 @@ def _http_json(
 class _Stats:
     """Thread-safe request accounting for one load level, bucketed by
     error class (429 vs 503 vs 504 vs transport vs other) plus the
-    resilient-client retry/hedge tallies when that path is active."""
+    resilient-client retry/hedge tallies, connection-reuse accounting,
+    and (``--verify``) answer-integrity counts."""
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -101,6 +129,9 @@ class _Stats:
         self.retries = 0
         self.hedges = 0
         self.attempts = 0
+        self.connections_opened = 0
+        self.wrong_answers = 0
+        self.mixed_iteration_answers = 0
         self.traces: List[tuple] = []  # (latency_ms, status, trace_id)
 
     def record(self, status: int, latency_ms: float,
@@ -126,6 +157,15 @@ class _Stats:
             else:
                 self.other_http += 1
 
+    def count_connection(self) -> None:
+        with self.lock:
+            self.connections_opened += 1
+
+    def count_integrity(self, wrong: bool, mixed: bool) -> None:
+        with self.lock:
+            self.wrong_answers += int(wrong)
+            self.mixed_iteration_answers += int(mixed)
+
     @property
     def total(self) -> int:
         return (self.ok + self.rejected + self.not_ready + self.expired
@@ -139,47 +179,132 @@ def _percentile(sorted_values: List[float], q: float) -> Optional[float]:
     return sorted_values[i]
 
 
-def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
+class _KeepAliveConn:
+    """One worker's persistent HTTP connection: reused across requests,
+    replaced on transport error or server close.  A connection that was
+    *reused* and failed before yielding a response gets one fresh-
+    connection retry (the server reaping an idle keep-alive socket is
+    routine, not an error class)."""
+
+    def __init__(self, url: str, timeout_s: float, stats: _Stats):
+        u = urlparse(url)
+        self._host = u.hostname
+        self._port = u.port
+        self._timeout = timeout_s
+        self._stats = stats
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._fresh = True
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def request(self, method: str, path: str, body: Optional[bytes],
+                headers: Dict[str, str]) -> Tuple[int, bytes]:
+        for _attempt in (0, 1):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout
+                )
+                self._fresh = True
+                self._stats.count_connection()
+            reused = not self._fresh
+            try:
+                self._conn.request(
+                    method, path, body=body, headers=headers
+                )
+                resp = self._conn.getresponse()
+                raw = resp.read()
+                self._fresh = False
+                if resp.will_close:
+                    self.close()
+                return resp.status, raw
+            except (OSError, http.client.HTTPException):
+                self.close()
+                if not reused:
+                    raise
+                # stale keep-alive socket: one retry on a fresh dial
+        raise OSError("unreachable")  # pragma: no cover
+
+
+def _check_answer(raw: bytes, verify_ref: Dict, stats: _Stats) -> None:
+    """Compare one 200 body against the pre-fetched reference."""
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        got_iter = doc["model"]["iteration"]
+        res = doc["results"][0]
+        gene = res["query"]
+        neighbors = tuple(n["gene"] for n in res["neighbors"])
+    except (ValueError, KeyError, IndexError, TypeError):
+        stats.count_integrity(wrong=True, mixed=False)
+        return
+    ref = verify_ref.get(gene)
+    if ref is None:
+        stats.count_integrity(wrong=True, mixed=False)
+        return
+    ref_iter, ref_neighbors = ref
+    mixed = got_iter != ref_iter
+    wrong = (not mixed) and neighbors != ref_neighbors
+    if wrong or mixed:
+        stats.count_integrity(wrong=wrong, mixed=mixed)
+
+
+def _one_request(conn: Optional[_KeepAliveConn], url: str,
+                 genes: List[str], k: int, rng: random.Random,
                  stats: _Stats, timeout_s: float,
-                 client=None, trace: bool = False) -> None:
-    body = {"genes": [rng.choice(genes)], "k": k}
+                 client=None, trace: bool = False,
+                 method: str = "post",
+                 verify_ref: Optional[Dict] = None,
+                 t_ref: Optional[float] = None) -> None:
+    gene = rng.choice(genes)
     # when tracing, THIS request is a sampled trace root: the resilient
     # client adopts it as the ambient base (child span per attempt), the
     # plain path sends it as the traceparent header directly
     ctx = tracecontext.new_trace(sampled=True) if trace else None
+    t0 = t_ref if t_ref is not None else time.monotonic()
     if client is not None:
         # the resilient path: retries/hedging under one deadline, with
         # per-request attempt accounting for the amplification report
+        if method == "get":
+            path, body = f"/v1/similar?gene={quote(gene)}&k={k}", None
+        else:
+            path, body = "/v1/similar", {"genes": [gene], "k": k}
         with tracecontext.use(ctx):
-            r = client.request("/v1/similar", body, timeout_s=timeout_s)
+            r = client.request(path, body, timeout_s=timeout_s)
         status = r.status
         if status == 0:
             # no HTTP status reached the caller: bucket the client's own
             # deadline exhaustion with the 504s, transport trouble apart
             status = 504 if r.error_class == "deadline" else -1
+        if status == 200 and verify_ref is not None and r.raw:
+            _check_answer(r.raw, verify_ref, stats)
         stats.record(
             status,
-            r.latency_s * 1000.0,
+            (time.monotonic() - t0) * 1000.0,
             retries=r.retries, hedged=r.hedged, attempts=r.attempts,
             trace_id=r.trace_id if trace else None,
         )
         return
-    t0 = time.monotonic()
+    assert conn is not None
+    headers: Dict[str, str] = {}
+    if ctx is not None:
+        headers[TRACEPARENT_HEADER] = ctx.to_header()
     try:
-        headers = {"Content-Type": "application/json"}
-        if ctx is not None:
-            headers[TRACEPARENT_HEADER] = ctx.to_header()
-        req = urllib.request.Request(
-            f"{url}/v1/similar",
-            data=json.dumps(body).encode("utf-8"),
-            headers=headers,
-        )
-        with urllib.request.urlopen(req, timeout=timeout_s):
-            pass
-        status = 200
-    except urllib.error.HTTPError as e:
-        status = e.code
-        e.close()
+        if method == "get":
+            status, raw = conn.request(
+                "GET", f"/v1/similar?gene={quote(gene)}&k={k}", None,
+                headers,
+            )
+        else:
+            headers["Content-Type"] = "application/json"
+            status, raw = conn.request(
+                "POST", "/v1/similar",
+                json.dumps({"genes": [gene], "k": k}).encode("utf-8"),
+                headers,
+            )
+        if status == 200 and verify_ref is not None:
+            _check_answer(raw, verify_ref, stats)
     except Exception:
         status = -1
     stats.record(
@@ -190,28 +315,50 @@ def _one_request(url: str, genes: List[str], k: int, rng: random.Random,
 
 def run_open_level(url: str, genes: List[str], k: int, rps: float,
                    duration_s: float, seed: int, timeout_s: float,
-                   client=None, trace: bool = False) -> _Stats:
-    """Fixed-schedule arrivals at ``rps`` for ``duration_s``; each
-    arrival gets its own thread so a slow/queued response never delays
-    the next arrival (that is what makes the loop open)."""
+                   client=None, trace: bool = False,
+                   method: str = "post", workers: int = 128,
+                   verify_ref: Optional[Dict] = None) -> _Stats:
+    """Fixed-schedule arrivals at ``rps`` for ``duration_s`` handed to
+    a worker pool with persistent connections.  Latency is measured
+    from each arrival's SCHEDULED time — a saturated pool shows up as
+    latency, never as reduced offered load (that is what keeps the
+    loop open)."""
     stats = _Stats()
-    rng = random.Random(seed)
-    threads: List[threading.Thread] = []
+    n = int(rps * duration_s)
+    tasks: "queue_mod.Queue[Optional[float]]" = queue_mod.Queue()
+    n_workers = max(1, min(workers, n))
+
+    def work(widx: int) -> None:
+        rng = random.Random(seed * 1000003 + widx)
+        conn = _KeepAliveConn(url, timeout_s, stats)
+        try:
+            while True:
+                target = tasks.get()
+                if target is None:
+                    return
+                _one_request(
+                    conn, url, genes, k, rng, stats, timeout_s, client,
+                    trace, method, verify_ref, t_ref=target,
+                )
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=work, args=(w,), daemon=True)
+        for w in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
     interval = 1.0 / rps
     t_start = time.monotonic()
-    n = int(rps * duration_s)
     for i in range(n):
         target = t_start + i * interval
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        t = threading.Thread(
-            target=_one_request,
-            args=(url, genes, k, rng, stats, timeout_s, client, trace),
-            daemon=True,
-        )
-        t.start()
-        threads.append(t)
+        tasks.put(target)
+    for _ in threads:
+        tasks.put(None)
     for t in threads:
         t.join(timeout=timeout_s + 5.0)
     stats.wall_s = time.monotonic() - t_start  # type: ignore[attr-defined]
@@ -221,16 +368,22 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
 def run_closed_level(url: str, genes: List[str], k: int, workers: int,
                      duration_s: float, seed: int,
                      timeout_s: float, client=None,
-                     trace: bool = False) -> _Stats:
-    """N workers firing back-to-back until the clock runs out."""
+                     trace: bool = False, method: str = "post",
+                     verify_ref: Optional[Dict] = None) -> _Stats:
+    """N workers firing back-to-back on persistent connections until
+    the clock runs out."""
     stats = _Stats()
     stop = time.monotonic() + duration_s
 
     def loop(worker_seed: int) -> None:
         rng = random.Random(worker_seed)
-        while time.monotonic() < stop:
-            _one_request(url, genes, k, rng, stats, timeout_s, client,
-                         trace)
+        conn = _KeepAliveConn(url, timeout_s, stats)
+        try:
+            while time.monotonic() < stop:
+                _one_request(conn, url, genes, k, rng, stats, timeout_s,
+                             client, trace, method, verify_ref)
+        finally:
+            conn.close()
 
     t_start = time.monotonic()
     threads = [
@@ -246,7 +399,8 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
 
 
 def summarize(level: float, stats: _Stats, mode: str,
-              resilient: bool = False, trace_sample: int = 0) -> Dict:
+              resilient: bool = False, trace_sample: int = 0,
+              verify: bool = False) -> Dict:
     lat = sorted(stats.latencies_ms)
     wall = getattr(stats, "wall_s", 1.0) or 1.0
     row = {
@@ -269,6 +423,7 @@ def summarize(level: float, stats: _Stats, mode: str,
         "p99_ms": round(_percentile(lat, 0.99), 3) if lat else None,
         "mean_ms": round(sum(lat) / len(lat), 3) if lat else None,
         "wall_s": round(wall, 3),
+        "connections_opened": stats.connections_opened,
     }
     if resilient:
         row["retries"] = stats.retries
@@ -277,6 +432,9 @@ def summarize(level: float, stats: _Stats, mode: str,
         row["attempt_amplification"] = round(
             stats.attempts / stats.total, 4
         ) if stats.total else None
+    if verify:
+        row["wrong_answers"] = stats.wrong_answers
+        row["mixed_iteration_answers"] = stats.mixed_iteration_answers
     if trace_sample > 0 and stats.traces:
         # the N slowest requests, with the trace ids to go look at:
         # `python -m gene2vec_tpu.cli.obs trace <run_dir> <trace_id>`
@@ -287,6 +445,66 @@ def summarize(level: float, stats: _Stats, mode: str,
             for lat, status, tid in slowest
         ]
     return row
+
+
+def compute_capacity(rows: List[Dict], p99_budget_ms: float,
+                     min_availability: float) -> Dict:
+    """The capacity verdict over one phase's level rows: the highest
+    offered level that SUSTAINED its load — availability and p99 within
+    the criteria and achieved throughput >= 90% of offered (open mode;
+    closed-mode rows qualify on the latency/availability criteria
+    alone).  ``sustained_rps`` is 0 when no level qualified."""
+    best: Optional[Dict] = None
+    for row in rows:
+        level = row.get("offered_rps")
+        p99 = row.get("p99_ms")
+        avail = row.get("availability")
+        if p99 is None or avail is None:
+            continue
+        if p99 > p99_budget_ms or avail < min_availability:
+            continue
+        if level is not None and (
+            (row.get("achieved_rps") or 0.0) < 0.9 * level
+        ):
+            continue
+        rate = level if level is not None else row.get("achieved_rps")
+        if best is None or rate > best["sustained_rps"]:
+            best = {
+                "sustained_rps": rate,
+                "p99_ms": p99,
+                "p50_ms": row.get("p50_ms"),
+                "availability": avail,
+            }
+    out = best if best is not None else {
+        "sustained_rps": 0.0, "p99_ms": None, "p50_ms": None,
+        "availability": None,
+    }
+    out["p99_budget_ms"] = p99_budget_ms
+    out["min_availability"] = min_availability
+    return out
+
+
+def fetch_verify_ref(url: str, genes: List[str], k: int,
+                     timeout_s: float) -> Dict:
+    """One reference answer per query gene, fetched BEFORE the load
+    phase: (iteration, neighbor-gene tuple) keyed by gene.  Every 200
+    response during the run must match — a mismatch is a wrong answer,
+    a different iteration a mixed-iteration answer (no swaps happen
+    during a bench)."""
+    ref: Dict = {}
+    for gene in genes:
+        doc = _http_json(
+            f"{url}/v1/similar",
+            {"genes": [gene], "k": k},
+            timeout=timeout_s,
+        )
+        ref[gene] = (
+            doc["model"]["iteration"],
+            tuple(
+                n["gene"] for n in doc["results"][0]["neighbors"]
+            ),
+        )
+    return ref
 
 
 def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
@@ -310,6 +528,68 @@ def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
     return proc, info
 
 
+def spawn_fleet(export_dir: str, replicas: int,
+                extra: List[str]) -> "tuple":
+    """Launch ``python -m gene2vec_tpu.cli.fleet`` (N replicas + the
+    front-door proxy) and parse its contract line."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+         "--export-dir", export_dir, "--replicas", str(replicas),
+         "--port", "0", *extra],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.wait(timeout=10)
+        raise RuntimeError(
+            f"fleet CLI exited rc={proc.returncode} before reporting a URL"
+        )
+    info = json.loads(line)
+    return proc, info
+
+
+def _terminate(proc) -> None:
+    if proc is None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def _warmup(url: str, genes: List[str], k: int, rng: random.Random,
+            timeout_s: float, warmup: int, client=None,
+            method: str = "post") -> None:
+    """Concurrent bursts of 1,2,4,...,N so the batcher forms each batch
+    bucket and jit compiles land before the first measured level."""
+    burst = 1
+    while burst <= max(1, warmup):
+        stats = _Stats()
+        conns = [
+            _KeepAliveConn(url, timeout_s, stats) for _ in range(burst)
+        ]
+        threads = [
+            threading.Thread(
+                target=_one_request,
+                args=(conns[i], url, genes, k, rng, stats, timeout_s,
+                      client, False, method),
+                daemon=True,
+            )
+            for i in range(burst)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout_s + 5.0)
+        for c in conns:
+            c.close()
+        burst *= 2
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="serve_loadgen",
@@ -329,10 +609,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--duration", type=float, default=5.0,
                     help="seconds per level")
     ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--method", choices=("post", "get"), default="post",
+                    help="post = full dispatch pipeline; get = the "
+                         "event-loop hot read path (response cache + "
+                         "coalescing)")
     ap.add_argument("--num-genes", type=int, default=256,
                     help="distinct query genes sampled from /v1/genes")
     ap.add_argument("--timeout", type=float, default=10.0,
                     help="client-side socket timeout (s)")
+    ap.add_argument("--open-workers", type=int, default=128,
+                    help="sender pool size for --mode open (each worker "
+                         "holds one persistent connection)")
     ap.add_argument("--resilient", action="store_true",
                     help="route through gene2vec_tpu.serve.client."
                          "ResilientClient (retries + breakers; reports "
@@ -341,6 +628,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="resilient client max attempts per request")
     ap.add_argument("--hedge", action="store_true",
                     help="enable p95 hedging on the resilient client")
+    ap.add_argument("--verify", action="store_true",
+                    help="pre-fetch a reference answer per gene and "
+                         "check every 200 response against it "
+                         "(wrong/mixed-iteration answer counts)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="after the single-replica levels, spawn an "
+                         "N-replica cli.fleet over the SAME export dir "
+                         "and run --fleet-levels through its front "
+                         "door (requires --spawn)")
+    ap.add_argument("--fleet-levels", default=None,
+                    help="comma-separated levels for the fleet phase "
+                         "(default: --levels)")
+    ap.add_argument("--fleet-arg", action="append", default=[],
+                    help="extra flag for the spawned cli.fleet "
+                         "(repeatable)")
+    ap.add_argument("--capacity-p99-ms", type=float, default=50.0,
+                    help="p99 criterion for the capacity verdict")
+    ap.add_argument("--capacity-availability", type=float, default=0.99,
+                    help="availability criterion for the capacity "
+                         "verdict")
+    ap.add_argument("--assert-capacity", type=float, default=None,
+                    metavar="RPS",
+                    help="exit 1 unless capacity.sustained_rps >= RPS "
+                         "(CI smoke gate)")
+    ap.add_argument("--assert-fleet-capacity", type=float, default=None,
+                    metavar="RPS",
+                    help="exit 1 unless fleet_capacity.sustained_rps "
+                         ">= RPS")
     ap.add_argument("--trace-sample", type=int, default=0, metavar="N",
                     help="send a sampled traceparent root on EVERY "
                          "request and report the N slowest requests' "
@@ -352,20 +667,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--overhead-rounds", type=int, default=3,
                     help="untraced/traced round pairs for "
                          "--trace-overhead")
+    ap.add_argument("--warm-window", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="discarded load window at the first level "
+                         "before measurement (response caches + per-"
+                         "replica jit warm up; 0 disables)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--warmup", type=int, default=64,
                     help="largest warm-up burst; concurrent bursts of "
                          "1,2,4,...,N give the batcher a chance to form "
                          "each batch bucket so jit compiles land before "
                          "the first measured level")
-    ap.add_argument("--output", default="BENCH_SERVE_r06.json")
+    ap.add_argument("--output", default="BENCH_SERVE_r11.json")
     args = ap.parse_args(argv)
     if (args.url is None) == (args.spawn is None):
         print("error: provide exactly one of --url / --spawn",
               file=sys.stderr)
         return 2
+    if args.fleet and args.spawn is None:
+        print("error: --fleet needs --spawn (the fleet serves the same "
+              "export dir)", file=sys.stderr)
+        return 2
 
     proc = None
+    fleet_proc = None
     try:
         if args.spawn is not None:
             proc, info = spawn_server(args.spawn, args.spawn_arg)
@@ -414,37 +739,56 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
 
         rng = random.Random(args.seed)
-        burst = 1
-        while burst <= max(1, args.warmup):
-            stats = _Stats()
-            threads = [
-                threading.Thread(
-                    target=_one_request,
-                    args=(url, genes, args.k, rng, stats, args.timeout,
-                          client),
-                    daemon=True,
-                )
-                for _ in range(burst)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=args.timeout + 5.0)
-            burst *= 2
+        _warmup(url, genes, args.k, rng, args.timeout, args.warmup,
+                client, args.method)
+        verify_ref = None
+        if args.verify:
+            print(f"fetching {len(genes)} reference answers ...",
+                  file=sys.stderr)
+            verify_ref = fetch_verify_ref(url, genes, args.k,
+                                          args.timeout)
 
         levels = [float(x) for x in args.levels.split(",") if x]
         trace_all = args.trace_sample > 0
 
-        def run_level(level: float, trace: bool) -> _Stats:
+        def run_level(level: float, trace: bool,
+                      target_url: str = url,
+                      ref: Optional[Dict] = None,
+                      duration: Optional[float] = None,
+                      level_client=None) -> _Stats:
+            # the resilient client is bound to ONE base URL: the fleet
+            # phase must pass its own client or the "fleet" numbers
+            # would silently measure the single replica
+            use_client = (
+                level_client if level_client is not None
+                else (client if target_url == url else None)
+            )
+            dur = duration if duration is not None else args.duration
             if args.mode == "open":
                 return run_open_level(
-                    url, genes, args.k, level, args.duration, args.seed,
-                    args.timeout, client, trace=trace,
+                    target_url, genes, args.k, level, dur,
+                    args.seed, args.timeout, use_client, trace=trace,
+                    method=args.method, workers=args.open_workers,
+                    verify_ref=ref,
                 )
             return run_closed_level(
-                url, genes, args.k, int(level), args.duration,
-                args.seed, args.timeout, client, trace=trace,
+                target_url, genes, args.k, int(level), dur,
+                args.seed, args.timeout, use_client, trace=trace,
+                method=args.method, verify_ref=ref,
             )
+
+        def warm_window(level: float, target_url: str,
+                        level_client=None) -> None:
+            """One discarded window: per-replica response caches and
+            jit programs warm up OFF the record, so the first measured
+            level reports steady state, not cold start."""
+            if args.warm_window <= 0:
+                return
+            print(f"warm window level {level:g} for "
+                  f"{args.warm_window:g}s ...", file=sys.stderr)
+            run_level(level, False, target_url=target_url,
+                      duration=args.warm_window,
+                      level_client=level_client)
 
         results = []
         overhead = None
@@ -512,49 +856,150 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"trace overhead: {json.dumps(overhead)}",
                   file=sys.stderr)
         else:
+            warm_window(levels[0], url)
             for level in levels:
-                print(f"level {level:g} ({args.mode}) for "
-                      f"{args.duration:g}s ...", file=sys.stderr)
-                stats = run_level(level, trace_all)
+                print(f"level {level:g} ({args.mode}, {args.method}) "
+                      f"for {args.duration:g}s ...", file=sys.stderr)
+                stats = run_level(level, trace_all, ref=verify_ref)
                 row = summarize(level, stats, args.mode, args.resilient,
-                                trace_sample=args.trace_sample)
+                                trace_sample=args.trace_sample,
+                                verify=args.verify)
                 print(f"  -> {json.dumps(row)}", file=sys.stderr)
                 results.append(row)
+
+        capacity = None
+        if not args.trace_overhead and args.mode == "open":
+            capacity = compute_capacity(
+                results, args.capacity_p99_ms, args.capacity_availability
+            )
+            print(f"capacity: {json.dumps(capacity)}", file=sys.stderr)
+
+        fleet_results = None
+        fleet_capacity = None
+        fleet_info = None
+        if args.fleet:
+            fleet_proc, fleet_info = spawn_fleet(
+                args.spawn, args.fleet, args.fleet_arg
+            )
+            fleet_url = fleet_info["url"]
+            print(f"spawned {args.fleet}-replica fleet at {fleet_url}",
+                  file=sys.stderr)
+            _warmup(fleet_url, genes, args.k, rng, args.timeout,
+                    args.warmup, None, args.method)
+            fleet_ref = (
+                fetch_verify_ref(fleet_url, genes, args.k, args.timeout)
+                if args.verify else None
+            )
+            fleet_client = None
+            if args.resilient:
+                from gene2vec_tpu.serve.client import (
+                    ResilientClient,
+                    RetryPolicy,
+                )
+
+                fleet_client = ResilientClient(
+                    [fleet_url],
+                    RetryPolicy(
+                        max_attempts=args.retries,
+                        read_timeout_s=args.timeout,
+                        default_timeout_s=args.timeout,
+                        hedge=args.hedge,
+                    ),
+                    rng=random.Random(args.seed),
+                )
+            fleet_levels = [
+                float(x)
+                for x in (args.fleet_levels or args.levels).split(",")
+                if x
+            ]
+            fleet_results = []
+            warm_window(fleet_levels[0], fleet_url,
+                        level_client=fleet_client)
+            for level in fleet_levels:
+                print(f"fleet level {level:g} ({args.mode}, "
+                      f"{args.method}) for {args.duration:g}s ...",
+                      file=sys.stderr)
+                stats = run_level(level, trace_all,
+                                  target_url=fleet_url, ref=fleet_ref,
+                                  level_client=fleet_client)
+                row = summarize(level, stats, args.mode, args.resilient,
+                                trace_sample=args.trace_sample,
+                                verify=args.verify)
+                print(f"  -> {json.dumps(row)}", file=sys.stderr)
+                fleet_results.append(row)
+            if args.mode == "open":
+                fleet_capacity = compute_capacity(
+                    fleet_results, args.capacity_p99_ms,
+                    args.capacity_availability,
+                )
+                print(f"fleet capacity: {json.dumps(fleet_capacity)}",
+                      file=sys.stderr)
 
         doc = {
             # provenance stamp (ledger contract, docs/BENCHMARKS.md):
             # adapters treat records without schema_version as legacy
-            "schema_version": 1,
+            "schema_version": 2,
             "command": " ".join([sys.executable, *sys.argv]),
             "created_unix": time.time(),
             "bench": ("trace_overhead" if args.trace_overhead
                       else "serve_loadgen"),
             "mode": args.mode,
+            "method": args.method,
             "k": args.k,
             "duration_s": args.duration,
             "num_query_genes": len(genes),
+            "open_workers": args.open_workers,
+            "warm_window_s": args.warm_window,
             "server": health.get("model", {}),
             "resilient": bool(args.resilient),
+            "verify": bool(args.verify),
             "trace_sample": args.trace_sample,
             "levels": results,
         }
+        if capacity is not None:
+            doc["capacity"] = capacity
+        if fleet_results is not None:
+            doc["fleet_replicas"] = args.fleet
+            doc["fleet_levels"] = fleet_results
+            if fleet_capacity is not None:
+                doc["fleet_capacity"] = fleet_capacity
+            if fleet_client is not None:
+                doc["fleet_client_stats"] = dict(fleet_client.stats)
         if overhead is not None:
             doc["trace_overhead"] = overhead
         if client is not None:
             doc["client_stats"] = dict(client.stats)
+            transport = getattr(client, "_transport", None)
+            opened = getattr(transport, "connections_opened", None)
+            if opened is not None:
+                doc["client_stats"]["connections_opened"] = opened
+                doc["client_stats"]["stale_retries"] = (
+                    transport.stale_retries
+                )
         with open(args.output, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
             f.write("\n")
         # the one stdout line is the product; chatter above is stderr
         print(json.dumps(doc), file=sys.stdout)
-        return 0
+        rc = 0
+        if args.assert_capacity is not None:
+            got = (capacity or {}).get("sustained_rps") or 0.0
+            if got < args.assert_capacity:
+                print(f"CAPACITY ASSERT FAILED: sustained {got:g} rps "
+                      f"< required {args.assert_capacity:g}",
+                      file=sys.stderr)
+                rc = 1
+        if args.assert_fleet_capacity is not None:
+            got = (fleet_capacity or {}).get("sustained_rps") or 0.0
+            if got < args.assert_fleet_capacity:
+                print(f"FLEET CAPACITY ASSERT FAILED: sustained "
+                      f"{got:g} rps < required "
+                      f"{args.assert_fleet_capacity:g}", file=sys.stderr)
+                rc = 1
+        return rc
     finally:
-        if proc is not None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _terminate(fleet_proc)
+        _terminate(proc)
 
 
 if __name__ == "__main__":

@@ -103,6 +103,15 @@ def score(x):
     with ambient_span("inner"):
         return x * 2
 """,
+    "event-loop-blocking": """
+import time
+
+
+class AcceptorLoop:
+    def _on_timer(self, now):
+        time.sleep(0.01)
+        return now
+""",
 }
 
 CLEAN_FIXTURE = """

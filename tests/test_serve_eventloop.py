@@ -1,0 +1,663 @@
+"""Event-loop serve front end: keep-alive protocol conformance, the
+slow-loris read deadline, coalesced-GET correctness, hot-swap atomicity
+under keep-alive connections, the pooled client transport, and the
+serve capacity budget gate (passes_serve + ledger adapter).
+
+Protocol tests drive raw sockets against a real served app so the
+parser, keep-alive bookkeeping, and deadline sweeps are the actual code
+under test — no mocked loop."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gene2vec_tpu.io.checkpoint import save_iteration
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.serve.registry import ModelRegistry
+from gene2vec_tpu.serve.server import (
+    ServeApp,
+    ServeConfig,
+    make_server,
+)
+from gene2vec_tpu.sgns.model import SGNSParams
+
+V, D = 32, 8
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_iteration(export_dir, iteration, seed):
+    rng = np.random.RandomState(seed)
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    emb = rng.randn(V, D).astype(np.float32)
+    params = SGNSParams(
+        emb=jnp.asarray(emb), ctx=jnp.asarray(np.zeros((V, D), np.float32))
+    )
+    save_iteration(str(export_dir), D, iteration, params, vocab)
+    return emb
+
+
+def _serve(export_dir, config):
+    reg = ModelRegistry(str(export_dir))
+    assert reg.refresh()
+    app = ServeApp(reg, config).start()
+    server = make_server(app, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return reg, app, server
+
+
+@pytest.fixture
+def served(tmp_path):
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export,
+        ServeConfig(max_batch=8, max_delay_ms=2.0, max_queue=16),
+    )
+    yield export, reg, app, server
+    server.shutdown()
+    server.server_close()
+    app.stop()
+
+
+def _connect(server, timeout=5.0):
+    sock = socket.create_connection(
+        ("127.0.0.1", server.server_address[1]), timeout=timeout
+    )
+    return sock
+
+
+def _get_request(path, close=False):
+    extra = "Connection: close\r\n" if close else ""
+    return (
+        f"GET {path} HTTP/1.1\r\nHost: x\r\n{extra}\r\n"
+    ).encode("ascii")
+
+
+def _read_response(sock):
+    """(status, headers dict, body bytes) from one raw socket."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed before headers")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        name, _, value = ln.partition(b":")
+        headers[name.strip().lower().decode()] = value.strip().decode()
+    length = int(headers.get("content-length", "0"))
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed mid-body")
+        rest += chunk
+    return status, headers, rest[:length], rest[length:]
+
+
+def _closed(sock, timeout=3.0):
+    sock.settimeout(timeout)
+    try:
+        return sock.recv(1) == b""
+    except socket.timeout:
+        return False
+    except OSError:
+        return True
+
+
+# -- keep-alive protocol conformance -----------------------------------------
+
+
+def test_keepalive_sequential_requests_one_socket(served):
+    _, _, _, server = served
+    sock = _connect(server)
+    try:
+        for _ in range(3):
+            sock.sendall(_get_request("/v1/similar?gene=G0&k=3"))
+            status, headers, body, extra = _read_response(sock)
+            assert status == 200
+            assert headers.get("connection") != "close"
+            doc = json.loads(body)
+            assert len(doc["results"][0]["neighbors"]) == 3
+            assert extra == b""
+    finally:
+        sock.close()
+
+
+def test_pipelined_requests_one_socket(served):
+    """Two requests written back-to-back before reading anything: both
+    answers come back, in order."""
+    _, _, _, server = served
+    sock = _connect(server)
+    try:
+        sock.sendall(
+            _get_request("/v1/genes?limit=2")
+            + _get_request("/v1/similar?gene=G1&k=2")
+        )
+        status1, _, body1, extra = _read_response(sock)
+        assert status1 == 200
+        assert json.loads(body1)["genes"] == ["G0", "G1"]
+        # any bytes already read past response 1 belong to response 2
+        sock2 = _Rewound(sock, extra)
+        status2, _, body2, _ = _read_response(sock2)
+        assert status2 == 200
+        assert json.loads(body2)["results"][0]["query"] == "G1"
+    finally:
+        sock.close()
+
+
+class _Rewound:
+    """Socket wrapper replaying bytes already read past a response."""
+
+    def __init__(self, sock, buffered):
+        self._sock = sock
+        self._buf = buffered
+
+    def recv(self, n):
+        if self._buf:
+            out, self._buf = self._buf[:n], self._buf[n:]
+            return out
+        return self._sock.recv(n)
+
+
+def test_malformed_request_line_gets_400_and_close(served):
+    _, _, app, server = served
+    sock = _connect(server)
+    try:
+        sock.sendall(b"NONSENSE\r\n\r\n")
+        status, headers, _, _ = _read_response(sock)
+        assert status == 400
+        assert headers.get("connection") == "close"
+        assert _closed(sock)
+    finally:
+        sock.close()
+
+
+def test_idle_keepalive_connection_reaped(tmp_path):
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export, ServeConfig(idle_timeout_s=0.3)
+    )
+    try:
+        sock = _connect(server)
+        sock.sendall(_get_request("/livez"))
+        assert _read_response(sock)[0] == 200
+        t0 = time.monotonic()
+        assert _closed(sock, timeout=3.0)  # idle: silently closed
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+def test_request_cap_closes_connection(tmp_path):
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export, ServeConfig(max_conn_requests=2)
+    )
+    try:
+        sock = _connect(server)
+        sock.sendall(_get_request("/livez"))
+        status, headers, _, _ = _read_response(sock)
+        assert status == 200 and headers.get("connection") != "close"
+        sock.sendall(_get_request("/livez"))
+        status, headers, _, _ = _read_response(sock)
+        assert status == 200 and headers.get("connection") == "close"
+        assert _closed(sock)
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+def test_slow_loris_headers_stall_gets_408(tmp_path):
+    """A request whose HEADERS never finish trips the read deadline
+    too (the body-stall variant lives in test_fleet.py)."""
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export, ServeConfig(read_timeout_s=0.4)
+    )
+    try:
+        sock = _connect(server)
+        t0 = time.monotonic()
+        sock.sendall(b"GET /livez HTTP/1.1\r\nHost: x\r\n")  # no blank line
+        status, headers, _, _ = _read_response(sock)
+        assert status == 408
+        assert time.monotonic() - t0 < 2.0
+        assert headers.get("connection") == "close"
+        assert app.metrics.counter("serve_http_408_total").value >= 1
+        assert _closed(sock)
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+def test_oversized_body_gets_413_and_close(served):
+    _, _, _, server = served
+    sock = _connect(server)
+    try:
+        sock.sendall(
+            b"POST /v1/similar HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 9000000\r\n\r\n"
+        )
+        status, headers, body, _ = _read_response(sock)
+        assert status == 413
+        assert b"too large" in body
+        assert headers.get("connection") == "close"
+        assert _closed(sock)
+    finally:
+        sock.close()
+
+
+def test_inflight_backpressure_bounds_read_buffer(tmp_path):
+    """A client streaming garbage behind a slow in-flight request must
+    not grow the server's read buffer unboundedly: the loop pauses
+    reading at the pipeline cap, other connections stay responsive,
+    and the garbage is rejected once the response lands."""
+    from gene2vec_tpu.serve import eventloop
+
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export, ServeConfig(cache_size=0, max_delay_ms=1.0)
+    )
+    real_compute = app._compute_batch
+
+    def slow_compute(items, k_max):
+        time.sleep(0.8)
+        return real_compute(items, k_max)
+
+    app.batcher.compute = slow_compute
+    loop = server._loops[0]
+    try:
+        sock = _connect(server)
+        sock.sendall(_get_request("/v1/similar?gene=G0&k=2"))
+        time.sleep(0.1)  # request dispatched; compute sleeping
+        # stream garbage well past the pipeline cap
+        junk = b"x" * 65536
+        sock.settimeout(0.2)
+        sent = 0
+        try:
+            while sent < 4 * eventloop._PIPELINE_BUF_CAP:
+                sent += sock.send(junk)
+        except socket.timeout:
+            pass  # kernel window closed: the loop stopped reading
+        # the loop buffered at most ~cap + one recv worth of bytes
+        bufs = [len(c.rbuf) for c in loop.conns.values()]
+        assert max(bufs, default=0) <= (
+            eventloop._PIPELINE_BUF_CAP + 262144
+        )
+        # other connections stay responsive while that one is paused
+        other = _connect(server)
+        other.sendall(_get_request("/livez"))
+        assert _read_response(other)[0] == 200
+        other.close()
+        # once the slow response lands, the buffered junk parses as a
+        # malformed request -> 400 + close
+        sock.settimeout(5.0)
+        status, _, _, extra = _read_response(sock)
+        assert status == 200
+        if b"400" not in extra:
+            status2, _, _, _ = _read_response(_Rewound(sock, extra))
+            assert status2 == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+# -- coalescing + response cache ---------------------------------------------
+
+
+def test_concurrent_identical_gets_coalesce_to_one_compute(tmp_path):
+    """N concurrent identical GETs -> ONE batcher compute, N correct
+    responses.  Caches are disabled so coalescing (not caching) is
+    what's under test."""
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export,
+        ServeConfig(cache_size=0, max_delay_ms=50.0, max_batch=8),
+    )
+    compute_calls = []
+    real_compute = app._compute_batch
+
+    def counting_compute(items, k_max):
+        compute_calls.append(len(items))
+        time.sleep(0.15)  # hold the window open for late joiners
+        return real_compute(items, k_max)
+
+    app.batcher.compute = counting_compute
+    try:
+        n = 8
+        results = [None] * n
+
+        def fire(i):
+            sock = _connect(server)
+            try:
+                sock.sendall(_get_request("/v1/similar?gene=G5&k=4"))
+                status, _, body, _ = _read_response(sock)
+                results[i] = (status, json.loads(body))
+            finally:
+                sock.close()
+
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert all(r is not None for r in results)
+        assert all(status == 200 for status, _ in results)
+        # one engine slot for the hot gene, no matter the fan-in
+        assert compute_calls == [1], compute_calls
+        assert (
+            app.metrics.counter("serve_coalesced_total").value == n - 1
+        )
+        # every response is the same correct answer
+        m = reg.model
+        scores = np.asarray(m.unit) @ np.asarray(m.unit)[5]
+        oracle = [m.tokens[i] for i in np.argsort(-scores) if i != 5][:4]
+        for _, doc in results:
+            got = [
+                nb["gene"] for nb in doc["results"][0]["neighbors"]
+            ]
+            assert got == oracle
+            assert doc["model"]["iteration"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+def test_response_cache_serves_reused_bytes(served):
+    _, reg, app, server = served
+    sock = _connect(server)
+    try:
+        sock.sendall(_get_request("/v1/similar?gene=G2&k=3"))
+        status, _, body1, _ = _read_response(sock)
+        assert status == 200
+        hits0 = app.metrics.counter(
+            "serve_response_cache_hits_total"
+        ).value
+        sock.sendall(_get_request("/v1/similar?gene=G2&k=3"))
+        status, _, body2, _ = _read_response(sock)
+        assert status == 200
+        assert body2 == body1
+        assert app.metrics.counter(
+            "serve_response_cache_hits_total"
+        ).value == hits0 + 1
+        # the cached bytes ARE the stored object (zero-copy, not a
+        # re-encode)
+        m = reg.model
+        assert app.response_cache.get((m.version, "G2", 3)) == body1
+    finally:
+        sock.close()
+
+
+# -- hot swap under keep-alive -----------------------------------------------
+
+
+def test_hot_swap_atomicity_over_keepalive_connection(served):
+    """One keep-alive connection spanning a hot swap: every response is
+    internally consistent (its iteration's table produced its
+    neighbors), and the connection survives the swap."""
+    export, reg, app, server = served
+    embs = {1: _write_iteration(export, 1, seed=1)}
+
+    def oracle(iteration, gene_row, k):
+        emb = embs[iteration]
+        unit = emb / np.linalg.norm(emb, axis=1, keepdims=True)
+        scores = unit @ unit[gene_row]
+        return [
+            f"G{i}" for i in np.argsort(-scores) if i != gene_row
+        ][:k]
+
+    sock = _connect(server)
+    try:
+        seen_iterations = set()
+        for it in (2, 3, 4):
+            embs[it] = _write_iteration(export, it, seed=it * 11)
+            assert reg.refresh()
+            for _ in range(3):
+                sock.sendall(_get_request("/v1/similar?gene=G7&k=5"))
+                status, _, body, _ = _read_response(sock)
+                assert status == 200
+                doc = json.loads(body)
+                got_iter = doc["model"]["iteration"]
+                seen_iterations.add(got_iter)
+                got = [
+                    nb["gene"]
+                    for nb in doc["results"][0]["neighbors"]
+                ]
+                # the answer must cohere with ITS OWN iteration — a
+                # response mixing a new iteration stamp with old-table
+                # neighbors (or vice versa) fails here
+                assert got == oracle(got_iter, 7, 5), (
+                    f"iteration {got_iter} answer does not match its "
+                    "own table"
+                )
+        assert max(seen_iterations) == 4  # the swaps actually served
+    finally:
+        sock.close()
+
+
+# -- pooled client transport --------------------------------------------------
+
+
+def test_pooled_transport_reuses_and_recovers_stale(tmp_path):
+    from gene2vec_tpu.serve.client import PooledTransport
+
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(
+        export, ServeConfig(idle_timeout_s=0.3)
+    )
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        transport = PooledTransport()
+        status, _ = transport(url, "GET", "/livez", None, 2.0, 5.0)
+        assert status == 200
+        status, _ = transport(url, "GET", "/livez", None, 2.0, 5.0)
+        assert status == 200
+        assert transport.connections_opened == 1  # reused, not re-dialed
+        # let the server's idle timeout reap the pooled socket, then
+        # the next request must transparently re-dial
+        time.sleep(0.8)
+        status, _ = transport(url, "GET", "/livez", None, 2.0, 5.0)
+        assert status == 200
+        assert transport.connections_opened == 2
+        transport.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+def test_resilient_client_pools_connections_per_replica(tmp_path):
+    from gene2vec_tpu.serve.client import (
+        PooledTransport,
+        ResilientClient,
+        RetryPolicy,
+    )
+
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg, app, server = _serve(export, ServeConfig())
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        client = ResilientClient([url], RetryPolicy(max_attempts=2))
+        assert isinstance(client._transport, PooledTransport)
+        for _ in range(5):
+            r = client.request("/v1/similar?gene=G0&k=2", timeout_s=5.0)
+            assert r.ok, r.error_class
+            # zero-copy surface: raw bytes present, doc parses lazily
+            assert r.raw is not None
+            assert r.doc["results"][0]["query"] == "G0"
+        assert client._transport.connections_opened == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
+
+
+# -- ledger adapter: capacity fields -----------------------------------------
+
+
+def test_ledger_ingests_capacity_fields(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    new_doc = {
+        "schema_version": 2,
+        "bench": "serve_loadgen",
+        "levels": [
+            {"offered_rps": 200.0, "p50_ms": 1.0, "p99_ms": 4.0,
+             "rejection_rate": 0.0, "errors": 0},
+        ],
+        "capacity": {"sustained_rps": 800.0, "p99_ms": 9.0},
+        "fleet_capacity": {"sustained_rps": 1200.0, "p99_ms": 12.0},
+    }
+    legacy_doc = {
+        "bench": "serve_loadgen",
+        "levels": [
+            {"offered_rps": 50.0, "p50_ms": 24.0, "p99_ms": 236.0,
+             "rejection_rate": 0.0, "errors": 0},
+        ],
+    }
+    (tmp_path / "BENCH_SERVE_r06.json").write_text(
+        json.dumps(legacy_doc)
+    )
+    (tmp_path / "BENCH_SERVE_r11.json").write_text(json.dumps(new_doc))
+    records = ledger.ingest_root(str(tmp_path))
+    by_src = {r["source"]: r for r in records}
+    assert not by_src["BENCH_SERVE_r06.json"].get("error")
+    # pre-capacity legacy: visibly marked, never an ingest error
+    assert (
+        by_src["BENCH_SERVE_r06.json"]["metrics"][
+            "serve_pre_capacity_legacy"
+        ] == 1.0
+    )
+    m = by_src["BENCH_SERVE_r11.json"]["metrics"]
+    assert m["serve_capacity_rps"] == 800.0
+    assert m["serve_fleet_capacity_rps"] == 1200.0
+    assert "serve_pre_capacity_legacy" not in m
+
+
+# -- the capacity budget gate (passes_serve) ---------------------------------
+
+
+def _capacity_doc(sustained=900.0, fleet=1200.0, wrong=0, mixed=0,
+                  **overrides):
+    doc = {
+        "schema_version": 2,
+        "bench": "serve_loadgen",
+        "mode": "open",
+        "method": "get",
+        "k": 10,
+        "duration_s": 5.0,
+        "num_query_genes": 256,
+        "levels": [
+            {"offered_rps": 200.0, "p50_ms": 1.0, "p99_ms": 4.0},
+        ],
+        "capacity": {
+            "sustained_rps": sustained, "p99_ms": 9.0,
+            "availability": 1.0, "p99_budget_ms": 50.0,
+            "min_availability": 0.99,
+        },
+        "fleet_capacity": {
+            "sustained_rps": fleet, "p99_ms": 12.0,
+            "availability": 1.0, "p99_budget_ms": 50.0,
+            "min_availability": 0.99,
+        },
+        "fleet_levels": [
+            {"offered_rps": fleet, "wrong_answers": wrong,
+             "mixed_iteration_answers": mixed},
+        ],
+    }
+    doc.update(overrides)
+    return doc
+
+
+def test_capacity_gate_passes_on_committed_bench():
+    """The committed BENCH_SERVE_r11.json satisfies the budget (the
+    analyzer's default tier depends on it)."""
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_serve import (
+        serve_capacity_findings,
+    )
+
+    bad = gating(serve_capacity_findings(root=REPO))
+    assert bad == [], "\n".join(f.format() for f in bad)
+
+
+def test_capacity_gate_planted_violation_fires_exactly_once(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_serve import (
+        serve_capacity_findings,
+    )
+
+    (tmp_path / "BENCH_SERVE_r99.json").write_text(
+        json.dumps(_capacity_doc(sustained=120.0))
+    )
+    findings = serve_capacity_findings(root=str(tmp_path))
+    bad = gating(findings)
+    assert len(bad) == 1, [f.format() for f in findings]
+    assert "sustained_rps 120" in bad[0].message
+    assert bad[0].pass_id == "serve-capacity-budget"
+
+
+def test_capacity_gate_off_recipe_and_integrity_violations(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_serve import (
+        serve_capacity_findings,
+    )
+
+    # off-recipe: measured with POST instead of the pinned GET
+    (tmp_path / "BENCH_SERVE_r99.json").write_text(
+        json.dumps(_capacity_doc(method="post"))
+    )
+    (bad,) = gating(serve_capacity_findings(root=str(tmp_path)))
+    assert "pins method='get'" in bad.message
+
+    # a wrong answer in the fleet phase gates even at full capacity
+    (tmp_path / "BENCH_SERVE_r99.json").write_text(
+        json.dumps(_capacity_doc(wrong=1))
+    )
+    (bad,) = gating(serve_capacity_findings(root=str(tmp_path)))
+    assert "answer integrity" in bad.message
+
+    # a shortened window gates (a lucky 1s window must not pass)
+    (tmp_path / "BENCH_SERVE_r99.json").write_text(
+        json.dumps(_capacity_doc(duration_s=1.0))
+    )
+    (bad,) = gating(serve_capacity_findings(root=str(tmp_path)))
+    assert "pins >= 5" in bad.message
+
+
+def test_capacity_gate_missing_bench_is_info(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_serve import (
+        serve_capacity_findings,
+    )
+
+    findings = serve_capacity_findings(root=str(tmp_path))
+    assert gating(findings) == []
+    assert findings[0].severity == "info"
+    assert "no serve bench recorded yet" in findings[0].message
