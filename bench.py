@@ -566,6 +566,180 @@ def timeline_overhead(
     return bench_stamp(doc)
 
 
+def _ann_clustered_table(
+    rows: int, dim: int, clusters: int, seed: int, spread: float = 0.35
+) -> np.ndarray:
+    """Synthetic L2-normalized table with mixture-of-centroid geometry —
+    the shape real embedding tables have (trained embeddings cluster by
+    function; QUALITY_NOTES' planted-set analysis is the small-scale
+    version).  A uniform-random table is the adversarial IVF case and
+    is covered by the recall harness's nprobe sweep in tests/."""
+    from gene2vec_tpu.serve.registry import l2_normalize
+
+    rng = np.random.RandomState(seed)
+    cent = rng.randn(clusters, dim).astype(np.float32)
+    assign = rng.randint(0, clusters, rows)
+    out = np.empty((rows, dim), np.float32)
+    step = 131072  # chunked: 1M x dim materializes once, not thrice
+    for s in range(0, rows, step):
+        block = cent[assign[s : s + step]]
+        out[s : s + step] = (
+            block + spread * rng.randn(*block.shape).astype(np.float32)
+        )
+    return l2_normalize(out)
+
+
+def _ann_mode_latency(call, reps: int) -> dict:
+    """p50/p99 of ``reps`` single-query calls (ms), first call excluded
+    by the caller (compile)."""
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        call()
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    arr = np.asarray(samples)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+    }
+
+
+def ann_bench(
+    rows: int, dim: int, k: int, queries: int, clusters: int,
+    nprobe: int, rescore_mult: int, seed: int = 0,
+    latency_reps: int = 50, real_rows: int = 24447, real_dim: int = 200,
+) -> dict:
+    """The approximate-retrieval scaling bench (``--ann``):
+    exact vs int8-quantized vs IVF+int8 top-k on a synthetic clustered
+    ``rows``-row table, recall@10 scored against the exact numpy
+    oracle, p50/p99 per mode from single-query calls, analytic
+    bytes-touched per query — plus the same recall check at the real
+    24,447-vocab serving geometry.  Queries are drawn from table rows
+    (the production ``/v1/similar`` workload: gene queries ARE table
+    rows).  Stamped into ``BENCH_ANN_r*.json`` and gated by
+    ``analysis/passes_ann.py`` against budgets.json ``ann.recall``."""
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.serve import ann as ann_mod
+    from gene2vec_tpu.serve.engine import BucketedTopKEngine
+
+    rng = np.random.RandomState(seed)
+    log(f"=== ANN bench: {rows:,} x {dim} synthetic clustered table ===")
+    t0 = time.perf_counter()
+    table = _ann_clustered_table(rows, dim, clusters, seed)
+    unit = jnp.asarray(table)
+    unit.block_until_ready()
+    log(f"table built in {time.perf_counter() - t0:.1f}s "
+        f"({table.nbytes / 1e6:.0f} MB f32)")
+
+    q_idx = rng.choice(rows, queries, replace=False)
+    qs = table[q_idx]
+    t0 = time.perf_counter()
+    oracle = ann_mod.exact_oracle(table, qs, k)
+    log(f"numpy exact oracle over {queries} queries in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    engine = BucketedTopKEngine(
+        max_batch=64, index="ivf", nprobe=nprobe,
+        rescore_mult=rescore_mult,
+    )
+    t0 = time.perf_counter()
+    quant = ann_mod.build_index(table, "quant")
+    log(f"quant index built in {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    ivf = ann_mod.build_index(table, "ivf", clusters=clusters, seed=seed)
+    log(f"ivf index built in {time.perf_counter() - t0:.1f}s "
+        f"(C={ivf.n_clusters}, L={ivf.list_len})")
+
+    rb = engine.r_bucket(engine.k_bucket(k, rows), rows)
+    bytes_exact = ann_mod.bytes_per_query("exact", rows, dim)
+    per_mode = {
+        "exact": (
+            lambda q, n: engine.top_k(unit, q, n),
+            bytes_exact,
+        ),
+        "quant": (
+            lambda q, n: engine.top_k_ann(quant, unit, q, n),
+            ann_mod.bytes_per_query("quant", rows, dim, r=rb),
+        ),
+        "ivf": (
+            lambda q, n: engine.top_k_ann(ivf, unit, q, n),
+            ann_mod.bytes_per_query(
+                "ivf", rows, dim, r=rb, clusters=ivf.n_clusters,
+                list_len=ivf.list_len, nprobe=nprobe,
+            ),
+        ),
+    }
+    modes: dict = {}
+    for mode, (call, bpq) in per_mode.items():
+        found = np.empty((queries, k), np.int64)
+        t0 = time.perf_counter()
+        for s in range(0, queries, 64):
+            _, idx = call(qs[s : s + 64], k)
+            found[s : s + 64] = idx
+        batch_s = time.perf_counter() - t0
+        recall = ann_mod.recall_at_k(found, oracle)
+        one = qs[:1]
+        call(one, k)  # warm the B=1 bucket before timing
+        lat = _ann_mode_latency(lambda: call(one, k), latency_reps)
+        modes[mode] = {
+            "recall_at_10": round(recall, 4),
+            "bytes_per_query": bpq,
+            "batched_queries_per_sec": round(queries / batch_s, 1),
+            **lat,
+        }
+        log(f"{mode}: recall@{k} {recall:.4f}  p50 {lat['p50_ms']}ms  "
+            f"p99 {lat['p99_ms']}ms  {bpq / 1e6:.2f} MB/query")
+    modes["ivf"]["p99_speedup_vs_exact"] = round(
+        modes["exact"]["p99_ms"] / max(modes["ivf"]["p99_ms"], 1e-9), 2
+    )
+    modes["ivf"]["bytes_reduction_vs_exact"] = round(
+        bytes_exact / max(modes["ivf"]["bytes_per_query"], 1e-9), 1
+    )
+    modes["quant"]["bytes_reduction_vs_exact"] = round(
+        bytes_exact / max(modes["quant"]["bytes_per_query"], 1e-9), 1
+    )
+
+    # the real serving geometry: same recall floor must hold at the
+    # 24,447-vocab table the paper's checkpoints actually have
+    log(f"=== real-geometry recall check: {real_rows:,} x {real_dim} ===")
+    real_table = _ann_clustered_table(
+        real_rows, real_dim, clusters=max(8, int(np.sqrt(real_rows))),
+        seed=seed + 1,
+    )
+    real_unit = jnp.asarray(real_table)
+    real_q = real_table[
+        np.random.RandomState(seed + 2).choice(real_rows, 128, replace=False)
+    ]
+    real_oracle = ann_mod.exact_oracle(real_table, real_q, k)
+    real_quant = ann_mod.build_index(real_table, "quant")
+    real_ivf = ann_mod.build_index(real_table, "ivf", seed=seed)
+    real = {"rows": real_rows, "dim": real_dim,
+            "source": "synthetic-clustered@real-geometry"}
+    for name, index in (("ivf", real_ivf), ("quant", real_quant)):
+        found = np.empty((real_q.shape[0], k), np.int64)
+        for s in range(0, real_q.shape[0], 64):
+            _, idx = engine.top_k_ann(index, real_unit, real_q[s : s + 64], k)
+            found[s : s + 64] = idx
+        real[f"recall_at_10_{name}"] = round(
+            ann_mod.recall_at_k(found, real_oracle), 4
+        )
+    log(f"real-geometry recall: {real}")
+
+    return bench_stamp({
+        "bench": "ann",
+        "schema": "gene2vec-tpu/bench-ann/v1",
+        "recipe": {
+            "rows": rows, "dim": dim, "k": k, "queries": queries,
+            "clusters": clusters, "nprobe": nprobe,
+            "rescore_mult": rescore_mult, "seed": seed,
+        },
+        "modes": modes,
+        "real_table": real,
+        "ivf_index": ann_mod.index_stats(ivf),
+    })
+
+
 def quality_gate(dim: int, batch_pairs: int, data_dir: str) -> dict:
     """Verify the HEADLINE configuration learns before any throughput is
     reported (VERDICT round-2 item 3: a flat-loss run must not produce a
@@ -694,7 +868,67 @@ def main() -> None:
                     "the normal bench pipeline")
     ap.add_argument("--perf-out", default="BENCH_PERF_r10.json",
                     help="output path for --timeline-overhead")
+    ap.add_argument("--ann", action="store_true",
+                    help="run the approximate-retrieval scaling bench "
+                    "(exact vs int8-quant vs IVF+int8 top-k, recall@10 "
+                    "vs the exact numpy oracle, p50/p99 + bytes/query; "
+                    "recipe defaults come from budgets.json 'ann'); "
+                    "skips the normal bench pipeline; exits 1 when "
+                    "recall falls below --ann-min-recall")
+    ap.add_argument("--ann-rows", type=int, default=None,
+                    help="synthetic table rows (default: the pinned "
+                    "recipe's 1,000,000; the CI smoke uses 65536)")
+    ap.add_argument("--ann-queries", type=int, default=None,
+                    help="recall query count (default: recipe)")
+    ap.add_argument("--ann-min-recall", type=float, default=0.99,
+                    help="exit 1 when quant/ivf recall@10 lands below "
+                    "this on either table")
+    ap.add_argument("--ann-out", default="BENCH_ANN_r12.json",
+                    help="output path for --ann")
     args = ap.parse_args()
+
+    if args.ann:
+        from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+        recipe = load_budgets().get("ann", {}).get("recall", {}).get(
+            "recipe", {}
+        )
+        rows = int(args.ann_rows or recipe.get("rows", 1_000_000))
+        # the centroid count scales with the table when the smoke
+        # shrinks rows off-recipe; on-recipe it is the pinned value
+        clusters = int(recipe.get("clusters", 1024))
+        if args.ann_rows and args.ann_rows != recipe.get("rows"):
+            from gene2vec_tpu.serve.ann import default_clusters
+
+            clusters = default_clusters(rows)
+        doc = ann_bench(
+            rows=rows,
+            dim=int(recipe.get("dim", 64)),
+            k=int(recipe.get("k", 10)),
+            queries=int(args.ann_queries or recipe.get("queries", 512)),
+            clusters=clusters,
+            nprobe=int(recipe.get("nprobe", 32)),
+            rescore_mult=int(recipe.get("rescore_mult", 4)),
+            seed=int(recipe.get("seed", 0)),
+        )
+        floor = float(args.ann_min_recall)
+        recalls = {
+            "ivf": doc["modes"]["ivf"]["recall_at_10"],
+            "quant": doc["modes"]["quant"]["recall_at_10"],
+            "real_ivf": doc["real_table"]["recall_at_10_ivf"],
+            "real_quant": doc["real_table"]["recall_at_10_quant"],
+        }
+        doc["min_recall_at_10"] = floor
+        doc["passed"] = all(v >= floor for v in recalls.values())
+        with open(args.ann_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        log(f"wrote {args.ann_out}")
+        print(json.dumps(doc))
+        if not doc["passed"]:
+            log(f"ANN recall gate FAILED: {recalls} < {floor}")
+            sys.exit(1)
+        return
 
     if args.timeline_overhead:
         from gene2vec_tpu.analysis.passes_hlo import load_budgets

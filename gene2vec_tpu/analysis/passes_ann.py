@@ -1,0 +1,231 @@
+"""ANN retrieval budget gate: BENCH_ANN vs budgets.json ``ann``.
+
+``python bench.py --ann`` stamps a ``BENCH_ANN_r*.json`` artifact —
+recall@10 vs the exact numpy oracle per index mode on the 1M-row
+synthetic table AND the real 24,447-vocab-geometry table, p50/p99 per
+mode, and analytic bytes-touched-per-query.  This pass re-checks the
+NEWEST committed record against the ``recall`` entry of the ``ann``
+budgets section every ``cli.analyze`` run, so an approximate-retrieval
+quality collapse (a rerun stamping worse recall, a bench re-measured
+off-recipe, the scaling win quietly evaporating) fails the analyzer
+exactly like a collective-bytes regression does.
+
+Rules (the passes_serve / passes_perf shape — jax-free, I/O-only, so
+it rides the DEFAULT tier):
+
+* no ``BENCH_ANN_r*`` artifact at all → *info* (a fresh checkout must
+  not fail lint before its first bench);
+* the budget pins the **measurement recipe** (rows, dim, k, query
+  count, clusters, nprobe, rescore_mult): a record measured with
+  different geometry or looser knobs gates hard — recall at nprobe=256
+  must not pass a gate whose serving default is 32;
+* IVF **and** quant recall@10 below ``min_recall_at_10`` on either the
+  synthetic or the real-geometry table gates hard; a missing budgeted
+  quantity gates like a violation — dropping the key must never be the
+  way to pass;
+* the IVF path must beat exact brute force by ``min_gain_factor`` in
+  p99 latency **or** bytes touched per query (bytes are
+  host-independent; latency is this container's CPU — either proves
+  the scaling story).
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (shared with
+``passes_perf``/``passes_serve`` so staged fixture dirs work
+uniformly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.passes_perf import perf_root
+
+_PASS = "ann-recall-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_ann_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_ANN_*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties) — the gate follows the round
+    convention like the ledger does."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched is not None and matched[0] == "ann":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime,
+                               path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def ann_recall_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the newest committed ANN bench against ``ann.recall``."""
+    budget = load_budgets(budgets_path).get("ann", {}).get("recall")
+    if not isinstance(budget, dict):
+        return []
+    root = root or perf_root()
+    path = _newest_ann_bench(root)
+    if path is None:
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path="BENCH_ANN",
+            message=(
+                "no ANN bench recorded yet (BENCH_ANN_r*.json missing); "
+                "run `python bench.py --ann` (it reads the pinned "
+                "recipe from budgets.json 'ann') to stamp one"
+            ),
+        )]
+    label = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable ANN bench: {e}",
+        )]
+
+    problems: List[str] = []
+    data: Dict = {"budget": "ann.recall"}
+
+    # the budget pins the MEASUREMENT RECIPE — recall at a different
+    # geometry or with looser probe/rescore knobs is not comparable
+    recipe = bench.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    for key in ("rows", "dim", "k", "queries", "clusters", "nprobe",
+                "rescore_mult"):
+        pinned = _get(budget.get("recipe") or {}, key)
+        if pinned is None:
+            continue
+        measured = _get(recipe, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"recipe.{key} missing from the bench record")
+        elif measured != pinned:
+            problems.append(
+                f"bench measured with {key}={measured:g} but the budget "
+                f"pins {key}={pinned:g} — re-run `python bench.py --ann`"
+            )
+
+    floor = _get(budget, "min_recall_at_10")
+    modes = bench.get("modes")
+    modes = modes if isinstance(modes, dict) else {}
+    if floor is not None:
+        for mode in ("ivf", "quant"):
+            section = modes.get(mode)
+            recall = (
+                _get(section, "recall_at_10")
+                if isinstance(section, dict) else None
+            )
+            data[f"{mode}_recall_at_10"] = recall
+            if recall is None:
+                problems.append(
+                    f"modes.{mode}.recall_at_10 missing from the bench "
+                    "record"
+                )
+            elif recall < floor:
+                problems.append(
+                    f"modes.{mode}.recall_at_10 {recall:g} < budget "
+                    f"{floor:g} (approximate retrieval is losing true "
+                    "neighbors)"
+                )
+        # the real-vocab-geometry table must hold the same floor — a
+        # recipe tuned to the synthetic distribution alone could ship
+        # a config that loses neighbors at the served geometry
+        real = bench.get("real_table")
+        real = real if isinstance(real, dict) else {}
+        want_rows = _get(budget, "real_table_rows")
+        got_rows = _get(real, "rows")
+        data["real_table_rows"] = got_rows
+        if want_rows is not None and got_rows != want_rows:
+            problems.append(
+                f"real_table.rows is {got_rows} but the budget pins "
+                f"{want_rows:g}"
+            )
+        for key in ("recall_at_10_ivf", "recall_at_10_quant"):
+            recall = _get(real, key)
+            data[f"real_{key}"] = recall
+            if recall is None:
+                problems.append(
+                    f"real_table.{key} missing from the bench record"
+                )
+            elif recall < floor:
+                problems.append(
+                    f"real_table.{key} {recall:g} < budget {floor:g}"
+                )
+
+    # the scaling story: IVF must beat exact by the factor in p99 OR
+    # bytes touched per query; both missing gates (dropping the fields
+    # must never be the way to pass)
+    gain_floor = _get(budget, "min_gain_factor")
+    if gain_floor is not None:
+        ivf = modes.get("ivf")
+        ivf = ivf if isinstance(ivf, dict) else {}
+        speedup = _get(ivf, "p99_speedup_vs_exact")
+        bytes_factor = _get(ivf, "bytes_reduction_vs_exact")
+        data["p99_speedup_vs_exact"] = speedup
+        data["bytes_reduction_vs_exact"] = bytes_factor
+        data["min_gain_factor"] = gain_floor
+        if speedup is None and bytes_factor is None:
+            problems.append(
+                "modes.ivf carries neither p99_speedup_vs_exact nor "
+                "bytes_reduction_vs_exact — the scaling claim is "
+                "unmeasured"
+            )
+        elif max(speedup or 0.0, bytes_factor or 0.0) < gain_floor:
+            problems.append(
+                f"IVF gain vs exact (p99 {speedup}, bytes "
+                f"{bytes_factor}) is below the budget's "
+                f"{gain_floor:g}x — the index no longer pays for "
+                "itself at 1M rows"
+            )
+
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "ANN bench record violates budget 'ann.recall': "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"ANN recall@10 ivf {data.get('ivf_recall_at_10')} / quant "
+            f"{data.get('quant_recall_at_10')} (real table "
+            f"{data.get('real_recall_at_10_ivf')}), IVF gain "
+            f"{max(data.get('p99_speedup_vs_exact') or 0, data.get('bytes_reduction_vs_exact') or 0):g}x "
+            "within budget 'ann.recall'"
+        ),
+        data=data,
+    )]

@@ -426,58 +426,81 @@ def build_serve(
 def serve_bucket_findings(
     dim: int = 16, vocab: int = 128, max_batch: int = 8, k: int = 4
 ) -> List[Finding]:
-    """Jit-cache stability ACROSS the engine's bucketed batch shapes:
-    one warm cycle over every bucket compiles each once; a second cycle
-    must not grow the cache (the padded-shape contract that keeps
-    production request mixes from recompiling)."""
+    """Jit-cache stability ACROSS the engine's bucketed batch shapes,
+    PER INDEX MODE (exact + quant + ivf): one warm cycle over every
+    bucket compiles each once; a second cycle must not grow any mode's
+    cache (the padded-shape contract that keeps production request
+    mixes from recompiling).  The quant/IVF kernels run with a small
+    serve/ann.py index built over the same unit matrix, so the exact
+    entry points ``cli.serve --index`` would bind are the ones
+    compiled."""
     import numpy as _np
+
+    from gene2vec_tpu.serve.ann import build_index
+    from gene2vec_tpu.serve.registry import l2_normalize
 
     engine, unit, _, _ = build_serve(
         dim=dim, vocab=vocab, max_batch=max_batch, k=k
     )
     rng = _np.random.RandomState(1)
-    label = "hlo:serve/buckets"
+    unit_np = l2_normalize(_np.asarray(unit))
+    indexes = {
+        "quant": build_index(unit_np, "quant"),
+        "ivf": build_index(unit_np, "ivf", clusters=max(4, vocab // 16)),
+    }
 
     def cycle():
         for n in engine.buckets:
-            engine.top_k(unit, rng.randn(n, dim).astype(_np.float32), k)
+            q = rng.randn(n, dim).astype(_np.float32)
+            engine.top_k(unit, q, k)
+            for index in indexes.values():
+                engine.top_k_ann(index, unit, q, k)
 
     cycle()
-    after_warmup = engine._cache_size()
-    if after_warmup is None:
+    after_warmup = engine.cache_sizes()
+    if all(v is None for v in after_warmup.values()):
         return [Finding(
             pass_id="hlo-cache-stability",
             severity="info",
-            path=label,
+            path="hlo:serve/buckets",
             message="jit cache size introspection unavailable on this "
                     "jax version; bucket stability not checked",
             data={"checked": False},
         )]
     cycle()
-    after = engine._cache_size()
-    if after > after_warmup:
-        return [Finding(
-            pass_id="hlo-cache-stability",
-            path=label,
-            message=(
-                f"jit cache grew {after_warmup} -> {after} on a repeat "
-                f"cycle over buckets {engine.buckets} — padded request "
-                "shapes are not hitting the compiled executables"
-            ),
-            data={"checked": True, "after_warmup": after_warmup,
-                  "after": after, "buckets": list(engine.buckets)},
-        )]
-    return [Finding(
-        pass_id="hlo-cache-stability",
-        severity="info",
-        path=label,
-        message=(
-            f"stable at {after} cached executable(s) across buckets "
-            f"{engine.buckets}"
-        ),
-        data={"checked": True, "cached": after,
-              "buckets": list(engine.buckets)},
-    )]
+    after = engine.cache_sizes()
+    findings: List[Finding] = []
+    for mode in after:
+        label = f"hlo:serve/buckets/{mode}"
+        warm, now = after_warmup.get(mode), after[mode]
+        if warm is None or now is None:
+            continue
+        if now > warm:
+            findings.append(Finding(
+                pass_id="hlo-cache-stability",
+                path=label,
+                message=(
+                    f"{mode} jit cache grew {warm} -> {now} on a repeat "
+                    f"cycle over buckets {engine.buckets} — padded "
+                    "request shapes are not hitting the compiled "
+                    "executables"
+                ),
+                data={"checked": True, "mode": mode, "after_warmup": warm,
+                      "after": now, "buckets": list(engine.buckets)},
+            ))
+        else:
+            findings.append(Finding(
+                pass_id="hlo-cache-stability",
+                severity="info",
+                path=label,
+                message=(
+                    f"{mode} stable at {now} cached executable(s) "
+                    f"across buckets {engine.buckets}"
+                ),
+                data={"checked": True, "mode": mode, "cached": now,
+                      "buckets": list(engine.buckets)},
+            ))
+    return findings
 
 
 def hot_path_findings(
@@ -597,6 +620,11 @@ def budget_findings(
         )
     for key, entry in budgets.get("serve", {}).items():
         if keys is not None and key not in keys:
+            continue
+        if "mesh" not in entry:
+            # the serve section also carries non-kernel budgets
+            # (capacity_rps, gated by passes_serve at the default tier);
+            # only entries pinning a mesh geometry compile here
             continue
         _, _, lowered, _ = build_serve(
             dim=entry["dim"],

@@ -165,6 +165,11 @@ def _run(args) -> int:
         )
 
         findings.extend(serve_capacity_findings())
+        # ... and the ANN retrieval gate (BENCH_ANN recall@10 +
+        # scaling factors vs budgets.json "ann.recall", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
+
+        findings.extend(ann_recall_findings())
 
     if args.hlo:
         _pin_cpu_backend()

@@ -90,6 +90,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard-rows", action="store_true",
                    help="row-shard the table over every visible device "
                         "(parallel/sharding.py row_sharding)")
+    p.add_argument("--index", choices=("exact", "quant", "ivf"),
+                   default="exact",
+                   help="retrieval index (serve/ann.py; docs/SERVING.md "
+                        "'Index modes & capacity planning'): exact = "
+                        "full f32 brute force (default, bitwise-"
+                        "identical to the pre-index engine); quant = "
+                        "int8 compressed scan + exact-rescore tail; "
+                        "ivf = k-means centroid scan -> --nprobe "
+                        "inverted lists -> int8 candidates -> exact "
+                        "rescore (centroids cached under "
+                        "<export-dir>/ann_cache keyed by table CRC)")
+    p.add_argument("--nprobe", type=int, default=8,
+                   help="IVF lists probed per query (recall/latency "
+                        "knob; ignored unless --index ivf)")
+    p.add_argument("--rescore-mult", type=int, default=4,
+                   help="exact-rescore tail size as a multiple of k "
+                        "(quant/ivf modes; higher = more recall "
+                        "headroom per query)")
+    p.add_argument("--ann-clusters", type=int, default=None,
+                   help="IVF centroid count (default ~4*sqrt(vocab))")
     return p
 
 
@@ -135,7 +155,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         sharding = row_sharding(mesh)
     registry = ModelRegistry(
         args.export_dir, dim=args.dim, sharding=sharding,
-        metrics=run.registry,
+        metrics=run.registry, index_mode=args.index,
+        ann_clusters=args.ann_clusters,
     )
     if not registry.refresh():
         print(
@@ -160,6 +181,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_conn_requests=args.max_conn_requests,
             acceptors=args.acceptors,
             http_workers=args.http_workers,
+            index=args.index,
+            nprobe=args.nprobe,
+            rescore_mult=args.rescore_mult,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,
@@ -198,6 +222,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "dim": model.dim,
                 "iteration": model.iteration,
                 "run_dir": run.run_dir,
+                "index": args.index,
             }
         ),
         flush=True,

@@ -257,11 +257,45 @@ def _adapt_perf(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "timeline_regression_frac"
 
 
+def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_ANN_* (bench.py --ann): per-index-mode recall@10 vs the
+    exact numpy oracle, p50/p99 at the 1M-row synthetic geometry, and
+    the IVF-vs-exact gain factors the ``ann.recall`` budget gates."""
+    m: Dict[str, float] = {}
+    modes = doc.get("modes")
+    modes = modes if isinstance(modes, dict) else {}
+    for mode in ("exact", "quant", "ivf"):
+        section = modes.get(mode)
+        if not isinstance(section, dict):
+            continue
+        _put(m, f"ann_{mode}_recall_at_10", section.get("recall_at_10"))
+        _put(m, f"ann_{mode}_p50_ms", section.get("p50_ms"))
+        _put(m, f"ann_{mode}_p99_ms", section.get("p99_ms"))
+        _put(m, f"ann_{mode}_bytes_per_query",
+             section.get("bytes_per_query"))
+    ivf = modes.get("ivf")
+    if isinstance(ivf, dict):
+        # the two headline series the perf.regression rules watch
+        _put(m, "ann_recall_at_10", ivf.get("recall_at_10"))
+        _put(m, "ann_p99_ms_1m", ivf.get("p99_ms"))
+        _put(m, "ann_p99_speedup_vs_exact",
+             ivf.get("p99_speedup_vs_exact"))
+        _put(m, "ann_bytes_reduction_vs_exact",
+             ivf.get("bytes_reduction_vs_exact"))
+    real = doc.get("real_table")
+    if isinstance(real, dict):
+        _put(m, "ann_real_recall_at_10_ivf", real.get("recall_at_10_ivf"))
+        _put(m, "ann_real_recall_at_10_quant",
+             real.get("recall_at_10_quant"))
+    return m, "ann_recall_at_10"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),
+    (re.compile(r"^BENCH_ANN_\w*\.json$"), "ann", _adapt_ann),
     (re.compile(r"^BENCH_SERVE_\w*\.json$"), "serve_loadgen", _adapt_serve),
     (re.compile(r"^BENCH_FLEET_\w*\.json$"), "fleet_chaos", _adapt_fleet),
     (re.compile(r"^BENCH_OBS_\w*\.json$"), "obs_trace", _adapt_obs_trace),

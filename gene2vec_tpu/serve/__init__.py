@@ -4,8 +4,11 @@ Layering (docs/SERVING.md):
 
 * :mod:`~gene2vec_tpu.serve.registry` — checkpoint discovery + atomic
   hot swap of the device-resident L2-normalized table;
-* :mod:`~gene2vec_tpu.serve.engine` — the jitted bucketed top-k cosine
-  kernel;
+* :mod:`~gene2vec_tpu.serve.engine` — the jitted bucketed top-k engine
+  (exact | quant | ivf index modes);
+* :mod:`~gene2vec_tpu.serve.ann` — approximate retrieval: int8
+  per-row-quantized scoring tables and the IVF two-stage index, both
+  with an exact-rescore tail;
 * :mod:`~gene2vec_tpu.serve.batcher` — micro-batching with max-delay /
   max-batch admission, bounded-queue backpressure, deadlines, LRU;
 * :mod:`~gene2vec_tpu.serve.interaction` — GGIPNN pair scoring;
@@ -35,7 +38,8 @@ from gene2vec_tpu.serve.client import (
     ResilientClient,
     RetryPolicy,
 )
-from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.ann import AnnIndex, build_index
+from gene2vec_tpu.serve.engine import BucketedTopKEngine, SimilarityEngine
 from gene2vec_tpu.serve.eventloop import (
     EventLoopConfig,
     EventLoopHTTPServer,
@@ -45,6 +49,9 @@ from gene2vec_tpu.serve.registry import LoadedModel, ModelRegistry
 from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
 
 __all__ = [
+    "AnnIndex",
+    "BucketedTopKEngine",
+    "build_index",
     "CircuitBreaker",
     "ClientResponse",
     "DeadlineExceeded",

@@ -1,0 +1,666 @@
+"""Approximate + quantized retrieval: IVF two-stage top-k with int8
+scoring and an exact-rescore tail.
+
+The exact engine (``serve/engine.py``) brute-forces cosine over the
+whole table every query — the right shape at vocab 24,447, the wrong
+one for million-row tables (ROADMAP open item 2).  This module supplies
+the two standard compressed-domain index structures (Jégou et al.'s
+product-quantization lineage as scaled by FAISS):
+
+* **Quantized scoring table** — int8 symmetric per-row quantization of
+  the unit matrix (``q[i] = round(unit[i] / scale[i])``, ``scale[i] =
+  max|unit[i]| / 127`` — the same symmetric-scale convention as the
+  TPU quantization kernels) with a float32 scale vector, or a bf16
+  table where the backend supports it.  Queries quantize in-trace; the
+  approximate scan is one int8×int8 matmul accumulated in int32 (1/4
+  of the f32 memory traffic), the approximate top-``r`` candidates are
+  then **exactly rescored** against the float32 unit rows (``r =
+  rescore_mult * k``), so quantization noise costs extra candidates,
+  never wrong answers.
+* **IVF two-stage index** — k-means centroids built offline over the
+  table (cached next to the checkpoint, keyed by table CRC); each row
+  lives in exactly one inverted list (capacity-capped; overflow spills
+  to the row's next-nearest centroid so one mega-cluster cannot blow
+  up every probe).  A query scans the centroids, probes the ``nprobe``
+  nearest lists, int8-scores only those candidates, and exact-rescores
+  the approximate top-``r`` — bytes touched per query drop from
+  ``V*D*4`` to ``C*D*4 + nprobe*L*(D+8) + r*D*4``.
+
+Both index shapes ride the model snapshot
+(:class:`~gene2vec_tpu.serve.registry.LoadedModel` carries the index
+built for exactly its table), so the registry's atomic hot swap swaps
+table and index as ONE reference — a reader can never score against a
+mismatched pair.  Sharded variants reuse the two-stage distributed
+top-k merge in ``parallel/sharding.py`` (local candidate scan, then a
+``(B, P*k)`` gather instead of an all-gather of the score matrix).
+
+The kernels here are jit-TARGETS: ``serve/engine.py`` binds them with
+``jax.jit`` once per index mode and buckets batch/k/rescore shapes to
+powers of two, so the per-mode jit cache stays bounded
+(``analysis/passes_hlo.py`` cycles every mode's buckets and asserts
+the cache stops growing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+INDEX_MODES = ("exact", "quant", "ivf")
+
+#: quantized-table widths build_index accepts
+QUANT_DTYPES = ("int8", "bf16")
+
+_EPS = 1e-12
+
+
+# -- host-side build ---------------------------------------------------------
+
+
+def table_crc(unit: np.ndarray) -> int:
+    """CRC32 of the table bytes — the cache key that pins a built index
+    to exactly the table it was built from."""
+    return zlib.crc32(np.ascontiguousarray(unit, dtype=np.float32)) & 0xFFFFFFFF
+
+
+def quantize_rows(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization: ``(q, scale)`` with
+    ``q * scale[:, None] ~= x`` and ``scale = max|row| / 127`` (zero
+    rows get an epsilon scale and stay zero)."""
+    x = np.asarray(x, dtype=np.float32)
+    scale = np.abs(x).max(axis=1) / 127.0
+    scale = np.maximum(scale, _EPS).astype(np.float32)
+    q = np.clip(np.rint(x / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def default_clusters(rows: int) -> int:
+    """Heuristic centroid count: ~4·sqrt(V) clamped to [8, 4096] (the
+    FAISS guidance band), never more than rows // 4."""
+    c = int(4.0 * np.sqrt(max(rows, 1)))
+    return max(1, min(max(8, c), 4096, max(1, rows // 4)))
+
+
+def kmeans_centroids(
+    unit: np.ndarray,
+    clusters: int,
+    iters: int = 8,
+    sample: int = 131072,
+    seed: int = 0,
+) -> np.ndarray:
+    """Spherical k-means on (a sample of) the unit rows — returns
+    L2-normalized (C, D) float32 centroids.  Sampled training is the
+    FAISS convention: centroid quality saturates long before the full
+    table is seen, and the full-table assignment pass happens once in
+    :func:`build_lists` anyway."""
+    rng = np.random.RandomState(seed)
+    unit = np.asarray(unit, dtype=np.float32)
+    rows = unit.shape[0]
+    clusters = min(int(clusters), rows)
+    xs = (
+        unit[rng.choice(rows, sample, replace=False)]
+        if 0 < sample < rows else unit
+    )
+    cent = xs[rng.choice(xs.shape[0], clusters, replace=False)].copy()
+    for _ in range(max(1, int(iters))):
+        cn = cent / np.maximum(
+            np.linalg.norm(cent, axis=1, keepdims=True), _EPS
+        )
+        assign = np.argmax(xs @ cn.T, axis=1)
+        sums = np.zeros_like(cent)
+        np.add.at(sums, assign, xs)
+        counts = np.bincount(assign, minlength=clusters).astype(np.float32)
+        refreshed = xs[rng.randint(xs.shape[0], size=clusters)]
+        cent = np.where(
+            (counts == 0)[:, None],  # dead centroid: reseed from data
+            refreshed,
+            sums / np.maximum(counts, 1.0)[:, None],
+        )
+    return cent / np.maximum(np.linalg.norm(cent, axis=1, keepdims=True), _EPS)
+
+
+def build_lists(
+    unit: np.ndarray,
+    centroids: np.ndarray,
+    cap_mult: float = 2.0,
+    choices: int = 4,
+    chunk: int = 65536,
+) -> np.ndarray:
+    """(C, L) int32 inverted lists over the table rows, ``-1``-padded.
+
+    Every row lands in exactly ONE list.  ``L`` is a power of two near
+    ``cap_mult`` times the mean list size: rows overflowing their
+    nearest centroid's capacity spill to the next-nearest centroid with
+    space (up to ``choices`` candidates, then any list with room), so a
+    pathological mega-cluster bounds the per-probe candidate count
+    instead of inflating every query's scan.  The common case (row fits
+    its nearest list) places vectorized; only the overflow tail pays a
+    per-row pass."""
+    unit = np.asarray(unit, dtype=np.float32)
+    rows, C = unit.shape[0], centroids.shape[0]
+    mean = max(1, rows // max(C, 1))
+    cap = 1 << max(0, int(np.ceil(cap_mult * mean)) - 1).bit_length()
+    while cap * C < rows:  # capacity must fit every row
+        cap *= 2
+    choices = min(max(1, int(choices)), C)
+    assign = np.empty(rows, np.int64)
+    for s in range(0, rows, chunk):
+        assign[s : s + chunk] = np.argmax(
+            unit[s : s + chunk] @ centroids.T, axis=1
+        )
+    lists = np.full((C, cap), -1, dtype=np.int32)
+    # group rows by cluster; each row's rank within its cluster decides
+    # whether it fits under the cap (stable order: low row ids first)
+    order = np.argsort(assign, kind="stable")
+    a_sorted = assign[order]
+    counts = np.bincount(assign, minlength=C)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    within = np.arange(rows) - starts[a_sorted]
+    fits = within < cap
+    lists[a_sorted[fits], within[fits]] = order[fits].astype(np.int32)
+    fill = np.minimum(counts, cap).astype(np.int64)
+    overflow = order[~fits]
+    if overflow.size:
+        # spill each overflow row to its best-scoring centroid with
+        # space (next-nearest first), then any list with room
+        block = unit[overflow] @ centroids.T
+        pref = np.argsort(-block, axis=1)[:, :choices]
+        for j, i in enumerate(overflow):
+            for c in pref[j]:
+                if fill[c] < cap:
+                    lists[c, fill[c]] = i
+                    fill[c] += 1
+                    break
+            else:
+                c = int(np.argmin(fill))
+                lists[c, fill[c]] = i
+                fill[c] += 1
+    return lists
+
+
+# -- the index ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AnnIndex:
+    """One immutable built index, device-resident, pinned to one table.
+
+    ``table_q`` is the quantized scoring table (int8, with ``scale``;
+    or bf16, scale unused), row-padded exactly like the model's unit
+    matrix when placed sharded.  ``centroids``/``lists`` are the IVF
+    stage (``None`` in pure-quant mode).  ``version`` mirrors
+    ``LoadedModel.version`` so readers can assert the pair cohere, and
+    ``crc`` pins the index to the table bytes it was built from."""
+
+    mode: str
+    table_q: "object"            # jax.Array (V, D) int8 | bf16
+    scale: "object"              # jax.Array (V,) f32
+    centroids: Optional["object"]  # jax.Array (C, D) f32
+    lists: Optional["object"]      # jax.Array (C, L) int32
+    crc: int
+    version: Optional[Tuple[int, int]] = None
+    built_from_cache: bool = False
+    build_seconds: float = 0.0
+
+    @property
+    def n_clusters(self) -> int:
+        return 0 if self.centroids is None else int(self.centroids.shape[0])
+
+    @property
+    def list_len(self) -> int:
+        return 0 if self.lists is None else int(self.lists.shape[1])
+
+
+def _cache_name(tag: str, clusters: int, crc: int) -> str:
+    return f"ivf_{tag}_c{clusters}_crc{crc:08x}.npz"
+
+
+def _load_centroid_cache(
+    path: str, crc: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(centroids, lists) from a cache file, or None when the file is
+    missing, unreadable, or stamped with a different table CRC (a
+    forged/stale file must trigger a rebuild, never a silent reuse)."""
+    try:
+        with np.load(path) as z:
+            meta = json.loads(str(z["meta"]))
+            if int(meta.get("crc", -1)) != crc:
+                return None
+            return (
+                np.asarray(z["centroids"], dtype=np.float32),
+                np.asarray(z["lists"], dtype=np.int32),
+            )
+    except Exception:
+        # any unreadable cache (missing, truncated zip, rotted pickle,
+        # wrong shape) means REBUILD — a bad cache file must never be
+        # able to block loading a perfectly good checkpoint
+        return None
+
+
+def build_index(
+    unit: np.ndarray,
+    mode: str,
+    *,
+    clusters: Optional[int] = None,
+    nprobe_hint: int = 8,
+    seed: int = 0,
+    quant_dtype: str = "int8",
+    cache_dir: Optional[str] = None,
+    tag: str = "table",
+    version: Optional[Tuple[int, int]] = None,
+    sharding=None,
+    pad_rows: int = 0,
+) -> AnnIndex:
+    """Build (or load from cache) the index for one unit matrix.
+
+    ``unit`` is the UNPADDED L2-normalized table — IVF lists only ever
+    reference real rows.  ``pad_rows`` appends that many zero rows to
+    the quantized table so a sharded placement (``sharding``) divides
+    evenly, mirroring the registry's unit-matrix padding.  With
+    ``cache_dir``, the k-means centroids + lists are cached under a
+    name keyed by ``tag`` and the table CRC: a re-exported checkpoint
+    with different bytes under the same name misses the cache and
+    rebuilds (and a cache file whose stamped CRC disagrees with the
+    table is ignored)."""
+    import jax
+    import jax.numpy as jnp
+
+    if mode not in ("quant", "ivf"):
+        raise ValueError(f"build_index mode must be quant|ivf, got {mode!r}")
+    if quant_dtype not in QUANT_DTYPES:
+        raise ValueError(
+            f"quant_dtype must be one of {QUANT_DTYPES}, got {quant_dtype!r}"
+        )
+    t0 = time.monotonic()
+    unit = np.asarray(unit, dtype=np.float32)
+    crc = table_crc(unit)
+
+    cent_np = lists_np = None
+    from_cache = False
+    if mode == "ivf":
+        n_clusters = int(clusters or default_clusters(unit.shape[0]))
+        cache_path = None
+        if cache_dir:
+            cache_path = os.path.join(
+                cache_dir, _cache_name(tag, n_clusters, crc)
+            )
+            cached = _load_centroid_cache(cache_path, crc)
+            if cached is not None:
+                cent_np, lists_np = cached
+                from_cache = True
+        if cent_np is None:
+            cent_np = kmeans_centroids(unit, n_clusters, seed=seed)
+            lists_np = build_lists(unit, cent_np)
+            if cache_path is not None:
+                from gene2vec_tpu.resilience.snapshot import atomic_savez
+
+                os.makedirs(cache_dir, exist_ok=True)
+                atomic_savez(
+                    cache_path,
+                    centroids=cent_np,
+                    lists=lists_np,
+                    meta=json.dumps({
+                        "crc": crc,
+                        "clusters": int(cent_np.shape[0]),
+                        "rows": int(unit.shape[0]),
+                        "nprobe_hint": int(nprobe_hint),
+                    }),
+                )
+
+    if quant_dtype == "bf16":
+        tq_np = unit.astype(jnp.bfloat16)
+        scale_np = np.ones(unit.shape[0], np.float32)
+    else:
+        tq_np, scale_np = quantize_rows(unit)
+    if pad_rows:
+        tq_np = np.concatenate(
+            [tq_np, np.zeros((pad_rows, tq_np.shape[1]), tq_np.dtype)]
+        )
+        scale_np = np.concatenate(
+            [scale_np, np.full(pad_rows, _EPS, np.float32)]
+        )
+
+    if sharding is not None:
+        table_q = jax.device_put(jnp.asarray(tq_np), sharding)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec0 = sharding.spec[0]
+        scale = jax.device_put(
+            jnp.asarray(scale_np), NamedSharding(sharding.mesh, P(spec0))
+        )
+    else:
+        table_q = jnp.asarray(tq_np)
+        scale = jnp.asarray(scale_np)
+    centroids = jnp.asarray(cent_np) if cent_np is not None else None
+    lists = jnp.asarray(lists_np) if lists_np is not None else None
+    table_q.block_until_ready()
+    return AnnIndex(
+        mode=mode,
+        table_q=table_q,
+        scale=scale,
+        centroids=centroids,
+        lists=lists,
+        crc=crc,
+        version=version,
+        built_from_cache=from_cache,
+        build_seconds=time.monotonic() - t0,
+    )
+
+
+# -- bytes accounting --------------------------------------------------------
+
+
+def bytes_per_query(
+    mode: str,
+    rows: int,
+    dim: int,
+    *,
+    r: int = 0,
+    clusters: int = 0,
+    list_len: int = 0,
+    nprobe: int = 0,
+) -> float:
+    """Analytic table bytes TOUCHED per single query — the memory-
+    traffic side of the scaling story (docs/SERVING.md "Index modes &
+    capacity planning" derives these).  exact: the full f32 table.
+    quant: the full int8 table + scale vector + the r rescored f32
+    rows.  ivf: the f32 centroids + the probed lists' int8 rows (ids +
+    scales included) + the r rescored f32 rows."""
+    if mode == "exact":
+        return float(rows * dim * 4)
+    if mode == "quant":
+        return float(rows * dim + rows * 4 + r * dim * 4)
+    if mode == "ivf":
+        probed = min(nprobe * list_len, rows) if list_len else rows
+        return float(
+            clusters * dim * 4 + probed * (dim + 8) + r * dim * 4
+        )
+    raise ValueError(f"unknown mode {mode!r}")
+
+
+# -- jit-target kernels ------------------------------------------------------
+
+
+def _normalize(queries):
+    import jax.numpy as jnp
+
+    norms = jnp.sqrt(jnp.sum(queries * queries, axis=1, keepdims=True))
+    return queries / jnp.maximum(norms, _EPS)
+
+
+def _quantize_queries(qn):
+    """In-trace symmetric per-row int8 quantization of the (already
+    normalized) queries: (q_int8, scale_f32[:, None])."""
+    import jax.numpy as jnp
+
+    qs = jnp.maximum(jnp.max(jnp.abs(qn), axis=1) / 127.0, _EPS)
+    qq = jnp.clip(jnp.round(qn / qs[:, None]), -127, 127).astype(jnp.int8)
+    return qq, qs[:, None]
+
+
+def _approx_scores(qn, table_q, scale):
+    """(B, V) approximate cosine scores in the table's compressed
+    domain: int8×int8 matmul accumulated in int32, rescaled by the
+    query/row scales — or a bf16 matmul when the table is bf16."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if table_q.dtype == jnp.int8:
+        qq, qs = _quantize_queries(qn)
+        acc = lax.dot_general(
+            qq, table_q,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return acc.astype(jnp.float32) * qs * scale[None, :]
+    return lax.dot_general(
+        qn.astype(table_q.dtype), table_q,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _gathered_approx(qn, table_q, scale, pos):
+    """Approximate scores for explicit candidates: gather the (B, N)
+    candidate rows and batch-contract — only the probed rows' bytes are
+    touched, which is the whole point of the IVF stage."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rows = table_q[pos]                       # (B, N, D)
+    if table_q.dtype == jnp.int8:
+        qq, qs = _quantize_queries(qn)
+        acc = lax.dot_general(
+            qq, rows,
+            dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
+        )
+        return acc.astype(jnp.float32) * qs * scale[pos]
+    return lax.dot_general(
+        qn.astype(table_q.dtype), rows,
+        dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _rescore_topk(qn, unit, ri, approx_ok, k):
+    """Exact-rescore tail: gather the candidate unit rows in f32, score
+    exactly, and return the final (scores, row ids) top-k.  ``ri`` may
+    carry invalid entries (list padding); ``approx_ok`` masks them."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    pos = jnp.where(approx_ok, ri, 0)
+    cand_rows = unit[pos]                                   # (B, r, D) f32
+    exact = lax.dot_general(
+        qn, cand_rows,
+        dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+    )
+    exact = jnp.where(approx_ok, exact, -jnp.inf)
+    fs, fi = lax.top_k(exact, min(k, exact.shape[1]))
+    return fs, jnp.take_along_axis(pos, fi, axis=1)
+
+
+def make_quant_kernel(mesh=None, axis: str = "model"):
+    """``fn(table_q, scale, unit, queries, k, r, valid)`` — full-table
+    compressed scan, approximate top-``r``, exact rescore, top-``k``.
+    With a mesh, the scan runs shard-local over the row-sharded tables
+    and only the per-shard exact top-k candidates gather
+    (``parallel/sharding.py:two_stage_topk``)."""
+    if mesh is None:
+        def quant_topk(table_q, scale, unit, queries, k: int, r: int,
+                       valid: Optional[int]):
+            import jax.numpy as jnp
+            from jax import lax
+
+            qn = _normalize(queries)
+            approx = _approx_scores(qn, table_q, scale)
+            total = table_q.shape[0]
+            ok = None
+            if valid is not None and valid < total:
+                ok = jnp.arange(total)[None, :] < valid
+                approx = jnp.where(ok, approx, -jnp.inf)
+            _, ri = lax.top_k(approx, min(r, total))
+            ok_r = (
+                jnp.take_along_axis(
+                    jnp.broadcast_to(ok, approx.shape), ri, axis=1
+                )
+                if ok is not None
+                else jnp.ones(ri.shape, bool)
+            )
+            return _rescore_topk(qn, unit, ri, ok_r, k)
+
+        return quant_topk
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from gene2vec_tpu.parallel.sharding import two_stage_topk
+
+    def quant_topk_sharded(table_q, scale, unit, queries, k: int, r: int,
+                           valid: Optional[int]):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        total = table_q.shape[0]
+        shard_rows = total // mesh.shape[axis]
+
+        def local(tq_s, sc_s, un_s, q_rep):
+            qn = _normalize(q_rep)
+            approx = _approx_scores(qn, tq_s, sc_s)         # (B, V/P)
+            base = jax.lax.axis_index(axis) * shard_rows
+            ok = None
+            if valid is not None and valid < total:
+                ok = (base + jnp.arange(shard_rows))[None, :] < valid
+                approx = jnp.where(ok, approx, -jnp.inf)
+            rs, li = lax.top_k(approx, min(r, shard_rows))
+            ok_r = jnp.isfinite(rs)
+            exact, gids = _rescore_topk(
+                qn, un_s, li, ok_r, min(r, shard_rows)
+            )
+            return two_stage_topk(axis, exact, k, ids=gids + base)
+
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(axis, None), P(None, None)),
+            out_specs=(P(None, None), P(None, None)),
+            check_rep=False,
+        )(table_q, scale, unit, queries)
+
+    return quant_topk_sharded
+
+
+def make_ivf_kernel(mesh=None, axis: str = "model"):
+    """``fn(centroids, lists, table_q, scale, unit, queries, nprobe, k,
+    r, valid)`` — centroid scan → probe ``nprobe`` lists → compressed
+    candidate scan → approximate top-``r`` → exact rescore → top-``k``.
+    Lists hold only real row ids (< valid) so no pad masking is needed
+    beyond the ``-1`` list padding.  The sharded variant replicates
+    centroids/lists, scans each shard's own candidate rows, and merges
+    via the two-stage distributed top-k."""
+    if mesh is None:
+        def ivf_topk(centroids, lists, table_q, scale, unit, queries,
+                     nprobe: int, k: int, r: int, valid: Optional[int]):
+            import jax.numpy as jnp
+            from jax import lax
+
+            qn = _normalize(queries)
+            cs = qn @ centroids.T                           # (B, C)
+            _, ci = lax.top_k(cs, nprobe)                   # (B, nprobe)
+            cand = lists[ci].reshape(qn.shape[0], -1)       # (B, N)
+            ok = cand >= 0
+            if valid is not None and valid < table_q.shape[0]:
+                # registry-built lists never reference pad rows, but
+                # the top_k contract lets any caller restrict to a
+                # row prefix — honor it like the exact/quant kernels
+                ok &= cand < valid
+            pos = jnp.where(ok, cand, 0)
+            approx = _gathered_approx(qn, table_q, scale, pos)
+            approx = jnp.where(ok, approx, -jnp.inf)
+            r_eff = min(r, approx.shape[1])
+            rs, rpos = lax.top_k(approx, r_eff)
+            ri = jnp.take_along_axis(pos, rpos, axis=1)
+            return _rescore_topk(qn, unit, ri, jnp.isfinite(rs), k)
+
+        return ivf_topk
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from gene2vec_tpu.parallel.sharding import two_stage_topk
+
+    def ivf_topk_sharded(centroids, lists, table_q, scale, unit, queries,
+                         nprobe: int, k: int, r: int,
+                         valid: Optional[int]):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        total = table_q.shape[0]
+        shard_rows = total // mesh.shape[axis]
+
+        def local(cent, lst, tq_s, sc_s, un_s, q_rep):
+            qn = _normalize(q_rep)
+            cs = qn @ cent.T
+            _, ci = lax.top_k(cs, nprobe)
+            cand = lst[ci].reshape(qn.shape[0], -1)         # global ids
+            base = jax.lax.axis_index(axis) * shard_rows
+            mine = (cand >= base) & (cand < base + shard_rows)
+            if valid is not None and valid < total:
+                mine &= cand < valid
+            pos = jnp.where(mine, cand - base, 0)
+            approx = _gathered_approx(qn, tq_s, sc_s, pos)
+            approx = jnp.where(mine, approx, -jnp.inf)
+            r_eff = min(r, approx.shape[1])
+            rs, rpos = lax.top_k(approx, r_eff)
+            lpos = jnp.take_along_axis(pos, rpos, axis=1)
+            exact, sel = _rescore_topk(
+                qn, un_s, lpos, jnp.isfinite(rs), r_eff
+            )
+            return two_stage_topk(axis, exact, k, ids=sel + base)
+
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                P(None, None), P(None, None), P(axis, None), P(axis),
+                P(axis, None), P(None, None),
+            ),
+            out_specs=(P(None, None), P(None, None)),
+            check_rep=False,
+        )(centroids, lists, table_q, scale, unit, queries)
+
+    return ivf_topk_sharded
+
+
+# -- numpy oracle (tests / bench) --------------------------------------------
+
+
+def exact_oracle(
+    unit: np.ndarray, queries: np.ndarray, k: int, chunk: int = 128
+) -> np.ndarray:
+    """(Q, k) row indices of the exact cosine top-k — the recall
+    reference the bench and the recall harness score against."""
+    unit = np.asarray(unit, dtype=np.float32)
+    qn = np.asarray(queries, dtype=np.float32)
+    qn = qn / np.maximum(np.linalg.norm(qn, axis=1, keepdims=True), _EPS)
+    out = np.empty((qn.shape[0], k), np.int64)
+    for s in range(0, qn.shape[0], chunk):
+        scores = qn[s : s + chunk] @ unit.T
+        part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        order = np.argsort(
+            -np.take_along_axis(scores, part, axis=1), axis=1
+        )
+        out[s : s + chunk] = np.take_along_axis(part, order, axis=1)
+    return out
+
+
+def recall_at_k(found_idx: np.ndarray, oracle_idx: np.ndarray) -> float:
+    """Mean fraction of oracle rows recovered, per query."""
+    hits = 0
+    for f, o in zip(found_idx, oracle_idx):
+        hits += len(set(int(i) for i in f) & set(int(i) for i in o))
+    return hits / float(oracle_idx.size)
+
+
+def index_stats(index: AnnIndex) -> Dict:
+    """JSON-ready facts about one built index (bench + /healthz use)."""
+    return {
+        "mode": index.mode,
+        "dtype": str(np.dtype("int8"))
+        if str(index.table_q.dtype) == "int8" else str(index.table_q.dtype),
+        "rows": int(index.table_q.shape[0]),
+        "clusters": index.n_clusters,
+        "list_len": index.list_len,
+        "crc": index.crc,
+        "built_from_cache": index.built_from_cache,
+        "build_seconds": round(index.build_seconds, 3),
+    }

@@ -1,29 +1,38 @@
-"""Jitted top-k cosine-similarity kernel with bucketed batch shapes.
+"""Jitted top-k cosine-similarity kernels with bucketed batch shapes.
 
-One compiled program per (batch-bucket, k-bucket) pair serves every
-query: batches pad up to the next power-of-two bucket and ``k`` rounds
-up the same way, so the jit cache holds at most
+One compiled program per (index mode, batch-bucket, k-bucket) serves
+every query: batches pad up to the next power-of-two bucket and ``k``
+rounds up the same way, so each mode's jit cache holds at most
 ``len(buckets) x len(k-buckets)`` executables no matter what request
-mix arrives — graftcheck's ``hlo-cache-stability`` pass compiles this
-exact entry point and asserts the cache stops growing once the buckets
-are warm (``analysis/passes_hlo.py:build_serve``).
+mix arrives — graftcheck's ``hlo-cache-stability`` pass compiles these
+exact entry points and asserts each mode's cache stops growing once
+the buckets are warm (``analysis/passes_hlo.py:serve_bucket_findings``).
 
-The kernel itself is one matmul plus ``jax.lax.top_k``: queries are
-L2-normalized *inside* the traced function (zero rows stay zero), so
-cosine scores come out of ``queries @ unitᵀ`` directly.  The matrix may
-be row-sharded over a mesh axis (``parallel/sharding.py:row_sharding``)
-— per-shard score columns compute locally and only the top-k selection
-communicates, a per-query byte budget enforced by the ``serve`` section
-of ``analysis/budgets.json``.
+Index modes (:data:`INDEX_MODES`, selected by ``cli.serve --index``):
+
+* ``exact`` (default) — one matmul plus ``jax.lax.top_k`` over the full
+  f32 unit matrix, bitwise-identical to the engine before index modes
+  existed;
+* ``quant`` — int8 (or bf16) compressed full-table scan with an
+  exact-rescore tail (``serve/ann.py``);
+* ``ivf`` — centroid scan → ``nprobe`` inverted lists → compressed
+  candidate scan → exact-rescore tail.
+
+The matrix may be row-sharded over a mesh axis
+(``parallel/sharding.py:row_sharding``) — per-shard score columns
+compute locally and only the top-k selection communicates
+(``two_stage_topk``), a per-query byte budget enforced by the ``serve``
+section of ``analysis/budgets.json``.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from gene2vec_tpu.obs.trace import ambient_span
+from gene2vec_tpu.serve.ann import INDEX_MODES, AnnIndex
 
 
 def next_pow2(n: int) -> int:
@@ -53,13 +62,14 @@ def _topk_cosine(unit, queries, k: int, valid: Optional[int]):
 def _make_topk_sharded(mesh, axis: str):
     """Two-stage distributed top-k over a row-sharded unit matrix:
     each shard computes its local score columns and local top-k, then
-    only the (B, P*k) candidate sets gather — 1 KB/query at the
-    full-vocab dim-512 geometry vs 98 KB/query for the single-shot
-    ``lax.top_k`` the SPMD partitioner lowers (it all-gathers the whole
-    (B, V) score matrix).  Exact: any global top-k row is in its own
-    shard's top-k, so the candidate union always contains the answer."""
+    only the (B, P*k) candidate sets gather
+    (``parallel/sharding.py:two_stage_topk`` — the merge the ANN
+    kernels reuse).  Exact: any global top-k row is in its own shard's
+    top-k, so the candidate union always contains the answer."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from gene2vec_tpu.parallel.sharding import two_stage_topk
 
     def _topk_cosine_sharded(unit, queries, k: int, valid: Optional[int]):
         import jax
@@ -69,7 +79,6 @@ def _make_topk_sharded(mesh, axis: str):
         qn = queries / jnp.maximum(norms, 1e-12)
         total_rows = unit.shape[0]
         shard_rows = total_rows // mesh.shape[axis]
-        lk = min(k, shard_rows)
 
         def local(unit_shard, qn_rep):
             scores = qn_rep @ unit_shard.T            # (B, V/P), local
@@ -79,12 +88,7 @@ def _make_topk_sharded(mesh, axis: str):
                 scores = jnp.where(
                     (rows >= valid)[None, :], -jnp.inf, scores
                 )
-            ls, li = jax.lax.top_k(scores, lk)        # local candidates
-            gi = li + base
-            ls_all = jax.lax.all_gather(ls, axis, axis=1, tiled=True)
-            gi_all = jax.lax.all_gather(gi, axis, axis=1, tiled=True)
-            fs, fi = jax.lax.top_k(ls_all, k)
-            return fs, jnp.take_along_axis(gi_all, fi, axis=1)
+            return two_stage_topk(axis, scores, k, base=base)
 
         return shard_map(
             local,
@@ -97,14 +101,35 @@ def _make_topk_sharded(mesh, axis: str):
     return _topk_cosine_sharded
 
 
-class SimilarityEngine:
-    """Bucketed batched top-k over a device-resident unit matrix."""
+class BucketedTopKEngine:
+    """Bucketed batched top-k over a device-resident unit matrix, with
+    an optional quantized/IVF approximate path (``index=``) whose
+    candidates are always exact-rescored before anything is returned.
 
-    def __init__(self, max_batch: int = 64, mesh=None, axis: str = "model"):
+    ``nprobe`` (IVF lists probed per query) and ``rescore_mult``
+    (exact-rescore tail size, ``r = rescore_mult * k``) are the two
+    recall/latency knobs; ``--index exact`` bypasses both and is
+    bitwise-identical to the pre-ANN engine."""
+
+    def __init__(
+        self,
+        max_batch: int = 64,
+        mesh=None,
+        axis: str = "model",
+        index: str = "exact",
+        nprobe: int = 8,
+        rescore_mult: int = 4,
+    ):
         import jax
 
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if index not in INDEX_MODES:
+            raise ValueError(
+                f"index must be one of {INDEX_MODES}, got {index!r}"
+            )
+        if nprobe < 1 or rescore_mult < 1:
+            raise ValueError("nprobe and rescore_mult must be >= 1")
         self.max_batch = next_pow2(max_batch)
         #: ascending padded batch shapes the jit cache may hold
         self.buckets: Tuple[int, ...] = tuple(
@@ -112,6 +137,9 @@ class SimilarityEngine:
         )
         self.mesh = mesh
         self.axis = axis
+        self.index_mode = index
+        self.nprobe = int(nprobe)
+        self.rescore_mult = int(rescore_mult)
         kernel = (
             _make_topk_sharded(mesh, axis) if mesh is not None
             else _topk_cosine
@@ -120,10 +148,42 @@ class SimilarityEngine:
         # cache every invocation (the graftcheck jit-recompile-hazard
         # class this engine is budgeted against)
         self._topk_fn = jax.jit(kernel, static_argnums=(2, 3))
+        # per-mode jitted ANN kernels, bound lazily on first use so an
+        # exact-only server never traces them
+        self._ann_fns: Dict[str, object] = {}
+
+    # -- jit-cache accounting ---------------------------------------------
+
+    @staticmethod
+    def _fn_cache_size(fn) -> Optional[int]:
+        size = getattr(fn, "_cache_size", None)
+        return size() if size is not None else None
 
     def _cache_size(self) -> Optional[int]:
-        size = getattr(self._topk_fn, "_cache_size", None)
-        return size() if size is not None else None
+        # kept under its historical name: analysis/passes_hlo.py and the
+        # bucket-stability tests read it for the EXACT kernel
+        return self._fn_cache_size(self._topk_fn)
+
+    def cache_sizes(self) -> Dict[str, Optional[int]]:
+        """Jit-cache entry count per index mode (only modes that have
+        actually traced appear beyond ``exact``); ``None`` when this
+        jax version exposes no cache introspection."""
+        out: Dict[str, Optional[int]] = {"exact": self._cache_size()}
+        for mode, fn in self._ann_fns.items():
+            out[mode] = self._fn_cache_size(fn)
+        return out
+
+    def cache_size(self, mode: Optional[str] = None) -> Optional[int]:
+        """Public jit-cache size — one mode, or the sum over all modes
+        (``/metrics`` exports this per mode as
+        ``engine_jit_cache_entries``)."""
+        sizes = self.cache_sizes()
+        if mode is not None:
+            return sizes.get(mode)
+        known = [s for s in sizes.values() if s is not None]
+        return sum(known) if known else None
+
+    # -- bucketing ---------------------------------------------------------
 
     def bucket(self, n: int) -> int:
         """Padded batch size for ``n`` queries."""
@@ -137,6 +197,25 @@ class SimilarityEngine:
         """Padded (static) k: next power of two, capped at the vocab."""
         return min(next_pow2(max(1, k)), vocab_size)
 
+    def r_bucket(self, kb: int, vocab_size: int) -> int:
+        """Padded (static) rescore-tail size: ``rescore_mult * kb``
+        rounded to the next power of two, capped at the vocab — a
+        function of the k-bucket alone, so the ANN jit caches stay
+        bounded by the same bucket grid as the exact kernel."""
+        return min(next_pow2(max(kb, self.rescore_mult * kb)), vocab_size)
+
+    def _pad_queries(self, queries: np.ndarray) -> Tuple[np.ndarray, int]:
+        queries = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        n = queries.shape[0]
+        b = self.bucket(n)
+        if b != n:
+            queries = np.concatenate(
+                [queries, np.zeros((b - n, queries.shape[1]), np.float32)]
+            )
+        return queries, n
+
+    # -- exact path --------------------------------------------------------
+
     def top_k(
         self, unit, queries: np.ndarray, k: int,
         valid: Optional[int] = None,
@@ -147,17 +226,11 @@ class SimilarityEngine:
         real row count when ``unit`` carries sharding pad rows."""
         import jax.numpy as jnp
 
-        queries = np.atleast_2d(np.asarray(queries, dtype=np.float32))
-        n = queries.shape[0]
+        queries, n = self._pad_queries(queries)
         vocab_size = int(valid if valid is not None else unit.shape[0])
         k = min(int(k), vocab_size)
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
-        b = self.bucket(n)
-        if b != n:
-            queries = np.concatenate(
-                [queries, np.zeros((b - n, queries.shape[1]), np.float32)]
-            )
         kb = self.k_bucket(k, vocab_size)
         valid_arg = (
             int(valid) if valid is not None and valid < int(unit.shape[0])
@@ -167,13 +240,90 @@ class SimilarityEngine:
         # the device->host copies below force the async dispatch, so the
         # span covers real compute, and it nests under serve_compute in
         # the worker thread — cli.obs trace links it to each batch_item
-        with ambient_span("engine_topk", batch=b, k=kb):
+        with ambient_span("engine_topk", batch=queries.shape[0], k=kb):
             scores, idx = self._topk_fn(
                 unit, jnp.asarray(queries), kb, valid_arg
             )
             scores = np.asarray(scores)
             idx = np.asarray(idx)
         return scores[:n, :k], idx[:n, :k]
+
+    # -- approximate path --------------------------------------------------
+
+    def _ann_fn(self, mode: str):
+        fn = self._ann_fns.get(mode)
+        if fn is None:
+            import jax
+
+            from gene2vec_tpu.serve import ann as ann_mod
+
+            if mode == "quant":
+                fn = jax.jit(
+                    ann_mod.make_quant_kernel(self.mesh, self.axis),
+                    static_argnums=(4, 5, 6),
+                )
+            elif mode == "ivf":
+                fn = jax.jit(
+                    ann_mod.make_ivf_kernel(self.mesh, self.axis),
+                    static_argnums=(6, 7, 8, 9),
+                )
+            else:
+                raise ValueError(f"no ANN kernel for mode {mode!r}")
+            self._ann_fns[mode] = fn
+        return fn
+
+    def top_k_ann(
+        self, index: AnnIndex, unit, queries: np.ndarray, k: int,
+        valid: Optional[int] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Approximate top-k through a built :class:`AnnIndex` —
+        same contract and padding discipline as :meth:`top_k`, one jit
+        cache per index mode."""
+        import jax.numpy as jnp
+
+        queries, n = self._pad_queries(queries)
+        vocab_size = int(valid if valid is not None else unit.shape[0])
+        k = min(int(k), vocab_size)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        kb = self.k_bucket(k, vocab_size)
+        rb = self.r_bucket(kb, vocab_size)
+        valid_arg = (
+            int(valid) if valid is not None and valid < int(unit.shape[0])
+            else None
+        )
+        qd = jnp.asarray(queries)
+        with ambient_span(
+            "engine_topk", batch=queries.shape[0], k=kb,
+            index=index.mode,
+        ):
+            if index.mode == "quant":
+                scores, idx = self._ann_fn("quant")(
+                    index.table_q, index.scale, unit, qd, kb, rb,
+                    valid_arg,
+                )
+            elif index.mode == "ivf":
+                # enough probes that the candidate pool can cover kb
+                # even on tiny tables; still static per (kb, geometry)
+                nprobe = min(self.nprobe, index.n_clusters)
+                if index.list_len:
+                    need = -(-kb // index.list_len)  # ceil
+                    nprobe = min(
+                        max(nprobe, need), index.n_clusters
+                    )
+                scores, idx = self._ann_fn("ivf")(
+                    index.centroids, index.lists, index.table_q,
+                    index.scale, unit, qd, nprobe, kb, rb, valid_arg,
+                )
+            else:
+                raise ValueError(
+                    f"AnnIndex mode {index.mode!r} is not approximate"
+                )
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+        return scores[:n, :k], idx[:n, :k]
+
+    # -- model-level entry point -------------------------------------------
 
     def similar_batch(
         self,
@@ -183,14 +333,30 @@ class SimilarityEngine:
     ) -> List[List[Tuple[str, float]]]:
         """Neighbor lists for raw query vectors against one
         :class:`~gene2vec_tpu.serve.registry.LoadedModel` snapshot:
-        per query, ``k`` (token, cosine) pairs, best first."""
+        per query, ``k`` (token, cosine) pairs, best first.  Routed
+        through the snapshot's ANN index when this engine runs an
+        approximate mode AND the snapshot carries a matching index;
+        otherwise the exact kernel (so ``--index exact``, a model
+        loaded without an index, or a mid-rollout mixed fleet all stay
+        correct)."""
         if not queries:
             return []
-        scores, idx = self.top_k(
-            model.unit, np.stack(queries), k, valid=len(model)
-        )
+        index = getattr(model, "ann", None)
+        if self.index_mode != "exact" and index is not None:
+            scores, idx = self.top_k_ann(
+                index, model.unit, np.stack(queries), k, valid=len(model)
+            )
+        else:
+            scores, idx = self.top_k(
+                model.unit, np.stack(queries), k, valid=len(model)
+            )
         tokens = model.tokens
         return [
             [(tokens[int(j)], float(s)) for j, s in zip(row_i, row_s)]
             for row_i, row_s in zip(idx, scores)
         ]
+
+
+#: historical name — PR-3..9 era callers and tests constructed
+#: SimilarityEngine; the bucketed-index engine is the same object
+SimilarityEngine = BucketedTopKEngine

@@ -88,6 +88,12 @@ class LoadedModel:
     unit: "object"  # jax.Array — typed loosely so the module imports jax lazily
     source: str
     meta: Dict
+    #: serve/ann.py AnnIndex built for EXACTLY this table (None under
+    #: --index exact).  Riding the snapshot is what makes hot swap
+    #: atomic for the pair: one reference assignment swaps table AND
+    #: index together, so a reader can never score a new table against
+    #: an old index or vice versa.
+    ann: Optional["object"] = None
 
     @property
     def version(self) -> Tuple[int, int]:
@@ -149,6 +155,14 @@ class ModelRegistry:
     gauges and ``model_swaps_total`` / ``model_load_failures_total``
     counters.
 
+    ``index_mode`` (exact|quant|ivf) builds a ``serve/ann.py`` index
+    per loaded checkpoint: the index rides the immutable
+    :class:`LoadedModel`, so the hot swap replaces table and index as
+    ONE reference, and IVF centroids cache under
+    ``<export_dir>/ann_cache`` keyed by the table CRC (a re-exported
+    table with different bytes rebuilds; an unchanged one reloads in
+    milliseconds).
+
     A candidate that fails to load is retried with exponential backoff
     (``retry_backoff_s`` doubling per consecutive failure, capped at
     5 min) and quarantined after ``quarantine_after`` failures;
@@ -165,13 +179,29 @@ class ModelRegistry:
         metrics=None,
         retry_backoff_s: float = 2.0,
         quarantine_after: int = 3,
+        index_mode: str = "exact",
+        ann_clusters: Optional[int] = None,
+        ann_seed: int = 0,
     ):
+        from gene2vec_tpu.serve.ann import INDEX_MODES
+
+        if index_mode not in INDEX_MODES:
+            raise ValueError(
+                f"index_mode must be one of {INDEX_MODES}, got "
+                f"{index_mode!r}"
+            )
         self.export_dir = export_dir
         self.dim = dim
         self.sharding = sharding
         self.metrics = metrics
         self.retry_backoff_s = retry_backoff_s
         self.quarantine_after = quarantine_after
+        #: exact|quant|ivf — approximate modes build a serve/ann.py
+        #: index per loaded checkpoint (IVF centroids cached under
+        #: <export_dir>/ann_cache keyed by table CRC)
+        self.index_mode = index_mode
+        self.ann_clusters = ann_clusters
+        self.ann_seed = ann_seed
         self._model: Optional[LoadedModel] = None
         self._refresh_lock = threading.Lock()
         self._watcher: Optional[threading.Thread] = None
@@ -218,8 +248,44 @@ class ModelRegistry:
                 tokens, emb = read_word2vec_format(path)
                 meta = {"dim": dim, "iteration": iteration, "format": "w2v"}
             unit_np = l2_normalize(emb)
+            pad = 0
             if self.sharding is not None:
                 pad = (-unit_np.shape[0]) % dim0_shards(self.sharding)
+            ann = None
+            if self.index_mode in ("quant", "ivf"):
+                # built from the UNPADDED table (lists reference real
+                # rows only), then padded/placed exactly like the unit
+                # matrix; the IVF centroids cache under ann_cache keyed
+                # by table CRC, so a re-export with different bytes
+                # rebuilds and an unchanged table loads in milliseconds
+                from gene2vec_tpu.serve.ann import build_index
+
+                with ambient_span(
+                    "ann_build", mode=self.index_mode, dim=dim,
+                    iteration=iteration,
+                ):
+                    ann = build_index(
+                        unit_np,
+                        self.index_mode,
+                        clusters=self.ann_clusters,
+                        seed=self.ann_seed,
+                        cache_dir=os.path.join(
+                            self.export_dir, "ann_cache"
+                        ),
+                        tag=f"dim{dim}_iter{iteration}",
+                        version=(dim, iteration),
+                        sharding=self.sharding,
+                        pad_rows=pad,
+                    )
+                if self.metrics is not None:
+                    self.metrics.gauge("ann_build_seconds").set(
+                        ann.build_seconds
+                    )
+                    self.metrics.counter(
+                        "ann_cache_hits_total"
+                        if ann.built_from_cache else "ann_builds_total"
+                    ).inc()
+            if self.sharding is not None:
                 if pad:
                     unit_np = np.concatenate(
                         [unit_np,
@@ -238,6 +304,7 @@ class ModelRegistry:
             unit=unit,
             source=path,
             meta=meta,
+            ann=ann,
         )
 
     @staticmethod

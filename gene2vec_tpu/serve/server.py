@@ -109,6 +109,15 @@ class ServeConfig:
     timeout_ms: float = 2000.0
     max_k: int = 256
     max_queries_per_request: int = 64
+    # -- retrieval index (serve/ann.py; cli/serve.py --index) -------------
+    # exact (default, bitwise-identical to the pre-ANN engine) | quant
+    # (int8 full-table scan + exact-rescore tail) | ivf (centroid scan
+    # -> nprobe lists -> int8 candidates -> exact rescore)
+    index: str = "exact"
+    # IVF lists probed per query (recall/latency knob)
+    nprobe: int = 8
+    # exact-rescore tail size multiplier: r = rescore_mult * k
+    rescore_mult: int = 4
     # per-request read deadline: once the first byte of a request has
     # arrived the WHOLE request must arrive within this window
     # (slow-loris guard; expiry -> 408 + close)
@@ -174,7 +183,9 @@ class ServeApp:
         # mesh set => the two-stage distributed top-k over the
         # registry's row-sharded matrix (engine._make_topk_sharded)
         self.engine = SimilarityEngine(
-            max_batch=config.max_batch, mesh=mesh
+            max_batch=config.max_batch, mesh=mesh,
+            index=config.index, nprobe=config.nprobe,
+            rescore_mult=config.rescore_mult,
         )
         self.batcher = MicroBatcher(
             self._compute_batch,
@@ -246,6 +257,12 @@ class ServeApp:
         # gene queries ask one extra so dropping the self-hit still
         # leaves k neighbors
         kq = min(k_max + 1, len(model))
+        if self.engine.index_mode != "exact" and model.ann is None:
+            # approximate engine over a snapshot without an index
+            # (registry built exact, or a legacy LoadedModel): served
+            # exactly, but visibly — a fleet rollout that silently
+            # never uses its index would hide a real capacity gap
+            self.metrics.counter("engine_index_fallback_total").inc()
         neighbors = self.engine.similar_batch(model, vectors, kq)
         out: List[dict] = []
         for item, row, hits in zip(items, self_rows, neighbors):
@@ -454,6 +471,18 @@ class ServeApp:
             "genes": list(model.tokens[offset : offset + limit]),
         }
 
+    def publish_engine_metrics(self) -> None:
+        """Export the engine's per-index-mode jit-cache entry counts as
+        ``engine_jit_cache_entries{mode=...}`` — refreshed at each
+        ``/metrics`` scrape, so a recompile leak in any mode (the
+        hazard class ``hlo-cache-stability`` gates at analysis time)
+        is also observable on a live replica."""
+        for mode, size in self.engine.cache_sizes().items():
+            if size is not None:
+                self.metrics.gauge(
+                    "engine_jit_cache_entries", labels={"mode": mode}
+                ).set(size)
+
     def livez(self) -> dict:
         """Liveness: the process answers HTTP.  Never inspects the
         registry — a replica mid-load (or quarantined with no fallback)
@@ -489,6 +518,11 @@ class ServeApp:
             "vocab_size": len(m),
             "source": m.source,
         }
+        out["index"] = self.engine.index_mode
+        if m.ann is not None:
+            from gene2vec_tpu.serve.ann import index_stats
+
+            out["ann"] = index_stats(m.ann)
         return 200, out
 
     def _timeout_s(self, body: dict) -> Optional[float]:
@@ -670,6 +704,7 @@ class ServeAdapter:
         if app.faults is not None and self._apply_fault(req, peer, route):
             return
         if req.method == "GET" and route == "/metrics":
+            app.publish_engine_metrics()
             peer.respond(Response(
                 200,
                 app.metrics.prometheus_text().encode("utf-8"),

@@ -173,5 +173,21 @@ EOF
     --assert-capacity "$SMOKE_RPS" \
     --output "$SMOKE_OUT" > /dev/null || rc=$?
   echo "capacity smoke: exit $rc -> $SMOKE_OUT" >&2
+  if [ "$rc" -ne 0 ]; then
+    exit "$rc"
+  fi
+
+  # ANN recall smoke: the IVF+int8+exact-rescore retrieval path must
+  # hold recall@10 >= 0.99 vs the exact numpy oracle at a REDUCED table
+  # size (64k synthetic rows; the full 1M-row gate runs against the
+  # committed BENCH_ANN record via cli.analyze above).  Same recipe
+  # shape as the committed bench — clustered table, table-row queries,
+  # pinned nprobe/rescore — asserted directly by the bench's exit code.
+  echo "== ANN recall smoke (IVF+int8 retrieval, 64k rows) ==" >&2
+  ANN_SMOKE_OUT="${ANN_SMOKE_OUT:-/tmp/ann_recall_smoke.json}"
+  JAX_PLATFORMS=cpu python bench.py --ann \
+    --ann-rows 65536 --ann-queries 128 --ann-min-recall 0.99 \
+    --ann-out "$ANN_SMOKE_OUT" > /dev/null || rc=$?
+  echo "ann smoke: exit $rc -> $ANN_SMOKE_OUT" >&2
 fi
 exit "$rc"

@@ -65,7 +65,10 @@ def test_hot_paths_clean():
     assert bad == [], "\n".join(f.format() for f in bad)
     labels = {f.path for f in findings}
     assert "hlo:serve" in labels
-    assert "hlo:serve/buckets" in labels
+    # bucket stability is asserted PER INDEX MODE: the quant/IVF
+    # kernels must compile once per bucket exactly like the exact one
+    for mode in ("exact", "quant", "ivf"):
+        assert f"hlo:serve/buckets/{mode}" in labels
     # the cache checks must actually have RUN — the introspection-
     # unavailable skip also emits this pass_id, so assert on the
     # structured checked flag, not mere presence
@@ -109,6 +112,8 @@ def test_budget_file_documented():
     for section, unit in units.items():
         assert budgets[section], section
         for key, entry in budgets[section].items():
+            if "mesh" not in entry:
+                continue  # non-kernel budget (capacity_rps: passes_serve)
             ref, cap = entry[f"reference_{unit}"], entry[f"max_{unit}"]
             assert cap >= ref, key
             # headroom stays a budget, not a blank check (< 10%)

@@ -1,0 +1,608 @@
+"""Approximate + quantized retrieval (serve/ann.py + engine index modes):
+numpy-oracle recall harness, exact-mode bitwise parity, hot-swap
+atomicity of the table+index pair, centroid-cache CRC invalidation,
+per-mode jit-cache bucketing, and the BENCH_ANN analysis gate."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gene2vec_tpu.serve import ann
+from gene2vec_tpu.serve.engine import (
+    BucketedTopKEngine,
+    SimilarityEngine,
+    _topk_cosine,
+)
+from gene2vec_tpu.serve.registry import ModelRegistry, l2_normalize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def clustered_table(rows, dim, clusters, seed=0, spread=0.35):
+    rng = np.random.RandomState(seed)
+    cent = rng.randn(clusters, dim).astype(np.float32)
+    x = cent[rng.randint(0, clusters, rows)]
+    return l2_normalize(x + spread * rng.randn(rows, dim).astype(np.float32))
+
+
+def random_table(rows, dim, seed=0):
+    return l2_normalize(
+        np.random.RandomState(seed).randn(rows, dim).astype(np.float32)
+    )
+
+
+# -- quantization ------------------------------------------------------------
+
+
+def test_quantize_rows_roundtrip():
+    x = random_table(64, 16, seed=1)
+    q, scale = quantized = ann.quantize_rows(x)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    # symmetric per-row scale: dequantized error under half a step
+    np.testing.assert_allclose(
+        q.astype(np.float32) * scale[:, None], x,
+        atol=float(scale.max()) * 0.51,
+    )
+    del quantized
+
+
+def test_quantize_rows_zero_row_stays_zero():
+    x = np.zeros((3, 8), np.float32)
+    x[0, 0] = 1.0
+    q, scale = ann.quantize_rows(x)
+    assert (q[1:] == 0).all()
+    assert np.isfinite(scale).all()
+
+
+# -- recall harness vs the numpy oracle --------------------------------------
+
+
+def test_quant_recall_on_seeded_random_tables():
+    """Quantization noise must be fully absorbed by the exact-rescore
+    tail: recall@10 >= 0.99 on pure-random tables (the adversarial,
+    structureless case) across seeds."""
+    engine = BucketedTopKEngine(max_batch=32, index="quant")
+    for seed in (0, 1, 2):
+        x = random_table(2048, 32, seed=seed)
+        q = x[np.random.RandomState(seed + 10).choice(2048, 32, False)]
+        oracle = ann.exact_oracle(x, q, 10)
+        index = ann.build_index(x, "quant")
+        _, idx = engine.top_k_ann(index, jnp.asarray(x), q, 10)
+        assert ann.recall_at_k(idx, oracle) >= 0.99, f"seed {seed}"
+
+
+def test_ivf_recall_on_clustered_table():
+    x = clustered_table(4096, 32, clusters=64, seed=3)
+    q = x[np.random.RandomState(7).choice(4096, 48, False)]
+    oracle = ann.exact_oracle(x, q, 10)
+    engine = BucketedTopKEngine(max_batch=64, index="ivf", nprobe=8)
+    index = ann.build_index(x, "ivf", clusters=64)
+    _, idx = engine.top_k_ann(index, jnp.asarray(x), q, 10)
+    assert ann.recall_at_k(idx, oracle) >= 0.99
+
+
+def test_ivf_recall_at_real_vocab_geometry():
+    """The real serving geometry: a clustered table at the paper's
+    24,447-gene vocab must hold recall@10 >= 0.99 for quant AND ivf
+    (the bench gates the same floor at 1M rows)."""
+    V = 24447
+    x = clustered_table(V, 64, clusters=256, seed=5)
+    q = x[np.random.RandomState(11).choice(V, 64, False)]
+    oracle = ann.exact_oracle(x, q, 10)
+    engine = BucketedTopKEngine(max_batch=64, index="ivf", nprobe=32)
+    unit = jnp.asarray(x)
+    for mode, kw in (("quant", {}), ("ivf", {"clusters": 256})):
+        index = ann.build_index(x, mode, **kw)
+        _, idx = engine.top_k_ann(index, unit, q, 10)
+        assert ann.recall_at_k(idx, oracle) >= 0.99, mode
+
+
+def test_ivf_nprobe_sweep_monotone_to_exhaustive():
+    """On a RANDOM table (no cluster structure — IVF's worst case)
+    recall must improve with nprobe and reach 1.0 when every list is
+    probed (nprobe=C makes the index an exhaustive scan + rescore)."""
+    x = random_table(1024, 16, seed=4)
+    q = x[np.random.RandomState(9).choice(1024, 24, False)]
+    oracle = ann.exact_oracle(x, q, 10)
+    index = ann.build_index(x, "ivf", clusters=16)
+    unit = jnp.asarray(x)
+    recalls = []
+    for nprobe in (1, 4, 16):
+        engine = BucketedTopKEngine(
+            max_batch=32, index="ivf", nprobe=nprobe, rescore_mult=8
+        )
+        _, idx = engine.top_k_ann(index, unit, q, 10)
+        recalls.append(ann.recall_at_k(idx, oracle))
+    assert recalls == sorted(recalls), recalls
+    assert recalls[-1] == 1.0  # exhaustive probe == exact
+
+
+def test_bf16_quant_variant():
+    x = random_table(512, 16, seed=6)
+    q = x[:16]
+    oracle = ann.exact_oracle(x, q, 5)
+    index = ann.build_index(x, "quant", quant_dtype="bf16")
+    assert str(index.table_q.dtype) == "bfloat16"
+    engine = BucketedTopKEngine(max_batch=16, index="quant")
+    _, idx = engine.top_k_ann(index, jnp.asarray(x), q, 5)
+    assert ann.recall_at_k(idx, oracle) >= 0.99
+
+
+def test_rescore_tail_returns_exact_scores():
+    """Whatever the approximate stage surfaces, returned SCORES are the
+    exact f32 cosine (the rescore contract: approximation can cost
+    recall, never wrong numbers)."""
+    x = clustered_table(1024, 16, clusters=16, seed=8)
+    q = x[:8]
+    engine = BucketedTopKEngine(max_batch=8, index="ivf", nprobe=16)
+    index = ann.build_index(x, "ivf", clusters=16)
+    scores, idx = engine.top_k_ann(index, jnp.asarray(x), q, 5)
+    qn = l2_normalize(q)
+    for b in range(8):
+        expect = qn[b] @ x[idx[b]].T
+        np.testing.assert_allclose(scores[b], expect, atol=1e-5)
+
+
+def test_quant_valid_mask_hides_pad_rows():
+    x = random_table(20, 8, seed=2)
+    padded = np.concatenate([x, np.zeros((12, 8), np.float32)])
+    index = ann.build_index(x, "quant", pad_rows=12)
+    engine = BucketedTopKEngine(max_batch=8, index="quant")
+    _, idx = engine.top_k_ann(
+        index, jnp.asarray(padded), x[:4], 10, valid=20
+    )
+    assert (idx < 20).all()
+
+
+def test_ivf_honors_caller_valid_prefix():
+    """The top_k contract lets a caller restrict to a row prefix; the
+    IVF kernel must honor it like the exact/quant kernels even though
+    registry-built lists never reference pad rows."""
+    x = random_table(64, 8, seed=2)
+    index = ann.build_index(x, "ivf", clusters=4)
+    engine = BucketedTopKEngine(max_batch=8, index="ivf", nprobe=4)
+    _, idx = engine.top_k_ann(index, jnp.asarray(x), x[:4], 10, valid=30)
+    assert (idx < 30).all()
+
+
+# -- exact-mode parity -------------------------------------------------------
+
+
+def test_index_exact_bitwise_parity_with_plain_kernel():
+    """--index exact must be BITWISE identical to the pre-ANN engine:
+    same kernel, same buckets, same bytes out."""
+    x = random_table(256, 16, seed=0)
+    unit = jnp.asarray(x)
+    q = np.random.RandomState(1).randn(5, 16).astype(np.float32)
+    engine = BucketedTopKEngine(max_batch=8, index="exact")
+    scores, idx = engine.top_k(unit, q, 7)
+    # reference: the raw kernel at the same padded shapes
+    ref_fn = jax.jit(_topk_cosine, static_argnums=(2, 3))
+    qp = np.concatenate([q, np.zeros((3, 16), np.float32)])
+    ref_s, ref_i = ref_fn(unit, jnp.asarray(qp), 8, None)
+    assert np.array_equal(scores, np.asarray(ref_s)[:5, :7])
+    assert np.array_equal(idx, np.asarray(ref_i)[:5, :7])
+    # the legacy name keeps constructing the same engine
+    assert SimilarityEngine is BucketedTopKEngine
+
+
+def test_approximate_engine_without_index_falls_back_exact():
+    """An approximate-mode engine given a snapshot with no AnnIndex
+    serves the exact path (mixed-rollout safety)."""
+    x = random_table(64, 8, seed=3)
+
+    class Snapshot:
+        unit = jnp.asarray(x)
+        tokens = tuple(f"G{i}" for i in range(64))
+        ann = None
+
+        def __len__(self):
+            return 64
+
+    model = Snapshot()
+    engine_ivf = BucketedTopKEngine(max_batch=8, index="ivf")
+    engine_exact = BucketedTopKEngine(max_batch=8, index="exact")
+    q = [x[1], x[2]]
+    out_a = engine_ivf.similar_batch(model, q, 5)
+    out_b = engine_exact.similar_batch(model, q, 5)
+    assert out_a == out_b
+
+
+# -- per-mode jit-cache bucketing --------------------------------------------
+
+
+def test_per_mode_jit_cache_is_bucket_stable():
+    x = random_table(512, 16, seed=0)
+    unit = jnp.asarray(x)
+    engine = BucketedTopKEngine(max_batch=8, index="ivf", nprobe=4)
+    quant = ann.build_index(x, "quant")
+    ivf = ann.build_index(x, "ivf", clusters=16)
+    rng = np.random.RandomState(0)
+
+    def cycle():
+        for n in engine.buckets:
+            q = rng.randn(n, 16).astype(np.float32)
+            engine.top_k(unit, q, 3)
+            engine.top_k_ann(quant, unit, q, 3)
+            engine.top_k_ann(ivf, unit, q, 3)
+
+    cycle()
+    warm = engine.cache_sizes()
+    if all(v is None for v in warm.values()):
+        pytest.skip("jit cache introspection unavailable")
+    cycle()
+    cycle()
+    assert engine.cache_sizes() == warm
+    assert set(warm) == {"exact", "quant", "ivf"}
+    # the public accessor /metrics exports
+    assert engine.cache_size("quant") == warm["quant"]
+    assert engine.cache_size() == sum(v for v in warm.values())
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs a multi-device mesh")
+def test_sharded_ann_kernels_match_unsharded():
+    """Row-sharded quant/IVF kernels (two_stage_topk merge) return the
+    same neighbors as their unsharded twins."""
+    from gene2vec_tpu.config import MeshConfig
+    from gene2vec_tpu.parallel.mesh import make_mesh
+    from gene2vec_tpu.parallel.sharding import row_sharding
+
+    P = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=1, model=P))
+    sharding = row_sharding(mesh)
+    V, D = 256, 16
+    x = clustered_table(V, D, clusters=16, seed=1)
+    pad = (-V) % P
+    padded = np.concatenate([x, np.zeros((pad, D), np.float32)])
+    unit_sh = jax.device_put(jnp.asarray(padded), sharding)
+    q = x[np.random.RandomState(3).choice(V, 8, False)]
+
+    plain = BucketedTopKEngine(max_batch=8, index="ivf", nprobe=16)
+    shard = BucketedTopKEngine(
+        max_batch=8, mesh=mesh, index="ivf", nprobe=16
+    )
+    for mode in ("quant", "ivf"):
+        kw = {"clusters": 16} if mode == "ivf" else {}
+        idx_plain = ann.build_index(x, mode, **kw)
+        idx_shard = ann.build_index(
+            x, mode, sharding=sharding, pad_rows=pad, **kw
+        )
+        _, i_plain = plain.top_k_ann(
+            idx_plain, jnp.asarray(x), q, 10, valid=V
+        )
+        _, i_shard = shard.top_k_ann(idx_shard, unit_sh, q, 10, valid=V)
+        assert set(map(tuple, i_plain)) == set(map(tuple, i_shard)), mode
+
+
+# -- registry: build, cache, hot swap ----------------------------------------
+
+V, D = 48, 8
+
+
+def _write_iteration(export_dir, iteration, seed):
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    rng = np.random.RandomState(seed)
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    emb = rng.randn(V, D).astype(np.float32)
+    params = SGNSParams(
+        emb=jnp.asarray(emb), ctx=jnp.asarray(np.zeros((V, D), np.float32))
+    )
+    save_iteration(str(export_dir), D, iteration, params, vocab)
+    return emb
+
+
+def test_registry_builds_and_caches_ivf_index(tmp_path):
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg.refresh()
+    m = reg.model
+    assert m.ann is not None and m.ann.mode == "ivf"
+    assert m.ann.version == m.version
+    assert not m.ann.built_from_cache
+    cache_dir = export / "ann_cache"
+    assert list(cache_dir.glob("ivf_*_crc*.npz")), "centroids not cached"
+    # a fresh registry over the same export loads the cache
+    reg2 = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg2.refresh()
+    assert reg2.model.ann.built_from_cache
+    assert reg2.model.ann.crc == m.ann.crc
+
+
+def test_centroid_cache_invalidated_when_table_crc_changes(tmp_path):
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg.refresh()
+    old_crc = reg.model.ann.crc
+    # same iteration re-exported with DIFFERENT bytes: the old cache
+    # file may still sit in ann_cache, but its CRC key no longer
+    # matches the table — the index must rebuild, not reuse
+    for f in export.glob("gene2vec_dim_*"):
+        f.unlink()
+    _write_iteration(export, 1, seed=99)
+    reg2 = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg2.refresh()
+    m2 = reg2.model
+    assert m2.ann.crc != old_crc
+    assert not m2.ann.built_from_cache
+
+
+def test_forged_cache_file_is_ignored(tmp_path):
+    x = random_table(32, 8, seed=0)
+    cache_dir = tmp_path / "ann_cache"
+    index = ann.build_index(
+        x, "ivf", clusters=4, cache_dir=str(cache_dir), tag="t"
+    )
+    (path,) = cache_dir.glob("*.npz")
+    # restamp the cached meta with a wrong CRC: loader must reject it
+    with np.load(path) as z:
+        cent, lists = z["centroids"], z["lists"]
+    meta = json.dumps({"crc": (index.crc + 1) & 0xFFFFFFFF})
+    np.savez(path, centroids=cent, lists=lists, meta=meta)
+    assert ann._load_centroid_cache(str(path), index.crc) is None
+    rebuilt = ann.build_index(
+        x, "ivf", clusters=4, cache_dir=str(cache_dir), tag="t"
+    )
+    assert not rebuilt.built_from_cache
+    # a TRUNCATED cache (valid zip magic, broken structure) must also
+    # mean rebuild — a bad cache file can never block loading a good
+    # checkpoint into quarantine
+    path.write_bytes(path.read_bytes()[: path.stat().st_size // 2])
+    assert ann._load_centroid_cache(str(path), index.crc) is None
+    again = ann.build_index(
+        x, "ivf", clusters=4, cache_dir=str(cache_dir), tag="t"
+    )
+    assert not again.built_from_cache
+
+
+def test_hot_swap_atomicity_of_table_and_index(tmp_path):
+    """Under a concurrent reader, every observed snapshot must carry an
+    index built for EXACTLY its table — never a (new table, old index)
+    or (old table, new index) pair."""
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg.refresh()
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            m = reg.model  # one snapshot
+            a = m.ann
+            if a is None or a.version != m.version or (
+                a.table_q.shape[0] != m.unit.shape[0]
+            ) or a.crc != ann.table_crc(l2_normalize(m.emb)):
+                torn.append(m.iteration)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for it in range(2, 6):
+        _write_iteration(export, it, seed=it)
+        assert reg.refresh()
+        assert reg.model.iteration == it
+    stop.set()
+    t.join(timeout=5)
+    assert torn == []
+
+
+# -- serve app integration ---------------------------------------------------
+
+
+def test_serve_app_ivf_mode_end_to_end(tmp_path):
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig
+
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg = ModelRegistry(str(export), index_mode="ivf", ann_clusters=8)
+    assert reg.refresh()
+    app = ServeApp(
+        reg,
+        ServeConfig(max_batch=8, max_delay_ms=1.0, index="ivf",
+                    nprobe=8, rescore_mult=4),
+    ).start()
+    try:
+        status, doc = app.handle(
+            "POST", "/v1/similar", {"genes": ["G0"], "k": 5}
+        )
+        assert status == 200
+        got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+        # nprobe=8 over 8 lists == exhaustive: must match the oracle
+        m = reg.model
+        scores = np.asarray(m.unit) @ np.asarray(m.unit)[0]
+        want = [m.tokens[i] for i in np.argsort(-scores) if i != 0][:5]
+        assert got == want
+        status, health = app.healthz()
+        assert status == 200 and health["index"] == "ivf"
+        assert health["ann"]["mode"] == "ivf"
+        app.publish_engine_metrics()
+        text = app.metrics.prometheus_text()
+        assert "engine_jit_cache_entries" in text
+        assert 'mode="ivf"' in text
+    finally:
+        app.stop()
+
+
+def test_serve_app_exact_mode_counts_no_fallback(tmp_path):
+    """index=exact never touches the fallback counter; an approximate
+    config over an index-less registry counts it (visibly exact)."""
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig
+
+    export = tmp_path / "exports"
+    _write_iteration(export, 1, seed=1)
+    reg = ModelRegistry(str(export))  # no index built
+    assert reg.refresh()
+    app = ServeApp(
+        reg, ServeConfig(max_batch=8, max_delay_ms=1.0, index="quant")
+    ).start()
+    try:
+        status, _ = app.handle(
+            "POST", "/v1/similar", {"genes": ["G1"], "k": 3}
+        )
+        assert status == 200
+        assert (
+            app.metrics.counter("engine_index_fallback_total").value >= 1
+        )
+    finally:
+        app.stop()
+
+
+# -- ledger + analysis gate --------------------------------------------------
+
+
+def _ann_doc(ivf_recall=0.999, quant_recall=1.0, real_ivf=0.999,
+             real_quant=1.0, speedup=8.0, bytes_factor=30.0, **over):
+    doc = {
+        "schema_version": 1,
+        "bench": "ann",
+        "recipe": {
+            "rows": 1000000, "dim": 64, "k": 10, "queries": 512,
+            "clusters": 1024, "nprobe": 32, "rescore_mult": 4,
+            "seed": 0,
+        },
+        "modes": {
+            "exact": {"recall_at_10": 1.0, "p50_ms": 90.0, "p99_ms": 120.0,
+                      "bytes_per_query": 256e6},
+            "quant": {"recall_at_10": quant_recall, "p50_ms": 30.0,
+                      "p99_ms": 40.0, "bytes_per_query": 68e6},
+            "ivf": {"recall_at_10": ivf_recall, "p50_ms": 5.0,
+                    "p99_ms": 12.0, "bytes_per_query": 8e6,
+                    "p99_speedup_vs_exact": speedup,
+                    "bytes_reduction_vs_exact": bytes_factor},
+        },
+        "real_table": {
+            "rows": 24447, "dim": 200,
+            "recall_at_10_ivf": real_ivf,
+            "recall_at_10_quant": real_quant,
+        },
+    }
+    doc.update(over)
+    return doc
+
+
+def test_ledger_adapts_ann_family(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    p = tmp_path / "BENCH_ANN_r12.json"
+    p.write_text(json.dumps(_ann_doc()))
+    (rec,) = ledger.ingest_root(str(tmp_path))
+    assert rec["family"] == "ann" and rec["round"] == 12
+    assert rec["headline_metric"] == "ann_recall_at_10"
+    assert rec["metrics"]["ann_recall_at_10"] == 0.999
+    assert rec["metrics"]["ann_p99_ms_1m"] == 12.0
+    assert rec["metrics"]["ann_real_recall_at_10_ivf"] == 0.999
+    assert not rec["legacy_unstamped"]
+
+
+def test_ann_gate_passes_on_committed_bench():
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
+
+    bad = gating(ann_recall_findings(root=REPO))
+    assert bad == [], "\n".join(f.format() for f in bad)
+
+
+def test_ann_gate_planted_low_recall_fires_exactly_once(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
+
+    (tmp_path / "BENCH_ANN_r99.json").write_text(
+        json.dumps(_ann_doc(ivf_recall=0.9))
+    )
+    findings = ann_recall_findings(root=str(tmp_path))
+    bad = gating(findings)
+    assert len(bad) == 1, [f.format() for f in findings]
+    assert "recall_at_10 0.9 < budget" in bad[0].message
+    assert bad[0].pass_id == "ann-recall-budget"
+
+
+def test_ann_gate_off_recipe_and_missing_keys(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
+
+    # looser probe knob than the budget pins
+    doc = _ann_doc()
+    doc["recipe"]["nprobe"] = 256
+    (tmp_path / "BENCH_ANN_r99.json").write_text(json.dumps(doc))
+    (bad,) = gating(ann_recall_findings(root=str(tmp_path)))
+    assert "pins nprobe=32" in bad.message
+
+    # dropping the real-table recall must gate, not pass
+    doc = _ann_doc()
+    del doc["real_table"]["recall_at_10_ivf"]
+    (tmp_path / "BENCH_ANN_r99.json").write_text(json.dumps(doc))
+    (bad,) = gating(ann_recall_findings(root=str(tmp_path)))
+    assert "real_table.recall_at_10_ivf missing" in bad.message
+
+    # the scaling claim must be measured: both gain fields gone gates
+    doc = _ann_doc()
+    del doc["modes"]["ivf"]["p99_speedup_vs_exact"]
+    del doc["modes"]["ivf"]["bytes_reduction_vs_exact"]
+    (tmp_path / "BENCH_ANN_r99.json").write_text(json.dumps(doc))
+    (bad,) = gating(ann_recall_findings(root=str(tmp_path)))
+    assert "scaling claim is unmeasured" in bad.message
+
+    # a gain below the floor gates
+    doc = _ann_doc(speedup=1.5, bytes_factor=2.0)
+    (tmp_path / "BENCH_ANN_r99.json").write_text(json.dumps(doc))
+    (bad,) = gating(ann_recall_findings(root=str(tmp_path)))
+    assert "below the budget's 5x" in bad.message
+
+
+def test_ann_gate_missing_bench_is_info(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
+
+    findings = ann_recall_findings(root=str(tmp_path))
+    assert gating(findings) == []
+    assert findings[0].severity == "info"
+    assert "no ANN bench recorded yet" in findings[0].message
+
+
+def test_analyze_cli_exits_1_on_planted_recall_collapse(tmp_path):
+    """Acceptance: a planted recall collapse fails the DEFAULT
+    cli.analyze tier through GENE2VEC_TPU_PERF_ROOT, firing the ANN
+    gate exactly once."""
+    import subprocess
+    import sys
+
+    (tmp_path / "BENCH_ANN_r99.json").write_text(
+        json.dumps(_ann_doc(real_ivf=0.5))
+    )
+    env = {**os.environ, "GENE2VEC_TPU_PERF_ROOT": str(tmp_path)}
+    proc = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    fired = [
+        f for f in json.loads(proc.stdout)["findings"]
+        if f["pass"] == "ann-recall-budget" and f["severity"] != "info"
+    ]
+    assert len(fired) == 1
+    assert "real_table.recall_at_10_ivf 0.5 < budget" in fired[0]["message"]
+
+
+def test_bytes_per_query_accounting():
+    # exact touches the full f32 table; ivf touches centroids + probed
+    # int8 lists + the rescore tail — the 1M-row geometry must clear
+    # the budget's 5x floor analytically
+    exact = ann.bytes_per_query("exact", 1_000_000, 64)
+    ivf = ann.bytes_per_query(
+        "ivf", 1_000_000, 64, r=64, clusters=1024, list_len=2048,
+        nprobe=32,
+    )
+    assert exact == 256e6
+    assert exact / ivf >= 5.0
+    with pytest.raises(ValueError):
+        ann.bytes_per_query("nope", 1, 1)
