@@ -1,0 +1,211 @@
+"""Alert-detection budget gate: BENCH_ALERTS vs budgets.json ``alerts``.
+
+The chaos drill's alerting phase (``scripts/chaos_drill.py``, phase
+``alerts``) injects faults into one replica of a live fleet and
+measures the detection loop end to end: how long until the right rule
+fires (``alert_detection_latency_s``), whether any rule fired during
+the clean warmup (false positives), and whether the auto-assembled
+incident bundle is manifest-CRC-verified and contains a reassembled
+trace through the faulty replica.  Results land in
+``BENCH_ALERTS_r*.json``; this pass re-checks the NEWEST committed
+record against the ``alerts`` section of ``budgets.json`` on every
+``cli.analyze`` run — detection latency that quietly erodes, or a
+drill rerun stamping false positives, fails the analyzer exactly like
+a collective-bytes regression does.
+
+Deliberately jax-free and I/O-only (two small JSON reads): it rides
+the DEFAULT tier.  A missing bench file is an *info* finding (a fresh
+checkout must not fail lint before its first drill); a record that
+exists and violates — or omits — a budgeted quantity, or was measured
+off the pinned recipe, gates hard (the passes_obs recipe-pinning
+lesson).  ``GENE2VEC_TPU_ALERTS_ROOT`` overrides the artifact root for
+the planted-violation fixtures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+ALERTS_ROOT_ENV = "GENE2VEC_TPU_ALERTS_ROOT"
+BENCH_ALERTS_NAME = "BENCH_ALERTS_r13.json"
+
+_PASS = "alerts-detection-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_alerts_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_ALERTS_r*`` under ``root`` (highest round
+    wins, mtime breaks ties) — a violating r14 must beat a stale clean
+    r13, the round convention every bench family follows."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched and matched[0] == "alerts":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime, path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def alerts_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the recorded alert-detection drill against the budget."""
+    budgets: Dict = load_budgets(budgets_path).get("alerts", {})
+    if not budgets:
+        return []
+    root = root or os.environ.get(ALERTS_ROOT_ENV) or REPO_ROOT
+    path = _newest_alerts_bench(root) or os.path.join(
+        root, BENCH_ALERTS_NAME
+    )
+    label = os.path.basename(path)
+    if not os.path.exists(path):
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no alert-detection bench recorded yet ({label} "
+                "missing); run `python scripts/chaos_drill.py --only "
+                f"alerts --alerts-out {label}` to stamp one"
+            ),
+        )]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable alert-detection bench: {e}",
+        )]
+
+    findings: List[Finding] = []
+    for name, budget in budgets.items():
+        if name.startswith("_"):
+            continue
+        section = bench.get("alerts")
+        if not isinstance(section, dict):
+            findings.append(Finding(
+                pass_id=_PASS,
+                path=label,
+                message=(
+                    f"{label} has no 'alerts' results section to check "
+                    f"against budget {name!r}"
+                ),
+            ))
+            continue
+        findings.extend(_check_one(name, budget, section, label))
+    return findings
+
+
+def _check_one(
+    name: str, budget: Dict, section: Dict, label: str
+) -> List[Finding]:
+    latency = _get(section, "detection_latency_s")
+    false_pos = _get(section, "warmup_false_positives")
+    verified = section.get("bundle_verified")
+    trace_ok = section.get("bundle_trace_through_faulty_replica")
+    max_latency = float(budget["max_detection_latency_s"])
+    data = {
+        "budget": name,
+        "detection_latency_s": latency,
+        "max_detection_latency_s": max_latency,
+        "warmup_false_positives": false_pos,
+        "bundle_verified": verified,
+        "bundle_trace_through_faulty_replica": trace_ok,
+    }
+    # every budgeted quantity must be PRESENT: a record missing a field
+    # must gate like a violation, or dropping the key becomes the way
+    # to pass (the passes_fleet lesson)
+    problems: List[str] = []
+    if latency is None:
+        problems.append("detection_latency_s missing from the bench record")
+    elif latency > max_latency:
+        problems.append(
+            f"detection latency {latency:.2f}s > budget {max_latency:g}s "
+            "(the fleet noticed its own fault too slowly)"
+        )
+    max_fp = float(budget.get("max_false_positives", 0))
+    if false_pos is None:
+        problems.append(
+            "warmup_false_positives missing from the bench record"
+        )
+    elif false_pos > max_fp:
+        problems.append(
+            f"{int(false_pos)} rule(s) fired during the CLEAN warmup "
+            f"(budget {int(max_fp)}) — the rules are too twitchy to "
+            "page on"
+        )
+    if budget.get("require_bundle_verified", True) and verified is not True:
+        problems.append(
+            "incident bundle was not manifest-CRC-verified "
+            f"(bundle_verified={verified!r})"
+        )
+    if budget.get(
+        "require_trace_through_faulty_replica", True
+    ) and trace_ok is not True:
+        problems.append(
+            "no reassembled bundle trace passes through the faulty "
+            f"replica (bundle_trace_through_faulty_replica={trace_ok!r})"
+        )
+    # the budget pins the drill RECIPE — a one-replica no-load run must
+    # not pass a detection-latency gate by construction
+    for key in ("replicas", "scrape_interval_s", "proxy_attempts"):
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(section, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"drill ran with {key}={measured:g} but the budget pins "
+                f"{key}={pinned:g} — re-run with the budgeted recipe"
+            )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                f"alert-detection record violates budget {name!r}: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"alert detection latency {latency:.2f}s within budget "
+            f"{name!r} (<= {max_latency:g}s), {int(false_pos)} warmup "
+            "false positive(s), bundle verified"
+        ),
+        data=data,
+    )]

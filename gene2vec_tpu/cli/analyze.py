@@ -170,6 +170,12 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_ann import ann_recall_findings
 
         findings.extend(ann_recall_findings())
+        # ... and the alert-detection gate (BENCH_ALERTS detection
+        # latency / false positives / bundle integrity vs budgets.json
+        # "alerts", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_alerts import alerts_findings
+
+        findings.extend(alerts_findings())
 
     if args.hlo:
         _pin_cpu_backend()

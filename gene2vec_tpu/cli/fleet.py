@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between replica /metrics scrapes for "
                         "the merged /metrics/fleet view (0 disables "
                         "aggregation)")
+    p.add_argument("--alert-rules", default="default", metavar="PATH",
+                   help="SLO alert rules evaluated on every scrape tick "
+                        "(obs/alerts.py): an alerts.json path, "
+                        "'default' for the built-in availability/"
+                        "p99/rejection/queue rules, 'none' to disable; "
+                        "firings append to <run-dir>/alerts.jsonl and "
+                        "assemble incident bundles under "
+                        "<run-dir>/incidents/ "
+                        "(docs/OBSERVABILITY.md#alerting)")
     p.add_argument("--seed", type=int, default=None,
                    help="restart-jitter seed (reproducible drills)")
     p.add_argument("--run-dir", default=None,
@@ -148,6 +157,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics=run.registry,
         rng=random.Random(args.seed),
     )
+    # validate the alert rules BEFORE paying N replica spawns — a typo'd
+    # alerts.json must fail in milliseconds
+    alert_rules = None
+    if args.alert_rules and args.alert_rules != "none":
+        from gene2vec_tpu.obs import alerts as alerts_mod
+
+        try:
+            alert_rules = (
+                alerts_mod.default_rules()
+                if args.alert_rules == "default"
+                else alerts_mod.load_rules(args.alert_rules)
+            )
+        except (OSError, ValueError) as e:
+            print(f"error: bad --alert-rules: {e}", file=sys.stderr)
+            run.close()
+            return 2
+        if args.scrape_interval <= 0:
+            print(
+                "warning: --alert-rules given but --scrape-interval 0 "
+                "disables the aggregator tick; alerting is off",
+                file=sys.stderr,
+            )
     try:
         supervisor.start()
     except BaseException as e:
@@ -171,6 +202,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         flight_dir=run.run_dir,
         proxy_workers=args.proxy_workers,
         acceptors=args.proxy_acceptors,
+        alert_rules=alert_rules,
     )
     url = proxy.serve(args.host, args.port)
     run.annotate(fleet_url=url)

@@ -23,7 +23,14 @@
   (gene2vec_tpu/obs/ledger.py, docs/BENCHMARKS.md) into the unified
   ledger; ``--out/--csv`` persist it, ``--check`` exits 1 when the
   trailing-window regression rules (budgets.json ``perf.regression``)
-  fire.
+  fire;
+* ``python -m gene2vec_tpu.cli.obs alerts <run_dir>`` — render the
+  SLO alert transition timeline from every ``alerts.jsonl`` under a
+  run dir (obs/alerts.py; exit 1 when no transitions were recorded);
+* ``python -m gene2vec_tpu.cli.obs incident <bundle>`` — CRC-verify an
+  incident bundle's ``incident.MANIFEST.json`` and render it (rule,
+  firing snapshot, raw metric window, flight dumps, reassembled
+  traces; obs/incident.py; exit 1 on a torn/empty bundle).
 
 Schema and run-dir layout: docs/OBSERVABILITY.md.
 """
@@ -72,6 +79,22 @@ def build_parser() -> argparse.ArgumentParser:
     tml.add_argument("--out", default=None,
                      help="output path (default <run_dir>/trace.json; "
                      "'-' writes the document to stdout)")
+    al = sub.add_parser(
+        "alerts",
+        help="render the SLO alert transition timeline under a run dir",
+    )
+    al.add_argument("run_dir", help="directory tree holding alerts.jsonl "
+                    "(a fleet run dir, or an export dir covering several)")
+    al.add_argument("--json", action="store_true",
+                    help="emit the transition records as JSON")
+    inc = sub.add_parser(
+        "incident",
+        help="verify + render one incident bundle "
+             "(<run_dir>/incidents/<ts>_<rule>/)",
+    )
+    inc.add_argument("bundle", help="incident bundle directory")
+    inc.add_argument("--json", action="store_true",
+                     help="emit the bundle facts as JSON")
     led = sub.add_parser(
         "ledger",
         help="unified bench ledger over the root bench artifacts",
@@ -215,6 +238,48 @@ def main(argv: Optional[List[str]] = None) -> int:
             "trace_events": n,
             "phase_tracks": doc["otherData"]["phase_tracks"],
         }))
+        return 0
+
+    if args.command == "alerts":
+        from gene2vec_tpu.obs import alerts as alerts_mod
+
+        if not os.path.isdir(args.run_dir):
+            print(f"obs alerts: {args.run_dir} is not a directory",
+                  file=sys.stderr)
+            return 2
+        records = alerts_mod.collect_transitions(args.run_dir)
+        if args.json:
+            print(json.dumps(records, indent=1, default=str))
+        else:
+            print(alerts_mod.format_timeline(records))
+        # exit 1 when no transitions exist — drills/scripts assert
+        # "alerting saw something" without parsing (the trace contract)
+        return 0 if records else 1
+
+    if args.command == "incident":
+        from gene2vec_tpu.obs import incident as incident_mod
+
+        if not os.path.isdir(args.bundle):
+            print(f"obs incident: {args.bundle} is not a directory",
+                  file=sys.stderr)
+            return 2
+        verify = incident_mod.verify_bundle(args.bundle)
+        if args.json:
+            print(json.dumps({
+                "bundle": os.path.abspath(args.bundle),
+                "verified": bool(verify),
+                "reason": verify.reason,
+                "manifest": verify.manifest,
+            }, indent=1, default=str))
+        else:
+            print(incident_mod.format_bundle(args.bundle, verify))
+        if not verify:
+            print(
+                f"obs incident: bundle failed verification "
+                f"({verify.reason})",
+                file=sys.stderr,
+            )
+            return 1
         return 0
 
     if args.command == "ledger":

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded worker pool for the full-dispatch "
                         "path (POSTs, traced/fault-injected requests); "
                         "saturation answers 429")
+    p.add_argument("--burst-threshold", type=int, default=10,
+                   help="5xx responses within --burst-window that dump "
+                        "the flight-recorder ring to the run dir "
+                        "(rate-limited; docs/OBSERVABILITY.md#alerting)")
+    p.add_argument("--burst-window", type=float, default=5.0,
+                   help="the 5xx-burst detection window in seconds")
     p.add_argument("--trace-sample", type=float, default=0.0,
                    help="root-trace sampling rate for requests without "
                         "a traceparent header (0..1; propagated sampled "
@@ -184,6 +190,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             index=args.index,
             nprobe=args.nprobe,
             rescore_mult=args.rescore_mult,
+            burst_threshold=args.burst_threshold,
+            burst_window_s=args.burst_window,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,

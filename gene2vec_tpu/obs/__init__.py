@@ -36,7 +36,17 @@ the four trainers:
 * :mod:`gene2vec_tpu.obs.ledger` — the unified bench ledger: every
   root bench artifact adapted into one record schema, trailing-window
   regression detection (``cli.obs ledger``, gated by
-  ``analysis/passes_perf.py``; docs/BENCHMARKS.md).
+  ``analysis/passes_perf.py``; docs/BENCHMARKS.md);
+* :mod:`gene2vec_tpu.obs.alerts` — SLO alerting: declarative
+  burn-rate/threshold rules with debounce + hysteresis, evaluated on
+  every fleet-aggregator scrape tick, exported as
+  ``fleet_alert_active{rule=}`` and logged to ``alerts.jsonl``
+  (``cli.obs alerts``);
+* :mod:`gene2vec_tpu.obs.incident` — incident capture: a rule firing
+  assembles a rate-limited, disk-capped, manifest-CRC-verified bundle
+  (rule + raw metric window + solicited flight dumps + slowest
+  reassembled traces) under ``<run_dir>/incidents/``
+  (``cli.obs incident``).
 
 Every trainer's ``run(export_dir)`` writes ``manifest.json`` +
 ``events.jsonl`` into its export/run directory;

@@ -23,7 +23,12 @@ served at ``/metrics/fleet``:
   with reset detection, so a replica dying or restarting with zeroed
   counters never makes a fleet sum go backward;
 * scrape health: ``fleet_replicas_scraped``,
-  ``fleet_scrape_errors_total``.
+  ``fleet_scrape_errors_total``, and per-target
+  ``fleet_scrape_staleness{target=}`` (consecutive missed scrapes; a
+  target missing ``stale_after`` scrapes in a row is **stale** — its
+  frozen histogram history is excluded from the per-route quantile
+  estimates, and the snapshot handed to the alert evaluator carries
+  ``_fresh_targets`` so rules hold instead of evaluating frozen data).
 
 Every scrape also appends one CSV row (``fleet_telemetry.csv`` in the
 fleet run dir) through the registry's CSV sink, so the load-signal
@@ -36,6 +41,7 @@ The Prometheus text parser here is the escape-aware inverse of
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import threading
@@ -233,17 +239,32 @@ class FleetAggregator:
         csv_path: Optional[str] = None,
         fetch: Callable[[str, float], str] = _default_fetch,
         timeout_s: float = 2.0,
+        evaluator=None,
+        stale_after: int = 3,
+        raw_window_records: int = 512,
     ):
         self._targets = targets
         self.proxy_registry = proxy_registry
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self._fetch = fetch
+        #: optional obs.alerts.AlertEvaluator fed one snapshot per tick
+        self.evaluator = evaluator
+        #: consecutive missed scrapes before a target's series go stale
+        self.stale_after = int(stale_after)
         #: the merged fleet-level registry served at /metrics/fleet
         self.view = MetricsRegistry()
         if csv_path:
             self.view.attach_csv(csv_path)
         self._scrapes = 0
+        # per-target consecutive-miss counts (exported as
+        # fleet_scrape_staleness{target=}); >= stale_after -> stale
+        self._missed: Dict[str, int] = {}
+        # bounded ring of RAW per-target scrapes — the UN-merged series
+        # an incident bundle files so per-replica attribution survives
+        self._raw_ring: "collections.deque" = collections.deque(
+            maxlen=int(raw_window_records)
+        )
         # per-(target, series) monotone-counter state: (last_raw,
         # accumulated).  A replica that dies keeps its accumulated
         # contribution, and one that restarts (counters back at 0) is
@@ -316,6 +337,7 @@ class FleetAggregator:
         for t in fetchers:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         ok_targets = 0
+        scrape_wall = time.time()
         scrapes: List[List[Sample]] = []
         with self._lock:
             for url in target_list:
@@ -326,18 +348,53 @@ class FleetAggregator:
                         "fleet_scrape_errors_total",
                         "replica /metrics scrapes that failed",
                     ).inc()
+                    self._missed[url] = self._missed.get(url, 0) + 1
                     continue
+                self._missed[url] = 0
                 ok_targets += 1
                 scrapes.append(samples)
                 self._accumulate(url, samples)
+                # raw (un-merged) window ring for incident bundles:
+                # which REPLICA's series went bad must survive the merge
+                self._raw_ring.append({
+                    "wall": scrape_wall,
+                    "target": url,
+                    "samples": {
+                        s.name + (
+                            "{" + ",".join(
+                                f"{k}={v}" for k, v in s.labels
+                            ) + "}" if s.labels else ""
+                        ): s.value
+                        for s in samples
+                    },
+                })
+            # staleness bookkeeping: consecutive misses per LISTED
+            # target; a departed URL's series is REMOVED, not zeroed —
+            # ephemeral-port targets never recur, and a crash-looping
+            # replica must not grow /metrics/fleet one dead
+            # target= label set per restart
+            current_targets = set(target_list)
+            for url in [u for u in self._missed if u not in current_targets]:
+                del self._missed[url]
+                self.view.remove(
+                    "fleet_scrape_staleness", labels={"target": url}
+                )
+            stale = {
+                url for url, n in self._missed.items()
+                if n >= self.stale_after
+            }
+            for url in target_list:
+                self.view.gauge(
+                    "fleet_scrape_staleness", labels={"target": url}
+                ).set(self._missed.get(url, 0))
             # fold state for targets no longer LISTED into the retired
             # baseline (caveat: a target re-listed later under the SAME
             # url restarts from its current raw value — supervisor
             # fleets never reuse urls, and static target lists never
             # unlist, so neither path double-counts in practice)
-            current = set(target_list)
             for key in [
-                k for k in self._counter_state if k[0] not in current
+                k for k in self._counter_state
+                if k[0] not in current_targets
             ]:
                 _target, name, labels = key
                 _last, acc = self._counter_state.pop(key)
@@ -351,11 +408,22 @@ class FleetAggregator:
                 for key, value in merge_samples(scrapes).items()
                 if not self._monotone(key[0])
             }
+            # quantile estimates use FRESH histogram history only: a
+            # stale (or retired) target's buckets are frozen — letting
+            # them keep weighing the percentile would freeze exactly
+            # the gauge an alert rule is watching (the staleness
+            # satellite's contract); fleet SUMS still include every
+            # accumulation so counters never go backward
+            fresh_hist: Dict[Tuple[str, LabelKey], float] = {}
             for (
-                (_target, name, labels), (_last, acc)
+                (target, name, labels), (_last, acc)
             ) in self._counter_state.items():
                 key = (name, labels)
                 merged[key] = merged.get(key, 0.0) + acc
+                if target not in stale and name.startswith(
+                    self.ROUTE_HISTOGRAM
+                ):
+                    fresh_hist[key] = fresh_hist.get(key, 0.0) + acc
             for rkey, acc in self._retired.items():
                 merged[rkey] = merged.get(rkey, 0.0) + acc
 
@@ -379,6 +447,11 @@ class FleetAggregator:
             ).value
         availability = (ok_total / total) if total > 0 else 1.0
 
+        # the flat snapshot handed to the alert evaluator: headline
+        # values, the raw availability counter pair (burn-rate rules
+        # delta them), labeled route quantiles, and the freshness facts
+        # that let rules HOLD instead of evaluating frozen data
+        snapshot: Dict[str, float] = {}
         with self._lock:
             self._scrapes += 1
             v = self.view
@@ -390,18 +463,25 @@ class FleetAggregator:
             v.gauge("fleet_ok").set(ok_total)
             v.gauge("fleet_responses").set(total)
             v.gauge("fleet_availability").set(availability)
-            v.gauge("fleet_last_scrape_unix").set(time.time())
-            for labels in histogram_routes(merged, self.ROUTE_HISTOGRAM):
+            v.gauge("fleet_stale_targets").set(len(stale))
+            v.gauge("fleet_last_scrape_unix").set(scrape_wall)
+            for labels in histogram_routes(fresh_hist, self.ROUTE_HISTOGRAM):
                 label_dict = dict(labels)
                 for gauge_name, q in (
                     ("fleet_route_p50_seconds", 0.50),
                     ("fleet_route_p99_seconds", 0.99),
                 ):
                     quant = histogram_quantile(
-                        merged, self.ROUTE_HISTOGRAM, labels, q
+                        fresh_hist, self.ROUTE_HISTOGRAM, labels, q
                     )
                     if quant is not None and math.isfinite(quant):
                         v.gauge(gauge_name, labels=label_dict).set(quant)
+                        suffix = ",".join(
+                            f"{k}={val}" for k, val in sorted(
+                                label_dict.items()
+                            )
+                        )
+                        snapshot[f"{gauge_name}{{{suffix}}}"] = quant
             headline = {
                 "fleet_availability": availability,
                 "fleet_queue_depth": queue_depth,
@@ -410,9 +490,26 @@ class FleetAggregator:
                 "fleet_requests": requests,
                 "fleet_rejected": rejected,
             }
+            snapshot.update(headline)
+            snapshot.update({
+                "fleet_ok": ok_total,
+                "fleet_responses": total,
+                "fleet_stale_targets": float(len(stale)),
+                "_fresh_targets": float(ok_targets),
+            })
             # CSV history: one row per scrape through the standard sink
             v.log_row(self._scrapes, headline)
+        if self.evaluator is not None:
+            # outside the view lock: the evaluator takes its own lock
+            # and writes alert gauges back through the registry's
+            self.evaluator.observe(snapshot, wall=scrape_wall)
         return headline
+
+    def raw_recent(self) -> List[Dict]:
+        """The raw per-target scrape ring (newest last) — what an
+        incident bundle files as ``metrics_window.json``."""
+        with self._lock:
+            return list(self._raw_ring)
 
     def fleet_text(self) -> str:
         """The ``/metrics/fleet`` exposition."""

@@ -74,8 +74,14 @@ class FlightRecorder:
     """Bounded ring of recent request records with 5xx-burst detection.
 
     ``record`` returns True when its 5xx pushed the burst window over
-    ``burst_threshold`` and a dump is due (at most one per window) —
-    the caller dumps, the recorder never touches disk on the hot path.
+    ``burst_threshold`` and a dump is due — the caller dumps, the
+    recorder never touches disk on the hot path.  The trigger is
+    configurable (``cli.serve --burst-threshold/--burst-window``), and
+    when a shared :class:`~gene2vec_tpu.obs.alerts.RateLimiter` is
+    provided it arbitrates dump cadence INSTEAD of the internal
+    once-per-window rule — in the fleet proxy, burst dumps and
+    rule-triggered incident bundles then draw from one budget, so an
+    error storm plus a flapping alert cannot multiply disk writes.
     """
 
     def __init__(
@@ -84,11 +90,13 @@ class FlightRecorder:
         burst_threshold: int = 10,
         burst_window_s: float = 5.0,
         clock=time.monotonic,
+        limiter=None,
     ):
         self.capacity = capacity
         self.burst_threshold = burst_threshold
         self.burst_window_s = burst_window_s
         self._clock = clock
+        self.limiter = limiter
         self._ring: "collections.deque[Dict]" = collections.deque(
             maxlen=capacity
         )
@@ -125,10 +133,12 @@ class FlightRecorder:
             horizon = now - self.burst_window_s
             while self._5xx and self._5xx[0] < horizon:
                 self._5xx.popleft()
-            if (
-                len(self._5xx) >= self.burst_threshold
-                and now - self._last_burst_dump >= self.burst_window_s
-            ):
+            if len(self._5xx) < self.burst_threshold:
+                return False
+            if self.limiter is not None:
+                # the shared alert/incident limiter owns dump cadence
+                return self.limiter.allow("5xx-burst")
+            if now - self._last_burst_dump >= self.burst_window_s:
                 self._last_burst_dump = now
                 return True
         return False
@@ -137,6 +147,18 @@ class FlightRecorder:
         with self._lock:
             return list(self._ring)
 
+    def snapshot_doc(self, reason: str) -> Dict:
+        """The dump document WITHOUT touching disk — what ``GET
+        /debug/flight`` returns and the incident manager files into a
+        bundle (one schema for on-disk and over-the-wire dumps)."""
+        return {
+            "schema": "gene2vec-tpu/flight/v1",
+            "reason": reason,
+            "written_unix": time.time(),
+            "pid": os.getpid(),
+            "records": self.snapshot(),
+        }
+
     def dump(self, dirpath: str, reason: str) -> str:
         """Write the current ring to ``<dirpath>/flight-<pid>-<n>.json``
         (tmp + rename, so reassembly never reads a torn dump)."""
@@ -144,13 +166,7 @@ class FlightRecorder:
         path = os.path.join(
             dirpath, f"{FLIGHT_PREFIX}{os.getpid()}-{next(self._seq)}.json"
         )
-        doc = {
-            "schema": "gene2vec-tpu/flight/v1",
-            "reason": reason,
-            "written_unix": time.time(),
-            "pid": os.getpid(),
-            "records": self.snapshot(),
-        }
+        doc = self.snapshot_doc(reason)
         tmp = f"{path}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)

@@ -257,6 +257,26 @@ def _adapt_perf(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "timeline_regression_frac"
 
 
+def _adapt_alerts(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_ALERTS_* (chaos_drill.py --only alerts --alerts-out): the
+    detection loop's headline is how fast the right rule fired after
+    the injected fault; the ``perf.regression`` rules watch it so
+    detection latency cannot silently erode."""
+    m: Dict[str, float] = {}
+    section = doc.get("alerts")
+    section = section if isinstance(section, dict) else {}
+    _put(m, "alert_detection_latency_s",
+         section.get("detection_latency_s"))
+    _put(m, "alert_warmup_false_positives",
+         section.get("warmup_false_positives"))
+    _put(m, "alert_bundle_verified", section.get("bundle_verified"))
+    _put(m, "alert_bundle_trace_through_faulty_replica",
+         section.get("bundle_trace_through_faulty_replica"))
+    _put(m, "alert_bundle_traces", section.get("bundle_traces"))
+    _put(m, "passed", doc.get("passed"))
+    return m, "alert_detection_latency_s"
+
+
 def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
     """BENCH_ANN_* (bench.py --ann): per-index-mode recall@10 vs the
     exact numpy oracle, p50/p99 at the 1M-row synthetic geometry, and
@@ -295,6 +315,7 @@ def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),
+    (re.compile(r"^BENCH_ALERTS_\w*\.json$"), "alerts", _adapt_alerts),
     (re.compile(r"^BENCH_ANN_\w*\.json$"), "ann", _adapt_ann),
     (re.compile(r"^BENCH_SERVE_\w*\.json$"), "serve_loadgen", _adapt_serve),
     (re.compile(r"^BENCH_FLEET_\w*\.json$"), "fleet_chaos", _adapt_fleet),

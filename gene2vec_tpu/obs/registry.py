@@ -308,6 +308,24 @@ class MetricsRegistry:
         return self._get(Histogram, name, help, labels=labels,
                          buckets=buckets)
 
+    def remove(self, name: str, labels=None) -> bool:
+        """Drop one series from the exposition (and free its label-set
+        slot).  For series keyed by inherently ephemeral label values —
+        the fleet aggregator's ``fleet_scrape_staleness{target=}``
+        gauges, whose ephemeral-port targets never recur — where
+        leaving a dead series behind would grow the scrape without
+        bound.  Returns whether the series existed."""
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            if self._instruments.pop(key, None) is None:
+                return False
+            remaining = self._label_sets.get(name, 1) - 1
+            if remaining > 0:
+                self._label_sets[name] = remaining
+            else:
+                self._label_sets.pop(name, None)
+            return True
+
     # -- exposition --------------------------------------------------------
 
     def prometheus_text(self) -> str:

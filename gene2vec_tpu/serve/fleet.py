@@ -56,7 +56,9 @@ from urllib.parse import urlparse
 
 from gene2vec_tpu.obs import tracecontext
 from gene2vec_tpu.obs.aggregate import FleetAggregator
+from gene2vec_tpu.obs.alerts import ALERTS_LOG_NAME, AlertEvaluator, RateLimiter
 from gene2vec_tpu.obs.flight import FlightRecorder
+from gene2vec_tpu.obs.incident import IncidentManager
 from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
 from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
@@ -529,6 +531,16 @@ class _ProxyAdapter:
                 _PROM_CT,
             ))
             return
+        if req.method == "GET" and route == "/debug/flight":
+            # the proxy's own ring, same contract as a replica's
+            # /debug/flight (serve/server.py)
+            peer.respond(Response(
+                200,
+                json.dumps(
+                    proxy.flight.snapshot_doc("debug")
+                ).encode("utf-8"),
+            ))
+            return
         if req.method == "GET" and route == "/metrics/fleet":
             # the merged fleet-level SLO view (docs/OBSERVABILITY.md):
             # availability, per-route p50/p99, total queue depth,
@@ -631,6 +643,7 @@ class FleetProxy:
         proxy_workers: int = 16,
         idle_timeout_s: float = 30.0,
         acceptors: int = 1,
+        alert_rules=None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
@@ -659,8 +672,35 @@ class FleetProxy:
             )
             if scrape_interval_s > 0 else None
         )
-        self.flight = FlightRecorder()
+        # ONE rate limiter for everything that writes forensics to disk
+        # from this process: the proxy's own 5xx-burst flight dumps and
+        # rule-triggered incident bundles share the budget
+        self.limiter = RateLimiter()
+        self.flight = FlightRecorder(limiter=self.limiter)
         self.flight_dir = flight_dir
+        # the detection loop: alert rules evaluated on every scrape
+        # tick; a rule transitioning to firing hands the incident
+        # manager a bundle job on its own thread (obs/alerts.py,
+        # obs/incident.py) — nothing here ever runs on the serve path
+        self.evaluator: Optional[AlertEvaluator] = None
+        self.incidents: Optional[IncidentManager] = None
+        if alert_rules and self.aggregator is not None and flight_dir:
+            self.incidents = IncidentManager(
+                os.path.join(flight_dir, "incidents"),
+                scan_roots=[supervisor.export_dir, flight_dir],
+                targets=supervisor.live_urls,
+                local_flight=self.flight,
+                aggregator=self.aggregator,
+                limiter=self.limiter,
+                metrics=metrics,
+            )
+            self.evaluator = AlertEvaluator(
+                alert_rules,
+                registry=self.aggregator.view,
+                log_path=os.path.join(flight_dir, ALERTS_LOG_NAME),
+                on_fire=self.incidents.fire_async,
+            )
+            self.aggregator.evaluator = self.evaluator
         self._server: Optional[EventLoopHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 

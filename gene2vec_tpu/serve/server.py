@@ -65,6 +65,7 @@ import numpy as np
 
 from gene2vec_tpu.obs import flight as flight_mod
 from gene2vec_tpu.obs import tracecontext
+from gene2vec_tpu.obs.alerts import RateLimiter
 from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.registry import MetricsRegistry
 from gene2vec_tpu.obs.trace import ambient_span
@@ -138,6 +139,12 @@ class ServeConfig:
     # fault-injected requests); saturation answers 429
     http_workers: int = 8
     http_queue: int = 512
+    # -- flight recorder (obs/flight.py; cli/serve.py --burst-*) ----------
+    # a 5xx burst of >= burst_threshold within burst_window_s dumps the
+    # ring to the run dir; dump cadence is arbitrated by the shared
+    # obs.alerts.RateLimiter (one budget with incident bundles)
+    burst_threshold: int = 10
+    burst_window_s: float = 5.0
 
 
 #: routes whose latency gets its own labeled histogram series; anything
@@ -208,8 +215,17 @@ class ServeApp:
         )
         # always-on bounded ring of recent requests; cli/serve.py sets
         # flight_dir (the run dir) and installs the SIGQUIT dump — a
-        # 5xx burst dumps from the handler path below
-        self.flight = FlightRecorder()
+        # 5xx burst dumps from the handler path below, through the
+        # shared rate limiter (obs/alerts.py) so burst dumps and any
+        # rule-triggered bundles draw from one disk-write budget
+        self.flight_limiter = RateLimiter(
+            min_interval_s=config.burst_window_s
+        )
+        self.flight = FlightRecorder(
+            burst_threshold=config.burst_threshold,
+            burst_window_s=config.burst_window_s,
+            limiter=self.flight_limiter,
+        )
         self.flight_dir: Optional[str] = None
         # -- event-loop hot path state ---------------------------------
         # pre-serialized response bodies keyed (model version, gene, k):
@@ -709,6 +725,17 @@ class ServeAdapter:
                 200,
                 app.metrics.prometheus_text().encode("utf-8"),
                 b"text/plain; version=0.0.4",
+            ))
+            return
+        if req.method == "GET" and route == "/debug/flight":
+            # the SIGQUIT-equivalent flight dump, over the wire: the
+            # incident manager solicits every live replica's ring when
+            # a rule fires (docs/OBSERVABILITY.md#alerting); needs no
+            # model, so a not-ready replica still testifies
+            peer.respond(Response(
+                200,
+                json.dumps(app.flight.snapshot_doc("debug"))
+                .encode("utf-8"),
             ))
             return
         if req.method not in ("GET", "POST"):

@@ -33,6 +33,15 @@ Five phases (docs/RESILIENCE.md runbook):
   are stamped into ``BENCH_FLEET_r08.json`` via ``--fleet-out`` and
   re-gated on every ``cli.analyze`` run
   (``analysis/passes_fleet.py``).
+* **alerts** — the detection loop (docs/OBSERVABILITY.md#alerting):
+  spawn ``cli.fleet`` with the default SLO alert rules, prove a CLEAN
+  warmup fires nothing, then load a route where one byzantine replica
+  injects deterministic 404s + latency and measure how long until the
+  availability burn-rate rule fires in ``alerts.jsonl``; the
+  auto-assembled incident bundle must CRC-verify via ``cli.obs
+  incident`` and contain a reassembled trace through the faulty
+  replica.  Stamped into ``BENCH_ALERTS_r13.json`` via ``--alerts-out``
+  and gated by ``analysis/passes_alerts.py`` (budgets.json ``alerts``).
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -55,6 +64,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -709,6 +719,334 @@ def drill_fleet(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
             proc.wait(timeout=30)
 
 
+# -- phase: alert detection + incident capture -------------------------------
+
+
+def _read_alert_transitions(run_dir: str) -> list:
+    from gene2vec_tpu.obs.alerts import collect_transitions
+
+    return collect_transitions(run_dir)
+
+
+def _trace_doc_pids(doc: dict) -> set:
+    """Every pid a reassembled trace document touches (hop nodes,
+    process-local subtrees, flight records)."""
+    pids = set(doc.get("processes") or [])
+
+    def walk(node: dict) -> None:
+        if node.get("pid"):
+            pids.add(node["pid"])
+        for sub in node.get("process_spans", []):
+            walk(sub)
+        for child in node.get("children", []):
+            walk(child)
+
+    for root in doc.get("roots", []):
+        walk(root)
+    for rec in doc.get("flight", []):
+        if rec.get("pid"):
+            pids.add(rec["pid"])
+    return pids
+
+
+def drill_alerts(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """Measure the detection loop end to end: clean warmup fires NOTHING,
+    an injected replica fault fires the availability burn-rate rule
+    within the budgeted latency, and the auto-assembled incident bundle
+    is CRC-verified and holds a reassembled trace through the faulty
+    replica."""
+    import glob
+    import threading
+
+    from gene2vec_tpu.resilience.faults import FaultSpec
+    from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    export_dir = os.path.join(tmp, "alerts_export")
+    _write_iteration(export_dir, 1, vocab_size=48, dim=8)
+
+    replicas = int(budget.get("replicas", 3))
+    scrape_s = float(budget.get("scrape_interval_s", 0.25))
+    proxy_attempts = int(budget.get("proxy_attempts", 1))
+    max_latency = float(budget.get("max_detection_latency_s", 20.0))
+    warmup_s = 6.0
+    workers = 4
+    expected_rule = "availability-burn"
+
+    # The faulty replica is BYZANTINE, not crashed: it answers promptly
+    # with 404s for valid requests (a bad deploy / corrupted routing
+    # table) plus injected latency, scoped to /v1/similar so the warmup
+    # route stays clean.  The fault class is chosen deliberately —
+    # retry-safe faults (503s, resets, kills) are ABSORBED by the PR-5
+    # resilience layer (per-replica breakers eject a 500-spewing
+    # replica within seconds; measured here: 8 of 3285 responses
+    # surfaced before the breaker closed the tap), so the front door
+    # never shows an SLO burn and nothing SHOULD alert.  A 4xx from a
+    # replica is classified replica-healthy (never retried, breaker
+    # records success) and forwards straight to the caller: a steady,
+    # unabsorbable availability burn — exactly the gray-failure class
+    # burn-rate alerting exists to catch.
+    faults = FaultSpec(
+        seed=seed, route_prefix="/v1/similar",
+        latency_p=0.5, latency_ms=180.0,
+        error_p=0.5, error_status=404,
+    )
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir, "--replicas", str(replicas),
+        "--port", "0", "--health-interval", "0.25",
+        "--backoff-base", "0.3",
+        "--proxy-attempts", str(proxy_attempts),
+        "--proxy-timeout-ms", "4000",
+        "--scrape-interval", str(scrape_s),
+        "--alert-rules", "default",
+        "--seed", str(seed),
+        # no LRU: a cached answer never touches the batcher/engine, and
+        # the bundle's reassembled trace must span the whole pipeline
+        "--serve-arg=--cache-size", "--serve-arg=0",
+        "--replica-arg", "1:--faults", "--replica-arg",
+        f"1:{faults.to_json()}",
+    ]
+    log(f"spawning fleet: {replicas} replicas, byzantine 404s+latency "
+        f"on replica 1 (route-scoped to /v1/similar), default alert "
+        f"rules")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        run_dir = info["run_dir"]
+        faulty_pid = info["replica_pids"][1]
+        log(f"fleet front door at {url}; faulty replica pid {faulty_pid}; "
+            f"run dir {run_dir}")
+
+        client = ResilientClient(
+            [url],
+            RetryPolicy(
+                max_attempts=1, default_timeout_s=6.0,
+                read_timeout_s=6.0, trace_sample=1.0,
+            ),
+        )
+        query_genes = [f"G{i}" for i in range(8)]
+        # prime the /v1/similar compile caches DIRECTLY on every
+        # replica, bypassing the proxy: the first top-k batch
+        # jit-compiles (~hundreds of ms), and neither the clean-warmup
+        # check nor the detection clock may be polluted by it — direct
+        # requests never touch the proxy's availability counters.  The
+        # faulty replica can 404 a priming request; retry until one
+        # compile-carrying 200 lands.
+        body = json.dumps(
+            {"genes": [query_genes[0]], "k": 4}
+        ).encode("utf-8")
+        for replica_url in info["replica_urls"]:
+            for _ in range(12):
+                req = urllib.request.Request(
+                    f"{replica_url}/v1/similar", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=15.0) as r:
+                        if r.status == 200:
+                            break
+                except urllib.error.HTTPError:
+                    continue  # injected 404: try again
+        # --- clean warmup: load a route the fault spec never matches;
+        # ZERO rules may fire.  Lightly paced — the warmup must
+        # exercise the pipeline, not flood the burn-rate windows with
+        # so much clean traffic that the later fault burn is diluted
+        # below its threshold for most of the detection budget.
+        log(f"clean warmup ({warmup_s:.0f}s on /v1/embedding)")
+        stop_at = time.monotonic() + warmup_s
+
+        def warm_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while time.monotonic() < stop_at:
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                client.request("/v1/embedding", {"genes": [g]},
+                               timeout_s=6.0)
+                time.sleep(0.02)
+
+        threads = [
+            threading.Thread(target=warm_worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=warmup_s + 30.0)
+        time.sleep(max(3 * scrape_s, 1.0))  # let the evaluator tick
+        warmup_firings = [
+            r for r in _read_alert_transitions(run_dir)
+            if r.get("to") == "firing"
+        ]
+        assert not warmup_firings, (
+            f"rule(s) fired during the CLEAN warmup: "
+            f"{[r['rule'] for r in warmup_firings]}"
+        )
+        log("clean warmup: zero rules fired")
+
+        # --- fault exposure: load the faulty route, clock the firing
+        t_fault = time.time()
+        load_stop = [time.monotonic() + max_latency + 15.0]
+
+        def fault_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 100 + widx)
+            while time.monotonic() < load_stop[0]:
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                client.request(
+                    "/v1/similar", {"genes": [g], "k": 4}, timeout_s=6.0
+                )
+
+        threads = [
+            threading.Thread(target=fault_worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+
+        def find_firing():
+            for r in _read_alert_transitions(run_dir):
+                if (
+                    r.get("to") == "firing"
+                    and r.get("rule") == expected_rule
+                    and r.get("wall", 0.0) >= t_fault
+                ):
+                    return r
+            return None
+
+        firing = wait_until(
+            find_firing, max_latency + 5.0, interval_s=0.2,
+            what=f"rule {expected_rule!r} firing",
+        )
+        detection_latency = firing["wall"] - t_fault
+        log(f"rule {expected_rule!r} fired {detection_latency:.2f}s after "
+            f"the first faulty request (budget {max_latency:g}s)")
+        # keep load flowing briefly so the bundle's flight rings and
+        # trace window are rich, then stop
+        time.sleep(2.0)
+        load_stop[0] = 0.0
+        for t in threads:
+            t.join(timeout=30.0)
+
+        # --- the incident bundle: assembled in the proxy process on its
+        # own thread; its manifest is written LAST, so waiting for the
+        # manifest waits for the whole bundle
+        def find_bundle():
+            manifests = glob.glob(os.path.join(
+                run_dir, "incidents", "*", "incident.MANIFEST.json"
+            ))
+            # the availability firing's bundle specifically — another
+            # rule may legitimately fire later in the fault window
+            mine = [
+                os.path.dirname(m) for m in manifests
+                if os.path.basename(os.path.dirname(m)).split("_", 1)[-1]
+                .startswith(expected_rule)
+            ]
+            return sorted(mine) or None
+
+        bundles = wait_until(find_bundle, 45.0, interval_s=0.5,
+                             what="incident bundle manifest")
+        bundle = bundles[0]
+        # verify through the operator's tool (cli.obs incident: CRC
+        # verification + render; exit 0 is the verified contract)
+        cli = subprocess.run(
+            [sys.executable, "-m", "gene2vec_tpu.cli.obs", "incident",
+             bundle],
+            capture_output=True, text=True, timeout=120,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        assert cli.returncode == 0, (
+            f"cli.obs incident failed (rc={cli.returncode}):\n"
+            f"{cli.stdout}\n{cli.stderr}"
+        )
+        assert "VERIFIED" in cli.stdout, cli.stdout
+        # ... and the timeline renderer sees the firing
+        cli = subprocess.run(
+            [sys.executable, "-m", "gene2vec_tpu.cli.obs", "alerts",
+             run_dir],
+            capture_output=True, text=True, timeout=120,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        assert cli.returncode == 0 and expected_rule in cli.stdout, (
+            f"cli.obs alerts missing the firing (rc={cli.returncode}):\n"
+            f"{cli.stdout}"
+        )
+
+        trace_files = sorted(glob.glob(os.path.join(bundle, "trace-*.json")))
+        assert trace_files, "incident bundle reassembled no traces"
+        trace_pids = {}
+        for path in trace_files:
+            with open(path) as f:
+                trace_pids[os.path.basename(path)] = _trace_doc_pids(
+                    json.load(f)
+                )
+        through_faulty = [
+            name for name, pids in trace_pids.items() if faulty_pid in pids
+        ]
+        assert through_faulty, (
+            f"no bundle trace passes through the faulty replica pid "
+            f"{faulty_pid}: {trace_pids}"
+        )
+        dump_files = sorted(
+            os.path.basename(p) for p in
+            glob.glob(os.path.join(bundle, "flightdump-*.json"))
+        )
+        # proxy ring + one dump per live replica — a silently failed
+        # /debug/flight fetch (the faulty replica's ring is the
+        # interesting one) must fail the drill, not just shrink the
+        # bundle
+        assert len(dump_files) >= replicas + 1, (
+            f"expected flight dumps from the proxy + every live replica "
+            f"({replicas + 1}), got {dump_files}"
+        )
+        assert os.path.exists(
+            os.path.join(bundle, "metrics_window.json")
+        ), "bundle is missing its raw metrics window"
+
+        all_firings = sorted({
+            r["rule"] for r in _read_alert_transitions(run_dir)
+            if r.get("to") == "firing"
+        })
+        result = {
+            "replicas": replicas,
+            "scrape_interval_s": scrape_s,
+            "proxy_attempts": proxy_attempts,
+            "warmup_s": warmup_s,
+            "workers": workers,
+            "expected_rule": expected_rule,
+            "fired_rules": all_firings,
+            "detection_latency_s": round(detection_latency, 3),
+            "warmup_false_positives": len(warmup_firings),
+            "bundle": os.path.relpath(bundle, tmp),
+            "bundle_verified": True,
+            "bundle_traces": len(trace_files),
+            "bundle_trace_through_faulty_replica": True,
+            "bundle_flight_dumps": len(dump_files),
+            "faulty_replica_pid": faulty_pid,
+            "faults_spec": faults.to_json(),
+            "budget": {k: v for k, v in budget.items()
+                       if not k.startswith("_")},
+        }
+        log(f"alerts: detection {detection_latency:.2f}s, fired "
+            f"{all_firings}, bundle {os.path.basename(bundle)} verified "
+            f"({len(trace_files)} trace(s), {len(dump_files)} dump(s))")
+        assert detection_latency <= max_latency, (
+            f"detection latency {detection_latency:.2f}s exceeds budget "
+            f"{max_latency:g}s"
+        )
+        return result
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 # -- phase: async checkpoint overhead ---------------------------------------
 
 
@@ -770,7 +1108,7 @@ def drill_async_overhead(tmp: str, budget: dict) -> dict:
 
 
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet")
+          "fleet", "alerts")
 
 
 def main(argv=None) -> int:
@@ -787,6 +1125,11 @@ def main(argv=None) -> int:
                          "budget) as a standalone bench document, e.g. "
                          "BENCH_FLEET_r08.json — the record "
                          "analysis/passes_fleet.py gates on")
+    ap.add_argument("--alerts-out", default=None, metavar="PATH",
+                    help="also write the alerts phase's results (plus "
+                         "budget) as a standalone bench document, e.g. "
+                         "BENCH_ALERTS_r13.json — the record "
+                         "analysis/passes_alerts.py gates on")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -814,6 +1157,7 @@ def main(argv=None) -> int:
     budgets = load_budgets()
     budget = budgets["resilience"]["async_ckpt"]
     fleet_budget = budgets["fleet"]["chaos"]
+    alerts_budget = budgets["alerts"]["detection"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -844,6 +1188,10 @@ def main(argv=None) -> int:
             elif phase == "fleet":
                 doc["phases"][phase] = drill_fleet(
                     tmp, args.smoke, fleet_budget, seed
+                )
+            elif phase == "alerts":
+                doc["phases"][phase] = drill_alerts(
+                    tmp, args.smoke, alerts_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -876,6 +1224,22 @@ def main(argv=None) -> int:
         with open(args.fleet_out, "w") as f:
             f.write(json.dumps(fleet_doc, indent=1) + "\n")
         log(f"wrote {args.fleet_out}")
+    if args.alerts_out and "alerts" in doc["phases"]:
+        alerts_doc = {
+            "schema": "gene2vec-tpu/bench-alerts/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "alerts_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["alerts"],
+            "alerts": doc["phases"]["alerts"],
+        }
+        with open(args.alerts_out, "w") as f:
+            f.write(json.dumps(alerts_doc, indent=1) + "\n")
+        log(f"wrote {args.alerts_out}")
     print(blob)
     log("DRILL PASSED" if doc["passed"] else "DRILL FAILED")
     return 0 if doc["passed"] else 1

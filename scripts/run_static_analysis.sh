@@ -23,13 +23,20 @@
 #                                                  # smoke drill (kill/resume
 #                                                  # bit-exactness, torn-export
 #                                                  # no-swap, async-ckpt
-#                                                  # budget, AND the fleet
+#                                                  # budget, the fleet
 #                                                  # smoke: 3 replicas, one
 #                                                  # SIGKILLed + one fault-
 #                                                  # injected under closed-loop
 #                                                  # load, availability gated
 #                                                  # by budgets.json "fleet";
-#                                                  # docs/RESILIENCE.md)
+#                                                  # AND the alert-detection
+#                                                  # smoke: one injected fault
+#                                                  # -> the availability rule
+#                                                  # fires within budget, zero
+#                                                  # warmup false positives,
+#                                                  # incident bundle verified;
+#                                                  # docs/RESILIENCE.md +
+#                                                  # docs/OBSERVABILITY.md)
 #   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
 #                                                  # (expect intended-race
 #                                                  # reports; for auditing
@@ -125,15 +132,20 @@ fi
 
 if [ "$CHAOS" = "1" ]; then
   echo "== chaos smoke drill (scripts/chaos_drill.py --smoke; incl. the" >&2
-  echo "   fleet phase: replica kill + fault injection under load) ==" >&2
+  echo "   fleet phase: replica kill + fault injection under load, and" >&2
+  echo "   the alerts phase: injected fault -> rule fires -> incident" >&2
+  echo "   bundle CRC-verified with a trace through the faulty replica) ==" >&2
   CHAOS_OUT="${CHAOS_DRILL_OUT:-/tmp/chaos_drill_smoke.json}"
-  # the fleet results also land in a standalone bench document so the
-  # analyzer's fleet-availability gate can be refreshed from CI runs
-  # (committed BENCH_FLEET_r08.json comes from the full, non-smoke drill)
+  # the fleet/alerts results also land in standalone bench documents so
+  # the analyzer's gates can be refreshed from CI runs (the committed
+  # BENCH_FLEET/BENCH_ALERTS records come from the full, non-smoke drill)
   FLEET_OUT="${FLEET_DRILL_OUT:-/tmp/chaos_drill_fleet_smoke.json}"
+  ALERTS_OUT="${ALERTS_DRILL_OUT:-/tmp/chaos_drill_alerts_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
+    --alerts-out "$ALERTS_OUT" \
     > "$CHAOS_OUT" || rc=$?
-  echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT)" >&2
+  echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
+  echo "  alerts: $ALERTS_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

@@ -1,0 +1,792 @@
+"""SLO alerting & incident capture: rule engine edge cases, aggregator
+staleness, the shared dump/bundle rate limiter, incident bundles, the
+cli.obs alerts/incident contracts, and the passes_alerts budget gate
+(docs/OBSERVABILITY.md#alerting)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from gene2vec_tpu.obs.aggregate import FleetAggregator, parse_prometheus
+from gene2vec_tpu.obs.alerts import (
+    AlertEvaluator,
+    AlertRule,
+    RateLimiter,
+    collect_transitions,
+    default_rules,
+    format_timeline,
+    parse_rules,
+)
+from gene2vec_tpu.obs.flight import FlightRecorder
+from gene2vec_tpu.obs.incident import IncidentManager, verify_bundle
+from gene2vec_tpu.obs.registry import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _threshold_rule(**kw):
+    base = dict(
+        name="q", metric="fleet_queue_depth", op=">", value=5.0,
+        clear_value=2.0, for_s=2.0, clear_for_s=3.0,
+    )
+    base.update(kw)
+    return AlertRule(**base)
+
+
+def _firing(transitions):
+    return [t for t in transitions if t["to"] == "firing"]
+
+
+# -- rule parsing ------------------------------------------------------------
+
+
+def test_parse_rules_validates():
+    rules = parse_rules({"rules": [
+        {"name": "a", "metric": "m", "op": ">", "value": 1.0},
+        {"name": "b", "kind": "burn_rate", "good": "ok", "total": "all"},
+    ]})
+    assert [r.name for r in rules] == ["a", "b"]
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_rules({"rules": [{"name": "a", "metric": "m",
+                                "treshold": 3}]})
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules({"rules": [
+            {"name": "a", "metric": "m"}, {"name": "a", "metric": "m"},
+        ]})
+    with pytest.raises(ValueError, match="kind"):
+        parse_rules({"rules": [{"name": "a", "kind": "nope"}]})
+    with pytest.raises(ValueError, match="'good' and 'total'"):
+        parse_rules({"rules": [{"name": "a", "kind": "burn_rate"}]})
+    with pytest.raises(ValueError, match="op"):
+        parse_rules({"rules": [{"name": "a", "metric": "m", "op": "!="}]})
+    with pytest.raises(ValueError, match="rules"):
+        parse_rules({})
+
+
+def test_default_rules_cover_the_slo_signals():
+    rules = default_rules()
+    for r in rules:
+        r.validate()
+    by_name = {r.name: r for r in rules}
+    assert by_name["availability-burn"].kind == "burn_rate"
+    assert by_name["availability-burn"].good == "fleet_ok"
+    assert by_name["availability-burn"].total == "fleet_responses"
+    assert "fleet_route_p99_seconds" in by_name["route-p99"].metric
+    assert by_name["rejection-rate"].metric == "fleet_rejection_rate"
+    assert by_name["queue-depth"].metric == "fleet_queue_depth"
+
+
+# -- threshold state machine -------------------------------------------------
+
+
+def test_debounce_fires_exactly_at_the_for_duration_boundary():
+    clk = FakeClock()
+    ev = AlertEvaluator([_threshold_rule(for_s=2.0)], clock=clk)
+    snap = {"fleet_queue_depth": 10.0, "_fresh_targets": 1.0}
+    assert _firing(ev.observe(snap)) == []          # t=0: pending
+    clk.t = 1.999
+    assert _firing(ev.observe(snap)) == []          # just inside
+    clk.t = 2.0
+    fired = _firing(ev.observe(snap))               # the boundary FIRES
+    assert len(fired) == 1 and fired[0]["rule"] == "q"
+    assert ev.states()["q"] == "firing"
+
+
+def test_breach_lost_during_debounce_never_fires():
+    clk = FakeClock()
+    ev = AlertEvaluator([_threshold_rule(for_s=2.0)], clock=clk)
+    ev.observe({"fleet_queue_depth": 10.0, "_fresh_targets": 1.0})
+    clk.t = 1.0
+    out = ev.observe({"fleet_queue_depth": 0.0, "_fresh_targets": 1.0})
+    assert [t["to"] for t in out] == ["inactive"]
+    clk.t = 5.0  # breach again much later: the old pending must not leak
+    assert _firing(
+        ev.observe({"fleet_queue_depth": 10.0, "_fresh_targets": 1.0})
+    ) == []
+    assert ev.states()["q"] == "pending"
+
+
+def test_hysteresis_clear_vs_immediate_refire():
+    clk = FakeClock()
+    ev = AlertEvaluator(
+        [_threshold_rule(for_s=0.0, clear_for_s=3.0)], clock=clk
+    )
+    hot = {"fleet_queue_depth": 10.0, "_fresh_targets": 1.0}
+    # between value (5) and clear_value (2): no longer breaching, but
+    # still too hot to start clearing from scratch after a re-breach
+    warm = {"fleet_queue_depth": 3.0, "_fresh_targets": 1.0}
+    cold = {"fleet_queue_depth": 1.0, "_fresh_targets": 1.0}
+    assert len(_firing(ev.observe(hot))) == 1
+    clk.t = 1.0
+    assert ev.observe(cold) == []                  # clear timer starts
+    clk.t = 2.0
+    assert ev.observe(warm) == []                  # re-hot: timer RESETS
+    assert ev.states()["q"] == "firing"            # no flap, no re-fire
+    clk.t = 4.5                                    # cold again: new timer
+    assert ev.observe(cold) == []
+    clk.t = 7.4                                    # 2.9s cold < clear_for_s
+    assert ev.observe(cold) == []
+    assert ev.states()["q"] == "firing"
+    clk.t = 7.5                                    # 3.0s cold: clears
+    out = ev.observe(cold)
+    assert [t["to"] for t in out] == ["inactive"]
+    # a fresh breach after a full clear fires AGAIN (one transition)
+    clk.t = 8.0
+    assert len(_firing(ev.observe(hot))) == 1
+
+
+def test_missing_metric_holds_the_rule():
+    clk = FakeClock()
+    ev = AlertEvaluator([_threshold_rule(for_s=0.0)], clock=clk)
+    assert ev.observe({"_fresh_targets": 1.0}) == []
+    assert ev.states()["q"] == "inactive"
+
+
+# -- burn-rate rules ---------------------------------------------------------
+
+
+def _burn_rule(**kw):
+    base = dict(
+        name="burn", kind="burn_rate", good="ok", total="all",
+        max_bad_frac=0.02, short_window_s=5.0, long_window_s=10.0,
+        min_count=10.0, for_s=0.0, clear_for_s=5.0,
+    )
+    base.update(kw)
+    return AlertRule(**base)
+
+
+def test_burn_rate_fires_on_sustained_bad_fraction():
+    clk = FakeClock()
+    ev = AlertEvaluator([_burn_rule()], clock=clk)
+    # clean traffic: 100 events/tick, all good
+    for i in range(3):
+        clk.t = float(i)
+        assert ev.observe({"ok": 100.0 * (i + 1),
+                           "all": 100.0 * (i + 1)}) == []
+    # 50% of new events fail
+    fired = []
+    for i in range(3, 6):
+        clk.t = float(i)
+        fired += _firing(ev.observe(
+            {"ok": 300.0 + (i - 2) * 50.0, "all": 100.0 * (i + 1)}
+        ))
+    assert len(fired) == 1
+    assert 0.02 < fired[0]["value"] <= 0.5
+
+
+def test_burn_rate_counter_reset_is_not_a_spike():
+    """A replica restart zeroes its counters; the fleet sums rebase, and
+    so must the evaluator — a reset must never read as a burn."""
+    clk = FakeClock()
+    ev = AlertEvaluator([_burn_rule()], clock=clk)
+    feeds = [
+        (100.0, 100.0), (200.0, 200.0),
+        (20.0, 20.0),          # restart: both counters back near zero
+        (120.0, 120.0), (220.0, 220.0), (320.0, 320.0),
+    ]
+    out = []
+    for i, (g, t) in enumerate(feeds):
+        clk.t = float(i)
+        out += ev.observe({"ok": g, "all": t})
+    assert _firing(out) == []
+    assert ev.states()["burn"] == "inactive"
+
+
+def test_burn_rate_needs_min_count_evidence():
+    clk = FakeClock()
+    ev = AlertEvaluator([_burn_rule(min_count=10.0)], clock=clk)
+    # 100% bad fraction but only 4 events in the window: no evidence
+    out = []
+    for i, (g, t) in enumerate([(0.0, 1.0), (0.0, 2.0), (0.0, 4.0)]):
+        clk.t = float(i)
+        out += ev.observe({"ok": g, "all": t})
+    assert _firing(out) == []
+
+
+def test_availability_burn_pages_through_a_total_scrape_outage():
+    """The default availability rule's counter pair is PROXY-local: it
+    stays fresh when every replica stops answering scrapes (the
+    worst outage class), so the staleness hold must not silence it."""
+    clk = FakeClock()
+    rule = next(r for r in default_rules()
+                if r.name == "availability-burn")
+    assert rule.min_fresh_targets == 0
+    ev = AlertEvaluator([rule], clock=clk)
+    fired = []
+    # every replica wedged: zero fresh targets, 100% burn at the proxy
+    for i in range(8):
+        clk.t = float(i * 10)
+        fired += _firing(ev.observe({
+            "fleet_ok": 10.0,                       # frozen
+            "fleet_responses": 10.0 + 50.0 * i,     # all failures
+            "_fresh_targets": 0.0,
+        }))
+    assert len(fired) == 1 and fired[0]["rule"] == "availability-burn"
+
+
+def test_staleness_holds_rules_on_frozen_data():
+    clk = FakeClock()
+    ev = AlertEvaluator([_threshold_rule(for_s=0.0)], clock=clk)
+    hot_stale = {"fleet_queue_depth": 10.0, "_fresh_targets": 0.0}
+    assert ev.observe(hot_stale) == []             # held, not evaluated
+    assert ev.states()["q"] == "inactive"
+    # freshness returns: the rule evaluates (and fires) normally
+    clk.t = 1.0
+    assert len(_firing(ev.observe(
+        {"fleet_queue_depth": 10.0, "_fresh_targets": 2.0}
+    ))) == 1
+    # ... and a firing rule cannot CLEAR on frozen data either
+    clk.t = 100.0
+    assert ev.observe(
+        {"fleet_queue_depth": 0.0, "_fresh_targets": 0.0}
+    ) == []
+    assert ev.states()["q"] == "firing"
+
+
+def test_evaluator_exports_state_and_log(tmp_path):
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    log_path = str(tmp_path / "alerts.jsonl")
+    fired = []
+    ev = AlertEvaluator(
+        [_threshold_rule(for_s=0.0)],
+        registry=reg, log_path=log_path,
+        on_fire=lambda rule, snap, rec: fired.append(rule.name),
+        clock=clk,
+    )
+    text = reg.prometheus_text()
+    assert 'fleet_alert_active{rule="q"} 0' in text  # visible pre-fire
+    ev.observe({"fleet_queue_depth": 10.0, "_fresh_targets": 1.0})
+    text = reg.prometheus_text()
+    assert 'fleet_alert_active{rule="q"} 1' in text
+    assert 'fleet_alert_transitions_total{rule="q",to="firing"} 1' in text
+    assert fired == ["q"]
+    records = collect_transitions(str(tmp_path))
+    assert [r["to"] for r in records] == ["pending", "firing"]
+    rendered = format_timeline(records)
+    assert "FIRING" in rendered and "currently firing: q" in rendered
+
+
+# -- aggregator staleness ----------------------------------------------------
+
+
+def _replica_text(requests, route_ms):
+    r = MetricsRegistry()
+    r.counter("serve_requests_total").inc(requests)
+    h = r.histogram(
+        "serve_route_seconds", labels={"route": "/v1/similar"},
+        buckets=(0.001, 0.008, 0.064, 0.512),
+    )
+    for ms in route_ms:
+        h.observe(ms / 1000.0)
+    return r.prometheus_text()
+
+
+def test_aggregator_marks_series_stale_and_quantiles_go_fresh_only():
+    texts = {
+        "http://fast": _replica_text(100, [2.0] * 100),
+        "http://slow": _replica_text(100, [400.0] * 100),
+    }
+    alive = dict(texts)
+
+    def fetch(url, timeout):
+        return alive[url]
+
+    snapshots = []
+
+    class Sink:
+        def observe(self, snapshot, wall=None):
+            snapshots.append(snapshot)
+
+    agg = FleetAggregator(
+        lambda: list(texts), fetch=fetch, stale_after=2, evaluator=Sink(),
+    )
+    agg.scrape_once()
+    samples = {
+        (s.name, s.labels): s.value
+        for s in parse_prometheus(agg.fleet_text())
+    }
+    key99 = ("fleet_route_p99_seconds", (("route", "/v1/similar"),))
+    assert samples[key99] >= 0.064      # the slow replica weighs the p99
+    assert samples[
+        ("fleet_scrape_staleness", (("target", "http://slow"),))
+    ] == 0
+    assert snapshots[-1]["_fresh_targets"] == 2.0
+    assert (
+        "fleet_route_p99_seconds{route=/v1/similar}" in snapshots[-1]
+    )
+    # the slow replica stops answering scrapes (still listed = wedged,
+    # not dead); first miss is not yet stale
+    del alive["http://slow"]
+    agg.scrape_once()
+    samples = {
+        (s.name, s.labels): s.value
+        for s in parse_prometheus(agg.fleet_text())
+    }
+    assert samples[
+        ("fleet_scrape_staleness", (("target", "http://slow"),))
+    ] == 1
+    assert samples[("fleet_stale_targets", ())] == 0
+    assert samples[key99] >= 0.064      # history still counts pre-stale
+    # second consecutive miss: stale — its frozen histogram no longer
+    # freezes the quantile the alert rules watch
+    agg.scrape_once()
+    samples = {
+        (s.name, s.labels): s.value
+        for s in parse_prometheus(agg.fleet_text())
+    }
+    assert samples[
+        ("fleet_scrape_staleness", (("target", "http://slow"),))
+    ] == 2
+    assert samples[("fleet_stale_targets", ())] == 1
+    assert samples[key99] <= 0.008      # fresh-replica latency only
+    assert snapshots[-1]["_fresh_targets"] == 1.0
+    # counters NEVER go backward on staleness (sums keep the history)
+    assert samples[("fleet_requests", ())] == 200
+    # recovery resets the miss count and restores its histogram weight
+    alive["http://slow"] = texts["http://slow"]
+    agg.scrape_once()
+    samples = {
+        (s.name, s.labels): s.value
+        for s in parse_prometheus(agg.fleet_text())
+    }
+    assert samples[
+        ("fleet_scrape_staleness", (("target", "http://slow"),))
+    ] == 0
+    assert samples[key99] >= 0.064
+    assert samples[("fleet_requests", ())] == 200  # no double count
+    # a DEPARTED target (restarted replica, fresh ephemeral port) sheds
+    # its staleness series entirely — dead target= label sets must not
+    # accumulate in /metrics/fleet across restarts
+    del texts["http://slow"], alive["http://slow"]
+    agg.scrape_once()
+    samples = {
+        (s.name, s.labels): s.value
+        for s in parse_prometheus(agg.fleet_text())
+    }
+    assert (
+        "fleet_scrape_staleness", (("target", "http://slow"),)
+    ) not in samples
+    assert samples[
+        ("fleet_scrape_staleness", (("target", "http://fast"),))
+    ] == 0
+    agg.view.close()
+
+
+# -- the shared rate limiter -------------------------------------------------
+
+
+def test_rate_limiter_per_key_and_global_budget():
+    clk = FakeClock()
+    lim = RateLimiter(min_interval_s=30.0, max_per_window=3,
+                      window_s=100.0, clock=clk)
+    assert lim.allow("a")
+    assert not lim.allow("a")           # per-key interval
+    assert lim.allow("b")               # other keys unaffected
+    clk.t = 31.0
+    assert lim.allow("a")
+    clk.t = 32.0
+    assert not lim.allow("c")           # global window cap (3 events)
+    clk.t = 131.0                       # old events age out
+    assert lim.allow("c")
+    assert lim.denied == 2
+
+
+def test_flight_burst_configurable_and_shared_limiter():
+    clk = FakeClock()
+    lim = RateLimiter(min_interval_s=60.0, max_per_window=10,
+                      window_s=3600.0, clock=clk)
+    rec = FlightRecorder(burst_threshold=3, burst_window_s=1.0,
+                        clock=clk, limiter=lim)
+    assert rec.record("/v1/similar", 500, 0.01) is False
+    assert rec.record("/v1/similar", 500, 0.01) is False
+    assert rec.record("/v1/similar", 500, 0.01) is True  # 3rd 5xx dumps
+    # the burst consumed the SHARED budget: an incident for the same
+    # window is arbitrated by the same limiter instance
+    assert rec.record("/v1/similar", 500, 0.01) is False
+    assert not lim.allow("5xx-burst")
+    assert lim.allow("incident:availability-burn")  # different key ok
+    clk.t = 61.0
+    rec2 = FlightRecorder(burst_threshold=2, burst_window_s=1.0,
+                          clock=clk, limiter=lim)
+    assert rec2.record("/x", 503, 0.01) is False
+    assert rec2.record("/x", 503, 0.01) is True
+    doc = rec2.snapshot_doc("debug")
+    assert doc["schema"] == "gene2vec-tpu/flight/v1"
+    assert len(doc["records"]) == 2 and doc["pid"] == os.getpid()
+
+
+# -- incident bundles --------------------------------------------------------
+
+
+def _span_end(path, trace, tsid, name, pid, wall, dur, tpid=None):
+    rec = {
+        "type": "span_end", "name": name, "trace": trace, "tsid": tsid,
+        "pid": pid, "wall": wall, "dur": dur, "span": None,
+    }
+    if tpid:
+        rec["tpid"] = tpid
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+@pytest.fixture
+def bundle_env(tmp_path):
+    """A fake two-process trace on disk + replica flight fetch stubs."""
+    import time as time_mod
+
+    now = time_mod.time()
+    scan = tmp_path / "export"
+    (scan / "fleet_runs" / "1").mkdir(parents=True)
+    (scan / "serve_runs" / "1").mkdir(parents=True)
+    proxy_events = str(scan / "fleet_runs" / "1" / "events.jsonl")
+    replica_events = str(scan / "serve_runs" / "1" / "events.jsonl")
+    slow_tid, fast_tid = "a" * 32, "b" * 32
+    _span_end(proxy_events, slow_tid, "11", "proxy_request", 100,
+              now - 2.0, 0.5)
+    _span_end(replica_events, slow_tid, "22", "serve_request", 200,
+              now - 1.9, 0.45, tpid="11")
+    _span_end(proxy_events, fast_tid, "33", "proxy_request", 100,
+              now - 1.0, 0.002)
+
+    local = FlightRecorder()
+    local.record("/v1/similar", 200, 0.5, trace_id=slow_tid)
+    local.record("/v1/similar", 200, 0.002, trace_id=fast_tid)
+
+    def fetch(url, timeout):
+        if url == "http://dead":
+            raise OSError("replica mid-incident")
+        return {
+            "schema": "gene2vec-tpu/flight/v1", "reason": "debug",
+            "pid": 200,
+            "records": [{
+                "wall": now - 1.9, "pid": 200, "route": "/v1/similar",
+                "status": 200, "dur_s": 0.45, "trace": slow_tid,
+            }],
+        }
+
+    class Agg:
+        def raw_recent(self):
+            return [{"wall": now, "target": "http://r0",
+                     "samples": {"serve_requests_total": 7.0}}]
+
+    return {
+        "scan": str(scan), "local": local, "fetch": fetch, "agg": Agg(),
+        "slow_tid": slow_tid, "fast_tid": fast_tid,
+        "incidents": str(tmp_path / "run" / "incidents"),
+    }
+
+
+def test_incident_bundle_assembly_and_verification(bundle_env):
+    clk = FakeClock()
+    lim = RateLimiter(min_interval_s=30.0, clock=clk)
+    reg = MetricsRegistry()
+    mgr = IncidentManager(
+        bundle_env["incidents"],
+        scan_roots=[bundle_env["scan"]],
+        targets=lambda: ["http://r0", "http://dead"],
+        local_flight=bundle_env["local"],
+        aggregator=bundle_env["agg"],
+        limiter=lim,
+        metrics=reg,
+        fetch=bundle_env["fetch"],
+        max_traces=1,
+    )
+    rule = _threshold_rule(name="route-p99")
+    bundle = mgr.on_fire(
+        rule, {"fleet_queue_depth": 9.0, "_fresh_targets": 2.0},
+        {"rule": "route-p99", "from": "pending", "to": "firing",
+         "value": 9.0},
+    )
+    assert bundle and os.path.basename(bundle).endswith("_route-p99")
+    names = sorted(os.listdir(bundle))
+    assert "rule.json" in names
+    assert "metrics_window.json" in names
+    assert "incident.MANIFEST.json" in names
+    # flight dumps: the local (proxy) ring + the one answering replica;
+    # the dead replica is counted, not fatal
+    dumps = [n for n in names if n.startswith("flightdump-")]
+    assert len(dumps) == 2
+    assert reg.counter("incident_flight_fetch_errors_total").value == 1
+    # max_traces=1 picks the SLOWEST trace, reassembled cross-process
+    traces = [n for n in names if n.startswith("trace-")]
+    assert traces == [f"trace-{bundle_env['slow_tid']}.json"]
+    with open(os.path.join(bundle, traces[0])) as f:
+        doc = json.load(f)
+    assert set(doc["processes"]) == {100, 200}
+    assert doc["picked_for"]["dur_s"] == 0.5
+    # the manifest CRC-verifies through the resilience primitives...
+    assert verify_bundle(bundle)
+    # ... and catches post-commit rot
+    with open(os.path.join(bundle, "rule.json"), "a") as f:
+        f.write("rot")
+    v = verify_bundle(bundle)
+    assert not v and v.reason.startswith(("size:", "crc:"))
+    # a flapping rule is rate-limited: same rule, same window -> None
+    assert mgr.on_fire(rule, {}, {}) is None
+    assert reg.counter("incident_rate_limited_total").value == 1
+
+
+def test_incident_bundle_disk_caps(bundle_env):
+    clk = FakeClock()
+    mgr = IncidentManager(
+        bundle_env["incidents"],
+        scan_roots=[bundle_env["scan"]],
+        local_flight=bundle_env["local"],
+        limiter=RateLimiter(min_interval_s=0.0, max_per_window=100,
+                            clock=clk),
+        max_bundles=2,
+        metrics=MetricsRegistry(),
+    )
+    rule_a = _threshold_rule(name="a")
+    rule_b = _threshold_rule(name="b")
+    rule_c = _threshold_rule(name="c")
+    b1 = mgr.on_fire(rule_a, {}, {"to": "firing"})
+    b2 = mgr.on_fire(rule_b, {}, {"to": "firing"})
+    b3 = mgr.on_fire(rule_c, {}, {"to": "firing"})
+    assert b1 and b2 and b3
+    kept = sorted(os.listdir(bundle_env["incidents"]))
+    assert len(kept) == 2                      # oldest pruned
+    assert os.path.basename(b3) in kept
+    # the hard byte ceiling refuses outright
+    mgr.max_total_bytes = 1
+    assert mgr.on_fire(rule_a, {}, {"to": "firing"}) is None
+
+
+# -- CLI contracts -----------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.obs", *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_obs_alerts_contract(tmp_path):
+    run_dir = tmp_path / "fleet_runs" / "1"
+    run_dir.mkdir(parents=True)
+    # exit 1: directory exists, no transitions recorded
+    r = _run_cli("alerts", str(tmp_path))
+    assert r.returncode == 1
+    # exit 2: not a directory
+    r = _run_cli("alerts", str(tmp_path / "nope"))
+    assert r.returncode == 2
+    with open(run_dir / "alerts.jsonl", "w") as f:
+        f.write(json.dumps({
+            "wall": 1000.0, "rule": "availability-burn",
+            "severity": "page", "from": "pending", "to": "firing",
+            "value": 0.2,
+        }) + "\n")
+        f.write("{torn")  # torn trailing line: skipped, not fatal
+    r = _run_cli("alerts", str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "availability-burn" in r.stdout and "FIRING" in r.stdout
+    r = _run_cli("alerts", "--json", str(tmp_path))
+    assert r.returncode == 0
+    assert json.loads(r.stdout)[0]["rule"] == "availability-burn"
+
+
+def test_cli_obs_incident_contract(bundle_env):
+    mgr = IncidentManager(
+        bundle_env["incidents"],
+        scan_roots=[bundle_env["scan"]],
+        local_flight=bundle_env["local"],
+        metrics=MetricsRegistry(),
+    )
+    bundle = mgr.on_fire(
+        _threshold_rule(name="queue-depth"),
+        {"fleet_queue_depth": 9.0},
+        {"rule": "queue-depth", "from": "pending", "to": "firing",
+         "value": 9.0},
+    )
+    r = _run_cli("incident", bundle)
+    assert r.returncode == 0, r.stderr
+    assert "VERIFIED" in r.stdout and "queue-depth" in r.stdout
+    r = _run_cli("incident", "--json", bundle)
+    assert r.returncode == 0 and json.loads(r.stdout)["verified"] is True
+    # torn bundle -> exit 1 with the manifest's machine reason
+    os.unlink(os.path.join(bundle, "rule.json"))
+    r = _run_cli("incident", bundle)
+    assert r.returncode == 1
+    assert "missing:rule.json" in r.stdout + r.stderr
+    # bad dir -> 2 (the cli.obs trace contract)
+    r = _run_cli("incident", bundle + "-nope")
+    assert r.returncode == 2
+
+
+# -- the analysis gate -------------------------------------------------------
+
+
+def _alerts_doc(**over):
+    section = {
+        "replicas": 3, "scrape_interval_s": 0.25, "proxy_attempts": 3,
+        "detection_latency_s": 4.2, "warmup_false_positives": 0,
+        "bundle_verified": True,
+        "bundle_trace_through_faulty_replica": True,
+    }
+    section.update(over)
+    return {"schema_version": 1, "alerts": section}
+
+
+def test_passes_alerts_budget_gate(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_alerts import alerts_findings
+
+    # missing bench = info (fresh checkout must not fail lint)
+    missing = alerts_findings(root=str(tmp_path / "absent"))
+    assert [f.severity for f in missing] == ["info"]
+
+    def run(doc):
+        root = tmp_path / "root"
+        root.mkdir(exist_ok=True)
+        with open(root / "BENCH_ALERTS_r13.json", "w") as f:
+            json.dump(doc, f)
+        return alerts_findings(root=str(root))
+
+    fs = run(_alerts_doc())
+    assert gating(fs) == [], [f.format() for f in fs]
+
+    # each planted violation fires EXACTLY once
+    for doc in (
+        _alerts_doc(detection_latency_s=120.0),       # too slow
+        _alerts_doc(warmup_false_positives=2),        # twitchy rules
+        _alerts_doc(bundle_verified=False),           # torn bundle
+        _alerts_doc(bundle_trace_through_faulty_replica=False),
+        _alerts_doc(detection_latency_s=None),        # dropped key
+        _alerts_doc(scrape_interval_s=5.0),           # off-recipe
+        {"schema_version": 1},                        # no section
+    ):
+        fs = run(doc)
+        assert len(gating(fs)) == 1, doc
+
+    # the newest round wins: a violating r14 beats a stale clean r13
+    root = tmp_path / "root"
+    with open(root / "BENCH_ALERTS_r14.json", "w") as f:
+        json.dump(_alerts_doc(detection_latency_s=120.0), f)
+    with open(root / "BENCH_ALERTS_r13.json", "w") as f:
+        json.dump(_alerts_doc(), f)
+    fs = alerts_findings(root=str(root))
+    assert len(gating(fs)) == 1
+    assert gating(fs)[0].path == "BENCH_ALERTS_r14.json"
+
+
+def test_cli_analyze_gates_on_planted_alerts_violation(tmp_path):
+    """The env-override path: a violating BENCH_ALERTS under
+    GENE2VEC_TPU_ALERTS_ROOT makes the real cli.analyze exit 1 with
+    exactly one alerts-detection-budget finding."""
+    root = tmp_path / "root"
+    root.mkdir()
+    with open(root / "BENCH_ALERTS_r13.json", "w") as f:
+        json.dump(_alerts_doc(detection_latency_s=120.0), f)
+    env = {**os.environ, "GENE2VEC_TPU_ALERTS_ROOT": str(root)}
+    r = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 1, r.stderr
+    doc = json.loads(r.stdout)
+    mine = [f for f in doc["findings"]
+            if f["pass"] == "alerts-detection-budget"]
+    assert len(mine) == 1
+    assert mine[0]["severity"] != "info"
+    assert "detection latency 120.00s" in mine[0]["message"]
+
+
+def test_ledger_adapts_alerts_family(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    with open(tmp_path / "BENCH_ALERTS_r13.json", "w") as f:
+        json.dump({
+            "schema_version": 1, "command": "chaos_drill --only alerts",
+            "created_unix": 1000.0, "passed": True,
+            "alerts": {
+                "detection_latency_s": 4.2, "warmup_false_positives": 0,
+                "bundle_verified": True, "bundle_traces": 3,
+                "bundle_trace_through_faulty_replica": True,
+            },
+        }, f)
+    records = ledger.ingest_root(str(tmp_path))
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["family"] == "alerts" and rec["round"] == 13
+    assert rec["headline_metric"] == "alert_detection_latency_s"
+    assert rec["metrics"]["alert_detection_latency_s"] == 4.2
+    assert rec["metrics"]["alert_bundle_verified"] == 1.0
+    assert not rec["legacy_unstamped"]
+
+
+# -- /debug/flight over real HTTP --------------------------------------------
+
+
+def test_debug_flight_endpoint(tmp_path):
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_server,
+    )
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    export = str(tmp_path / "export")
+    rng = np.random.RandomState(0)
+    save_iteration(
+        export, 4, 1,
+        SGNSParams(emb=rng.randn(16, 4).astype(np.float32),
+                   ctx=np.zeros((16, 4), np.float32)),
+        Vocab([f"G{i}" for i in range(16)], np.arange(16, 0, -1)),
+    )
+    registry = ModelRegistry(export)
+    assert registry.refresh()
+    app = ServeApp(
+        registry,
+        config=ServeConfig(burst_threshold=3, burst_window_s=1.0),
+    ).start()
+    assert app.flight.burst_threshold == 3     # ServeConfig plumbs through
+    server = make_server(app, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/v1/similar?gene=G1&k=3",
+                                    timeout=10) as r:
+            assert r.status == 200
+        # the ring append happens just AFTER the response write on the
+        # worker thread — poll briefly instead of racing it
+        import time as time_mod
+
+        doc = {}
+        for _ in range(50):
+            with urllib.request.urlopen(f"{base}/debug/flight",
+                                        timeout=10) as r:
+                doc = json.loads(r.read().decode("utf-8"))
+            if any(rec["route"] == "/v1/similar"
+                   for rec in doc["records"]):
+                break
+            time_mod.sleep(0.05)
+        assert doc["schema"] == "gene2vec-tpu/flight/v1"
+        assert doc["reason"] == "debug"
+        assert any(
+            rec["route"] == "/v1/similar" for rec in doc["records"]
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.stop()
