@@ -1,0 +1,223 @@
+"""Elastic-fleet budget gate: BENCH_AUTOSCALE vs budgets.json
+``autoscale``.
+
+The chaos drill's autoscale phase (``scripts/chaos_drill.py``, phase
+``autoscale``) exercises the elastic fleet end to end: a load ramp must
+trigger a scale-up decision within the budgeted number of scrape ticks
+(``scale_up_detection_ticks``), the hysteresis scale-down must drain
+the victim replica with ZERO dropped/wrong/mixed-iteration answers
+under continuous verified load, the post-convergence steady-state
+window must record zero further scale actions (no flapping), and an
+abusive tenant flooding its quota must leave a victim tenant's
+availability at or above the budget floor.  Results land in
+``BENCH_AUTOSCALE_r*.json``; this pass re-checks the NEWEST committed
+record against the ``autoscale`` section of ``budgets.json`` on every
+``cli.analyze`` run — elasticity that quietly slows down, starts
+dropping drained requests, or stops isolating tenants fails the
+analyzer exactly like a collective-bytes regression does.
+
+Deliberately jax-free and I/O-only (two small JSON reads): it rides
+the DEFAULT tier.  A missing bench file is an *info* finding (a fresh
+checkout must not fail lint before its first drill); a record that
+exists and violates — or omits — a budgeted quantity, or was measured
+off the pinned recipe, gates hard (the passes_obs recipe-pinning
+lesson).  ``GENE2VEC_TPU_AUTOSCALE_ROOT`` overrides the artifact root
+for the planted-violation fixtures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+AUTOSCALE_ROOT_ENV = "GENE2VEC_TPU_AUTOSCALE_ROOT"
+BENCH_AUTOSCALE_NAME = "BENCH_AUTOSCALE_r14.json"
+
+_PASS = "autoscale-elasticity-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_autoscale_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_AUTOSCALE_r*`` under ``root`` (highest round
+    wins, mtime breaks ties) — a violating r15 must beat a stale clean
+    r14, the round convention every bench family follows."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched and matched[0] == "autoscale":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime, path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def autoscale_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the recorded elasticity drill against the budget."""
+    budgets: Dict = load_budgets(budgets_path).get("autoscale", {})
+    if not budgets:
+        return []
+    root = root or os.environ.get(AUTOSCALE_ROOT_ENV) or REPO_ROOT
+    path = _newest_autoscale_bench(root) or os.path.join(
+        root, BENCH_AUTOSCALE_NAME
+    )
+    label = os.path.basename(path)
+    if not os.path.exists(path):
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no elasticity bench recorded yet ({label} missing); "
+                "run `python scripts/chaos_drill.py --only autoscale "
+                f"--autoscale-out {label}` to stamp one"
+            ),
+        )]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable elasticity bench: {e}",
+        )]
+
+    findings: List[Finding] = []
+    for name, budget in budgets.items():
+        if name.startswith("_"):
+            continue
+        section = bench.get("autoscale")
+        if not isinstance(section, dict):
+            findings.append(Finding(
+                pass_id=_PASS,
+                path=label,
+                message=(
+                    f"{label} has no 'autoscale' results section to "
+                    f"check against budget {name!r}"
+                ),
+            ))
+            continue
+        findings.extend(_check_one(name, budget, section, label))
+    return findings
+
+
+def _check_one(
+    name: str, budget: Dict, section: Dict, label: str
+) -> List[Finding]:
+    data: Dict = {"budget": name}
+    problems: List[str] = []
+
+    # every budgeted quantity must be PRESENT: a record missing a field
+    # must gate like a violation, or dropping the key becomes the way
+    # to pass (the passes_fleet/passes_alerts lesson)
+    def bounded(key: str, bound_key: str, *, upper: bool,
+                what: str) -> None:
+        bound = _get(budget, bound_key)
+        if bound is None:
+            return
+        measured = _get(section, key)
+        data[key] = measured
+        data[bound_key] = bound
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif upper and measured > bound:
+            problems.append(
+                f"{key} {measured:g} > budget {bound:g} ({what})"
+            )
+        elif not upper and measured < bound:
+            problems.append(
+                f"{key} {measured:g} < budget {bound:g} ({what})"
+            )
+
+    bounded(
+        "scale_up_detection_ticks", "max_scale_up_detection_ticks",
+        upper=True,
+        what="the scaler noticed the ramp too slowly",
+    )
+    bounded(
+        "dropped_answers", "max_dropped_answers", upper=True,
+        what="the zero-drop drain dropped requests",
+    )
+    bounded(
+        "wrong_answers", "max_wrong_answers", upper=True,
+        what="scale actions produced wrong answers",
+    )
+    bounded(
+        "mixed_iteration_answers", "max_mixed_iteration_answers",
+        upper=True,
+        what="scale actions mixed model iterations",
+    )
+    bounded(
+        "steady_state_scale_actions", "max_steady_state_scale_actions",
+        upper=True,
+        what="the fleet flapped after convergence",
+    )
+    bounded(
+        "victim_tenant_availability", "min_victim_availability",
+        upper=False,
+        what="an abusive tenant starved the victim tenant",
+    )
+    # the budget pins the drill RECIPE — a no-ramp, no-tenant run must
+    # not pass an elasticity gate by construction
+    for key in ("min_replicas", "max_replicas", "scrape_interval_s"):
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(section, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"drill ran with {key}={measured:g} but the budget pins "
+                f"{key}={pinned:g} — re-run with the budgeted recipe"
+            )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                f"elasticity record violates budget {name!r}: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"elasticity within budget {name!r}: scale-up detected in "
+            f"{data.get('scale_up_detection_ticks')} tick(s), zero "
+            "drops/wrong/mixed during scale-down, "
+            f"{data.get('steady_state_scale_actions')} steady-state "
+            "action(s), victim tenant availability "
+            f"{data.get('victim_tenant_availability')}"
+        ),
+        data=data,
+    )]

@@ -176,6 +176,14 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_alerts import alerts_findings
 
         findings.extend(alerts_findings())
+        # ... and the elastic-fleet gate (BENCH_AUTOSCALE scale-up
+        # detection ticks / zero-drop scale-down / steady-state no-flap
+        # / tenant isolation vs budgets.json "autoscale", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_autoscale import (
+            autoscale_findings,
+        )
+
+        findings.extend(autoscale_findings())
 
     if args.hlo:
         _pin_cpu_backend()

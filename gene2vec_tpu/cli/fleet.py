@@ -38,7 +38,43 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--export-dir", required=True,
                    help="io/checkpoint.py export dir every replica serves")
-    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--replicas", type=int, default=3,
+                   help="initial replica count")
+    p.add_argument("--min-replicas", type=int, default=0,
+                   help="autoscaling floor (0 = --replicas)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="autoscaling ceiling; > 0 turns the SLO-driven "
+                        "scaler ON (serve/autoscale.py: scale-up on "
+                        "queue/rejection/availability breach, slow "
+                        "hysteresis scale-down with a zero-drop drain; "
+                        "docs/SERVING.md#elastic-fleet).  Requires "
+                        "--scrape-interval > 0 — the scaler reads the "
+                        "aggregator's snapshot each tick")
+    p.add_argument("--scale-up-queue", type=float, default=8.0,
+                   help="scale-up breach threshold: fleet queue depth "
+                        "PER replica")
+    p.add_argument("--scale-up-rejection", type=float, default=0.02,
+                   help="scale-up breach threshold: windowed rejection "
+                        "rate (delta per tick, not lifetime)")
+    p.add_argument("--scale-up-after", type=int, default=2,
+                   help="consecutive breach ticks before scaling up")
+    p.add_argument("--scale-down-queue", type=float, default=1.0,
+                   help="scale-down clear threshold: fleet queue depth "
+                        "per replica must sit at or below this for the "
+                        "whole clear window (the hysteresis band is the "
+                        "gap up to --scale-up-queue)")
+    p.add_argument("--scale-down-after", type=int, default=30,
+                   help="consecutive CLEAR ticks before scaling down "
+                        "(asymmetric on purpose: ramps are emergencies, "
+                        "idle capacity is not)")
+    p.add_argument("--scale-cooldown", type=float, default=10.0,
+                   help="seconds after an action completes before the "
+                        "next may fire (anti-flap)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="max seconds to wait for a draining replica's "
+                        "in-flight requests to settle before it is "
+                        "terminated anyway (also bounds the fleet-wide "
+                        "shutdown drain)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8100,
                    help="front-door port; 0 picks an ephemeral one "
@@ -129,6 +165,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         FleetSupervisor,
     )
 
+    # validate the autoscale flags BEFORE paying N replica spawns
+    autoscale_cfg = None
+    if args.max_replicas > 0:
+        from gene2vec_tpu.serve.autoscale import AutoscaleConfig
+
+        if args.scrape_interval <= 0:
+            print(
+                "error: --max-replicas needs --scrape-interval > 0 — "
+                "the scaler reads the fleet aggregator's snapshot each "
+                "scrape tick",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            autoscale_cfg = AutoscaleConfig(
+                min_replicas=args.min_replicas or args.replicas,
+                max_replicas=args.max_replicas,
+                up_queue_per_replica=args.scale_up_queue,
+                up_rejection_rate=args.scale_up_rejection,
+                up_after_ticks=args.scale_up_after,
+                down_queue_per_replica=args.scale_down_queue,
+                down_after_ticks=args.scale_down_after,
+                cooldown_s=args.scale_cooldown,
+            )
+        except ValueError as e:
+            print(f"error: bad autoscale flags: {e}", file=sys.stderr)
+            return 2
+        if args.replicas < autoscale_cfg.min_replicas or (
+            args.replicas > autoscale_cfg.max_replicas
+        ):
+            print(
+                f"error: --replicas {args.replicas} outside "
+                f"[{autoscale_cfg.min_replicas}, "
+                f"{autoscale_cfg.max_replicas}]",
+                file=sys.stderr,
+            )
+            return 2
+
     run_dir = args.run_dir or os.path.join(
         args.export_dir, "fleet_runs", str(int(time.time()))
     )
@@ -204,6 +278,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         acceptors=args.proxy_acceptors,
         alert_rules=alert_rules,
     )
+    controller = None
+    if autoscale_cfg is not None:
+        from gene2vec_tpu.serve.autoscale import ElasticController
+
+        controller = ElasticController(
+            supervisor,
+            proxy,
+            autoscale_cfg,
+            metrics=run.registry,
+            drain_timeout_s=args.drain_timeout,
+        )
+        # the scaler rides the aggregator's scrape tick, after the
+        # alert evaluator — same snapshot, zero serve-path cost
+        assert proxy.aggregator is not None
+        proxy.aggregator.observers.append(controller.observe)
     url = proxy.serve(args.host, args.port)
     run.annotate(fleet_url=url)
     run.event(
@@ -218,13 +307,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "replica_urls": [r.url for r in supervisor.replicas],
                 "replica_pids": [r.pid for r in supervisor.replicas],
                 "run_dir": run.run_dir,
+                "autoscale": (
+                    {
+                        "min": autoscale_cfg.min_replicas,
+                        "max": autoscale_cfg.max_replicas,
+                    }
+                    if autoscale_cfg is not None else None
+                ),
             }
         ),
         flush=True,
     )
     print(
         f"fleet of {args.replicas} replicas over {args.export_dir} "
-        f"fronted at {url}; run dir {run.run_dir}",
+        f"fronted at {url}; run dir {run.run_dir}"
+        + (
+            f"; autoscaling [{autoscale_cfg.min_replicas}, "
+            f"{autoscale_cfg.max_replicas}]"
+            if autoscale_cfg is not None else ""
+        ),
         file=sys.stderr,
     )
     try:
@@ -233,7 +334,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     except KeyboardInterrupt:
         print("shutting down fleet", file=sys.stderr)
     finally:
+        # graceful, zero-drop shutdown ordering: stop scaling, stop
+        # accepting (front door down), DRAIN the forwards the proxy
+        # already dispatched, and only then SIGTERM the replicas —
+        # tearing children down under the proxy's in-flight requests
+        # was exactly the drop scale-down exists to prevent
+        if controller is not None:
+            controller.stop()
         proxy.stop()
+        proxy.drain(timeout_s=args.drain_timeout)
         supervisor.stop()
         run.close()
     return 0

@@ -116,6 +116,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "headroom per query)")
     p.add_argument("--ann-clusters", type=int, default=None,
                    help="IVF centroid count (default ~4*sqrt(vocab))")
+    p.add_argument("--tenant-quota", type=float, default=0.0,
+                   metavar="RATE",
+                   help="per-tenant token-bucket quota in requests/s "
+                        "(X-Tenant header; untagged traffic is the "
+                        "'default' tenant).  0 disables multi-tenant "
+                        "admission entirely (docs/SERVING.md"
+                        "#multi-tenant-admission).  Quotas are "
+                        "per-replica: a fleet of N admits N x RATE per "
+                        "tenant in aggregate")
+    p.add_argument("--tenant-burst", type=float, default=0.0,
+                   help="tenant bucket burst headroom "
+                        "(0 = 2 x --tenant-quota)")
+    p.add_argument("--tenant-override", action="append", default=[],
+                   metavar="ID:RATE[:BURST[:WEIGHT]]",
+                   help="explicit quota for one tenant (repeatable); "
+                        "WEIGHT is its weighted-fair-dequeue share in "
+                        "the batcher (default 1)")
     return p
 
 
@@ -129,6 +146,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         ServeConfig,
         make_server,
     )
+    from gene2vec_tpu.serve.tenancy import TenantPolicy
+
+    # a typo'd tenant quota must fail in milliseconds, before the model
+    # load (the cli.fleet --alert-rules lesson)
+    try:
+        TenantPolicy.from_args(
+            args.tenant_quota, args.tenant_burst or None,
+            args.tenant_override,
+        )
+    except ValueError as e:
+        print(f"error: bad tenant quota flags: {e}", file=sys.stderr)
+        return 2
 
     run_dir = args.run_dir or os.path.join(
         args.export_dir, "serve_runs", str(int(time.time()))
@@ -192,6 +221,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             rescore_mult=args.rescore_mult,
             burst_threshold=args.burst_threshold,
             burst_window_s=args.burst_window,
+            tenant_rate=args.tenant_quota,
+            tenant_burst=args.tenant_burst,
+            tenant_overrides=tuple(args.tenant_override),
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,

@@ -250,6 +250,12 @@ class FleetAggregator:
         self._fetch = fetch
         #: optional obs.alerts.AlertEvaluator fed one snapshot per tick
         self.evaluator = evaluator
+        #: additional per-tick snapshot consumers, called AFTER the
+        #: evaluator with the same (snapshot, wall) — the autoscaler
+        #: (serve/autoscale.py ElasticController.observe) registers
+        #: here.  Each observer is exception-isolated: a scaling bug
+        #: must not cost a telemetry tick.
+        self.observers: List[Callable[..., None]] = []
         #: consecutive missed scrapes before a target's series go stale
         self.stale_after = int(stale_after)
         #: the merged fleet-level registry served at /metrics/fleet
@@ -434,16 +440,28 @@ class FleetAggregator:
 
         requests = msum("serve_requests_total")
         rejected = msum("serve_rejected_total")
+        # the tenant-labeled slice of the rejections: per-tenant quota
+        # shedding (serve/tenancy.py).  Kept distinct so the autoscaler
+        # can scale on CAPACITY rejections (queue-full, unlabeled) and
+        # not on traffic a quota is deliberately rejecting.
+        quota_rejected = sum(
+            v for (n, lk), v in merged.items()
+            if n == "serve_rejected_total"
+            and any(k == "tenant" for k, _ in lk)
+        )
         queue_depth = msum("serve_queue_depth")
         rejection_rate = (rejected / requests) if requests > 0 else 0.0
 
-        ok_total = total = 0.0
+        ok_total = total = throttled = 0.0
         if self.proxy_registry is not None:
             ok_total = self.proxy_registry.counter(
                 "fleet_proxy_ok_total"
             ).value
             total = self.proxy_registry.counter(
                 "fleet_proxy_responses_total"
+            ).value
+            throttled = self.proxy_registry.counter(
+                "fleet_proxy_429_total"
             ).value
         availability = (ok_total / total) if total > 0 else 1.0
 
@@ -459,9 +477,11 @@ class FleetAggregator:
             v.gauge("fleet_queue_depth").set(queue_depth)
             v.gauge("fleet_requests").set(requests)
             v.gauge("fleet_rejected").set(rejected)
+            v.gauge("fleet_quota_rejected").set(quota_rejected)
             v.gauge("fleet_rejection_rate").set(rejection_rate)
             v.gauge("fleet_ok").set(ok_total)
             v.gauge("fleet_responses").set(total)
+            v.gauge("fleet_throttled").set(throttled)
             v.gauge("fleet_availability").set(availability)
             v.gauge("fleet_stale_targets").set(len(stale))
             v.gauge("fleet_last_scrape_unix").set(scrape_wall)
@@ -494,6 +514,8 @@ class FleetAggregator:
             snapshot.update({
                 "fleet_ok": ok_total,
                 "fleet_responses": total,
+                "fleet_throttled": throttled,
+                "fleet_quota_rejected": quota_rejected,
                 "fleet_stale_targets": float(len(stale)),
                 "_fresh_targets": float(ok_targets),
             })
@@ -503,6 +525,14 @@ class FleetAggregator:
             # outside the view lock: the evaluator takes its own lock
             # and writes alert gauges back through the registry's
             self.evaluator.observe(snapshot, wall=scrape_wall)
+        for observer in list(self.observers):
+            try:
+                observer(snapshot, wall=scrape_wall)
+            except Exception:
+                self.view.counter(
+                    "fleet_observer_errors_total",
+                    "snapshot observers (autoscaler) that raised",
+                ).inc()
         return headline
 
     def raw_recent(self) -> List[Dict]:

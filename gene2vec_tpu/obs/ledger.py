@@ -277,6 +277,31 @@ def _adapt_alerts(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "alert_detection_latency_s"
 
 
+def _adapt_autoscale(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_AUTOSCALE_* (chaos_drill.py --only autoscale
+    --autoscale-out): the elastic fleet's headline is how fast the
+    scaler noticed a load ramp (in scrape ticks) plus whether tenant
+    isolation held; the ``perf.regression`` rules watch both so
+    elasticity wins cannot silently erode."""
+    m: Dict[str, float] = {}
+    section = doc.get("autoscale")
+    section = section if isinstance(section, dict) else {}
+    for key in (
+        "scale_up_detection_ticks",
+        "victim_tenant_availability",
+        "dropped_answers",
+        "wrong_answers",
+        "mixed_iteration_answers",
+        "steady_state_scale_actions",
+        "scale_up_completed_s",
+        "scale_down_s",
+        "drain_timeouts",
+    ):
+        _put(m, key, section.get(key))
+    _put(m, "passed", doc.get("passed"))
+    return m, "scale_up_detection_ticks"
+
+
 def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
     """BENCH_ANN_* (bench.py --ann): per-index-mode recall@10 vs the
     exact numpy oracle, p50/p99 at the 1M-row synthetic geometry, and
@@ -316,6 +341,8 @@ def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),
     (re.compile(r"^BENCH_ALERTS_\w*\.json$"), "alerts", _adapt_alerts),
+    (re.compile(r"^BENCH_AUTOSCALE_\w*\.json$"), "autoscale",
+     _adapt_autoscale),
     (re.compile(r"^BENCH_ANN_\w*\.json$"), "ann", _adapt_ann),
     (re.compile(r"^BENCH_SERVE_\w*\.json$"), "serve_loadgen", _adapt_serve),
     (re.compile(r"^BENCH_FLEET_\w*\.json$"), "fleet_chaos", _adapt_fleet),

@@ -20,7 +20,13 @@ Layering (docs/SERVING.md):
 * :mod:`~gene2vec_tpu.serve.client` — the resilient caller (retries
   with deadline propagation + budgets, hedging, circuit breakers);
 * :mod:`~gene2vec_tpu.serve.fleet` — replica supervision and the
-  front-door round-robin proxy.
+  front-door round-robin proxy;
+* :mod:`~gene2vec_tpu.serve.tenancy` — multi-tenant admission:
+  per-tenant token-bucket quotas (``X-Tenant``) and the weighted-fair
+  queue the batcher drains;
+* :mod:`~gene2vec_tpu.serve.autoscale` — the SLO-driven elastic
+  scaler: hysteresis policy over the fleet aggregator's snapshot,
+  zero-drop scale-down drains.
 
 ``python -m gene2vec_tpu.cli.serve`` runs one replica,
 ``python -m gene2vec_tpu.cli.fleet`` a supervised fleet;
@@ -39,6 +45,11 @@ from gene2vec_tpu.serve.client import (
     RetryPolicy,
 )
 from gene2vec_tpu.serve.ann import AnnIndex, build_index
+from gene2vec_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    ElasticController,
+)
 from gene2vec_tpu.serve.engine import BucketedTopKEngine, SimilarityEngine
 from gene2vec_tpu.serve.eventloop import (
     EventLoopConfig,
@@ -47,27 +58,40 @@ from gene2vec_tpu.serve.eventloop import (
 from gene2vec_tpu.serve.fleet import FleetConfig, FleetProxy, FleetSupervisor
 from gene2vec_tpu.serve.registry import LoadedModel, ModelRegistry
 from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
+from gene2vec_tpu.serve.tenancy import (
+    FairQueue,
+    RateBucket,
+    TenantAdmission,
+    TenantPolicy,
+)
 
 __all__ = [
     "AnnIndex",
+    "AutoscaleConfig",
+    "AutoscalePolicy",
     "BucketedTopKEngine",
     "build_index",
     "CircuitBreaker",
     "ClientResponse",
     "DeadlineExceeded",
+    "ElasticController",
     "EventLoopConfig",
     "EventLoopHTTPServer",
+    "FairQueue",
     "FleetConfig",
     "FleetProxy",
     "FleetSupervisor",
     "LoadedModel",
     "MicroBatcher",
     "ModelRegistry",
+    "RateBucket",
     "RejectedError",
     "ResilientClient",
     "RetryPolicy",
     "ServeApp",
     "ServeConfig",
+    "TenantAdmission",
+    "TenantPolicy",
     "SimilarityEngine",
     "make_server",
 ]

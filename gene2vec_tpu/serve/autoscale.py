@@ -1,0 +1,465 @@
+"""SLO-driven fleet autoscaling: hysteresis policy + elastic controller.
+
+A fixed-N fleet over-provisions at night and sheds load during ramps.
+This module closes the loop the alerting plane opened: the
+:class:`~gene2vec_tpu.obs.aggregate.FleetAggregator` already computes
+the SLO signals autoscaling needs on every scrape tick
+(``fleet_queue_depth``, ``fleet_rejection_rate``, the raw
+``fleet_ok``/``fleet_responses`` counter pair, per-route p99, and the
+``_fresh_targets`` staleness facts) — the scaler consumes that same
+snapshot and adjusts replica count between ``min_replicas`` and
+``max_replicas``.
+
+Two pieces, deliberately separated:
+
+* :class:`AutoscalePolicy` — a **pure state machine**: one
+  ``observe(snapshot, now, current) -> ScaleDecision`` call per scrape
+  tick, no threads, no I/O, injectable clock values.  Asymmetric
+  hysteresis is the core: a breach (queue depth per replica, windowed
+  rejection rate, windowed availability burn, or route p99 over their
+  ``up_*`` thresholds) must hold for ``up_after_ticks`` consecutive
+  ticks to scale up (fast — a ramp is an emergency), while scale-down
+  requires ``down_after_ticks`` consecutive ticks **fully clear** of
+  the (lower) ``down_*`` thresholds (slow — idle capacity is cheap,
+  flapping is not).  The middle band between the two threshold sets
+  resets *both* streaks: ambiguous signals freeze the fleet where it
+  is.  A ``cooldown_s`` window after every action suppresses the next
+  one, and a **stale snapshot** (fewer than ``min_fresh_targets``
+  fresh scrape targets) advances neither streak — frozen telemetry
+  must neither grow nor shrink the fleet.  Rate signals are **windowed
+  deltas** over the raw counters, never lifetime ratios: one historic
+  rejection burst must not pin the cumulative rate above the clear
+  threshold forever.
+
+* :class:`ElasticController` — the impure shell: registered as an
+  aggregator observer, it feeds the policy each tick and applies
+  decisions on its own thread.  Scale-up spawns a fresh replica
+  through the supervisor and waits for readiness.  Scale-down is
+  **zero-drop by construction**: the victim leaves the rotation first
+  (``FleetSupervisor.begin_drain`` — the proxy's target callable stops
+  offering it on the very next pick), then the controller waits for
+  the front door's per-replica in-flight count
+  (:class:`~gene2vec_tpu.serve.client.InFlightTracker`) to hold at
+  zero, and only then does the supervisor SIGTERM the child — the same
+  terminate path ``FleetSupervisor.stop`` has always used.  A drain
+  that never settles times out (counted) rather than wedging the
+  control loop.
+
+``python -m gene2vec_tpu.cli.fleet --max-replicas N`` turns the loop
+on; the chaos drill's ``autoscale`` phase (ramp -> scale-up within
+budgeted ticks; ramp-down -> zero-drop scale-down; steady state ->
+zero actions) stamps ``BENCH_AUTOSCALE_r*.json``, gated by
+``analysis/passes_autoscale.py`` against budgets.json ``autoscale``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "AutoscaleConfig",
+    "AutoscalePolicy",
+    "ElasticController",
+    "ScaleDecision",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Scaler policy knobs (cli/fleet.py flags).  All ``*_ticks``
+    values count aggregator scrape ticks — the policy's only clock is
+    the snapshot cadence."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # -- breach thresholds: scale up when ANY signal exceeds its up_*
+    # bound for up_after_ticks consecutive ticks ------------------------
+    up_queue_per_replica: float = 8.0
+    up_rejection_rate: float = 0.02
+    up_availability: float = 0.95     # windowed Δok/Δresponses below this
+    up_p99_s: float = 0.0             # 0 disables the p99 signal
+    p99_route: str = "/v1/similar"
+    up_after_ticks: int = 2
+    # -- clear thresholds: scale down only when EVERY signal sits below
+    # its down_* bound for down_after_ticks consecutive ticks — the gap
+    # between up_* and down_* is the hysteresis band ---------------------
+    down_queue_per_replica: float = 1.0
+    down_rejection_rate: float = 0.0
+    down_availability: float = 0.999
+    down_p99_s: float = 0.0
+    down_after_ticks: int = 30
+    # -- damping ---------------------------------------------------------
+    cooldown_s: float = 10.0          # no two actions closer than this
+    min_fresh_targets: int = 1        # stale snapshot -> hold
+    min_window_responses: float = 1.0  # evidence floor for rate deltas
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """One tick's verdict.  ``target`` is the replica count the fleet
+    should move to (== ``current`` on hold)."""
+
+    action: str                # "up" | "down" | "hold"
+    target: int
+    reason: str
+    breach_ticks: int = 0
+    clear_ticks: int = 0
+
+
+def _route_key(route: str) -> str:
+    return f"fleet_route_p99_seconds{{route={route}}}"
+
+
+class AutoscalePolicy:
+    """The pure hysteresis state machine.  One instance per fleet;
+    :meth:`observe` is called with the aggregator's flat snapshot once
+    per scrape tick and never blocks, sleeps, or spawns."""
+
+    def __init__(self, config: AutoscaleConfig):
+        if config.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if config.max_replicas < config.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.config = config
+        self._breach_ticks = 0
+        self._clear_ticks = 0
+        self._last_action_at: Optional[float] = None
+        # counter baselines for the windowed rate signals; None until
+        # the first snapshot seeds them (the first tick can never act)
+        self._base: Optional[Dict[str, float]] = None
+
+    # -- controller hooks --------------------------------------------------
+
+    def note_action_done(self, now: float) -> None:
+        """Re-arm the cooldown from the moment an action COMPLETED: a
+        scale-up pays a full replica startup (tens of seconds of jax
+        import), and cooling down from the decision instant would let
+        the still-breaching window trigger a second spawn mid-first."""
+        self._last_action_at = now
+
+    # -- signal extraction -------------------------------------------------
+
+    def _window(self, snapshot: Dict[str, float]) -> Dict[str, Optional[float]]:
+        """Per-tick deltas of the monotone counters -> windowed rates.
+        Returns None for a rate with no evidence this window.
+
+        Deliberate shedding is NOT load the fleet should chase:
+        tenant-quota rejections (``fleet_quota_rejected``, the
+        tenant-labeled slice of the rejection counter) are subtracted
+        from the rejection signal — an abusive tenant saturating its
+        own bucket must not buy itself N x quota by scaling the fleet
+        — and 429 responses (``fleet_throttled``) leave the
+        availability-burn window entirely, since backpressure is a
+        policy outcome, not a failure.  Queue-full (capacity)
+        rejections still drive scale-up through the rejection rate."""
+        cur = {
+            k: float(snapshot.get(k, 0.0))
+            for k in ("fleet_requests", "fleet_rejected",
+                      "fleet_quota_rejected", "fleet_ok",
+                      "fleet_responses", "fleet_throttled")
+        }
+        base, self._base = self._base, cur
+        if base is None:
+            return {"rejection": None, "availability": None}
+        d = {k: max(0.0, cur[k] - base[k]) for k in cur}
+        floor = self.config.min_window_responses
+        capacity_rejected = max(
+            0.0, d["fleet_rejected"] - d["fleet_quota_rejected"]
+        )
+        rejection = (
+            capacity_rejected / d["fleet_requests"]
+            if d["fleet_requests"] >= floor else None
+        )
+        answered = d["fleet_responses"] - d["fleet_throttled"]
+        availability = (
+            min(1.0, d["fleet_ok"] / answered)
+            if answered >= floor else None
+        )
+        return {"rejection": rejection, "availability": availability}
+
+    def _classify(self, snapshot: Dict[str, float],
+                  current: int) -> "tuple[bool, bool, str]":
+        """(breach, clear, detail) for one snapshot.  ``breach`` = any
+        signal over its up_* bound; ``clear`` = every measurable signal
+        under its down_* bound (quiet windows with no traffic count as
+        clear — that is exactly when capacity should shrink)."""
+        cfg = self.config
+        rates = self._window(snapshot)
+        queue_per = (
+            float(snapshot.get("fleet_queue_depth", 0.0)) / max(current, 1)
+        )
+        p99 = snapshot.get(_route_key(cfg.p99_route))
+        breaches = []
+        if queue_per > cfg.up_queue_per_replica:
+            breaches.append(f"queue/replica {queue_per:.1f}")
+        r = rates["rejection"]
+        if r is not None and r > cfg.up_rejection_rate:
+            breaches.append(f"rejection {r:.3f}")
+        a = rates["availability"]
+        if a is not None and a < cfg.up_availability:
+            breaches.append(f"availability {a:.3f}")
+        if cfg.up_p99_s > 0 and p99 is not None and p99 > cfg.up_p99_s:
+            breaches.append(f"p99 {p99:.3f}s")
+        if breaches:
+            return True, False, "+".join(breaches)
+        clear = queue_per <= cfg.down_queue_per_replica
+        if r is not None and r > cfg.down_rejection_rate:
+            clear = False
+        if a is not None and a < cfg.down_availability:
+            clear = False
+        if cfg.down_p99_s > 0 and p99 is not None and p99 > cfg.down_p99_s:
+            clear = False
+        return False, clear, "clear" if clear else "between thresholds"
+
+    # -- the tick ----------------------------------------------------------
+
+    def observe(self, snapshot: Dict[str, float], now: float,
+                current: int) -> ScaleDecision:
+        cfg = self.config
+
+        def hold(reason: str) -> ScaleDecision:
+            return ScaleDecision(
+                "hold", current, reason,
+                breach_ticks=self._breach_ticks,
+                clear_ticks=self._clear_ticks,
+            )
+
+        fresh = snapshot.get("_fresh_targets")
+        if fresh is not None and fresh < cfg.min_fresh_targets:
+            # frozen telemetry: neither streak may advance — acting on
+            # a stale snapshot would scale on data from before the
+            # outage that froze it
+            return hold("stale snapshot (fresh targets "
+                        f"{int(fresh)} < {cfg.min_fresh_targets})")
+        if self._base is None:
+            # the very first snapshot only seeds the counter baselines:
+            # no windowed rate exists yet, so neither streak advances
+            self._window(snapshot)
+            return hold("seeding counter baselines")
+        breach, clear, detail = self._classify(snapshot, current)
+        if breach:
+            self._breach_ticks += 1
+            self._clear_ticks = 0
+        elif clear:
+            self._clear_ticks += 1
+            self._breach_ticks = 0
+        else:
+            # the hysteresis band: ambiguous — freeze both streaks
+            self._breach_ticks = 0
+            self._clear_ticks = 0
+        in_cooldown = (
+            self._last_action_at is not None
+            and now - self._last_action_at < cfg.cooldown_s
+        )
+        if breach and self._breach_ticks >= cfg.up_after_ticks:
+            if current >= cfg.max_replicas:
+                return hold(f"breach ({detail}) but at max_replicas "
+                            f"{cfg.max_replicas}")
+            if in_cooldown:
+                return hold(f"breach ({detail}) held by cooldown")
+            decision = ScaleDecision(
+                "up", min(cfg.max_replicas, current + 1),
+                f"breach for {self._breach_ticks} ticks: {detail}",
+                breach_ticks=self._breach_ticks,
+            )
+            self._breach_ticks = 0
+            self._clear_ticks = 0
+            self._last_action_at = now
+            return decision
+        if clear and self._clear_ticks >= cfg.down_after_ticks:
+            if current <= cfg.min_replicas:
+                return hold(f"clear but at min_replicas "
+                            f"{cfg.min_replicas}")
+            if in_cooldown:
+                return hold("clear window complete but held by cooldown")
+            decision = ScaleDecision(
+                "down", max(cfg.min_replicas, current - 1),
+                f"clear for {self._clear_ticks} ticks",
+                clear_ticks=self._clear_ticks,
+            )
+            self._breach_ticks = 0
+            self._clear_ticks = 0
+            self._last_action_at = now
+            return decision
+        return hold(detail)
+
+
+class ElasticController:
+    """Applies :class:`AutoscalePolicy` decisions to a live fleet.
+
+    Registered as a :class:`~gene2vec_tpu.obs.aggregate.FleetAggregator`
+    observer — :meth:`observe` runs on the aggregator's scrape thread
+    and must stay cheap, so actions run on their own daemon thread and
+    at most ONE action is in flight at a time (ticks during an action
+    are skipped outright: a 20-second replica spawn must not queue up
+    twenty more decisions behind it)."""
+
+    def __init__(
+        self,
+        supervisor,
+        proxy,
+        config: AutoscaleConfig,
+        metrics=None,
+        policy: Optional[AutoscalePolicy] = None,
+        drain_timeout_s: float = 30.0,
+        drain_poll_s: float = 0.05,
+        drain_settle_polls: int = 3,
+    ):
+        self.supervisor = supervisor
+        self.proxy = proxy
+        self.config = config
+        self.metrics = metrics
+        self.policy = policy if policy is not None else (
+            AutoscalePolicy(config)
+        )
+        self.drain_timeout_s = drain_timeout_s
+        self.drain_poll_s = drain_poll_s
+        # consecutive zero-in-flight polls required before the victim
+        # is terminated: closes the pick-to-dispatch race window where
+        # the client chose the victim just before it left the rotation
+        self.drain_settle_polls = max(1, int(drain_settle_polls))
+        self._lock = threading.Lock()
+        self._busy = False
+        self._stopped = False
+        if metrics is not None:
+            # pre-register the action counters at 0 so /metrics shows
+            # them from the first scrape and the drill's steady-state
+            # delta math never reads "absent" as "changed"
+            metrics.counter("fleet_scale_up_total")
+            metrics.counter("fleet_scale_down_total")
+            metrics.gauge("fleet_replicas_active").set(
+                supervisor.active_count()
+            )
+
+    # -- metrics -----------------------------------------------------------
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc()
+
+    def _publish(self, decision: ScaleDecision, current: int) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.gauge("fleet_replicas_active").set(current)
+        self.metrics.gauge("fleet_replicas_target").set(decision.target)
+        self.metrics.gauge("fleet_scale_breach_ticks").set(
+            decision.breach_ticks
+        )
+        self.metrics.gauge("fleet_scale_clear_ticks").set(
+            decision.clear_ticks
+        )
+
+    # -- aggregator observer ----------------------------------------------
+
+    def observe(self, snapshot: Dict[str, float], wall=None) -> None:
+        del wall  # the policy runs on the monotonic clock
+        with self._lock:
+            if self._busy or self._stopped:
+                return
+        current = self.supervisor.active_count()
+        decision = self.policy.observe(
+            snapshot, now=time.monotonic(), current=current
+        )
+        self._publish(decision, current)
+        if decision.action == "hold":
+            return
+        with self._lock:
+            if self._busy or self._stopped:
+                return
+            self._busy = True
+        # counted at DECISION time: scale_up_detection_ticks in the
+        # drill measures how fast the loop NOTICED, not how fast a jax
+        # import finishes
+        self._count(f"fleet_scale_{decision.action}_total")
+        print(
+            f"autoscale: {decision.action} -> {decision.target} "
+            f"replicas ({decision.reason})",
+            file=sys.stderr,
+        )
+        threading.Thread(
+            target=self._apply, args=(decision,),
+            name=f"fleet-scale-{decision.action}", daemon=True,
+        ).start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+
+    # -- actions (their own thread) ----------------------------------------
+
+    def _apply(self, decision: ScaleDecision) -> None:
+        try:
+            if decision.action == "up":
+                self._scale_up()
+            else:
+                self._scale_down()
+        except Exception as e:
+            self._count("fleet_scale_failures_total")
+            print(f"autoscale: {decision.action} failed: {e!r}",
+                  file=sys.stderr)
+        finally:
+            # cooldown restarts from action COMPLETION — a long spawn
+            # must not be immediately followed by another
+            self.policy.note_action_done(time.monotonic())
+            with self._lock:
+                self._busy = False
+            if self.metrics is not None:
+                self.metrics.gauge("fleet_replicas_active").set(
+                    self.supervisor.active_count()
+                )
+
+    def _scale_up(self) -> None:
+        replica = self.supervisor.scale_up()
+        # hold the action slot until the new replica actually serves
+        # (or demonstrably cannot): the breach persists while it warms
+        # up, and releasing early would spawn a second replica for the
+        # same breach
+        deadline = (
+            time.monotonic() + self.supervisor.config.contract_timeout_s
+        )
+        from gene2vec_tpu.serve.fleet import ReplicaState
+
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._stopped:
+                    return
+            if replica.state in (ReplicaState.UP, ReplicaState.FAILED):
+                break
+            if not replica.alive and not replica.spawning:
+                break
+            time.sleep(0.1)
+
+    def _scale_down(self) -> None:
+        victim = self.supervisor.pick_drain_victim()
+        if victim is None:
+            return
+        self.supervisor.begin_drain(victim)
+        url = victim.url
+        tracker = getattr(self.proxy, "inflight", None)
+        if tracker is not None and url is not None:
+            deadline = time.monotonic() + self.drain_timeout_s
+            settled = 0
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._stopped:
+                        break
+                if tracker.count(url) == 0:
+                    settled += 1
+                    if settled >= self.drain_settle_polls:
+                        break
+                else:
+                    settled = 0
+                time.sleep(self.drain_poll_s)
+            else:
+                self._count("fleet_drain_timeouts_total")
+                print(
+                    f"autoscale: drain of {url} timed out after "
+                    f"{self.drain_timeout_s:g}s with "
+                    f"{tracker.count(url)} request(s) in flight",
+                    file=sys.stderr,
+                )
+        self.supervisor.finish_drain(victim)

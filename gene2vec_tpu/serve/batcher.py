@@ -16,6 +16,16 @@ request carries a deadline; requests that expire while queued are failed
 them.  A bounded LRU keyed by (model version, query, k) serves repeat
 lookups without touching the queue at all.
 
+Requests carry a **tenant id** (``serve/tenancy.py``): the queue is a
+:class:`~gene2vec_tpu.serve.tenancy.FairQueue` of per-tenant FIFO lanes
+drained by smooth weighted round-robin, so when the queue is contended
+a batch interleaves tenants by their configured weights instead of
+strictly by arrival order — one tenant's admitted burst fills its own
+lane, not the head of everyone's line.  With a single (default) tenant
+the queue degenerates to plain FIFO.  Token-bucket *quotas* are
+enforced upstream at the front end (server.py ``TenantAdmission``),
+before a request ever reaches this queue.
+
 Every batch runs under an obs span (``serve_batch`` wrapping
 ``serve_compute``), so a run's ``events.jsonl`` shows the
 enqueue->batch->compute->respond pipeline per batch; counters/gauges
@@ -32,6 +42,7 @@ from typing import Any, Callable, Hashable, List, Optional, Tuple
 
 from gene2vec_tpu.obs import flight, tracecontext
 from gene2vec_tpu.obs.trace import ambient_span, hop_span
+from gene2vec_tpu.serve.tenancy import DEFAULT_TENANT, FairQueue
 
 
 class RejectedError(RuntimeError):
@@ -45,13 +56,15 @@ class DeadlineExceeded(RuntimeError):
 class _Pending:
     __slots__ = ("item", "k", "deadline", "event", "result", "error",
                  "ctx", "t0", "wait_s", "compute_s", "batch_n",
-                 "on_done", "cache_key")
+                 "on_done", "cache_key", "tenant")
 
     def __init__(self, item: Any, k: int, deadline: float,
-                 t0: float = 0.0, on_done=None, cache_key=None):
+                 t0: float = 0.0, on_done=None, cache_key=None,
+                 tenant: str = DEFAULT_TENANT):
         self.item = item
         self.k = k
         self.deadline = deadline
+        self.tenant = tenant
         self.event = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
@@ -162,6 +175,7 @@ class MicroBatcher:
         cache_size: int = 1024,
         default_timeout_s: float = 2.0,
         metrics=None,
+        tenant_weights: Optional[Callable[[str], float]] = None,
     ):
         self.compute = compute
         self.max_batch = max_batch
@@ -170,7 +184,9 @@ class MicroBatcher:
         self.default_timeout_s = default_timeout_s
         self.cache = LRUCache(cache_size)
         self.metrics = metrics
-        self._q: "collections.deque[_Pending]" = collections.deque()
+        # per-tenant lanes, weighted-fair drained; accessed only under
+        # self._cv (FairQueue itself is lock-free by contract)
+        self._q = FairQueue(weight_of=tenant_weights)
         self._cv = threading.Condition()
         self._stop = False
         self._worker: Optional[threading.Thread] = None
@@ -219,6 +235,7 @@ class MicroBatcher:
         timeout_s: Optional[float] = None,
         on_done: Optional[Callable[[Any, Optional[BaseException]], None]]
         = None,
+        tenant: str = DEFAULT_TENANT,
     ) -> "Ticket":
         """Enqueue one request and return a :class:`Ticket` immediately
         (so a multi-query HTTP request lands all its queries in the same
@@ -251,7 +268,8 @@ class MicroBatcher:
         )
         t0 = time.monotonic()
         pending = _Pending(item, int(k), t0 + timeout_s, t0=t0,
-                           on_done=on_done, cache_key=cache_key)
+                           on_done=on_done, cache_key=cache_key,
+                           tenant=tenant)
         with self._cv:
             if self._worker is None:
                 raise RuntimeError("MicroBatcher not started")
@@ -260,7 +278,7 @@ class MicroBatcher:
                 raise RejectedError(
                     f"queue full ({self.max_queue} waiting requests)"
                 )
-            self._q.append(pending)
+            self._q.push(tenant, pending)
             self._gauge_depth()
             self._cv.notify_all()
         return Ticket(self, pending, cache_key, t0, timeout_s=timeout_s)
@@ -271,12 +289,14 @@ class MicroBatcher:
         k: int,
         cache_key: Optional[Hashable] = None,
         timeout_s: Optional[float] = None,
+        tenant: str = DEFAULT_TENANT,
     ) -> Any:
         """Blocking :meth:`submit_async`: the result, or
         :class:`RejectedError` / :class:`DeadlineExceeded` /
         the compute failure."""
         return self.submit_async(
-            item, k, cache_key=cache_key, timeout_s=timeout_s
+            item, k, cache_key=cache_key, timeout_s=timeout_s,
+            tenant=tenant,
         ).get()
 
     # -- worker ------------------------------------------------------------
@@ -292,8 +312,9 @@ class MicroBatcher:
             window_ends = time.monotonic() + self.max_delay_s
             batch: List[_Pending] = []
             while len(batch) < self.max_batch:
-                while self._q and len(batch) < self.max_batch:
-                    batch.append(self._q.popleft())
+                # weighted-fair drain: lanes are interleaved by tenant
+                # weight, FIFO within a tenant (serve/tenancy.py)
+                batch.extend(self._q.pop_upto(self.max_batch - len(batch)))
                 remaining = window_ends - time.monotonic()
                 if remaining <= 0 or len(batch) >= self.max_batch:
                     break

@@ -68,6 +68,7 @@ __all__ = [
     "PooledTransport",
     "CircuitBreaker",
     "ClientResponse",
+    "InFlightTracker",
     "ResilientClient",
     "RetryPolicy",
     "TokenBucket",
@@ -135,6 +136,46 @@ class TokenBucket:
     @property
     def tokens(self) -> float:
         return self._tokens
+
+
+# -- per-target in-flight accounting -----------------------------------------
+
+
+class InFlightTracker:
+    """Per-target count of attempts currently on the wire.
+
+    The elastic fleet's zero-drop scale-down contract rides on this:
+    the front door stops routing to a draining replica (it leaves the
+    rotation), then waits for this tracker's count on the victim's URL
+    to settle to zero before the supervisor SIGTERMs it — a request the
+    client already dispatched must come back through the socket before
+    the process serving it dies.  ``enter``/``exit`` wrap exactly the
+    transport call in :meth:`ResilientClient._attempt`, so hedges and
+    retries are each their own in-flight unit."""
+
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def enter(self, target: str) -> None:
+        with self._lock:
+            self._counts[target] = self._counts.get(target, 0) + 1
+
+    def exit(self, target: str) -> None:
+        with self._lock:
+            n = self._counts.get(target, 0) - 1
+            if n <= 0:
+                self._counts.pop(target, None)
+            else:
+                self._counts[target] = n
+
+    def count(self, target: str) -> int:
+        with self._lock:
+            return self._counts.get(target, 0)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
 
 
 # -- circuit breaker ---------------------------------------------------------
@@ -487,10 +528,14 @@ class ResilientClient:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
+        inflight: Optional[InFlightTracker] = None,
     ):
         self._targets = targets
         self.policy = policy
         self.metrics = metrics
+        #: optional per-target in-flight accounting (the fleet proxy's
+        #: drain contract); None costs nothing on the attempt path
+        self.inflight = inflight
         # default: per-client keep-alive pools (PooledTransport) — one
         # TCP dial per replica per concurrent stream, not per attempt;
         # tests inject fake transports through this same seam
@@ -597,6 +642,7 @@ class ResilientClient:
         deadline: float,
         base_ctx: Optional[TraceContext] = None,
         hedge: bool = False,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[str, int, Optional[dict], str, bool, Optional[bytes]]:
         """(error_class, status, doc, target, retry_safe, raw); records
         breaker + latency.  The remaining budget is propagated INTO the
@@ -613,10 +659,11 @@ class ResilientClient:
             breaker.cancel()
             return "deadline", 0, None, target, False, None
         ctx = base_ctx.child() if base_ctx is not None else None
-        headers = (
-            {TRACEPARENT_HEADER: ctx.to_header()} if ctx is not None
-            else None
-        )
+        headers: Optional[Dict[str, str]] = None
+        if ctx is not None or extra_headers:
+            headers = dict(extra_headers or {})
+            if ctx is not None:
+                headers[TRACEPARENT_HEADER] = ctx.to_header()
         payload: Optional[bytes] = None
         if body is not None:
             shrunk = dict(body)
@@ -624,6 +671,8 @@ class ResilientClient:
             payload = json.dumps(shrunk).encode("utf-8")
         t0 = self._clock()
         t0_wall = time.time()
+        if self.inflight is not None:
+            self.inflight.enter(target)
         try:
             status, raw = self._transport(
                 target,
@@ -642,6 +691,9 @@ class ResilientClient:
                 error_class="transport", hedge=hedge,
             )
             return "transport", 0, None, target, True, None
+        finally:
+            if self.inflight is not None:
+                self.inflight.exit(target)
         # successful bodies stay UNPARSED (ClientResponse.doc parses
         # lazily; the fleet proxy forwards the raw bytes) — only error
         # statuses need the document for retry-safety classification
@@ -676,11 +728,15 @@ class ResilientClient:
         body: Optional[dict] = None,
         method: Optional[str] = None,
         timeout_s: Optional[float] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> ClientResponse:
         """One logical request with retries/hedging under one deadline.
         Never raises for server/transport failures — the terminal
         outcome (including ``deadline`` exhaustion) comes back as a
-        :class:`ClientResponse`."""
+        :class:`ClientResponse`.  ``headers`` are caller extras carried
+        on EVERY attempt (the fleet proxy forwards the request's
+        ``X-Tenant`` this way); the traceparent header still wins on
+        conflict."""
         method = method or ("POST" if body is not None else "GET")
         timeout_s = (
             self.policy.default_timeout_s if timeout_s is None
@@ -737,14 +793,15 @@ class ResilientClient:
             if hedge_after is not None and hedge_after < remaining:
                 outcome, was_hedge = self._attempt_hedged(
                     target, method, path, body, deadline, hedge_after,
-                    tried, base_ctx,
+                    tried, base_ctx, extra_headers=headers,
                 )
                 if was_hedge:
                     hedged = True
                     attempts += 1
             else:
                 outcome = self._attempt(
-                    target, method, path, body, deadline, base_ctx
+                    target, method, path, body, deadline, base_ctx,
+                    extra_headers=headers,
                 )
             last = outcome
             error_class, status, doc, _target, retry_safe, raw = outcome
@@ -792,6 +849,7 @@ class ResilientClient:
         hedge_after_s: float,
         tried: List[str],
         base_ctx: Optional[TraceContext] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Tuple[Tuple, bool]:
         """Primary attempt + one hedge fired at the p95 mark: whichever
         concludes first wins; a hedge is paid from the retry budget and
@@ -800,7 +858,8 @@ class ResilientClient:
 
         def run(t: str, is_hedge: bool = False) -> None:
             results.put(self._attempt(
-                t, method, path, body, deadline, base_ctx, hedge=is_hedge
+                t, method, path, body, deadline, base_ctx,
+                hedge=is_hedge, extra_headers=extra_headers,
             ))
 
         threading.Thread(target=run, args=(target,), daemon=True).start()

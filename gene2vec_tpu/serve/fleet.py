@@ -61,7 +61,11 @@ from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.incident import IncidentManager
 from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
-from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+from gene2vec_tpu.serve.client import (
+    InFlightTracker,
+    ResilientClient,
+    RetryPolicy,
+)
 from gene2vec_tpu.serve.eventloop import (
     ConnHandle,
     EventLoopConfig,
@@ -84,6 +88,9 @@ class ReplicaState:
     EJECTED = "ejected"      # alive but failing readiness; out of rotation
     BACKOFF = "backoff"      # dead, waiting out restart backoff
     FAILED = "failed"        # restart storm cap hit; given up
+    DRAINING = "draining"    # leaving the fleet: out of rotation, alive
+    #                          until its in-flight requests settle
+    #                          (serve/autoscale.py scale-down)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,6 +190,9 @@ class FleetSupervisor:
         self.env = env
         self._rng = rng if rng is not None else random.Random()
         self.replicas = [Replica(i) for i in range(config.replicas)]
+        #: next index for an elastically-added replica — indices are
+        #: never reused, so per-replica metrics/log lines stay unambiguous
+        self._next_index = config.replicas
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -192,9 +202,12 @@ class FleetSupervisor:
     def _publish(self) -> None:
         if self.metrics is None:
             return
-        up = sum(1 for r in self.replicas if r.state == ReplicaState.UP)
+        with self._lock:
+            replicas = list(self.replicas)
+        up = sum(1 for r in replicas if r.state == ReplicaState.UP)
         self.metrics.gauge("replica_up").set(up)
-        for r in self.replicas:
+        self.metrics.gauge("replica_count").set(len(replicas))
+        for r in replicas:
             self.metrics.gauge(f"replica_{r.index}_up").set(
                 1 if r.state == ReplicaState.UP else 0
             )
@@ -282,10 +295,12 @@ class FleetSupervisor:
         if self._monitor is not None:
             self._monitor.join(timeout=10.0)
             self._monitor = None
-        for r in self.replicas:
+        with self._lock:
+            replicas = list(self.replicas)
+        for r in replicas:
             if r.proc is not None and r.proc.poll() is None:
                 r.proc.terminate()
-        for r in self.replicas:
+        for r in replicas:
             if r.proc is not None:
                 try:
                     r.proc.wait(timeout=10.0)
@@ -341,6 +356,110 @@ class FleetSupervisor:
                 for r in self.replicas
             ]
 
+    # -- elasticity (serve/autoscale.py ElasticController) -----------------
+
+    def active_count(self) -> int:
+        """Replica slots that count toward capacity: everything except
+        abandoned (FAILED) and departing (DRAINING) slots — a dead slot
+        in backoff still counts, because a restart is coming and
+        scaling on top of it would double-provision."""
+        with self._lock:
+            return sum(
+                1 for r in self.replicas
+                if r.state not in (
+                    ReplicaState.FAILED, ReplicaState.DRAINING
+                )
+            )
+
+    def scale_up(self) -> Replica:
+        """Spawn one NEW replica slot (never reusing an index).  Blocks
+        on the child's startup contract line; the monitor loop admits
+        it to rotation once readiness probes pass.  A spawn failure
+        removes the slot again and propagates — the policy's cooldown
+        decides when to try again."""
+        with self._lock:
+            replica = Replica(self._next_index)
+            self._next_index += 1
+            replica.spawning = True
+            self.replicas.append(replica)
+        try:
+            self._spawn(replica)
+        except Exception:
+            with self._lock:
+                if replica in self.replicas:
+                    self.replicas.remove(replica)
+            raise
+        finally:
+            replica.spawning = False
+        if self._stop.is_set():
+            # raced a fleet stop: this child slipped past stop()'s
+            # terminate sweep — reap it here (the _respawn lesson)
+            if replica.proc is not None:
+                replica.proc.kill()
+                replica.proc.wait(timeout=10.0)
+            with self._lock:
+                if replica in self.replicas:
+                    self.replicas.remove(replica)
+            return replica
+        self._publish()
+        return replica
+
+    def pick_drain_victim(self) -> Optional[Replica]:
+        """The replica a scale-down should remove: a dead/not-ready
+        slot first (removing one is trivially zero-drop), else the
+        NEWEST serving replica — and never the last one in rotation.
+        A slot with a respawn in flight is not a candidate: draining
+        it would race the spawn and orphan the freshly-forked child."""
+        with self._lock:
+            candidates = [
+                r for r in self.replicas
+                if r.state not in (
+                    ReplicaState.FAILED, ReplicaState.DRAINING
+                ) and not r.spawning
+            ]
+            not_up = [
+                r for r in candidates if r.state != ReplicaState.UP
+            ]
+            if not_up:
+                return max(not_up, key=lambda r: r.index)
+            ups = [r for r in candidates if r.state == ReplicaState.UP]
+            if len(ups) > 1:
+                return max(ups, key=lambda r: r.index)
+            return None
+
+    def begin_drain(self, replica: Replica) -> None:
+        """Take the victim out of rotation: ``healthy_urls`` (the
+        proxy's target callable) stops offering it on the very next
+        pick, while ``live_urls`` keeps scraping it — its last-seconds
+        telemetry still belongs in the fleet view."""
+        with self._lock:
+            replica.state = ReplicaState.DRAINING
+        self._publish()
+
+    def finish_drain(self, replica: Replica) -> None:
+        """Terminate the drained victim (SIGTERM first — the same path
+        ``stop`` uses — escalating to SIGKILL) and retire its slot.
+        Call only after the front door's in-flight count on its URL
+        has settled; the controller owns that wait."""
+        proc = replica.proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+        if proc is not None:
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+        with self._lock:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+        if self.metrics is not None:
+            # retire the per-replica gauge with the slot: a long-lived
+            # elastic fleet must not accrete one dead series per
+            # departed replica
+            self.metrics.remove(f"replica_{replica.index}_up")
+        self._publish()
+
     # -- the monitor loop --------------------------------------------------
 
     def _schedule_restart(self, replica: Replica, now: float) -> None:
@@ -379,9 +498,16 @@ class FleetSupervisor:
             if self._stop.is_set():
                 return
             self._spawn(replica)
-            if self._stop.is_set():
-                # the fleet stopped while we were spawning: this child
-                # raced past stop()'s terminate sweep — reap it here
+            with self._lock:
+                retired = (
+                    replica not in self.replicas
+                    or replica.state == ReplicaState.DRAINING
+                )
+            if self._stop.is_set() or retired:
+                # the fleet stopped — or a scale-down drained/removed
+                # this slot — while we were spawning: this child raced
+                # past the terminate sweep, reap it here (an orphaned
+                # serving process on a bound port is the alternative)
                 if replica.proc is not None:
                     replica.proc.kill()
                     replica.proc.wait(timeout=10.0)
@@ -397,8 +523,15 @@ class FleetSupervisor:
     def _tick(self) -> None:
         now = time.monotonic()
         probe_list: List[Replica] = []
-        for r in self.replicas:
-            if r.state == ReplicaState.FAILED or r.spawning:
+        with self._lock:
+            replicas = list(self.replicas)
+        for r in replicas:
+            if r.state in (
+                ReplicaState.FAILED, ReplicaState.DRAINING
+            ) or r.spawning:
+                # a DRAINING replica is leaving on purpose: no probes
+                # (it is out of rotation already) and above all no
+                # restart if it exits — the controller owns its death
                 continue
             if not r.alive:
                 if r.state == ReplicaState.BACKOFF:
@@ -587,6 +720,12 @@ class _ProxyAdapter:
             proxy.sampler.maybe_new_trace()
             if proxy.sampler is not None else None
         )
+        # tenant pass-through: the replicas own quota enforcement
+        # (per-replica token buckets, serve/tenancy.py); the proxy just
+        # forwards the identity so a quota 429 lands on the right
+        # tenant no matter which replica answers
+        tenant = req.headers.get("x-tenant")
+        extra = {"X-Tenant": tenant} if tenant else None
         t0 = time.monotonic()
         with tracecontext.use(ctx):
             with ambient_span("proxy_request", route=route) as span:
@@ -600,6 +739,7 @@ class _ProxyAdapter:
                         )
                         else None
                     ),
+                    headers=extra,
                 )
                 span["attempts"] = resp.attempts
         if resp.ok and resp.raw is not None:
@@ -651,6 +791,11 @@ class FleetProxy:
         self.proxy_workers = proxy_workers
         self.idle_timeout_s = idle_timeout_s
         self.acceptors = acceptors
+        # per-replica in-flight accounting: the zero-drop contract for
+        # elastic scale-down AND fleet-wide graceful shutdown — a
+        # draining replica is terminated only once its count here
+        # settles to zero (serve/autoscale.py, FleetProxy.drain)
+        self.inflight = InFlightTracker()
         self.client = ResilientClient(
             supervisor.healthy_urls,
             policy=policy if policy is not None else RetryPolicy(
@@ -659,6 +804,7 @@ class FleetProxy:
                 default_timeout_s=5.0,
             ),
             metrics=metrics,
+            inflight=self.inflight,
         )
         self.sampler = Sampler(trace_sample) if trace_sample > 0 else None
         # the telemetry plane: scrape every LIVE replica (not just the
@@ -712,6 +858,13 @@ class FleetProxy:
         self.metrics.counter("fleet_proxy_responses_total").inc()
         if 200 <= status < 300:
             self.metrics.counter("fleet_proxy_ok_total").inc()
+        elif status == 429:
+            # explicit backpressure (queue-full OR tenant quota) is
+            # deliberate shedding, not an availability failure: the
+            # aggregator exports this so the autoscaler can take 429s
+            # out of its availability-burn window (queue pressure still
+            # reaches it through the rejection-rate signal)
+            self.metrics.counter("fleet_proxy_429_total").inc()
         label = route if route in _PROXY_ROUTES else "other"
         self.metrics.histogram(
             "fleet_proxy_seconds", labels={"route": label}
@@ -757,6 +910,26 @@ class FleetProxy:
             self.aggregator.start()
         bound_host, bound_port = server.server_address[:2]
         return f"http://{bound_host}:{bound_port}"
+
+    def drain(self, timeout_s: float = 10.0,
+              poll_s: float = 0.05) -> bool:
+        """Wait for every in-flight replica forward to settle — the
+        graceful-shutdown half of the zero-drop contract: call after
+        :meth:`stop` (no new requests are being accepted) and BEFORE
+        ``supervisor.stop()`` tears the replicas down, so a forward the
+        proxy already dispatched completes against a living replica
+        instead of dying with it.  True when the front door is empty,
+        False on timeout (callers proceed either way; the wait is the
+        courtesy, not a lock)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.inflight.total() == 0:
+                return True
+            time.sleep(poll_s)
+        remaining = self.inflight.total()
+        if remaining and self.metrics is not None:
+            self.metrics.counter("fleet_drain_timeouts_total").inc()
+        return remaining == 0
 
     def stop(self) -> None:
         if self.aggregator is not None:

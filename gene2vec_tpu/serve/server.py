@@ -89,6 +89,12 @@ from gene2vec_tpu.serve.eventloop import (
 )
 from gene2vec_tpu.serve.interaction import InteractionScorer
 from gene2vec_tpu.serve.registry import ModelRegistry
+from gene2vec_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    TenantAdmission,
+    TenantPolicy,
+    sanitize_tenant,
+)
 
 
 class ApiError(Exception):
@@ -145,6 +151,19 @@ class ServeConfig:
     # obs.alerts.RateLimiter (one budget with incident bundles)
     burst_threshold: int = 10
     burst_window_s: float = 5.0
+    # -- multi-tenant admission (serve/tenancy.py; cli/serve.py
+    # --tenant-quota/--tenant-override) -----------------------------------
+    # per-tenant token-bucket quota: sustained requests/s admitted per
+    # tenant (the X-Tenant header; untagged traffic is the "default"
+    # tenant).  0 disables tenancy entirely — no bucket, no label, no
+    # per-request cost.  Quotas are per-replica: a fleet of N admits
+    # N x this rate per tenant in aggregate.
+    tenant_rate: float = 0.0
+    # bucket burst headroom (0 = 2 x tenant_rate)
+    tenant_burst: float = 0.0
+    # per-tenant overrides, "id:rate[:burst[:weight]]" strings; weight
+    # is the batcher's weighted-fair-dequeue share
+    tenant_overrides: Tuple[str, ...] = ()
 
 
 #: routes whose latency gets its own labeled histogram series; anything
@@ -194,6 +213,17 @@ class ServeApp:
             index=config.index, nprobe=config.nprobe,
             rescore_mult=config.rescore_mult,
         )
+        # multi-tenant admission: None (the default) means tenancy is
+        # entirely off — requests carry the default tenant id and never
+        # touch a bucket (docs/SERVING.md#multi-tenant-admission)
+        tenant_policy = TenantPolicy.from_args(
+            config.tenant_rate, config.tenant_burst or None,
+            config.tenant_overrides,
+        )
+        self.tenants: Optional[TenantAdmission] = (
+            TenantAdmission(tenant_policy, metrics=self.metrics)
+            if tenant_policy is not None else None
+        )
         self.batcher = MicroBatcher(
             self._compute_batch,
             max_batch=config.max_batch,
@@ -202,6 +232,9 @@ class ServeApp:
             cache_size=config.cache_size,
             default_timeout_s=config.timeout_ms / 1000.0,
             metrics=self.metrics,
+            tenant_weights=(
+                self.tenants.weight if self.tenants is not None else None
+            ),
         )
         self.ggipnn_checkpoint = ggipnn_checkpoint
         self._scorer: Optional[InteractionScorer] = None
@@ -319,7 +352,8 @@ class ServeApp:
             )
         return k
 
-    def similar(self, body: dict) -> dict:
+    def similar(self, body: dict,
+                tenant: str = DEFAULT_TENANT) -> dict:
         model = self._model_or_503()
         k = self._validate_k(body)
         timeout_s = self._timeout_s(body)
@@ -368,7 +402,8 @@ class ServeApp:
                 )
                 tickets.append(
                     (q, self.batcher.submit_async(
-                        q, k, cache_key=cache_key, timeout_s=timeout_s
+                        q, k, cache_key=cache_key, timeout_s=timeout_s,
+                        tenant=tenant,
                     ))
                 )
         except RejectedError as e:
@@ -535,6 +570,12 @@ class ServeApp:
             "source": m.source,
         }
         out["index"] = self.engine.index_mode
+        if self.tenants is not None:
+            out["tenancy"] = {
+                "default_rate": self.tenants.policy.default.rate,
+                "default_burst": self.tenants.policy.default.burst,
+                "overrides": sorted(self.tenants.policy.overrides),
+            }
         if m.ann is not None:
             from gene2vec_tpu.serve.ann import index_stats
 
@@ -553,7 +594,7 @@ class ServeApp:
 
     def _dispatch(
         self, method: str, route: str, query: Dict[str, List[str]],
-        body: Optional[dict],
+        body: Optional[dict], tenant: str = DEFAULT_TENANT,
     ) -> Tuple[int, dict]:
         if method == "GET" and route == "/livez":
             return 200, self.livez()
@@ -567,9 +608,11 @@ class ServeApp:
             if gene is None:
                 raise ApiError(400, "missing ?gene= parameter")
             k = self._int_param(query, "k", 10)
-            return 200, self.similar({"genes": [gene], "k": k})
+            return 200, self.similar(
+                {"genes": [gene], "k": k}, tenant=tenant
+            )
         if method == "POST" and route == "/v1/similar":
-            return 200, self.similar(body or {})
+            return 200, self.similar(body or {}, tenant=tenant)
         if method == "POST" and route == "/v1/embedding":
             return 200, self.embedding(body or {})
         if method == "POST" and route == "/v1/interaction":
@@ -579,6 +622,7 @@ class ServeApp:
     def handle(
         self, method: str, path: str, body: Optional[dict],
         traceparent: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Tuple[int, dict]:
         """(status, payload) for one request.  ``/metrics`` is the only
         non-JSON route and is dispatched by the handler directly.
@@ -587,10 +631,16 @@ class ServeApp:
         sampled one makes this request (and its batcher/engine hops) a
         child span of the sender's attempt; without one, the server's
         own sampler may start a root.  Untraced requests pay one header
-        parse and nothing else."""
+        parse and nothing else.
+
+        ``tenant`` is the request's (already sanitized) tenant id —
+        the adapter enforces the token-bucket quota BEFORE calling
+        here; inside, the id only routes the batcher's weighted-fair
+        lane."""
         url = urlparse(path)
         route = url.path.rstrip("/") or "/"
         query = parse_qs(url.query)
+        tenant = tenant if tenant else DEFAULT_TENANT
         incoming = TraceContext.from_header(traceparent)
         ctx = incoming.child() if incoming is not None else (
             self.sampler.maybe_new_trace()
@@ -602,7 +652,9 @@ class ServeApp:
         try:
             with tracecontext.use(ctx), flight_mod.collect_hops() as hops:
                 with ambient_span("serve_request", route=route) as span:
-                    status, doc = self._dispatch(method, route, query, body)
+                    status, doc = self._dispatch(
+                        method, route, query, body, tenant=tenant
+                    )
                     span["status"] = status
             return status, doc
         except ApiError as e:
@@ -640,6 +692,9 @@ class ServeApp:
 #: pre-encoded front-end bodies (the event loop never runs json.dumps)
 _POOL_FULL_BODY = b'{"error": "handler pool saturated; shed load"}'
 _DEADLINE_BODY = b'{"error": "request deadline exceeded"}'
+_TENANT_QUOTA_BODY = (
+    b'{"error": "tenant quota exhausted; retry after backoff"}'
+)
 
 
 class ServeAdapter:
@@ -697,6 +752,21 @@ class ServeAdapter:
     def __call__(self, req: HTTPRequest,
                  peer: ConnHandle) -> Optional[Response]:
         app = self.app
+        tenant = DEFAULT_TENANT
+        if app.tenants is not None:
+            # per-tenant token-bucket quota, decided HERE at the front
+            # door: an over-quota request costs one O(1) bucket take
+            # and a pre-encoded 429 — it never reaches the worker pool,
+            # the batcher queue, or the response cache.  The resolved
+            # label (bounded; minted ids collapse into "other") is what
+            # flows into the batcher's fair lanes.
+            if req.target.startswith("/v1/"):
+                ok, tenant = app.tenants.admit(
+                    sanitize_tenant(req.headers.get("x-tenant"))
+                )
+                if not ok:
+                    app.metrics.counter("serve_http_429_total").inc()
+                    return Response(429, _TENANT_QUOTA_BODY)
         if (
             req.method == "GET"
             and app.faults is None
@@ -704,17 +774,20 @@ class ServeAdapter:
             and "traceparent" not in req.headers
             and req.target.startswith("/v1/similar?")
         ):
-            out = self._similar_get_fast(req, peer)
+            out = self._similar_get_fast(req, peer, tenant)
             if out is not _SLOW_PATH:
                 return out
-        if not self.pool.submit(lambda: self._run_full(req, peer)):
+        if not self.pool.submit(
+            lambda: self._run_full(req, peer, tenant)
+        ):
             self.app.metrics.counter("serve_http_429_total").inc()
             return Response(429, _POOL_FULL_BODY)
         return None
 
     # -- the full pipeline (worker pool thread) ----------------------------
 
-    def _run_full(self, req: HTTPRequest, peer: ConnHandle) -> None:
+    def _run_full(self, req: HTTPRequest, peer: ConnHandle,
+                  tenant: str = DEFAULT_TENANT) -> None:
         app = self.app
         route = urlparse(req.target).path.rstrip("/") or "/"
         if app.faults is not None and self._apply_fault(req, peer, route):
@@ -755,6 +828,7 @@ class ServeAdapter:
         status, doc = app.handle(
             req.method, req.target, body,
             traceparent=req.headers.get("traceparent"),
+            tenant=tenant,
         )
         peer.respond(Response(
             status, json.dumps(doc).encode("utf-8")
@@ -790,7 +864,8 @@ class ServeAdapter:
 
     # -- the hot read path (loop thread; must never block) -----------------
 
-    def _similar_get_fast(self, req: HTTPRequest, peer: ConnHandle):
+    def _similar_get_fast(self, req: HTTPRequest, peer: ConnHandle,
+                          tenant: str = DEFAULT_TENANT):
         """``GET /v1/similar?gene=...&k=...`` without the full pipeline:
         response-bytes cache hit -> reused bytes; miss -> coalesce onto
         one batcher ticket.  Returns ``_SLOW_PATH`` for anything the
@@ -867,7 +942,7 @@ class ServeAdapter:
                 {"gene": gene, "k": k}, k,
                 cache_key=(model.version, "similar", gene, k),
                 timeout_s=app.config.timeout_ms / 1000.0,
-                on_done=done,
+                on_done=done, tenant=tenant,
             )
         except (RejectedError, RuntimeError):
             # queue full (or batcher not started): fail everyone waiting

@@ -42,6 +42,17 @@ Five phases (docs/RESILIENCE.md runbook):
   incident`` and contain a reassembled trace through the faulty
   replica.  Stamped into ``BENCH_ALERTS_r13.json`` via ``--alerts-out``
   and gated by ``analysis/passes_alerts.py`` (budgets.json ``alerts``).
+* **autoscale** — the elastic fleet (docs/SERVING.md#elastic-fleet):
+  spawn ``cli.fleet --max-replicas`` and prove a load ramp produces a
+  scale-up DECISION within the budgeted scrape ticks; ramp down and
+  prove the hysteresis scale-down drains the victim with ZERO
+  dropped/wrong/mixed answers under continuous verified load, plus a
+  steady-state window with ZERO further actions (no flapping); then a
+  per-tenant-quota fleet must hold a paced victim tenant at >= 0.99
+  availability while an abusive tenant floods (tenant-labeled 429s).
+  Stamped into ``BENCH_AUTOSCALE_r14.json`` via ``--autoscale-out``
+  and gated by ``analysis/passes_autoscale.py`` (budgets.json
+  ``autoscale``).
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -1047,6 +1058,484 @@ def drill_alerts(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
             proc.wait(timeout=30)
 
 
+# -- phase: elastic autoscaling + tenant isolation ---------------------------
+
+
+def _parse_labeled_counters(text: str) -> dict:
+    """(name, labels) -> value via the aggregator's escape-aware
+    parser (labeled tenant series need real label parsing)."""
+    from gene2vec_tpu.obs.aggregate import parse_prometheus
+
+    return {(s.name, s.labels): s.value for s in parse_prometheus(text)}
+
+
+def _fetch_metrics(url: str) -> dict:
+    return _parse_prom_counters(
+        urllib.request.urlopen(url + "/metrics", timeout=10.0)
+        .read().decode("utf-8")
+    )
+
+
+def _replica_states(url: str) -> list:
+    return _http_json(url + "/healthz", timeout=10.0)["replicas"]
+
+
+def drill_autoscale(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """Exercise the elastic fleet end to end: (A) a load ramp must
+    produce a scale-up DECISION within the budgeted number of scrape
+    ticks; (B) ramp-down must scale back down through the zero-drop
+    drain — continuous verified light load sees ZERO dropped, wrong, or
+    mixed-iteration answers, and a steady-state window after
+    convergence records ZERO further scale actions; (C) an abusive
+    tenant flooding far over its token bucket must leave a paced victim
+    tenant's availability >= the budget floor, with the abuser's 429s
+    landing in the tenant-labeled rejection series."""
+    import threading
+
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    export_dir = os.path.join(tmp, "autoscale_export")
+    _write_iteration(export_dir, 1, vocab_size=48, dim=8)
+
+    min_replicas = int(budget.get("min_replicas", 1))
+    max_replicas = int(budget.get("max_replicas", 2))
+    scrape_s = float(budget.get("scrape_interval_s", 0.25))
+    max_ticks = float(budget.get("max_scale_up_detection_ticks", 40))
+    steady_ticks = 16 if smoke else 24
+    ramp_workers = 48
+    query_genes = [f"G{i}" for i in range(8)]
+
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir,
+        "--replicas", str(min_replicas),
+        "--min-replicas", str(min_replicas),
+        "--max-replicas", str(max_replicas),
+        "--port", "0", "--health-interval", "0.25",
+        "--backoff-base", "0.3", "--proxy-timeout-ms", "4000",
+        "--proxy-workers", "64",
+        "--scrape-interval", str(scrape_s),
+        "--alert-rules", "none",
+        "--seed", str(seed),
+        # the scaler's drill knobs: breach fast (2 ticks), clear slow
+        # (12 ticks), short cooldown so the smoke finishes, bounded
+        # drain
+        "--scale-up-queue", "4", "--scale-up-rejection", "0.02",
+        "--scale-up-after", "2", "--scale-down-after", "12",
+        "--scale-down-queue", "3", "--scale-cooldown", "1.0",
+        "--drain-timeout", "15",
+        # replica geometry that makes one replica saturable by a CPU
+        # drill (the production knee is ~1,200 rps/replica,
+        # BENCH_SERVE_r11; here batches of 4 per 100 ms window cap
+        # service at ~40 rps, so 48 closed-loop workers keep the
+        # 8-deep queue pinned full and shedding): no LRU (cached
+        # answers bypass the queue the ramp must fill), long admission
+        # window, tiny batch, small bounded queue, enough HTTP workers
+        # that admission — not the handler pool — is the choke point
+        "--serve-arg=--cache-size", "--serve-arg=0",
+        "--serve-arg=--max-delay-ms", "--serve-arg=100",
+        "--serve-arg=--max-batch", "--serve-arg=4",
+        "--serve-arg=--max-queue", "--serve-arg=8",
+        "--serve-arg=--http-workers", "--serve-arg=32",
+    ]
+    log(f"spawning elastic fleet: {min_replicas} -> {max_replicas} "
+        f"replicas, scrape {scrape_s}s")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        assert info.get("autoscale") == {
+            "min": min_replicas, "max": max_replicas
+        }, f"contract line missing autoscale facts: {info}"
+        log(f"elastic fleet front door at {url}")
+
+        def post(gene: str, timeout: float = 10.0,
+                 tenant: str = None) -> "tuple":
+            """(status, doc-or-None) for one POST /v1/similar."""
+            body = json.dumps({"genes": [gene], "k": 4}).encode("utf-8")
+            headers = {"Content-Type": "application/json"}
+            if tenant:
+                headers["X-Tenant"] = tenant
+            req = urllib.request.Request(
+                url + "/v1/similar", data=body, headers=headers,
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, json.loads(
+                        r.read().decode("utf-8")
+                    )
+            except urllib.error.HTTPError as e:
+                e.read()
+                e.close()
+                return e.code, None
+            except Exception:
+                return 0, None
+
+        # pre-ramp reference answers: everything verified during the
+        # scale-down window must match these exactly
+        reference = {}
+        for g in query_genes:
+            status, doc = post(g, timeout=15.0)
+            assert status == 200, f"reference query failed ({status})"
+            reference[g] = (
+                doc["model"]["iteration"],
+                tuple(n["gene"] for n in doc["results"][0]["neighbors"]),
+            )
+
+        base = _fetch_metrics(url)
+        assert base.get("fleet_scale_up_total") == 0.0, (
+            "scaler acted before the ramp — thresholds are too twitchy"
+        )
+
+        # --- (A) the ramp: saturate the single replica's queue --------
+        ramp_stop = threading.Event()
+        ramp_counts = {"n": 0, "rejected": 0}
+        ramp_lock = threading.Lock()
+
+        def ramp_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while not ramp_stop.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                status, _ = post(g, timeout=10.0)
+                with ramp_lock:
+                    ramp_counts["n"] += 1
+                    if status == 429:
+                        ramp_counts["rejected"] += 1
+
+        t_ramp = time.monotonic()
+        ramp_threads = [
+            threading.Thread(target=ramp_worker, args=(w,), daemon=True)
+            for w in range(ramp_workers)
+        ]
+        for t in ramp_threads:
+            t.start()
+
+        def scale_up_decided():
+            m = _fetch_metrics(url)
+            return m.get("fleet_scale_up_total", 0.0) >= 1.0 or None
+
+        wait_until(
+            scale_up_decided, max_ticks * scrape_s + 10.0,
+            interval_s=0.1, what="scale-up decision",
+        )
+        detection_s = time.monotonic() - t_ramp
+        detection_ticks = max(1, int(np.ceil(detection_s / scrape_s)))
+        log(f"scale-up decided {detection_s:.2f}s after the ramp "
+            f"({detection_ticks} tick(s) at {scrape_s}s; budget "
+            f"{max_ticks:g})")
+
+        # completion is bounded separately: a replica spawn is a full
+        # jax import on this host
+        def scaled_up():
+            ups = [
+                r for r in _replica_states(url) if r["state"] == "up"
+            ]
+            return (len(ups) >= max_replicas) or None
+
+        wait_until(scaled_up, 180.0, interval_s=0.5,
+                   what="new replica in rotation")
+        scale_up_completed_s = time.monotonic() - t_ramp
+        log(f"fleet at {max_replicas} replicas "
+            f"{scale_up_completed_s:.1f}s after the ramp started")
+        ramp_stop.set()
+        for t in ramp_threads:
+            t.join(timeout=30.0)
+
+        # --- (B) ramp-down under continuous verified light load -------
+        light_stop = threading.Event()
+        light = {"n": 0, "dropped": 0, "wrong": 0, "mixed": 0}
+        light_lock = threading.Lock()
+
+        def light_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 500 + widx)
+            while not light_stop.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                status, doc = post(g, timeout=10.0)
+                with light_lock:
+                    light["n"] += 1
+                    if status != 200 or doc is None:
+                        # ANY non-200 during scale-down is a drop: the
+                        # light load sits far under every threshold, so
+                        # the only thing that could fail it is a replica
+                        # dying with requests on board
+                        light["dropped"] += 1
+                        continue
+                    ref_it, ref_neighbors = reference[g]
+                    it = doc["model"]["iteration"]
+                    got = tuple(
+                        n["gene"]
+                        for n in doc["results"][0]["neighbors"]
+                    )
+                    if it != ref_it:
+                        light["mixed"] += 1
+                    elif got != ref_neighbors:
+                        light["wrong"] += 1
+                time.sleep(0.1)
+
+        light_threads = [
+            threading.Thread(target=light_worker, args=(w,), daemon=True)
+            for w in range(2)
+        ]
+        t_down0 = time.monotonic()
+        for t in light_threads:
+            t.start()
+
+        def scaled_down():
+            m = _fetch_metrics(url)
+            if m.get("fleet_scale_down_total", 0.0) < 1.0:
+                return None
+            states = _replica_states(url)
+            ups = [r for r in states if r["state"] == "up"]
+            return (
+                len(states) == min_replicas
+                and len(ups) == min_replicas
+            ) or None
+
+        # clear window (12 ticks) + drain + cooldown + margin
+        wait_until(scaled_down, 12 * scrape_s + 60.0, interval_s=0.5,
+                   what="zero-drop scale-down back to min_replicas")
+        scale_down_s = time.monotonic() - t_down0
+        log(f"scaled back down to {min_replicas} replica(s) in "
+            f"{scale_down_s:.1f}s under verified light load")
+
+        # --- steady state: ZERO further actions after convergence -----
+        steady_base = _fetch_metrics(url)
+        time.sleep(steady_ticks * scrape_s)
+        steady_now = _fetch_metrics(url)
+        steady_actions = int(
+            (steady_now.get("fleet_scale_up_total", 0.0)
+             - steady_base.get("fleet_scale_up_total", 0.0))
+            + (steady_now.get("fleet_scale_down_total", 0.0)
+               - steady_base.get("fleet_scale_down_total", 0.0))
+        )
+        light_stop.set()
+        for t in light_threads:
+            t.join(timeout=30.0)
+        drain_timeouts = int(
+            steady_now.get("fleet_drain_timeouts_total", 0.0)
+        )
+        log(f"steady state: {steady_actions} scale action(s) over "
+            f"{steady_ticks} ticks; light load {light['n']} requests, "
+            f"{light['dropped']} dropped, {light['wrong']} wrong, "
+            f"{light['mixed']} mixed; drain timeouts {drain_timeouts}")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # --- (C) tenant isolation: a fresh single-replica fleet with
+    # per-tenant token buckets; the abuser floods, the victim paces ----
+    victim, abuser = "alice", "mallory"
+    tenant_argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir, "--replicas", "1",
+        "--port", "0", "--health-interval", "0.25",
+        "--proxy-timeout-ms", "4000", "--proxy-workers", "64",
+        "--scrape-interval", "0.5", "--alert-rules", "none",
+        "--seed", str(seed),
+        "--serve-arg=--cache-size", "--serve-arg=0",
+        # default quota 50 rps (burst 100) for every tenant incl. the
+        # abuser; the victim gets an explicit override with a 4x
+        # fair-dequeue weight — the drill exercises the override path
+        "--serve-arg=--tenant-quota", "--serve-arg=50",
+        "--serve-arg=--tenant-override",
+        f"--serve-arg={victim}:50:100:4",
+    ]
+    log("spawning tenant-isolation fleet (1 replica, 50 rps/tenant "
+        "token buckets)")
+    tduration_s = 6.0 if smoke else 12.0
+    proc = subprocess.Popen(
+        tenant_argv, stdout=subprocess.PIPE, text=True,
+        env=chaos.child_env(), cwd=REPO,
+    )
+    try:
+        from gene2vec_tpu.serve.fleet import read_contract_line
+
+        info = read_contract_line(proc, 180.0)
+        turl = info["url"]
+        replica_url = info["replica_urls"][0]
+        health = _http_json(replica_url + "/healthz", timeout=10.0)
+        assert health.get("tenancy", {}).get("default_rate") == 50.0, (
+            f"replica healthz shows no tenancy: {health}"
+        )
+
+        import threading
+
+        counts = {
+            victim: {"n": 0, "ok": 0, "rejected": 0, "lat": []},
+            abuser: {"n": 0, "ok": 0, "rejected": 0, "lat": []},
+        }
+        tlock = threading.Lock()
+        stop_at = time.monotonic() + tduration_s
+
+        def tenant_worker(tenant: str, pace_s: float, widx: int) -> None:
+            wrng = np.random.RandomState(seed + 900 + widx)
+            while time.monotonic() < stop_at:
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                body = json.dumps(
+                    {"genes": [g], "k": 4}
+                ).encode("utf-8")
+                req = urllib.request.Request(
+                    turl + "/v1/similar", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Tenant": tenant},
+                    method="POST",
+                )
+                t0 = time.monotonic()
+                try:
+                    with urllib.request.urlopen(req, timeout=10.0) as r:
+                        r.read()
+                        status = r.status
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    e.close()
+                    status = e.code
+                except Exception:
+                    status = 0
+                dur_ms = (time.monotonic() - t0) * 1000.0
+                with tlock:
+                    c = counts[tenant]
+                    c["n"] += 1
+                    if status == 200:
+                        c["ok"] += 1
+                        c["lat"].append(dur_ms)
+                    elif status == 429:
+                        c["rejected"] += 1
+                if pace_s > 0:
+                    time.sleep(pace_s)
+
+        # the victim paces at ~20 rps (well inside its 50 rps bucket);
+        # the abuser floods unpaced from 8 workers — hundreds of rps
+        # against the same 50 rps default bucket
+        tenant_threads = [
+            threading.Thread(
+                target=tenant_worker, args=(victim, 0.05, 0),
+                daemon=True,
+            )
+        ] + [
+            threading.Thread(
+                target=tenant_worker, args=(abuser, 0.0, 1 + w),
+                daemon=True,
+            )
+            for w in range(8)
+        ]
+        log(f"tenant isolation: {victim} paced vs {abuser} flooding "
+            f"for {tduration_s:g}s")
+        for t in tenant_threads:
+            t.start()
+        for t in tenant_threads:
+            t.join(timeout=tduration_s + 60.0)
+
+        v, a = counts[victim], counts[abuser]
+        victim_availability = v["ok"] / max(v["n"], 1)
+        v["lat"].sort()
+        victim_p99_ms = (
+            v["lat"][min(len(v["lat"]) - 1, int(0.99 * len(v["lat"])))]
+            if v["lat"] else None
+        )
+        # the labeled rejection series must exist on the replica: WHO
+        # was shed is the whole point of the tenant label
+        labeled = _parse_labeled_counters(
+            urllib.request.urlopen(replica_url + "/metrics", timeout=10.0)
+            .read().decode("utf-8")
+        )
+        abuser_series = labeled.get(
+            ("serve_rejected_total", (("tenant", abuser),))
+        )
+        log(f"tenant isolation: {victim} availability "
+            f"{victim_availability:.4f} over {v['n']} requests "
+            f"(p99 {victim_p99_ms} ms); {abuser} sent {a['n']}, "
+            f"shed {a['rejected']} as 429 "
+            f"(labeled series: {abuser_series})")
+        assert v["n"] >= tduration_s * 5, (
+            f"victim sent suspiciously few requests ({v['n']})"
+        )
+        assert a["rejected"] > 0, (
+            "the abusive tenant was never rejected — quotas are not "
+            "enforcing"
+        )
+        assert abuser_series is not None and abuser_series > 0, (
+            f"serve_rejected_total{{tenant={abuser!r}}} missing from "
+            "the replica's /metrics"
+        )
+        min_victim = float(budget.get("min_victim_availability", 0.99))
+        assert victim_availability >= min_victim, (
+            f"victim tenant availability {victim_availability:.4f} "
+            f"below budget {min_victim}"
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    result = {
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "scrape_interval_s": scrape_s,
+        "scale_up_detection_ticks": detection_ticks,
+        "scale_up_detection_s": round(detection_s, 3),
+        "scale_up_completed_s": round(scale_up_completed_s, 2),
+        "scale_down_s": round(scale_down_s, 2),
+        "drain_timeouts": drain_timeouts,
+        "ramp_workers": ramp_workers,
+        "ramp_requests": ramp_counts["n"],
+        "ramp_rejected_429": ramp_counts["rejected"],
+        "lightload_requests": light["n"],
+        "dropped_answers": light["dropped"],
+        "wrong_answers": light["wrong"],
+        "mixed_iteration_answers": light["mixed"],
+        "steady_state_ticks": steady_ticks,
+        "steady_state_scale_actions": steady_actions,
+        "victim_tenant": victim,
+        "abusive_tenant": abuser,
+        "victim_requests": v["n"],
+        "victim_ok": v["ok"],
+        "victim_tenant_availability": round(victim_availability, 5),
+        "victim_p99_ms": (
+            round(victim_p99_ms, 2) if victim_p99_ms is not None else None
+        ),
+        "abuser_requests": a["n"],
+        "abuser_rejected_429": a["rejected"],
+        "tenant_rejections_labeled": True,
+        "budget": {k: val for k, val in budget.items()
+                   if not k.startswith("_")},
+    }
+    assert detection_ticks <= max_ticks, (
+        f"scale-up detection took {detection_ticks} tick(s), budget "
+        f"{max_ticks:g}"
+    )
+    assert light["n"] >= 10, (
+        f"suspiciously little light load ({light['n']} requests) — "
+        "the scale-down window was never really exercised"
+    )
+    assert light["dropped"] == 0, (
+        f"{light['dropped']} request(s) dropped during scale-down — "
+        "the drain is not zero-drop"
+    )
+    assert light["wrong"] == 0, (
+        f"{light['wrong']} wrong answer(s) during scale actions"
+    )
+    assert light["mixed"] == 0, (
+        f"{light['mixed']} mixed-iteration answer(s) during scale "
+        "actions"
+    )
+    assert steady_actions == 0, (
+        f"{steady_actions} scale action(s) in the steady-state window "
+        "— the fleet is flapping"
+    )
+    return result
+
+
 # -- phase: async checkpoint overhead ---------------------------------------
 
 
@@ -1108,7 +1597,7 @@ def drill_async_overhead(tmp: str, budget: dict) -> dict:
 
 
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet", "alerts")
+          "fleet", "alerts", "autoscale")
 
 
 def main(argv=None) -> int:
@@ -1130,6 +1619,11 @@ def main(argv=None) -> int:
                          "budget) as a standalone bench document, e.g. "
                          "BENCH_ALERTS_r13.json — the record "
                          "analysis/passes_alerts.py gates on")
+    ap.add_argument("--autoscale-out", default=None, metavar="PATH",
+                    help="also write the autoscale phase's results "
+                         "(plus budget) as a standalone bench document, "
+                         "e.g. BENCH_AUTOSCALE_r14.json — the record "
+                         "analysis/passes_autoscale.py gates on")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -1158,6 +1652,7 @@ def main(argv=None) -> int:
     budget = budgets["resilience"]["async_ckpt"]
     fleet_budget = budgets["fleet"]["chaos"]
     alerts_budget = budgets["alerts"]["detection"]
+    autoscale_budget = budgets["autoscale"]["elasticity"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -1192,6 +1687,10 @@ def main(argv=None) -> int:
             elif phase == "alerts":
                 doc["phases"][phase] = drill_alerts(
                     tmp, args.smoke, alerts_budget, seed
+                )
+            elif phase == "autoscale":
+                doc["phases"][phase] = drill_autoscale(
+                    tmp, args.smoke, autoscale_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -1240,6 +1739,22 @@ def main(argv=None) -> int:
         with open(args.alerts_out, "w") as f:
             f.write(json.dumps(alerts_doc, indent=1) + "\n")
         log(f"wrote {args.alerts_out}")
+    if args.autoscale_out and "autoscale" in doc["phases"]:
+        autoscale_doc = {
+            "schema": "gene2vec-tpu/bench-autoscale/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "autoscale_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["autoscale"],
+            "autoscale": doc["phases"]["autoscale"],
+        }
+        with open(args.autoscale_out, "w") as f:
+            f.write(json.dumps(autoscale_doc, indent=1) + "\n")
+        log(f"wrote {args.autoscale_out}")
     print(blob)
     log("DRILL PASSED" if doc["passed"] else "DRILL FAILED")
     return 0 if doc["passed"] else 1

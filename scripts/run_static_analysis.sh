@@ -35,8 +35,14 @@
 #                                                  # fires within budget, zero
 #                                                  # warmup false positives,
 #                                                  # incident bundle verified;
+#                                                  # AND the autoscale smoke:
+#                                                  # 1 -> 2 -> 1 replicas
+#                                                  # under a short ramp with
+#                                                  # a zero-drop drain and
+#                                                  # abusive-tenant isolation;
 #                                                  # docs/RESILIENCE.md +
-#                                                  # docs/OBSERVABILITY.md)
+#                                                  # docs/OBSERVABILITY.md +
+#                                                  # docs/SERVING.md)
 #   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
 #                                                  # (expect intended-race
 #                                                  # reports; for auditing
@@ -136,16 +142,21 @@ if [ "$CHAOS" = "1" ]; then
   echo "   the alerts phase: injected fault -> rule fires -> incident" >&2
   echo "   bundle CRC-verified with a trace through the faulty replica) ==" >&2
   CHAOS_OUT="${CHAOS_DRILL_OUT:-/tmp/chaos_drill_smoke.json}"
-  # the fleet/alerts results also land in standalone bench documents so
-  # the analyzer's gates can be refreshed from CI runs (the committed
-  # BENCH_FLEET/BENCH_ALERTS records come from the full, non-smoke drill)
+  # the fleet/alerts/autoscale results also land in standalone bench
+  # documents so the analyzer's gates can be refreshed from CI runs
+  # (the committed BENCH_FLEET/BENCH_ALERTS/BENCH_AUTOSCALE records
+  # come from the full, non-smoke drill).  The autoscale phase IS the
+  # reduced-scale elasticity smoke: a 1 -> 2 -> 1 replica cycle under
+  # a short ramp, zero-drop drain verified, plus the abusive-tenant
+  # isolation check (docs/SERVING.md#elastic-fleet).
   FLEET_OUT="${FLEET_DRILL_OUT:-/tmp/chaos_drill_fleet_smoke.json}"
   ALERTS_OUT="${ALERTS_DRILL_OUT:-/tmp/chaos_drill_alerts_smoke.json}"
+  AUTOSCALE_OUT="${AUTOSCALE_DRILL_OUT:-/tmp/chaos_drill_autoscale_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
-    --alerts-out "$ALERTS_OUT" \
+    --alerts-out "$ALERTS_OUT" --autoscale-out "$AUTOSCALE_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
-  echo "  alerts: $ALERTS_OUT)" >&2
+  echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

@@ -44,7 +44,13 @@ request coalescing); the default ``post`` exercises the full dispatch
 pipeline.  ``--verify`` fetches a reference answer per query gene
 before each phase and checks every 200 response against it, counting
 ``wrong_answers`` and ``mixed_iteration_answers`` (the fleet-phase
-integrity gate).  With ``--resilient`` every request goes through
+integrity gate).  ``--tenant id[:weight]`` (repeatable) emits
+mixed-tenant traffic — each request draws a tenant by weight and
+carries it as ``X-Tenant`` — and every level row gains a per-tenant
+requests/ok/429/availability/p50/p99 breakdown, the measurement the
+multi-tenant isolation drill and capacity planning both read
+(docs/SERVING.md#multi-tenant-admission).  With ``--resilient`` every
+request goes through
 :class:`gene2vec_tpu.serve.client.ResilientClient` (retries, breakers,
 optional ``--hedge``, pooled keep-alive transport) and each level
 additionally reports retry/hedge counts and attempt amplification.
@@ -133,10 +139,14 @@ class _Stats:
         self.wrong_answers = 0
         self.mixed_iteration_answers = 0
         self.traces: List[tuple] = []  # (latency_ms, status, trace_id)
+        # --tenant mode: per-tenant sub-accounting so the isolation
+        # story (availability/429s/p99 per tenant) survives the merge
+        self.tenants: Dict[str, Dict] = {}
 
     def record(self, status: int, latency_ms: float,
                retries: int = 0, hedged: bool = False,
-               attempts: int = 1, trace_id: Optional[str] = None) -> None:
+               attempts: int = 1, trace_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> None:
         with self.lock:
             self.retries += retries
             self.hedges += int(hedged)
@@ -156,6 +166,21 @@ class _Stats:
                 self.transport += 1
             else:
                 self.other_http += 1
+            if tenant is not None:
+                t = self.tenants.get(tenant)
+                if t is None:
+                    t = self.tenants[tenant] = {
+                        "requests": 0, "ok": 0, "rejected_429": 0,
+                        "other_errors": 0, "latencies": [],
+                    }
+                t["requests"] += 1
+                if status == 200:
+                    t["ok"] += 1
+                    t["latencies"].append(latency_ms)
+                elif status == 429:
+                    t["rejected_429"] += 1
+                else:
+                    t["other_errors"] += 1
 
     def count_connection(self) -> None:
         with self.lock:
@@ -250,14 +275,46 @@ def _check_answer(raw: bytes, verify_ref: Dict, stats: _Stats) -> None:
         stats.count_integrity(wrong=wrong, mixed=mixed)
 
 
+def parse_tenants(specs: List[str]) -> Optional[List[Tuple[str, float]]]:
+    """``--tenant id[:weight]`` flags -> [(id, cumulative_weight)] for
+    weighted draws; None when tenancy is off."""
+    if not specs:
+        return None
+    out: List[Tuple[str, float]] = []
+    cum = 0.0
+    for spec in specs:
+        tid, sep, w = spec.partition(":")
+        if not tid:
+            raise ValueError(f"--tenant must be id[:weight], got {spec!r}")
+        weight = float(w) if sep else 1.0
+        if weight <= 0:
+            raise ValueError(f"--tenant {spec!r}: weight must be > 0")
+        cum += weight
+        out.append((tid, cum))
+    return out
+
+
+def _pick_tenant(tenants: Optional[List[Tuple[str, float]]],
+                 rng: random.Random) -> Optional[str]:
+    if not tenants:
+        return None
+    r = rng.random() * tenants[-1][1]
+    for tid, cum in tenants:
+        if r <= cum:
+            return tid
+    return tenants[-1][0]
+
+
 def _one_request(conn: Optional[_KeepAliveConn], url: str,
                  genes: List[str], k: int, rng: random.Random,
                  stats: _Stats, timeout_s: float,
                  client=None, trace: bool = False,
                  method: str = "post",
                  verify_ref: Optional[Dict] = None,
-                 t_ref: Optional[float] = None) -> None:
+                 t_ref: Optional[float] = None,
+                 tenants: Optional[List[Tuple[str, float]]] = None) -> None:
     gene = rng.choice(genes)
+    tenant = _pick_tenant(tenants, rng)
     # when tracing, THIS request is a sampled trace root: the resilient
     # client adopts it as the ambient base (child span per attempt), the
     # plain path sends it as the traceparent header directly
@@ -271,7 +328,10 @@ def _one_request(conn: Optional[_KeepAliveConn], url: str,
         else:
             path, body = "/v1/similar", {"genes": [gene], "k": k}
         with tracecontext.use(ctx):
-            r = client.request(path, body, timeout_s=timeout_s)
+            r = client.request(
+                path, body, timeout_s=timeout_s,
+                headers={"X-Tenant": tenant} if tenant else None,
+            )
         status = r.status
         if status == 0:
             # no HTTP status reached the caller: bucket the client's own
@@ -284,12 +344,15 @@ def _one_request(conn: Optional[_KeepAliveConn], url: str,
             (time.monotonic() - t0) * 1000.0,
             retries=r.retries, hedged=r.hedged, attempts=r.attempts,
             trace_id=r.trace_id if trace else None,
+            tenant=tenant,
         )
         return
     assert conn is not None
     headers: Dict[str, str] = {}
     if ctx is not None:
         headers[TRACEPARENT_HEADER] = ctx.to_header()
+    if tenant is not None:
+        headers["X-Tenant"] = tenant
     try:
         if method == "get":
             status, raw = conn.request(
@@ -310,6 +373,7 @@ def _one_request(conn: Optional[_KeepAliveConn], url: str,
     stats.record(
         status, (time.monotonic() - t0) * 1000.0,
         trace_id=ctx.trace_id if ctx is not None else None,
+        tenant=tenant,
     )
 
 
@@ -317,7 +381,9 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
                    duration_s: float, seed: int, timeout_s: float,
                    client=None, trace: bool = False,
                    method: str = "post", workers: int = 128,
-                   verify_ref: Optional[Dict] = None) -> _Stats:
+                   verify_ref: Optional[Dict] = None,
+                   tenants: Optional[List[Tuple[str, float]]] = None,
+                   ) -> _Stats:
     """Fixed-schedule arrivals at ``rps`` for ``duration_s`` handed to
     a worker pool with persistent connections.  Latency is measured
     from each arrival's SCHEDULED time — a saturated pool shows up as
@@ -339,6 +405,7 @@ def run_open_level(url: str, genes: List[str], k: int, rps: float,
                 _one_request(
                     conn, url, genes, k, rng, stats, timeout_s, client,
                     trace, method, verify_ref, t_ref=target,
+                    tenants=tenants,
                 )
         finally:
             conn.close()
@@ -369,7 +436,9 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
                      duration_s: float, seed: int,
                      timeout_s: float, client=None,
                      trace: bool = False, method: str = "post",
-                     verify_ref: Optional[Dict] = None) -> _Stats:
+                     verify_ref: Optional[Dict] = None,
+                     tenants: Optional[List[Tuple[str, float]]] = None,
+                     ) -> _Stats:
     """N workers firing back-to-back on persistent connections until
     the clock runs out."""
     stats = _Stats()
@@ -381,7 +450,8 @@ def run_closed_level(url: str, genes: List[str], k: int, workers: int,
         try:
             while time.monotonic() < stop:
                 _one_request(conn, url, genes, k, rng, stats, timeout_s,
-                             client, trace, method, verify_ref)
+                             client, trace, method, verify_ref,
+                             tenants=tenants)
         finally:
             conn.close()
 
@@ -435,6 +505,28 @@ def summarize(level: float, stats: _Stats, mode: str,
     if verify:
         row["wrong_answers"] = stats.wrong_answers
         row["mixed_iteration_answers"] = stats.mixed_iteration_answers
+    if stats.tenants:
+        # per-tenant breakdown: isolation is invisible in the merged
+        # row (the abuser's 429s and the victim's p99 cancel out)
+        row["tenants"] = {}
+        for tid in sorted(stats.tenants):
+            t = stats.tenants[tid]
+            lat_t = sorted(t["latencies"])
+            row["tenants"][tid] = {
+                "requests": t["requests"],
+                "ok": t["ok"],
+                "rejected_429": t["rejected_429"],
+                "other_errors": t["other_errors"],
+                "availability": round(
+                    t["ok"] / t["requests"], 4
+                ) if t["requests"] else None,
+                "p50_ms": round(
+                    _percentile(lat_t, 0.50), 3
+                ) if lat_t else None,
+                "p99_ms": round(
+                    _percentile(lat_t, 0.99), 3
+                ) if lat_t else None,
+            }
     if trace_sample > 0 and stats.traces:
         # the N slowest requests, with the trace ids to go look at:
         # `python -m gene2vec_tpu.cli.obs trace <run_dir> <trace_id>`
@@ -632,6 +724,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="pre-fetch a reference answer per gene and "
                          "check every 200 response against it "
                          "(wrong/mixed-iteration answer counts)")
+    ap.add_argument("--tenant", action="append", default=[],
+                    metavar="ID[:WEIGHT]",
+                    help="emit mixed-tenant traffic: each request "
+                         "draws a tenant id by WEIGHT (default 1) and "
+                         "carries it as X-Tenant; every level row "
+                         "gains a per-tenant requests/ok/429/"
+                         "availability/p50/p99 breakdown (repeatable; "
+                         "docs/SERVING.md#multi-tenant-admission)")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="after the single-replica levels, spawn an "
                          "N-replica cli.fleet over the SAME export dir "
@@ -738,6 +838,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rng=random.Random(args.seed),
             )
 
+        try:
+            tenants = parse_tenants(args.tenant)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
         rng = random.Random(args.seed)
         _warmup(url, genes, args.k, rng, args.timeout, args.warmup,
                 client, args.method)
@@ -769,12 +875,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     target_url, genes, args.k, level, dur,
                     args.seed, args.timeout, use_client, trace=trace,
                     method=args.method, workers=args.open_workers,
-                    verify_ref=ref,
+                    verify_ref=ref, tenants=tenants,
                 )
             return run_closed_level(
                 target_url, genes, args.k, int(level), dur,
                 args.seed, args.timeout, use_client, trace=trace,
-                method=args.method, verify_ref=ref,
+                method=args.method, verify_ref=ref, tenants=tenants,
             )
 
         def warm_window(level: float, target_url: str,
@@ -953,6 +1059,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "server": health.get("model", {}),
             "resilient": bool(args.resilient),
             "verify": bool(args.verify),
+            "tenants": args.tenant or None,
             "trace_sample": args.trace_sample,
             "levels": results,
         }

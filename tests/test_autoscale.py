@@ -1,0 +1,929 @@
+"""Elastic fleet & multi-tenant admission: the autoscale policy as a
+pure state machine over fake aggregator snapshots, tenant token
+buckets + weighted-fair dequeue, the in-flight drain contract, the
+elastic supervisor over a jax-free stub replica, and the
+passes_autoscale budget gate (docs/SERVING.md#elastic-fleet)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gene2vec_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    ElasticController,
+)
+from gene2vec_tpu.serve.batcher import MicroBatcher
+from gene2vec_tpu.serve.client import InFlightTracker, ResilientClient, RetryPolicy
+from gene2vec_tpu.serve.fleet import (
+    FleetConfig,
+    FleetSupervisor,
+    ReplicaState,
+)
+from gene2vec_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    OVERFLOW_TENANT,
+    FairQueue,
+    RateBucket,
+    TenantAdmission,
+    TenantPolicy,
+    TenantQuota,
+    sanitize_tenant,
+)
+from gene2vec_tpu.obs.registry import MetricsRegistry
+
+
+# -- snapshot helpers --------------------------------------------------------
+
+
+def snap(queue=0.0, requests=0.0, rejected=0.0, ok=None, responses=None,
+         fresh=3.0, p99=None, route="/v1/similar", quota_rejected=0.0,
+         throttled=0.0):
+    """One fake aggregator snapshot in the evaluator/scaler shape."""
+    responses = requests if responses is None else responses
+    ok = responses if ok is None else ok
+    s = {
+        "fleet_queue_depth": queue,
+        "fleet_requests": requests,
+        "fleet_rejected": rejected,
+        "fleet_quota_rejected": quota_rejected,
+        "fleet_ok": ok,
+        "fleet_responses": responses,
+        "fleet_throttled": throttled,
+        "_fresh_targets": fresh,
+    }
+    if p99 is not None:
+        s[f"fleet_route_p99_seconds{{route={route}}}"] = p99
+    return s
+
+
+def make_policy(**kw):
+    base = dict(
+        min_replicas=1, max_replicas=4,
+        up_queue_per_replica=8.0, up_rejection_rate=0.02,
+        up_after_ticks=2, down_after_ticks=3,
+        down_queue_per_replica=1.0, cooldown_s=10.0,
+    )
+    base.update(kw)
+    return AutoscalePolicy(AutoscaleConfig(**base))
+
+
+# -- the pure policy state machine -------------------------------------------
+
+
+def test_policy_breach_scales_up_exactly_at_tick_boundary():
+    p = make_policy()
+    # tick 0 seeds the counter baselines and can never act
+    assert p.observe(snap(), now=0.0, current=1).action == "hold"
+    # breach tick 1 of 2: hold
+    d = p.observe(snap(queue=20), now=1.0, current=1)
+    assert d.action == "hold" and d.breach_ticks == 1
+    # breach tick 2 of 2: up, +1 replica
+    d = p.observe(snap(queue=20), now=2.0, current=1)
+    assert d.action == "up" and d.target == 2
+    assert "queue" in d.reason
+
+
+def test_policy_rejection_signal_is_windowed_not_lifetime():
+    p = make_policy(cooldown_s=0.0, down_after_ticks=2)
+    # a historic rejection burst: lifetime rate 50%...
+    p.observe(snap(requests=100, rejected=50), now=0.0, current=2)
+    # ...but the following windows are perfectly clean: every tick's
+    # DELTA shows zero rejections, so the policy must read "clear" and
+    # scale down, not stay pinned on the cumulative ratio
+    p.observe(snap(requests=110, rejected=50), now=1.0, current=2)
+    d = p.observe(snap(requests=120, rejected=50), now=2.0, current=2)
+    assert d.action == "down" and d.target == 1
+
+
+def test_policy_clear_window_scale_down_and_min_clamp():
+    p = make_policy(cooldown_s=0.0)
+    p.observe(snap(), now=0.0, current=2)
+    p.observe(snap(), now=1.0, current=2)
+    p.observe(snap(), now=2.0, current=2)
+    d = p.observe(snap(), now=3.0, current=2)  # clear tick 3 of 3
+    assert d.action == "down" and d.target == 1
+    # at min_replicas a complete clear window holds instead
+    for i in range(6):
+        d = p.observe(snap(), now=10.0 + i, current=1)
+    assert d.action == "hold" and "min_replicas" in d.reason
+
+
+def test_policy_middle_band_resets_both_streaks():
+    p = make_policy(cooldown_s=0.0, down_after_ticks=2)
+    p.observe(snap(), now=0.0, current=2)
+    p.observe(snap(), now=1.0, current=2)  # clear 1/2
+    # queue per replica 2.0: above down (1.0), below up (8.0) — the
+    # hysteresis band; the clear streak must restart
+    d = p.observe(snap(queue=4), now=2.0, current=2)
+    assert d.action == "hold" and d.clear_ticks == 0
+    d = p.observe(snap(), now=3.0, current=2)  # clear 1/2 again
+    assert d.action == "hold" and d.clear_ticks == 1
+    d = p.observe(snap(), now=4.0, current=2)
+    assert d.action == "down"
+
+
+def test_policy_cooldown_suppresses_consecutive_actions():
+    p = make_policy(cooldown_s=100.0)
+    p.observe(snap(), now=0.0, current=1)
+    p.observe(snap(queue=20), now=1.0, current=1)
+    assert p.observe(snap(queue=20), now=2.0, current=1).action == "up"
+    # the breach persists: streak re-accumulates but cooldown holds
+    p.observe(snap(queue=20), now=3.0, current=2)
+    d = p.observe(snap(queue=20), now=4.0, current=2)
+    assert d.action == "hold" and "cooldown" in d.reason
+    # past the cooldown the pent-up breach fires immediately
+    d = p.observe(snap(queue=20), now=200.0, current=2)
+    assert d.action == "up" and d.target == 3
+
+
+def test_policy_max_clamp_holds_on_breach():
+    p = make_policy(cooldown_s=0.0, max_replicas=2)
+    p.observe(snap(), now=0.0, current=2)
+    p.observe(snap(queue=50), now=1.0, current=2)
+    d = p.observe(snap(queue=50), now=2.0, current=2)
+    assert d.action == "hold" and "max_replicas" in d.reason
+
+
+def test_policy_stale_snapshot_advances_neither_streak():
+    p = make_policy(cooldown_s=0.0, down_after_ticks=2)
+    p.observe(snap(), now=0.0, current=2)
+    # a frozen snapshot (no fresh targets) that LOOKS like a breach
+    # must not scale up...
+    for i in range(5):
+        d = p.observe(snap(queue=100, fresh=0.0), now=1.0 + i, current=2)
+        assert d.action == "hold" and "stale" in d.reason
+        assert d.breach_ticks == 0
+    # ...and one that looks clear must not scale down
+    for i in range(5):
+        d = p.observe(snap(fresh=0.0), now=10.0 + i, current=2)
+        assert d.action == "hold" and d.clear_ticks == 0
+
+
+def test_policy_availability_burn_breach():
+    p = make_policy(cooldown_s=0.0)
+    p.observe(snap(requests=0, responses=0), now=0.0, current=1)
+    # window: 100 responses, 50 ok -> availability 0.5 < 0.95
+    p.observe(snap(responses=100, ok=50, requests=100),
+              now=1.0, current=1)
+    d = p.observe(snap(responses=200, ok=100, requests=200),
+                  now=2.0, current=1)
+    assert d.action == "up" and "availability" in d.reason
+
+
+def test_policy_quota_shedding_does_not_scale_the_fleet():
+    """An abusive tenant saturating its own token bucket produces
+    tenant-labeled rejections and 429 responses — DELIBERATE shedding
+    that must not buy the abuser more capacity by scaling up."""
+    p = make_policy(cooldown_s=0.0)
+    p.observe(snap(), now=0.0, current=1)
+    # every tick: 500 new 429s, all of them quota rejections, all of
+    # them throttled responses; the handful of real answers are fine
+    for i in range(1, 6):
+        d = p.observe(
+            snap(
+                requests=10.0 * i, rejected=500.0 * i,
+                quota_rejected=500.0 * i,
+                responses=510.0 * i, ok=10.0 * i,
+                throttled=500.0 * i,
+            ),
+            now=float(i), current=1,
+        )
+        assert d.action != "up", d
+    # the same volume of QUEUE-FULL (capacity) rejections still fires
+    p2 = make_policy(cooldown_s=0.0)
+    p2.observe(snap(), now=0.0, current=1)
+    p2.observe(snap(requests=100, rejected=50), now=1.0, current=1)
+    d = p2.observe(snap(requests=200, rejected=100), now=2.0, current=1)
+    assert d.action == "up" and "rejection" in d.reason
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(AutoscaleConfig(min_replicas=0))
+    with pytest.raises(ValueError):
+        AutoscalePolicy(AutoscaleConfig(min_replicas=3, max_replicas=2))
+
+
+# -- tenant primitives -------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_rate_bucket_refill_and_burst_cap():
+    clock = FakeClock()
+    b = RateBucket(rate=10.0, burst=5.0, clock=clock)
+    # starts full at burst
+    assert all(b.take() for _ in range(5))
+    assert not b.take()
+    clock.t += 0.1  # +1 token
+    assert b.take() and not b.take()
+    clock.t += 100.0  # refill caps at burst, not rate*dt
+    assert all(b.take() for _ in range(5))
+    assert not b.take()
+
+
+def test_tenant_policy_from_args():
+    assert TenantPolicy.from_args(0.0) is None
+    p = TenantPolicy.from_args(10.0)
+    assert p.default == TenantQuota(10.0, 20.0, 1.0)
+    p = TenantPolicy.from_args(10.0, 30.0, ["vip:100:200:4"])
+    assert p.quota("vip") == TenantQuota(100.0, 200.0, 4.0)
+    assert p.quota("anyone") == TenantQuota(10.0, 30.0, 1.0)
+    with pytest.raises(ValueError):
+        TenantPolicy.from_args(10.0, None, ["vip"])  # no rate
+    with pytest.raises(ValueError):
+        TenantPolicy.from_args(10.0, None, ["vip:-1"])
+    with pytest.raises(ValueError):
+        # named overrides with an unmetered default is a footgun
+        TenantPolicy.from_args(0.0, None, ["vip:10"])
+    with pytest.raises(ValueError):
+        # a NEGATIVE rate is a typo, never a disable request — only
+        # exactly 0 turns tenancy off
+        TenantPolicy.from_args(-50.0)
+    with pytest.raises(ValueError):
+        TenantPolicy.from_args(10.0, -5.0)
+
+
+def test_sanitize_tenant():
+    assert sanitize_tenant(None) == DEFAULT_TENANT
+    assert sanitize_tenant("  ") == DEFAULT_TENANT
+    assert sanitize_tenant("alice") == "alice"
+    assert len(sanitize_tenant("x" * 500)) == 64
+
+
+def test_tenant_admission_buckets_and_labeled_rejections():
+    clock = FakeClock()
+    metrics = MetricsRegistry()
+    adm = TenantAdmission(
+        TenantPolicy.from_args(10.0, 2.0, ["vip:100:50"]),
+        metrics=metrics, clock=clock,
+    )
+    # default tenant: burst 2 then rejected
+    assert adm.admit("alice") == (True, "alice")
+    assert adm.admit("alice") == (True, "alice")
+    ok, label = adm.admit("alice")
+    assert not ok and label == "alice"
+    # the rejection is tenant-labeled in the registry
+    text = metrics.prometheus_text()
+    assert 'serve_rejected_total{tenant="alice"} 1' in text
+    # vip has its own bigger bucket
+    assert all(adm.admit("vip")[0] for _ in range(50))
+    assert not adm.admit("vip")[0]
+    assert adm.weight("vip") == 1.0
+
+
+def test_tenant_admission_bounded_table_collapses_minted_ids():
+    clock = FakeClock()
+    adm = TenantAdmission(
+        TenantPolicy.from_args(10.0, 1.0), clock=clock, max_tenants=3,
+    )
+    for t in ("a", "b", "c"):
+        assert adm.admit(t) == (True, t)
+    # the table is full: every further minted id shares ONE bucket
+    ok1, label1 = adm.admit("minted-1")
+    ok2, label2 = adm.admit("minted-2")
+    assert label1 == label2 == OVERFLOW_TENANT
+    assert ok1 and not ok2  # burst 1, shared
+    # known tenants keep their own buckets
+    assert adm.resolve("a") == "a"
+
+
+def test_fair_queue_weighted_interleave_and_fifo_within_tenant():
+    weights = {"a": 3.0, "b": 1.0}
+    q = FairQueue(weight_of=lambda t: weights.get(t, 1.0))
+    for i in range(12):
+        q.push("a", f"a{i}")
+    for i in range(4):
+        q.push("b", f"b{i}")
+    assert len(q) == 16
+    order = q.pop_upto(16)
+    assert len(q) == 0
+    # proportional drain: among the first 8 pops, ~3:1
+    first8 = order[:8]
+    n_a = sum(1 for x in first8 if x.startswith("a"))
+    assert n_a == 6, first8
+    # FIFO within each tenant
+    assert [x for x in order if x.startswith("a")] == [
+        f"a{i}" for i in range(12)
+    ]
+    assert [x for x in order if x.startswith("b")] == [
+        f"b{i}" for i in range(4)
+    ]
+
+
+def test_fair_queue_single_lane_is_fifo_and_credit_drops_when_empty():
+    q = FairQueue()
+    for i in range(5):
+        q.push("only", i)
+    assert q.pop_upto(5) == [0, 1, 2, 3, 4]
+    assert q.pop() is None and not q
+    # an idle tenant must not hoard scheduling credit: after its lane
+    # empties, a fresh contest starts from zero
+    q.push("a", "a0")
+    q.pop()
+    q.push("a", "a1")
+    q.push("b", "b0")
+    got = {q.pop(), q.pop()}
+    assert got == {"a1", "b0"}
+
+
+def test_batcher_drains_tenant_lanes_weighted_fair():
+    release = threading.Event()
+    batches = []
+
+    def compute(items, k):
+        if items == ["plug"]:
+            release.wait(timeout=10.0)
+        batches.append(list(items))
+        return [{"i": i} for i in items]
+
+    weights = {"heavy": 1.0, "light": 1.0}
+    b = MicroBatcher(
+        compute, max_batch=8, max_delay_s=0.01, max_queue=64,
+        cache_size=0, tenant_weights=lambda t: weights.get(t, 1.0),
+    ).start()
+    try:
+        plug = b.submit_async("plug", 1)
+        time.sleep(0.1)  # the worker is now parked inside compute
+        # a burst from "heavy" arrives FIRST, then a few from "light"
+        heavy = [b.submit_async(f"h{i}", 1, tenant="heavy")
+                 for i in range(16)]
+        light = [b.submit_async(f"l{i}", 1, tenant="light")
+                 for i in range(4)]
+        release.set()
+        for t in heavy + light:
+            t.get()
+        plug.get()
+        # the first contended batch (8 slots, 16 heavy + 4 light
+        # waiting) must interleave round-robin, not serve the heavy
+        # burst's arrival order
+        first = batches[1]
+        n_light = sum(1 for x in first if x.startswith("l"))
+        assert n_light == 4, batches
+    finally:
+        b.stop()
+
+
+# -- in-flight tracking + client integration ---------------------------------
+
+
+def test_inflight_tracker_counts():
+    t = InFlightTracker()
+    assert t.total() == 0
+    t.enter("u1")
+    t.enter("u1")
+    t.enter("u2")
+    assert t.count("u1") == 2 and t.count("u2") == 1 and t.total() == 3
+    t.exit("u1")
+    t.exit("u2")
+    assert t.count("u1") == 1 and t.count("u2") == 0 and t.total() == 1
+
+
+def test_client_tracks_inflight_and_passes_headers():
+    tracker = InFlightTracker()
+    seen = {}
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        seen["headers"] = dict(headers or {})
+        seen["inflight_during"] = tracker.count(base)
+        return 200, b'{"ok": true}'
+
+    c = ResilientClient(
+        ["http://replica-a"], RetryPolicy(max_attempts=1),
+        transport=transport, inflight=tracker,
+    )
+    r = c.request("/v1/similar", {"genes": ["G0"]},
+                  headers={"X-Tenant": "alice"})
+    assert r.ok
+    # the attempt was tracked exactly while on the wire, and released
+    assert seen["inflight_during"] == 1
+    assert tracker.total() == 0
+    assert seen["headers"].get("X-Tenant") == "alice"
+
+
+def test_client_releases_inflight_on_transport_error():
+    tracker = InFlightTracker()
+
+    def transport(base, method, path, body, ct, rt, headers=None):
+        raise ConnectionRefusedError("nope")
+
+    c = ResilientClient(
+        ["http://replica-a"], RetryPolicy(max_attempts=2),
+        transport=transport, inflight=tracker,
+        sleep=lambda s: None,
+    )
+    r = c.request("/v1/genes")
+    assert not r.ok
+    assert tracker.total() == 0
+
+
+# -- the elastic controller over fakes ---------------------------------------
+
+
+class FakeSupervisor:
+    def __init__(self, count=2):
+        self.count = count
+        self.config = FleetConfig(contract_timeout_s=5.0)
+        self.calls = []
+        self.victim = type(
+            "R", (), {"url": "http://victim", "state": ReplicaState.UP,
+                      "alive": True, "spawning": False, "index": 1},
+        )()
+
+    def active_count(self):
+        return self.count
+
+    def scale_up(self):
+        self.calls.append("scale_up")
+        self.count += 1
+        r = type(
+            "R", (), {"url": "http://new", "state": ReplicaState.UP,
+                      "alive": True, "spawning": False, "index": 99},
+        )()
+        return r
+
+    def pick_drain_victim(self):
+        return self.victim
+
+    def begin_drain(self, r):
+        self.calls.append(("begin_drain", r.url))
+        r.state = ReplicaState.DRAINING
+
+    def finish_drain(self, r):
+        self.calls.append(("finish_drain", r.url))
+        self.count -= 1
+
+
+class FakeProxy:
+    def __init__(self):
+        self.inflight = InFlightTracker()
+
+
+def _wait_for(predicate, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"{what} not reached in {timeout_s}s")
+
+
+def test_controller_scales_up_on_breach_and_counts_decision():
+    sup, proxy = FakeSupervisor(count=1), FakeProxy()
+    metrics = MetricsRegistry()
+    ctl = ElasticController(
+        sup, proxy,
+        AutoscaleConfig(min_replicas=1, max_replicas=2,
+                        up_after_ticks=2, cooldown_s=0.0),
+        metrics=metrics,
+    )
+    ctl.observe(snap())                      # seed baselines
+    ctl.observe(snap(queue=50))              # breach 1/2
+    assert sup.calls == []
+    ctl.observe(snap(queue=50))              # breach 2/2 -> act
+    _wait_for(lambda: "scale_up" in sup.calls, what="scale_up call")
+    _wait_for(lambda: not ctl._busy, what="action slot released")
+    assert metrics.counter("fleet_scale_up_total").value == 1
+
+
+def test_controller_drain_waits_for_inflight_then_terminates():
+    sup, proxy = FakeSupervisor(count=2), FakeProxy()
+    ctl = ElasticController(
+        sup, proxy,
+        AutoscaleConfig(min_replicas=1, max_replicas=2,
+                        down_after_ticks=2, cooldown_s=0.0),
+        metrics=MetricsRegistry(),
+        drain_timeout_s=10.0, drain_poll_s=0.01,
+    )
+    # a request is in flight against the victim when the drain begins
+    proxy.inflight.enter("http://victim")
+    ctl.observe(snap())
+    ctl.observe(snap())                       # clear 1/2
+    ctl.observe(snap())                       # clear 2/2 -> down
+    _wait_for(
+        lambda: ("begin_drain", "http://victim") in sup.calls,
+        what="begin_drain",
+    )
+    # the victim must NOT be terminated while its request is on board
+    time.sleep(0.2)
+    assert ("finish_drain", "http://victim") not in sup.calls
+    proxy.inflight.exit("http://victim")      # the request completes
+    _wait_for(
+        lambda: ("finish_drain", "http://victim") in sup.calls,
+        what="finish_drain after in-flight settles",
+    )
+
+
+def test_controller_drain_timeout_is_counted_not_wedged():
+    sup, proxy = FakeSupervisor(count=2), FakeProxy()
+    metrics = MetricsRegistry()
+    ctl = ElasticController(
+        sup, proxy,
+        AutoscaleConfig(min_replicas=1, max_replicas=2,
+                        down_after_ticks=1, cooldown_s=0.0),
+        metrics=metrics, drain_timeout_s=0.2, drain_poll_s=0.01,
+    )
+    proxy.inflight.enter("http://victim")     # never settles
+    ctl.observe(snap())
+    ctl.observe(snap())                       # clear 1/1 -> down
+    _wait_for(
+        lambda: ("finish_drain", "http://victim") in sup.calls,
+        what="finish_drain after timeout",
+    )
+    assert metrics.counter("fleet_drain_timeouts_total").value == 1
+
+
+def test_controller_skips_ticks_while_an_action_is_in_flight():
+    sup, proxy = FakeSupervisor(count=2), FakeProxy()
+    ctl = ElasticController(
+        sup, proxy,
+        AutoscaleConfig(min_replicas=1, max_replicas=4,
+                        down_after_ticks=1, cooldown_s=0.0),
+        metrics=MetricsRegistry(),
+        drain_timeout_s=5.0, drain_poll_s=0.01,
+    )
+    proxy.inflight.enter("http://victim")     # parks the drain
+    ctl.observe(snap())
+    ctl.observe(snap())                       # -> down starts
+    _wait_for(lambda: ctl._busy, what="action in flight")
+    # further clear ticks while busy must not queue a second action
+    for _ in range(5):
+        ctl.observe(snap())
+    proxy.inflight.exit("http://victim")
+    _wait_for(lambda: not ctl._busy, what="drain finished")
+    assert sup.calls.count(("finish_drain", "http://victim")) == 1
+
+
+# -- elastic supervisor over a jax-free stub replica --------------------------
+
+
+STUB = r"""
+import json, os, sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def do_GET(self):
+        payload = json.dumps({"status": "ok"}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+srv = HTTPServer(("127.0.0.1", 0), H)
+print(json.dumps({"url": f"http://127.0.0.1:{srv.server_address[1]}"}),
+      flush=True)
+srv.serve_forever()
+"""
+
+
+class StubSupervisor(FleetSupervisor):
+    """FleetSupervisor over the always-ready stub above: elasticity
+    semantics without paying a jax import per spawn."""
+
+    def __init__(self, tmp, **kw):
+        self._stub = os.path.join(tmp, "stub_replica.py")
+        with open(self._stub, "w") as f:
+            f.write(STUB)
+        super().__init__(tmp, **kw)
+
+    def _argv(self, index):
+        return [sys.executable, self._stub]
+
+
+FAST = dict(
+    health_interval_s=0.05, health_timeout_s=1.0, unhealthy_after=2,
+    readmit_after=1, backoff_base_s=0.05, backoff_max_s=0.2,
+    contract_timeout_s=20.0,
+)
+
+
+def test_supervisor_scale_up_adds_replica_to_rotation(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=1, **FAST),
+    )
+    sup.start()
+    try:
+        assert sup.active_count() == 1
+        r = sup.scale_up()
+        assert r.index == 1  # fresh index, never reused
+        assert sup.active_count() == 2
+        _wait_for(
+            lambda: r.state == ReplicaState.UP,
+            what="scaled-up replica admitted",
+        )
+        assert len(sup.healthy_urls()) == 2
+    finally:
+        sup.stop()
+
+
+def test_supervisor_drain_leaves_rotation_then_terminates(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=2, **FAST),
+    )
+    sup.start()
+    try:
+        victim = sup.pick_drain_victim()
+        assert victim is not None and victim.index == 1  # newest UP
+        pid = victim.pid
+        sup.begin_drain(victim)
+        # out of rotation IMMEDIATELY, but still alive (in-flight
+        # requests are still being answered) and still scraped
+        assert len(sup.healthy_urls()) == 1
+        assert victim.alive
+        assert victim.url in sup.live_urls()
+        # the monitor must not eject/restart a draining replica
+        time.sleep(0.3)
+        assert victim.state == ReplicaState.DRAINING
+        sup.finish_drain(victim)
+        assert sup.active_count() == 1
+        assert len(sup.replicas) == 1
+        _wait_for(
+            lambda: not _pid_alive(pid), what="victim terminated",
+        )
+    finally:
+        sup.stop()
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def test_supervisor_draining_replica_death_is_not_restarted(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=2, **FAST),
+    )
+    sup.start()
+    try:
+        victim = sup.pick_drain_victim()
+        sup.begin_drain(victim)
+        restarts_before = victim.restarts
+        os.kill(victim.pid, signal.SIGKILL)
+        time.sleep(0.5)  # several monitor ticks
+        assert victim.restarts == restarts_before
+        assert victim.state == ReplicaState.DRAINING
+        sup.finish_drain(victim)
+    finally:
+        sup.stop()
+
+
+def test_pick_drain_victim_never_picks_the_last_up_replica(tmp_path):
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=1, **FAST),
+    )
+    sup.start()
+    try:
+        assert sup.pick_drain_victim() is None
+    finally:
+        sup.stop()
+
+
+def test_pick_drain_victim_skips_replicas_mid_spawn(tmp_path):
+    """A slot whose respawn is in flight must not be drained: the
+    drain's terminate would race the spawn and orphan the fresh
+    child."""
+    sup = StubSupervisor(
+        str(tmp_path), config=FleetConfig(replicas=3, **FAST),
+    )
+    sup.start()
+    try:
+        newest = max(sup.replicas, key=lambda r: r.index)
+        newest.spawning = True
+        victim = sup.pick_drain_victim()
+        assert victim is not None and victim is not newest
+        assert victim.index == 1  # newest DRAINABLE, not newest overall
+        # ...and when excluding it would leave one serving replica,
+        # refuse outright
+        mid = victim
+        mid.spawning = True
+        assert sup.pick_drain_victim() is None
+        mid.spawning = False
+        newest.spawning = False
+    finally:
+        sup.stop()
+
+
+# -- serve app: tenant quota end to end over HTTP ----------------------------
+
+
+V, D = 32, 8
+
+
+def _write_iteration(export_dir, iteration, seed):
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    rng = np.random.RandomState(seed)
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(V, D).astype(np.float32)),
+        ctx=jnp.asarray(np.zeros((V, D), np.float32)),
+    )
+    save_iteration(str(export_dir), D, iteration, params, vocab)
+
+
+@pytest.fixture
+def tenant_serving(tmp_path):
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig, make_server
+
+    d = tmp_path / "exports"
+    _write_iteration(d, 1, seed=1)
+    reg = ModelRegistry(str(d))
+    assert reg.refresh()
+    app = ServeApp(
+        reg,
+        ServeConfig(
+            max_batch=8, max_delay_ms=2.0, max_queue=64, cache_size=0,
+            # near-zero refill: the bucket is effectively its burst of
+            # 3 for the duration of the test
+            tenant_rate=0.1, tenant_burst=3.0,
+            tenant_overrides=("vip:1000:1000",),
+        ),
+    ).start()
+    server = make_server(app, "127.0.0.1", 0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url, app
+    server.shutdown()
+    server.server_close()
+    app.stop()
+
+
+def _post_tenant(url, tenant, timeout=10.0):
+    import urllib.error
+    import urllib.request
+
+    headers = {"Content-Type": "application/json"}
+    if tenant is not None:
+        headers["X-Tenant"] = tenant
+    req = urllib.request.Request(
+        f"{url}/v1/similar",
+        data=json.dumps({"genes": ["G0"], "k": 4}).encode("utf-8"),
+        headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_tenant_quota_enforced_with_labeled_429(tenant_serving):
+    url, app = tenant_serving
+    # burst 3 for the default-quota tenant "abuser" (refill is near
+    # zero, so the 4th immediate request must shed)
+    statuses = [_post_tenant(url, "abuser")[0] for _ in range(4)]
+    assert statuses[:3] == [200, 200, 200]
+    assert statuses[3] == 429
+    status, doc = _post_tenant(url, "abuser")
+    assert status == 429 and "quota" in doc["error"]
+    # vip's bucket is untouched by the abuser's exhaustion
+    assert _post_tenant(url, "vip")[0] == 200
+    # untagged traffic is the default tenant, with its own bucket
+    assert _post_tenant(url, None)[0] == 200
+    text = app.metrics.prometheus_text()
+    assert 'serve_rejected_total{tenant="abuser"}' in text
+    assert 'serve_tenant_requests_total{tenant="vip"}' in text
+
+
+def test_tenancy_off_by_default_ignores_header(tenant_serving, tmp_path):
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig
+
+    d = tmp_path / "exports2"
+    _write_iteration(d, 1, seed=1)
+    reg = ModelRegistry(str(d))
+    assert reg.refresh()
+    app = ServeApp(reg, ServeConfig())
+    assert app.tenants is None  # no bucket, no per-request cost
+    app.stop()
+
+
+# -- the analysis gate -------------------------------------------------------
+
+
+def _autoscale_doc(**over):
+    section = {
+        "min_replicas": 1, "max_replicas": 2, "scrape_interval_s": 0.25,
+        "scale_up_detection_ticks": 8, "dropped_answers": 0,
+        "wrong_answers": 0, "mixed_iteration_answers": 0,
+        "steady_state_scale_actions": 0,
+        "victim_tenant_availability": 1.0,
+    }
+    section.update(over)
+    return {"schema_version": 1, "autoscale": section}
+
+
+def test_passes_autoscale_budget_gate(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_autoscale import autoscale_findings
+
+    # missing bench = info (fresh checkout must not fail lint)
+    missing = autoscale_findings(root=str(tmp_path / "absent"))
+    assert [f.severity for f in missing] == ["info"]
+
+    def run(doc):
+        root = tmp_path / "root"
+        root.mkdir(exist_ok=True)
+        with open(root / "BENCH_AUTOSCALE_r14.json", "w") as f:
+            json.dump(doc, f)
+        return autoscale_findings(root=str(root))
+
+    fs = run(_autoscale_doc())
+    assert gating(fs) == [], [f.format() for f in fs]
+
+    # each planted violation fires EXACTLY once
+    for doc in (
+        _autoscale_doc(scale_up_detection_ticks=500),   # slow detection
+        _autoscale_doc(dropped_answers=1),              # dropped a request
+        _autoscale_doc(wrong_answers=1),
+        _autoscale_doc(mixed_iteration_answers=2),
+        _autoscale_doc(steady_state_scale_actions=3),   # flapping
+        _autoscale_doc(victim_tenant_availability=0.5),  # starved tenant
+        _autoscale_doc(scale_up_detection_ticks=None),  # dropped key
+        _autoscale_doc(max_replicas=8),                 # off-recipe
+        {"schema_version": 1},                          # no section
+    ):
+        fs = run(doc)
+        assert len(gating(fs)) == 1, doc
+
+    # the newest round wins: a violating r15 beats a stale clean r14
+    root = tmp_path / "root"
+    with open(root / "BENCH_AUTOSCALE_r15.json", "w") as f:
+        json.dump(_autoscale_doc(dropped_answers=5), f)
+    with open(root / "BENCH_AUTOSCALE_r14.json", "w") as f:
+        json.dump(_autoscale_doc(), f)
+    fs = autoscale_findings(root=str(root))
+    assert len(gating(fs)) == 1
+    assert gating(fs)[0].path == "BENCH_AUTOSCALE_r15.json"
+
+
+def test_cli_analyze_gates_on_planted_autoscale_violation(tmp_path):
+    """The env-override path: a violating BENCH_AUTOSCALE under
+    GENE2VEC_TPU_AUTOSCALE_ROOT makes the real cli.analyze exit 1 with
+    exactly one autoscale-elasticity-budget finding."""
+    root = tmp_path / "root"
+    root.mkdir()
+    with open(root / "BENCH_AUTOSCALE_r14.json", "w") as f:
+        json.dump(_autoscale_doc(dropped_answers=3), f)
+    env = {**os.environ, "GENE2VEC_TPU_AUTOSCALE_ROOT": str(root)}
+    r = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 1, r.stderr
+    doc = json.loads(r.stdout)
+    mine = [f for f in doc["findings"]
+            if f["pass"] == "autoscale-elasticity-budget"]
+    assert len(mine) == 1
+    assert mine[0]["severity"] != "info"
+    assert "drain" in mine[0]["message"]
+
+
+def test_ledger_adapts_autoscale_family(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    with open(tmp_path / "BENCH_AUTOSCALE_r14.json", "w") as f:
+        json.dump({
+            "schema_version": 1,
+            "command": "chaos_drill --only autoscale",
+            "created_unix": 1000.0, "passed": True,
+            "autoscale": {
+                "scale_up_detection_ticks": 8,
+                "victim_tenant_availability": 1.0,
+                "dropped_answers": 0,
+                "steady_state_scale_actions": 0,
+            },
+        }, f)
+    records = ledger.ingest_root(str(tmp_path))
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["family"] == "autoscale"
+    assert rec["headline_metric"] == "scale_up_detection_ticks"
+    assert rec["metrics"]["scale_up_detection_ticks"] == 8.0
+    assert rec["metrics"]["victim_tenant_availability"] == 1.0
+    assert not rec["legacy_unstamped"]
