@@ -1,0 +1,273 @@
+"""Fleet-sharded serving gate: BENCH_SHARD vs budgets.json ``shard``.
+
+``python scripts/chaos_drill.py --only shard --shard-out
+BENCH_SHARD_r*.json`` stamps the sharded-serving record — the 10M-row
+scatter-merge bench (recall@10 vs the exact oracle with all shards up,
+degraded recall with one shard removed, merged p99) plus the HTTP
+chaos drill facts (availability and answer integrity under a SIGKILLed
+shard, swap-under-load, slow-loris shard).  This pass re-checks the
+NEWEST committed record against the ``scatter`` entry of the ``shard``
+budgets section every ``cli.analyze`` run.
+
+Rules (the passes_ann / passes_fleet shape — jax-free, I/O-only, so it
+rides the DEFAULT tier):
+
+* no ``BENCH_SHARD_r*`` artifact at all → *info* (a fresh checkout
+  must not fail lint before its first drill);
+* the budget pins the bench **measurement recipe** (rows, dim, shards,
+  k, queries, index, nprobe, rescore_mult, clusters): a record
+  measured at a smaller table or with looser knobs gates hard — a
+  64k-row smoke must never stand in for the 10M gate;
+* all-shards-up recall@10 below ``min_recall_at_10``, merged p99 over
+  ``max_p99_ms``, or degradation NOT tracking the dead shard's row
+  fraction (|recall_drop − row_fraction| > tolerance) gates hard;
+* the drill half gates availability, zero server 5xx (degraded answers
+  must be flagged 200s, never failures), zero wrong / mixed-iteration
+  answers (the epoch fence under swap-under-load), and retry
+  amplification (one shared token bucket across the fan-out);
+* any budgeted quantity missing from the record gates like a
+  violation — dropping the key must never be the way to pass.
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (shared with
+the other bench gates so staged fixture dirs work uniformly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.passes_perf import perf_root
+
+_PASS = "shard-scatter-budget"
+
+_RECIPE_KEYS = ("rows", "dim", "shards", "k", "queries", "nprobe",
+                "rescore_mult", "clusters")
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_shard_bench(root: str) -> Optional[str]:
+    """Newest ``BENCH_SHARD_*`` under ``root`` (highest round wins,
+    mtime breaks ties) — the round convention every gate follows."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched is not None and matched[0] == "shard":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime,
+                               path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def shard_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the newest committed shard bench against ``shard.scatter``."""
+    budget = load_budgets(budgets_path).get("shard", {}).get("scatter")
+    if not isinstance(budget, dict):
+        return []
+    root = root or perf_root()
+    path = _newest_shard_bench(root)
+    if path is None:
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path="BENCH_SHARD",
+            message=(
+                "no sharded-serving bench recorded yet "
+                "(BENCH_SHARD_r*.json missing); run `python "
+                "scripts/chaos_drill.py --only shard --shard-out "
+                "BENCH_SHARD_rNN.json` (it reads the pinned recipe "
+                "from budgets.json 'shard') to stamp one"
+            ),
+        )]
+    label = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable shard bench: {e}",
+        )]
+
+    problems: List[str] = []
+    data: Dict = {"budget": "shard.scatter"}
+    section = doc.get("shard")
+    section = section if isinstance(section, dict) else {}
+    bench = section.get("bench")
+    bench = bench if isinstance(bench, dict) else {}
+    drill = section.get("drill")
+    drill = drill if isinstance(drill, dict) else {}
+    if not bench:
+        problems.append("record has no shard.bench section")
+    if not drill:
+        problems.append("record has no shard.drill section")
+
+    # -- the bench half: recipe-pinned recall/latency/degradation ------
+    pinned_recipe = budget.get("recipe") or {}
+    for key in _RECIPE_KEYS:
+        pinned = _get(pinned_recipe, key)
+        if pinned is None:
+            continue
+        measured = _get(bench, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"bench.{key} missing from the record")
+        elif measured != pinned:
+            problems.append(
+                f"bench measured with {key}={measured:g} but the "
+                f"budget pins {key}={pinned:g} — re-run the full "
+                "(non-smoke) shard drill"
+            )
+    want_index = pinned_recipe.get("index")
+    if want_index is not None and bench.get("index") != want_index:
+        problems.append(
+            f"bench measured with index={bench.get('index')!r} but "
+            f"the budget pins {want_index!r}"
+        )
+
+    recall = _get(bench, "recall_at_10")
+    floor = _get(budget, "min_recall_at_10")
+    data["recall_at_10"] = recall
+    data["min_recall_at_10"] = floor
+    if floor is not None:
+        if recall is None:
+            problems.append(
+                "bench.recall_at_10 missing from the record"
+            )
+        elif recall < floor:
+            problems.append(
+                f"all-shards-up recall@10 {recall:g} < budget "
+                f"{floor:g} — the cross-process merge is losing true "
+                "neighbors"
+            )
+    p99 = _get(bench, "p99_ms")
+    ceiling = _get(budget, "max_p99_ms")
+    data["p99_ms"] = p99
+    data["max_p99_ms"] = ceiling
+    if ceiling is not None:
+        if p99 is None:
+            problems.append("bench.p99_ms missing from the record")
+        elif p99 > ceiling:
+            problems.append(
+                f"merged p99 {p99:g} ms > budget {ceiling:g} ms at "
+                "the 10M-row geometry"
+            )
+    # graceful degradation is MEASURED: killing one shard must cost
+    # recall roughly that shard's row fraction — more means the merge
+    # loses extra answers, (much) less means the "dead" shard leaked in
+    tol = _get(budget, "recall_degradation_tolerance")
+    degraded = _get(bench, "degraded_recall_at_10")
+    frac = _get(bench, "dead_shard_row_fraction")
+    data["degraded_recall_at_10"] = degraded
+    data["dead_shard_row_fraction"] = frac
+    if tol is not None:
+        if degraded is None or frac is None or recall is None:
+            problems.append(
+                "bench degraded_recall_at_10 / dead_shard_row_fraction "
+                "missing from the record"
+            )
+        elif abs((recall - degraded) - frac) > tol:
+            problems.append(
+                f"recall drop with one shard dead ({recall:g} -> "
+                f"{degraded:g}) does not track its row fraction "
+                f"{frac:g} within ±{tol:g} — degradation is not "
+                "graceful"
+            )
+
+    # -- the drill half: availability + answer integrity ---------------
+    for key, kind in (
+        ("availability", "min"),
+        ("retry_amplification", "max"),
+    ):
+        bound = _get(budget, f"{kind}_{key}")
+        if bound is None:
+            continue
+        v = _get(drill, key)
+        data[key] = v
+        data[f"{kind}_{key}"] = bound
+        if v is None:
+            problems.append(f"drill.{key} missing from the record")
+        elif kind == "min" and v < bound:
+            problems.append(
+                f"drill {key} {v:g} < budget {bound:g}"
+            )
+        elif kind == "max" and v > bound:
+            problems.append(
+                f"drill {key} {v:g} > budget {bound:g}"
+            )
+    for key in ("server_5xx", "wrong_answers",
+                "mixed_iteration_answers"):
+        ceiling = _get(budget, f"max_{key}")
+        if ceiling is None:
+            continue
+        v = _get(drill, key)
+        data[key] = v
+        if v is None:
+            problems.append(f"drill.{key} missing from the record")
+        elif v > ceiling:
+            problems.append(
+                f"{int(v)} {key.replace('_', ' ')} recorded (budget "
+                f"{int(ceiling)}) — "
+                + ("a dead shard must degrade, never 5xx"
+                   if key == "server_5xx"
+                   else "answer integrity is broken in the shard path")
+            )
+    http_shards = _get(budget, "http_shards")
+    if http_shards is not None:
+        got = _get(drill, "shards")
+        data["http_shards"] = got
+        if got is None:
+            problems.append("drill.shards missing from the record")
+        elif got != http_shards:
+            problems.append(
+                f"drill ran {got:g} shards but the budget pins "
+                f"{http_shards:g}"
+            )
+
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "shard bench record violates budget 'shard.scatter': "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"sharded serving within budget 'shard.scatter': "
+            f"recall@10 {recall:g} all-up / {degraded:g} one-dead "
+            f"(row fraction {frac:g}), p99 {p99:g} ms, drill "
+            f"availability {data.get('availability')}"
+        ),
+        data=data,
+    )]

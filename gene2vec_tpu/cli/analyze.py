@@ -184,6 +184,12 @@ def _run(args) -> int:
         )
 
         findings.extend(autoscale_findings())
+        # ... and the fleet-sharded serving gate (BENCH_SHARD recall/
+        # p99/degradation + drill availability & answer integrity vs
+        # budgets.json "shard.scatter", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_shard import shard_findings
+
+        findings.extend(shard_findings())
 
     if args.hlo:
         _pin_cpu_backend()

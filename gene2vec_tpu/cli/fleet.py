@@ -136,6 +136,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="extra flag for ONE replica, as <index>:<flag> "
                         "(repeatable; the drill injects faults into a "
                         "single replica this way)")
+    p.add_argument("--shard-by-rows", type=int, default=0, metavar="N",
+                   help="fleet-sharded index serving: run N replicas "
+                        "each owning a CONTIGUOUS row shard of the "
+                        "table (+ its inverted lists), with the front "
+                        "door scatter-gathering /v1/similar across all "
+                        "shards and merging shard-local top-k "
+                        "(serve/shardgroup.py; docs/SERVING.md"
+                        "#sharded-index-serving).  Overrides "
+                        "--replicas; incompatible with --max-replicas "
+                        "(shards are a partition, not a pool).  Hot "
+                        "swap becomes shard-ATOMIC: every shard stages "
+                        "the new iteration, then all flip under one "
+                        "epoch token")
+    p.add_argument("--shard-deadline-ms", type=float, default=2000.0,
+                   help="per-shard scatter-leg deadline; a dead or "
+                        "slow shard costs at most this before the "
+                        "merge proceeds without it (the answer is "
+                        "flagged degraded, never a 5xx)")
+    p.add_argument("--swap-interval", type=float, default=2.0,
+                   help="seconds between the shard swap coordinator's "
+                        "export-dir polls (sharded mode only)")
     return p
 
 
@@ -164,6 +185,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         FleetProxy,
         FleetSupervisor,
     )
+
+    # validate the shard flags BEFORE paying N replica spawns
+    if args.shard_by_rows < 0:
+        print("error: --shard-by-rows must be >= 0", file=sys.stderr)
+        return 2
+    if args.shard_by_rows and args.max_replicas > 0:
+        print(
+            "error: --shard-by-rows and --max-replicas are "
+            "incompatible — shards partition one table (a fixed set), "
+            "autoscaling grows a pool of identical replicas",
+            file=sys.stderr,
+        )
+        return 2
+    if args.shard_by_rows:
+        args.replicas = args.shard_by_rows
 
     # validate the autoscale flags BEFORE paying N replica spawns
     autoscale_cfg = None
@@ -215,6 +251,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _on_term)
+    replica_args = parse_replica_args(args.replica_arg)
+    if args.shard_by_rows:
+        # replica slot i IS shard i: the per-index args survive
+        # supervisor restarts, so a respawned replica reloads exactly
+        # its own row range
+        for i in range(args.shard_by_rows):
+            replica_args.setdefault(i, []).extend(
+                ["--shard-index", str(i),
+                 "--num-shards", str(args.shard_by_rows)]
+            )
     supervisor = FleetSupervisor(
         args.export_dir,
         config=FleetConfig(
@@ -227,7 +273,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             storm_window_s=args.storm_window,
         ),
         serve_args=args.serve_arg,
-        replica_args=parse_replica_args(args.replica_arg),
+        replica_args=replica_args,
         metrics=run.registry,
         rng=random.Random(args.seed),
     )
@@ -278,6 +324,61 @@ def main(argv: Optional[List[str]] = None) -> int:
         acceptors=args.proxy_acceptors,
         alert_rules=alert_rules,
     )
+    coordinator = None
+    if args.shard_by_rows:
+        from gene2vec_tpu.serve.fleet import ReplicaState
+        from gene2vec_tpu.serve.shardgroup import (
+            RoutingTable,
+            ShardGroup,
+            ShardGroupConfig,
+            SwapCoordinator,
+        )
+
+        def shard_url(i: int):
+            for r in supervisor.replicas:
+                if (
+                    r.index == i and r.state == ReplicaState.UP
+                    and r.url
+                ):
+                    return r.url
+            return None
+
+        routing = RoutingTable(
+            args.export_dir, args.shard_by_rows, dim=None
+        )
+        if not routing.reload():
+            print(
+                "error: no verified checkpoint to derive the "
+                "gene->shard routing table from",
+                file=sys.stderr,
+            )
+            supervisor.stop()
+            run.close()
+            return 2
+        group = ShardGroup(
+            ShardGroupConfig(
+                num_shards=args.shard_by_rows,
+                shard_deadline_s=args.shard_deadline_ms / 1000.0,
+                default_timeout_s=args.proxy_timeout_ms / 1000.0,
+            ),
+            shard_url,
+            metrics=run.registry,
+            policy=RetryPolicy(
+                max_attempts=args.proxy_attempts,
+                default_timeout_s=args.shard_deadline_ms / 1000.0,
+                hedge=args.hedge,
+            ),
+            inflight=proxy.inflight,
+            routing=routing,
+        )
+        proxy.shard_group = group
+        coordinator = SwapCoordinator(
+            args.export_dir,
+            group,
+            interval_s=args.swap_interval,
+            metrics=run.registry,
+        )
+        coordinator.start()
     controller = None
     if autoscale_cfg is not None:
         from gene2vec_tpu.serve.autoscale import ElasticController
@@ -314,6 +415,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     }
                     if autoscale_cfg is not None else None
                 ),
+                "shards": (
+                    {
+                        "num_shards": args.shard_by_rows,
+                        "total_rows": proxy.shard_group.routing
+                        .total_rows,
+                        "ranges": [
+                            list(r) for r in
+                            proxy.shard_group.routing.ranges
+                        ],
+                    }
+                    if args.shard_by_rows else None
+                ),
             }
         ),
         flush=True,
@@ -341,6 +454,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # was exactly the drop scale-down exists to prevent
         if controller is not None:
             controller.stop()
+        if coordinator is not None:
+            coordinator.stop()
         proxy.stop()
         proxy.drain(timeout_s=args.drain_timeout)
         supervisor.stop()

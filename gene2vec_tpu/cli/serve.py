@@ -96,6 +96,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard-rows", action="store_true",
                    help="row-shard the table over every visible device "
                         "(parallel/sharding.py row_sharding)")
+    p.add_argument("--shard-index", type=int, default=None,
+                   help="serve ONE contiguous row shard of the table: "
+                        "this replica's shard index in [0, "
+                        "--num-shards).  Loads only the shard's rows + "
+                        "inverted lists, exposes the /v1/shard/* "
+                        "scatter + stage/flip surface, and DISABLES "
+                        "the self-swap watcher — hot swap becomes the "
+                        "fleet coordinator's shard-atomic stage/flip "
+                        "(serve/shardgroup.py; normally set by "
+                        "cli.fleet --shard-by-rows)")
+    p.add_argument("--num-shards", type=int, default=None,
+                   help="total shard count for --shard-index")
     p.add_argument("--index", choices=("exact", "quant", "ivf"),
                    default="exact",
                    help="retrieval index (serve/ann.py; docs/SERVING.md "
@@ -159,6 +171,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: bad tenant quota flags: {e}", file=sys.stderr)
         return 2
 
+    shard = None
+    if (args.shard_index is None) != (args.num_shards is None):
+        print(
+            "error: --shard-index and --num-shards go together",
+            file=sys.stderr,
+        )
+        return 2
+    if args.shard_index is not None:
+        if not 0 <= args.shard_index < args.num_shards:
+            print(
+                f"error: --shard-index {args.shard_index} outside "
+                f"[0, {args.num_shards})",
+                file=sys.stderr,
+            )
+            return 2
+        shard = (args.shard_index, args.num_shards)
+
     run_dir = args.run_dir or os.path.join(
         args.export_dir, "serve_runs", str(int(time.time()))
     )
@@ -192,6 +221,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.export_dir, dim=args.dim, sharding=sharding,
         metrics=run.registry, index_mode=args.index,
         ann_clusters=args.ann_clusters,
+        shard=shard,
     )
     if not registry.refresh():
         print(
@@ -201,7 +231,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         run.close()
         return 2
-    registry.start_watcher(args.poll_interval)
+    if shard is None:
+        registry.start_watcher(args.poll_interval)
+    else:
+        # shard mode: NO self-swap — the fleet's SwapCoordinator
+        # stages + flips every shard as one logical version; a replica
+        # swapping on its own poll cadence is exactly the
+        # mixed-iteration merge the epoch protocol exists to prevent
+        print(
+            f"shard {shard[0]}/{shard[1]}: self-swap watcher disabled "
+            "(coordinator-driven stage/flip)",
+            file=sys.stderr,
+        )
     app = ServeApp(
         registry,
         config=ServeConfig(
@@ -255,18 +296,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     run.event("serve_start", url=url, iteration=model.iteration)
     # the one stdout line is the machine-readable contract (loadgen
     # --spawn parses it); everything else goes to stderr
-    print(
-        json.dumps(
-            {
-                "url": url,
-                "dim": model.dim,
-                "iteration": model.iteration,
-                "run_dir": run.run_dir,
-                "index": args.index,
-            }
-        ),
-        flush=True,
-    )
+    contract = {
+        "url": url,
+        "dim": model.dim,
+        "iteration": model.iteration,
+        "run_dir": run.run_dir,
+        "index": args.index,
+    }
+    if shard is not None:
+        base = model.row_base
+        contract["shard"] = {
+            "index": shard[0],
+            "num_shards": shard[1],
+            "rows": [base, base + len(model)],
+            "total_rows": model.total_rows,
+            "epoch": model.epoch,
+        }
+    print(json.dumps(contract), flush=True)
     print(
         f"serving {args.export_dir} (dim {model.dim}, iteration "
         f"{model.iteration}, vocab {len(model)}) on {url}; "

@@ -452,7 +452,7 @@ class FleetAggregator:
         queue_depth = msum("serve_queue_depth")
         rejection_rate = (rejected / requests) if requests > 0 else 0.0
 
-        ok_total = total = throttled = 0.0
+        ok_total = total = throttled = degraded = 0.0
         if self.proxy_registry is not None:
             ok_total = self.proxy_registry.counter(
                 "fleet_proxy_ok_total"
@@ -463,7 +463,16 @@ class FleetAggregator:
             throttled = self.proxy_registry.counter(
                 "fleet_proxy_429_total"
             ).value
+            # sharded-fleet degradation: 200s built from a PARTIAL
+            # shard gather (serve/shardgroup.py).  Exported alongside
+            # the plain availability pair — and as the good-counter
+            # complement fleet_undegraded, so the degraded-burn alert
+            # rule can treat "complete answer" as the good event.
+            degraded = self.proxy_registry.counter(
+                "fleet_degraded_responses_total"
+            ).value
         availability = (ok_total / total) if total > 0 else 1.0
+        undegraded = max(0.0, total - degraded)
 
         # the flat snapshot handed to the alert evaluator: headline
         # values, the raw availability counter pair (burn-rate rules
@@ -482,6 +491,8 @@ class FleetAggregator:
             v.gauge("fleet_ok").set(ok_total)
             v.gauge("fleet_responses").set(total)
             v.gauge("fleet_throttled").set(throttled)
+            v.gauge("fleet_degraded").set(degraded)
+            v.gauge("fleet_undegraded").set(undegraded)
             v.gauge("fleet_availability").set(availability)
             v.gauge("fleet_stale_targets").set(len(stale))
             v.gauge("fleet_last_scrape_unix").set(scrape_wall)
@@ -515,6 +526,8 @@ class FleetAggregator:
                 "fleet_ok": ok_total,
                 "fleet_responses": total,
                 "fleet_throttled": throttled,
+                "fleet_degraded": degraded,
+                "fleet_undegraded": undegraded,
                 "fleet_quota_rejected": quota_rejected,
                 "fleet_stale_targets": float(len(stale)),
                 "_fresh_targets": float(ok_targets),

@@ -205,6 +205,20 @@ def default_rules() -> List[AlertRule]:
             for_s=15.0, clear_for_s=60.0,
         ),
         AlertRule(
+            name="degraded-burn", kind="burn_rate", severity="warn",
+            # sharded-fleet recall degradation (serve/shardgroup.py):
+            # a response assembled from a PARTIAL shard gather is a
+            # 200, so the availability burn never sees it — this rule
+            # pages on the complete-answer fraction instead.  The
+            # counter pair is proxy-local like availability-burn, so
+            # the staleness hold must not silence it; on an unsharded
+            # fleet fleet_degraded stays 0 and the rule never fires.
+            good="fleet_undegraded", total="fleet_responses",
+            max_bad_frac=0.05, short_window_s=30.0, long_window_s=300.0,
+            min_count=20.0, for_s=0.0, clear_for_s=60.0,
+            min_fresh_targets=0,
+        ),
+        AlertRule(
             name="rejection-rate", kind="threshold", severity="warn",
             metric="fleet_rejection_rate",
             op=">", value=0.05, clear_value=0.01,

@@ -335,10 +335,46 @@ def _adapt_ann(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "ann_recall_at_10"
 
 
+def _adapt_shard(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_SHARD_* (chaos_drill.py --only shard --shard-out): the
+    fleet-sharded index story in two halves — the 10M-row scatter-merge
+    bench (recall@10 vs the exact oracle with all shards up, degraded
+    recall with one shard removed, merge p99) and the HTTP chaos drill
+    (availability + answer integrity under a SIGKILLed shard and a
+    swap-under-load).  The ``perf.regression`` rules watch the recall
+    and p99 headline series."""
+    m: Dict[str, float] = {}
+    section = doc.get("shard")
+    section = section if isinstance(section, dict) else {}
+    bench = section.get("bench")
+    if isinstance(bench, dict):
+        _put(m, "shard_recall_at_10", bench.get("recall_at_10"))
+        _put(m, "shard_degraded_recall_at_10",
+             bench.get("degraded_recall_at_10"))
+        _put(m, "shard_dead_row_fraction",
+             bench.get("dead_shard_row_fraction"))
+        _put(m, "shard_p50_ms", bench.get("p50_ms"))
+        _put(m, "shard_p99_ms_10m", bench.get("p99_ms"))
+        _put(m, "shard_rows", bench.get("rows"))
+        _put(m, "shard_count", bench.get("shards"))
+    drill = section.get("drill")
+    if isinstance(drill, dict):
+        _put(m, "shard_availability", drill.get("availability"))
+        _put(m, "shard_wrong_answers", drill.get("wrong_answers"))
+        _put(m, "shard_mixed_iteration_answers",
+             drill.get("mixed_iteration_answers"))
+        _put(m, "shard_server_5xx", drill.get("server_5xx"))
+        _put(m, "shard_retry_amplification",
+             drill.get("retry_amplification"))
+    _put(m, "passed", doc.get("passed"))
+    return m, "shard_recall_at_10"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
+    (re.compile(r"^BENCH_SHARD_\w*\.json$"), "shard", _adapt_shard),
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),
     (re.compile(r"^BENCH_ALERTS_\w*\.json$"), "alerts", _adapt_alerts),
     (re.compile(r"^BENCH_AUTOSCALE_\w*\.json$"), "autoscale",

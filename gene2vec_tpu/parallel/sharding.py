@@ -115,6 +115,83 @@ def two_stage_topk(axis: str, scores: jax.Array, k: int, *,
     return fs, jnp.take_along_axis(gi_all, fi, axis=1)
 
 
+def shard_ranges(total_rows: int, num_shards: int,
+                 pad_to_multiple: bool = False):
+    """Contiguous ``[start, end)`` row ranges assigning ``total_rows``
+    to ``num_shards`` — the cross-process analogue of the mesh row
+    split.  Default is balanced (first ``total % n`` shards take the
+    ceiling), which is what the serving fleet uses;
+    ``pad_to_multiple=True`` reproduces the DEVICE layout instead
+    (every shard spans ``ceil(total/n)`` padded rows, trailing shards
+    may run past ``total_rows`` — their overhang is pad, masked by the
+    per-shard ``valid`` row count), which is what the bitwise-parity
+    tests against the in-mesh ``two_stage_topk`` need."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if total_rows < 0:
+        raise ValueError(f"total_rows must be >= 0, got {total_rows}")
+    if pad_to_multiple:
+        per = -(-total_rows // num_shards) if total_rows else 0
+        return [(i * per, (i + 1) * per) for i in range(num_shards)]
+    base, extra = divmod(total_rows, num_shards)
+    out = []
+    start = 0
+    for i in range(num_shards):
+        end = start + base + (1 if i < extra else 0)
+        out.append((start, end))
+        start = end
+    return out
+
+
+def shard_of_row(row: int, ranges) -> int:
+    """Owning shard index for a global row under ``ranges`` (the
+    gene→shard half of the front door's routing table)."""
+    for i, (start, end) in enumerate(ranges):
+        if start <= row < end:
+            return i
+    raise ValueError(f"row {row} outside every shard range")
+
+
+def merge_shard_topk(parts, k: int):
+    """Cross-PROCESS top-k merge: the gather+select stage of
+    :func:`two_stage_topk`, lifted off the mesh so the fleet front door
+    can merge shard-local candidate sets arriving over HTTP
+    (``serve/shardgroup.py``).
+
+    ``parts`` is a sequence — in shard order, exactly like the tiled
+    ``all_gather`` concatenates — of ``(scores, rows)`` pairs, each
+    ``(B, lk_i)`` float32 scores (descending per row, a shard-local
+    top-k) with matching GLOBAL row ids.  Returns ``(B, k')`` merged
+    scores + rows where ``k' = min(k, total candidates)``.
+
+    Selection semantics are ``lax.top_k``'s exactly — descending by
+    score, ties broken toward the earlier position in the concatenated
+    candidate axis — so the result is bitwise-identical to the in-mesh
+    ``two_stage_topk`` on the same table (the property test in
+    tests/test_shard.py holds this).  A dead shard simply contributes
+    no columns: the merge degrades to the exact answer over the live
+    shards' rows, never to a wrong one."""
+    import numpy as np
+
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        raise ValueError("merge_shard_topk needs at least one shard part")
+    scores = np.concatenate(
+        [np.asarray(s, dtype=np.float32) for s, _ in parts], axis=1
+    )
+    rows = np.concatenate(
+        [np.asarray(r) for _, r in parts], axis=1
+    )
+    k_eff = min(int(k), scores.shape[1])
+    # stable argsort on the negated scores == lax.top_k tie-breaking
+    # (equal scores keep candidate order, i.e. lower concat index wins)
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :k_eff]
+    return (
+        np.take_along_axis(scores, order, axis=1),
+        np.take_along_axis(rows, order, axis=1),
+    )
+
+
 def row_sharding(mesh: Mesh, axis: str = "model") -> NamedSharding:
     """Row-shard a (V, D) embedding matrix over ``axis`` — each device
     owns V/P contiguous vocab rows.  This is the serve engine's layout

@@ -529,6 +529,7 @@ class ResilientClient:
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
         inflight: Optional[InFlightTracker] = None,
+        budget: Optional[TokenBucket] = None,
     ):
         self._targets = targets
         self.policy = policy
@@ -551,7 +552,12 @@ class ResilientClient:
         self._breakers_lock = threading.Lock()
         self._latencies: "List[float]" = []
         self._lat_lock = threading.Lock()
-        self.budget = TokenBucket(
+        # ``budget`` may be SHARED across clients: the sharded front
+        # door (serve/shardgroup.py) hands every per-shard client one
+        # bucket, so a dead shard's retries draw down the same budget
+        # as every other shard's — the scatter cannot amplify attempts
+        # fleet-wide no matter how many shards are failing
+        self.budget = budget if budget is not None else TokenBucket(
             policy.retry_budget_ratio, policy.retry_budget_burst
         )
         self.stats: Dict[str, int] = {

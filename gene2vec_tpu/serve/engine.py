@@ -323,7 +323,37 @@ class BucketedTopKEngine:
             idx = np.asarray(idx)
         return scores[:n, :k], idx[:n, :k]
 
-    # -- model-level entry point -------------------------------------------
+    # -- model-level entry points ------------------------------------------
+
+    def topk_rows(
+        self,
+        model,
+        queries: np.ndarray,
+        k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Shard-local top-k with GLOBAL row ids: ``(n, k')`` float32
+        scores + int64 rows where ``k' = min(k, len(model))`` and rows
+        are offset by the snapshot's ``row_base`` — what a shard
+        replica returns for the front door's cross-process merge
+        (``parallel/sharding.py:merge_shard_topk``).  Routed through
+        the snapshot's ANN index exactly like :meth:`similar_batch`,
+        so a sharded fleet keeps the quant/IVF capacity win per
+        shard."""
+        queries = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        k = min(int(k), len(model))
+        index = getattr(model, "ann", None)
+        if self.index_mode != "exact" and index is not None:
+            scores, idx = self.top_k_ann(
+                index, model.unit, queries, k, valid=len(model)
+            )
+        else:
+            scores, idx = self.top_k(
+                model.unit, queries, k, valid=len(model)
+            )
+        rows = idx.astype(np.int64) + int(
+            getattr(model, "row_base", 0) or 0
+        )
+        return scores, rows
 
     def similar_batch(
         self,

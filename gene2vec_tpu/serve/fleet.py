@@ -52,7 +52,7 @@ import time
 import urllib.request
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from gene2vec_tpu.obs import tracecontext
 from gene2vec_tpu.obs.aggregate import FleetAggregator
@@ -704,7 +704,87 @@ class _ProxyAdapter:
             if err is not None:
                 peer.respond(err)
                 return
+        if proxy.shard_group is not None:
+            self._scatter_dispatch(req, peer, route, body)
+            return
         self._forward(req, peer, route, body)
+
+    # -- sharded mode: scatter-gather instead of round-robin ---------------
+
+    def _scatter_dispatch(self, req: HTTPRequest, peer: ConnHandle,
+                          route: str, body: Optional[dict]) -> None:
+        """Route the /v1 surface through the shard group
+        (serve/shardgroup.py): ``/v1/similar`` scatter-gathers every
+        shard, ``/v1/embedding`` routes to the owning shards,
+        ``/v1/genes`` answers from the manifest-derived routing table.
+        Same trace ingress as the round-robin path — the scatter's
+        per-shard attempts become sibling child spans under one
+        ``proxy_scatter`` span."""
+        proxy = self.proxy
+        group = proxy.shard_group
+        incoming = TraceContext.from_header(
+            req.headers.get("traceparent")
+        )
+        ctx = incoming.child() if incoming is not None else (
+            proxy.sampler.maybe_new_trace()
+            if proxy.sampler is not None else None
+        )
+        t0 = time.monotonic()
+        with tracecontext.use(ctx):
+            with ambient_span("proxy_request", route=route) as span:
+                if route == "/v1/similar":
+                    if req.method == "GET":
+                        q = parse_qs(urlparse(req.target).query)
+                        gene = q.get("gene", [None])[0]
+                        if gene is None:
+                            status, doc = 400, {
+                                "error": "missing ?gene= parameter"
+                            }
+                        else:
+                            try:
+                                k = int(q.get("k", ["10"])[0])
+                            except ValueError:
+                                k = -1  # rejected by validation below
+                            status, doc = group.similar(
+                                {"genes": [gene], "k": k}
+                            )
+                    else:
+                        status, doc = group.similar(body or {})
+                elif route == "/v1/embedding" and req.method == "POST":
+                    status, doc = group.embedding(body or {})
+                elif route == "/v1/genes" and req.method == "GET":
+                    q = parse_qs(urlparse(req.target).query)
+                    try:
+                        limit = int(q.get("limit", ["100"])[0])
+                        offset = int(q.get("offset", ["0"])[0])
+                    except ValueError:
+                        limit, offset = -1, -1
+                    if limit < 0 or offset < 0:
+                        status, doc = 400, {
+                            "error": "limit/offset must be >= 0"
+                        }
+                    else:
+                        status, doc = 200, group.routing.genes_doc(
+                            limit, offset
+                        )
+                elif route == "/v1/interaction":
+                    status, doc = 501, {
+                        "error": (
+                            "/v1/interaction is not supported with "
+                            "--shard-by-rows (gene pairs span shards; "
+                            "docs/SERVING.md#sharded-index-serving)"
+                        ),
+                    }
+                else:
+                    status, doc = 404, {
+                        "error": f"no route {req.method} {route}"
+                    }
+                span["status"] = status
+        proxy.account(route, status, time.monotonic() - t0,
+                      ctx.trace_id if ctx is not None else None)
+        peer.respond(Response(
+            status, json.dumps(doc).encode("utf-8")
+        ))
 
     def _forward(self, req: HTTPRequest, peer: ConnHandle, route: str,
                  body: Optional[dict]) -> None:
@@ -784,9 +864,15 @@ class FleetProxy:
         idle_timeout_s: float = 30.0,
         acceptors: int = 1,
         alert_rules=None,
+        shard_group=None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
+        #: serve/shardgroup.py ShardGroup — set when the fleet serves
+        #: row SHARDS of one table instead of N identical replicas;
+        #: flips the /v1 surface from round-robin forwarding to
+        #: scatter-gather (cli/fleet.py --shard-by-rows)
+        self.shard_group = shard_group
         self.read_timeout_s = read_timeout_s
         self.proxy_workers = proxy_workers
         self.idle_timeout_s = idle_timeout_s
@@ -884,6 +970,18 @@ class FleetProxy:
             "replicas_up": len(up),
             "replicas": states,
         }
+        if self.shard_group is not None:
+            # per-shard state: row range, rotation membership, and the
+            # epoch each shard was last seen serving — the operator's
+            # one-look view of a degraded or mid-swap fleet
+            up_idx = {
+                s["index"] for s in states
+                if s["state"] == ReplicaState.UP
+            }
+            doc["shards"] = self.shard_group.shard_states(
+                up_for=lambda i: i in up_idx
+            )
+            doc["epoch"] = self.shard_group.current_epoch
         return (200 if up else 503), doc
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:

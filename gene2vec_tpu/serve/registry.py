@@ -94,6 +94,19 @@ class LoadedModel:
     #: index together, so a reader can never score a new table against
     #: an old index or vice versa.
     ann: Optional["object"] = None
+    #: -- fleet-sharded serving (serve/shardgroup.py) -----------------
+    #: global row offset of this shard's first row (0 unsharded);
+    #: the engine's local indices + row_base are the GLOBAL ids the
+    #: front door merges across shards
+    row_base: int = 0
+    #: full-table row count (== len(self) unsharded); the routing
+    #: table's denominator
+    total_rows: Optional[int] = None
+    #: the shard-atomic swap token (== iteration; stamped only in
+    #: shard mode).  The front door refuses to merge shard answers
+    #: carrying different epochs — that is the whole no-mixed-
+    #: iteration contract, made checkable per response.
+    epoch: Optional[int] = None
 
     @property
     def version(self) -> Tuple[int, int]:
@@ -125,21 +138,28 @@ def discover_newest(
     return next(discover_candidates(export_dir, dim, verified_only), None)
 
 
-def _load_npz(path: str) -> Tuple[List[str], np.ndarray, Dict]:
-    with np.load(path) as z:
-        meta = json.loads(str(z["meta"])) if "meta" in z.files else {}
-        emb = np.asarray(z["emb"], dtype=np.float32)
-    vocab_path = os.path.join(os.path.dirname(path), "vocab.tsv")
+def _read_vocab_tokens(ckpt_path: str) -> List[str]:
+    """The vocab.tsv token list next to a checkpoint — id order IS
+    global row order (the routing-table contract)."""
+    vocab_path = os.path.join(os.path.dirname(ckpt_path), "vocab.tsv")
     tokens: List[str] = []
     with open(vocab_path, "r", encoding="utf-8") as f:
         for line in f:
             line = line.rstrip("\n")
             if line:
                 tokens.append(line.split("\t")[0])
+    return tokens
+
+
+def _load_npz(path: str) -> Tuple[List[str], np.ndarray, Dict]:
+    with np.load(path) as z:
+        meta = json.loads(str(z["meta"])) if "meta" in z.files else {}
+        emb = np.asarray(z["emb"], dtype=np.float32)
+    tokens = _read_vocab_tokens(path)
     if len(tokens) != emb.shape[0]:
         raise ValueError(
-            f"{path}: {emb.shape[0]} embedding rows vs {len(tokens)} vocab "
-            f"tokens in {vocab_path}"
+            f"{path}: {emb.shape[0]} embedding rows vs {len(tokens)} "
+            "vocab tokens in vocab.tsv"
         )
     return tokens, emb, meta
 
@@ -182,6 +202,7 @@ class ModelRegistry:
         index_mode: str = "exact",
         ann_clusters: Optional[int] = None,
         ann_seed: int = 0,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         from gene2vec_tpu.serve.ann import INDEX_MODES
 
@@ -190,6 +211,14 @@ class ModelRegistry:
                 f"index_mode must be one of {INDEX_MODES}, got "
                 f"{index_mode!r}"
             )
+        if shard is not None:
+            idx, n = int(shard[0]), int(shard[1])
+            if n < 1 or not 0 <= idx < n:
+                raise ValueError(
+                    f"shard must be (index, num_shards) with "
+                    f"0 <= index < num_shards, got {shard!r}"
+                )
+            shard = (idx, n)
         self.export_dir = export_dir
         self.dim = dim
         self.sharding = sharding
@@ -202,7 +231,13 @@ class ModelRegistry:
         self.index_mode = index_mode
         self.ann_clusters = ann_clusters
         self.ann_seed = ann_seed
+        #: (shard_index, num_shards) — load only this contiguous row
+        #: range of the table and its index; hot swap becomes
+        #: coordinator-driven (stage/flip below) so every shard flips
+        #: to a new iteration as ONE logical version
+        self.shard = shard
         self._model: Optional[LoadedModel] = None
+        self._staged: Optional[LoadedModel] = None
         self._refresh_lock = threading.Lock()
         self._watcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -242,11 +277,67 @@ class ModelRegistry:
         with ambient_span(
             "model_load", dim=dim, iteration=iteration, path=path
         ):
-            if path.endswith(".npz"):
-                tokens, emb, meta = _load_npz(path)
-            else:
-                tokens, emb = read_word2vec_format(path)
-                meta = {"dim": dim, "iteration": iteration, "format": "w2v"}
+            row_base = 0
+            epoch = None
+            sharded = self.shard is not None
+            loaded_slice = False
+            if sharded and path.endswith(".npz"):
+                # read ONLY this shard's contiguous row range — one
+                # seek + one read into the uncompressed npz member
+                # (io/checkpoint.py read_npz_rows).  The whole point
+                # of sharding is a table too big for one host; a load
+                # (or hot-swap stage) that transiently materialized
+                # the full matrix would OOM the very replicas sized
+                # for rows/num_shards.  Falls back to the full-load
+                # path on any structural surprise.
+                from gene2vec_tpu.io.checkpoint import read_npz_rows
+                from gene2vec_tpu.parallel.sharding import shard_ranges
+
+                idx, n = self.shard
+                try:
+                    _, total_rows = read_npz_rows(path, "emb", 0, 0)
+                    row_base, end = shard_ranges(total_rows, n)[idx]
+                    emb, _ = read_npz_rows(path, "emb", row_base, end)
+                    emb = np.asarray(emb, dtype=np.float32)
+                    loaded_slice = True
+                except ValueError:
+                    row_base = 0
+                if loaded_slice:
+                    with np.load(path) as z:
+                        # NpzFile members load lazily: this touches
+                        # only the tiny meta entry, never the tables
+                        meta = (
+                            json.loads(str(z["meta"]))
+                            if "meta" in z.files else {}
+                        )
+                    tokens = _read_vocab_tokens(path)
+                    if len(tokens) != total_rows:
+                        raise ValueError(
+                            f"{path}: {total_rows} embedding rows vs "
+                            f"{len(tokens)} vocab tokens"
+                        )
+                    tokens = tokens[row_base:end]
+            if not loaded_slice:
+                if path.endswith(".npz"):
+                    tokens, emb, meta = _load_npz(path)
+                else:
+                    tokens, emb = read_word2vec_format(path)
+                    meta = {
+                        "dim": dim, "iteration": iteration,
+                        "format": "w2v",
+                    }
+                total_rows = emb.shape[0]
+                if sharded:
+                    from gene2vec_tpu.parallel.sharding import (
+                        shard_ranges,
+                    )
+
+                    idx, n = self.shard
+                    row_base, end = shard_ranges(total_rows, n)[idx]
+                    tokens = tokens[row_base:end]
+                    emb = np.ascontiguousarray(emb[row_base:end])
+            if sharded:
+                epoch = iteration  # the swap token IS the iteration
             unit_np = l2_normalize(emb)
             pad = 0
             if self.sharding is not None:
@@ -260,6 +351,10 @@ class ModelRegistry:
                 # rebuilds and an unchanged table loads in milliseconds
                 from gene2vec_tpu.serve.ann import build_index
 
+                shard_tag = (
+                    f"_shard{self.shard[0]}of{self.shard[1]}"
+                    if self.shard is not None else ""
+                )
                 with ambient_span(
                     "ann_build", mode=self.index_mode, dim=dim,
                     iteration=iteration,
@@ -272,7 +367,7 @@ class ModelRegistry:
                         cache_dir=os.path.join(
                             self.export_dir, "ann_cache"
                         ),
-                        tag=f"dim{dim}_iter{iteration}",
+                        tag=f"dim{dim}_iter{iteration}{shard_tag}",
                         version=(dim, iteration),
                         sharding=self.sharding,
                         pad_rows=pad,
@@ -305,6 +400,9 @@ class ModelRegistry:
             source=path,
             meta=meta,
             ann=ann,
+            row_base=row_base,
+            total_rows=total_rows,
+            epoch=epoch,
         )
 
     @staticmethod
@@ -411,6 +509,66 @@ class ModelRegistry:
             self.metrics.gauge("model_iteration").set(model.iteration)
             self.metrics.gauge("model_vocab_size").set(len(model))
         return True
+
+    # -- shard-atomic staged swap (serve/shardgroup.py SwapCoordinator) ----
+
+    def stage(self, dim: int, iteration: int) -> LoadedModel:
+        """Load iteration ``(dim, iteration)`` into the STAGING slot
+        without serving it — step one of the fleet's shard-atomic swap.
+        Discovery is manifest-verified, so the bytes are CRC-checked
+        before any shard reports "staged"; the served model is
+        untouched.  Raises on any failure (the coordinator aborts the
+        whole swap — no shard flips unless every shard staged)."""
+        with self._refresh_lock:
+            staged = self._staged
+            if (
+                staged is not None
+                and staged.version == (dim, iteration)
+            ):
+                return staged  # idempotent: a coordinator retry is free
+            for d, it, path in discover_candidates(
+                self.export_dir, dim
+            ):
+                if (d, it) == (dim, iteration):
+                    model = self._load(d, it, path)
+                    self._staged = model
+                    if self.metrics is not None:
+                        self.metrics.gauge("model_staged_iteration").set(
+                            iteration
+                        )
+                    return model
+            raise FileNotFoundError(
+                f"no verified checkpoint dim={dim} iteration={iteration} "
+                f"in {self.export_dir!r} to stage"
+            )
+
+    def flip(self, epoch: int) -> LoadedModel:
+        """Atomically swap the staged model in, stamped with the
+        fleet's ``epoch`` token — step two of the shard-atomic swap,
+        issued by the coordinator only after EVERY shard staged.  One
+        reference assignment, same atomicity as :meth:`refresh`.
+        Idempotent when the served model already carries ``epoch``;
+        raises when nothing matching is staged (the coordinator
+        re-stages and retries)."""
+        with self._refresh_lock:
+            cur = self._model
+            if cur is not None and cur.epoch == epoch:
+                return cur
+            staged = self._staged
+            if staged is None or staged.iteration != epoch:
+                raise RuntimeError(
+                    f"no staged model for epoch {epoch} "
+                    f"(staged: {staged.version if staged else None})"
+                )
+            model = dataclasses.replace(staged, epoch=epoch)
+            self._model = model
+            self._staged = None
+        if self.metrics is not None:
+            self.metrics.counter("model_swaps_total").inc()
+            self.metrics.gauge("model_iteration").set(model.iteration)
+            self.metrics.gauge("model_epoch").set(epoch)
+            self.metrics.gauge("model_vocab_size").set(len(model))
+        return model
 
     # -- watching ----------------------------------------------------------
 

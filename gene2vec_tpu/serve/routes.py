@@ -12,3 +12,13 @@ from __future__ import annotations
 V1_ROUTES = frozenset((
     "/v1/genes", "/v1/similar", "/v1/embedding", "/v1/interaction",
 ))
+
+#: the shard-replica control/scatter surface (serve/shardgroup.py):
+#: ``topk`` and ``vectors`` are the scatter data plane, ``stage`` and
+#: ``flip`` the coordinator's two-step shard-atomic hot swap.  Kept
+#: separate from V1_ROUTES so an unsharded fleet's label set is
+#: unchanged; the replica server unions both for its latency labels.
+SHARD_ROUTES = frozenset((
+    "/v1/shard/topk", "/v1/shard/vectors", "/v1/shard/stage",
+    "/v1/shard/flip",
+))

@@ -70,7 +70,7 @@ from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.registry import MetricsRegistry
 from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
-from gene2vec_tpu.serve.routes import V1_ROUTES
+from gene2vec_tpu.serve.routes import SHARD_ROUTES, V1_ROUTES
 from gene2vec_tpu.serve.batcher import (
     DeadlineExceeded,
     LRUCache,
@@ -168,7 +168,7 @@ class ServeConfig:
 
 #: routes whose latency gets its own labeled histogram series; anything
 #: else collapses into "other" so garbage paths can't mint label sets
-_KNOWN_ROUTES = V1_ROUTES | frozenset((
+_KNOWN_ROUTES = V1_ROUTES | SHARD_ROUTES | frozenset((
     "/", "/livez", "/healthz", "/metrics",
 ))
 
@@ -500,6 +500,173 @@ class ServeApp:
             ],
         }
 
+    # -- shard data/control plane (serve/shardgroup.py scatter-gather) -----
+
+    def _shard_facts(self, model) -> dict:
+        base = int(getattr(model, "row_base", 0) or 0)
+        return {
+            "index": self.registry.shard[0],
+            "num_shards": self.registry.shard[1],
+            "rows": [base, base + len(model)],
+            "total_rows": getattr(model, "total_rows", None),
+            "epoch": getattr(model, "epoch", None),
+            "iteration": model.iteration,
+        }
+
+    def _require_shard(self) -> None:
+        if self.registry.shard is None:
+            raise ApiError(
+                404,
+                "this replica is not sharded (/v1/shard/* needs "
+                "cli.serve --shard-index/--num-shards)",
+            )
+
+    def shard_topk(self, body: dict) -> dict:
+        """Shard-local top-k over this replica's row range, with GLOBAL
+        row ids — one leg of the front door's scatter.  ``vectors`` are
+        scored directly; ``genes`` must be OWNED by this shard (the
+        routing table sends gene resolution to the owner).  An
+        ``epoch`` in the body is the caller's merge target: answering
+        from a different epoch is refused with 409 so a mid-swap shard
+        can never leak rows from another iteration into a merge."""
+        self._require_shard()
+        model = self._model_or_503()
+        # max_k + 1 headroom: a front-door gene query fetches k+1 so
+        # dropping the self-hit still leaves k — k=max_k through the
+        # scatter must not 400 here when it is valid on a replica
+        k = body.get("k", 10)
+        if not isinstance(k, int) or not 1 <= k <= self.config.max_k + 1:
+            raise ApiError(
+                400, f"k must be an int in [1, {self.config.max_k + 1}]"
+            )
+        want_epoch = body.get("epoch")
+        if want_epoch is not None and want_epoch != model.epoch:
+            raise ApiError(
+                409,
+                f"epoch mismatch: serving {model.epoch}, caller wants "
+                f"{want_epoch}",
+            )
+        vectors = body.get("vectors")
+        genes = body.get("genes")
+        if (genes is None) == (vectors is None):
+            raise ApiError(
+                400, "provide exactly one of 'genes' or 'vectors'"
+            )
+        queries: List[np.ndarray] = []
+        if vectors is not None:
+            if not isinstance(vectors, list) or not vectors:
+                raise ApiError(400, "'vectors' must be a non-empty list")
+            for v in vectors:
+                if not isinstance(v, list) or len(v) != model.dim:
+                    raise ApiError(
+                        400, f"each vector must have dim {model.dim}"
+                    )
+                queries.append(np.asarray(v, dtype=np.float32))
+        else:
+            if not isinstance(genes, list) or not genes:
+                raise ApiError(400, "'genes' must be a non-empty list")
+            for g in genes:
+                row = model.index.get(g)
+                if row is None:
+                    raise ApiError(
+                        400,
+                        f"gene {g!r} is not owned by shard "
+                        f"{self.registry.shard[0]}",
+                    )
+                queries.append(model.emb[row])
+        if len(queries) > self.config.max_queries_per_request:
+            raise ApiError(
+                400,
+                f"at most {self.config.max_queries_per_request} queries "
+                "per request",
+            )
+        with ambient_span(
+            "shard_topk", n=len(queries), k=k,
+            shard=self.registry.shard[0],
+        ):
+            scores, rows = self.engine.topk_rows(
+                model, np.stack(queries), k
+            )
+        tokens = model.tokens
+        base = int(getattr(model, "row_base", 0) or 0)
+        return {
+            "shard": self._shard_facts(model),
+            "results": [
+                {
+                    "rows": [int(r) for r in row_ids],
+                    "scores": [round(float(s), 6) for s in row_scores],
+                    "tokens": [
+                        tokens[int(r) - base] for r in row_ids
+                    ],
+                }
+                for row_scores, row_ids in zip(scores, rows)
+            ],
+        }
+
+    def shard_vectors(self, body: dict) -> dict:
+        """Resolve OWNED genes to their raw embedding vectors — the
+        front door's gene→vector step before a vector scatter.  Genes
+        outside this shard's range are the caller's routing bug →
+        400."""
+        self._require_shard()
+        model = self._model_or_503()
+        genes = body.get("genes")
+        if not isinstance(genes, list) or not genes:
+            raise ApiError(400, "'genes' must be a non-empty list")
+        vectors = []
+        for g in genes:
+            row = model.index.get(g)
+            if row is None:
+                raise ApiError(
+                    400,
+                    f"gene {g!r} is not owned by shard "
+                    f"{self.registry.shard[0]}",
+                )
+            vectors.append([float(v) for v in model.emb[row]])
+        return {
+            "shard": self._shard_facts(model),
+            "vectors": vectors,
+        }
+
+    def shard_stage(self, body: dict) -> dict:
+        """Stage (load + CRC-verify, do NOT serve) one iteration — the
+        coordinator calls this on every shard before any shard flips.
+        Failure → 503 so the coordinator aborts the swap."""
+        self._require_shard()
+        dim = body.get("dim")
+        iteration = body.get("iteration")
+        if not isinstance(dim, int) or not isinstance(iteration, int):
+            raise ApiError(400, "'dim' and 'iteration' must be ints")
+        try:
+            staged = self.registry.stage(dim, iteration)
+        except Exception as e:
+            raise ApiError(
+                503, f"stage of dim={dim} iter={iteration} failed: {e!r}"
+            ) from e
+        return {
+            "staged": {
+                "dim": staged.dim,
+                "iteration": staged.iteration,
+                "rows": len(staged),
+                "total_rows": staged.total_rows,
+            },
+        }
+
+    def shard_flip(self, body: dict) -> dict:
+        """Atomically swap the staged iteration in under the fleet's
+        epoch token — the coordinator issues this only after EVERY
+        shard staged.  409 when nothing matching is staged (the
+        coordinator re-stages)."""
+        self._require_shard()
+        epoch = body.get("epoch")
+        if not isinstance(epoch, int):
+            raise ApiError(400, "'epoch' must be an int")
+        try:
+            model = self.registry.flip(epoch)
+        except RuntimeError as e:
+            raise ApiError(409, str(e)) from e
+        return {"shard": self._shard_facts(model)}
+
     @staticmethod
     def _int_param(query: Dict[str, List[str]], name: str,
                    default: int) -> int:
@@ -570,6 +737,8 @@ class ServeApp:
             "source": m.source,
         }
         out["index"] = self.engine.index_mode
+        if self.registry.shard is not None:
+            out["shard"] = self._shard_facts(m)
         if self.tenants is not None:
             out["tenancy"] = {
                 "default_rate": self.tenants.policy.default.rate,
@@ -617,6 +786,14 @@ class ServeApp:
             return 200, self.embedding(body or {})
         if method == "POST" and route == "/v1/interaction":
             return 200, self.interaction(body or {})
+        if method == "POST" and route == "/v1/shard/topk":
+            return 200, self.shard_topk(body or {})
+        if method == "POST" and route == "/v1/shard/vectors":
+            return 200, self.shard_vectors(body or {})
+        if method == "POST" and route == "/v1/shard/stage":
+            return 200, self.shard_stage(body or {})
+        if method == "POST" and route == "/v1/shard/flip":
+            return 200, self.shard_flip(body or {})
         return 404, {"error": f"no route {method} {route}"}
 
     def handle(

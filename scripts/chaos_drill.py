@@ -53,6 +53,21 @@ Five phases (docs/RESILIENCE.md runbook):
   Stamped into ``BENCH_AUTOSCALE_r14.json`` via ``--autoscale-out``
   and gated by ``analysis/passes_autoscale.py`` (budgets.json
   ``autoscale``).
+* **shard** — fleet-sharded index serving
+  (docs/SERVING.md#sharded-index-serving): an in-process 10M-row
+  scatter-merge bench (per-shard IVF+int8 indexes + the cross-process
+  ``merge_shard_topk``; recall@10 vs the exact oracle all-up AND with
+  one shard removed — the drop must track that shard's row fraction),
+  then the real ``cli.fleet --shard-by-rows``: SIGKILL one shard
+  mid-load (availability >= 0.99 with ZERO 5xx — dead-shard answers
+  are flagged degraded 200s scored against the exact restricted
+  oracle; full recall after the supervisor restart), a
+  swap-under-load through the shard-atomic stage/flip coordinator
+  (ZERO wrong or mixed-iteration answers — the epoch fence), a
+  reassembled ``proxy_scatter`` trace, and a slow-loris shard (p99
+  bounded by the per-shard deadline, not the fault).  Stamped into
+  ``BENCH_SHARD_r15.json`` via ``--shard-out`` and gated by
+  ``analysis/passes_shard.py`` (budgets.json ``shard``).
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -1596,8 +1611,760 @@ def drill_async_overhead(tmp: str, budget: dict) -> dict:
 # -- driver ------------------------------------------------------------------
 
 
+# -- phase: fleet-sharded index serving --------------------------------------
+
+
+def _shard_merge_bench(budget: dict, smoke: bool, seed: int) -> dict:
+    """The scatter-merge half of the shard phase, in-process: split a
+    synthetic clustered table (10M rows at the budget recipe; reduced
+    under --smoke) into contiguous row shards, build each shard's OWN
+    IVF+int8 index exactly as a shard replica does, run queries through
+    the per-shard engines + the cross-process merge, and score recall
+    against the exact full-table oracle — all shards up AND with one
+    shard removed from the merge (graceful degradation must track the
+    dead shard's row fraction)."""
+    import jax.numpy as jnp
+
+    from bench import _ann_clustered_table
+    from gene2vec_tpu.parallel.sharding import (
+        merge_shard_topk,
+        shard_ranges,
+    )
+    from gene2vec_tpu.serve.ann import build_index
+    from gene2vec_tpu.serve.engine import SimilarityEngine
+
+    recipe = budget["recipe"]
+    rows = 64000 if smoke else int(recipe["rows"])
+    clusters = 256 if smoke else int(recipe["clusters"])
+    dim = int(recipe["dim"])
+    shards = int(recipe["shards"])
+    k = int(recipe["k"])
+    n_queries = 128 if smoke else int(recipe["queries"])
+    nprobe = int(recipe["nprobe"])
+    rescore_mult = int(recipe["rescore_mult"])
+    latency_reps = 30 if smoke else 100
+
+    log(f"shard bench: {rows:,} x {dim} over {shards} shards "
+        f"(clusters {clusters}, nprobe {nprobe})")
+    t_build0 = time.monotonic()
+    table = _ann_clustered_table(rows, dim, clusters, seed)
+    qrng = np.random.RandomState(seed + 1)
+    q_idx = qrng.randint(0, rows, n_queries)
+    queries = np.ascontiguousarray(table[q_idx])
+
+    # exact oracle: chunked full-table top-k (a merge of chunk-local
+    # top-ks IS the exact answer — merge_shard_topk is exact).
+    # argpartition + a small sort per chunk: a full 134M-element
+    # argsort per chunk takes this single-core host ~a minute each
+    def oracle_rows(cols_ranges, kk):
+        parts = []
+        step = 262144
+        for s0, e0 in cols_ranges:
+            for s in range(s0, e0, step):
+                e = min(s + step, e0)
+                scores = (queries @ table[s:e].T).astype(np.float32)
+                lk = min(kk, e - s)
+                cand = np.argpartition(
+                    -scores, lk - 1, axis=1
+                )[:, :lk]
+                cs = np.take_along_axis(scores, cand, axis=1)
+                order = np.argsort(-cs, axis=1, kind="stable")
+                parts.append((
+                    np.take_along_axis(cs, order, axis=1),
+                    np.take_along_axis(cand, order, axis=1)
+                    .astype(np.int64) + s,
+                ))
+        return merge_shard_topk(parts, kk)[1]
+
+    t0 = time.monotonic()
+    oracle = oracle_rows([(0, rows)], k)
+    oracle_s = time.monotonic() - t0
+    log(f"exact oracle over {rows:,} rows in {oracle_s:.1f}s")
+
+    # per-shard replicas, in miniature: slice + per-shard IVF index +
+    # the same bucketed engine a shard replica serves from
+    ranges = shard_ranges(rows, shards)
+    per_shard_clusters = max(8, clusters // shards)
+    shard_engines = []
+    for s, e in ranges:
+        sl = np.ascontiguousarray(table[s:e])
+        index = build_index(
+            sl, "ivf", clusters=per_shard_clusters, seed=seed,
+        )
+        engine = SimilarityEngine(
+            max_batch=max(1, n_queries), index="ivf",
+            nprobe=nprobe, rescore_mult=rescore_mult,
+        )
+        shard_engines.append((engine, index, jnp.asarray(sl), (s, e)))
+    build_s = time.monotonic() - t_build0
+    log(f"{shards} shard indexes built "
+        f"({per_shard_clusters} clusters each) in {build_s:.1f}s total")
+
+    def scatter(kk, exclude=None, qs=None):
+        qs = queries if qs is None else qs
+        parts = []
+        for i, (engine, index, unit, (s, e)) in enumerate(
+            shard_engines
+        ):
+            if i == exclude:
+                continue
+            scores, lidx = engine.top_k_ann(
+                index, unit, qs, min(kk, e - s)
+            )
+            parts.append((scores, lidx.astype(np.int64) + s))
+        return merge_shard_topk(parts, kk)[1]
+
+    def recall(got, want):
+        hits = sum(
+            len(set(map(int, g)) & set(map(int, w)))
+            for g, w in zip(got, want)
+        )
+        return hits / float(want.shape[0] * want.shape[1])
+
+    merged = scatter(k)
+    recall_all = recall(merged, oracle)
+
+    dead = 0
+    dead_frac = (ranges[dead][1] - ranges[dead][0]) / float(rows)
+    degraded_recall = recall(scatter(k, exclude=dead), oracle)
+    log(f"recall@{k}: all-up {recall_all:.4f}, shard {dead} dead "
+        f"{degraded_recall:.4f} (row fraction {dead_frac:.3f})")
+
+    # single-query latency through the whole scatter+merge (the shard
+    # kernels run sequentially in-process — an upper bound on the
+    # parallel-fleet scatter, which pays max-over-shards, not the sum)
+    scatter(k, qs=queries[:1])  # warm the batch-1 bucket per shard
+    lat = []
+    for i in range(latency_reps):
+        q = queries[i % n_queries: i % n_queries + 1]
+        t0 = time.perf_counter()
+        scatter(k, qs=q)
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    arr = np.asarray(lat)
+    out = {
+        "rows": rows, "dim": dim, "shards": shards, "k": k,
+        "queries": n_queries, "index": "ivf", "nprobe": nprobe,
+        "rescore_mult": rescore_mult, "clusters": clusters,
+        "per_shard_clusters": per_shard_clusters,
+        "recall_at_10": round(float(recall_all), 5),
+        "degraded_recall_at_10": round(float(degraded_recall), 5),
+        "dead_shard_row_fraction": round(float(dead_frac), 5),
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "latency_reps": latency_reps,
+        "oracle_seconds": round(oracle_s, 2),
+        "build_seconds": round(build_s, 2),
+        "latency_model": "sequential-shard-sum (upper bound)",
+    }
+    if not smoke:
+        assert recall_all >= float(budget["min_recall_at_10"]), (
+            f"all-shards-up recall {recall_all:.4f} below budget"
+        )
+        tol = float(budget["recall_degradation_tolerance"])
+        assert abs((recall_all - degraded_recall) - dead_frac) <= tol, (
+            f"degradation {recall_all - degraded_recall:.4f} does not "
+            f"track row fraction {dead_frac:.4f}"
+        )
+        assert out["p99_ms"] <= float(budget["max_p99_ms"]), (
+            f"merged p99 {out['p99_ms']}ms over budget"
+        )
+    return out
+
+
+def _shard_oracle(emb: np.ndarray, tokens, qvec, k: int, cols,
+                  exclude_token=None):
+    """Exact neighbor-token list for one query over the rows in
+    ``cols`` — the drill's local referee for full AND degraded
+    (restricted-to-live-shards) answers."""
+    from gene2vec_tpu.serve.registry import l2_normalize
+
+    unit = l2_normalize(emb)
+    q = l2_normalize(np.asarray([qvec], np.float32))[0]
+    cols = np.asarray(sorted(cols))
+    scores = unit[cols] @ q
+    order = np.argsort(-scores, kind="stable")
+    out = []
+    for j in order:
+        tok = tokens[int(cols[j])]
+        if tok == exclude_token:
+            continue
+        out.append(tok)
+        if len(out) >= k:
+            break
+    return out
+
+
+def drill_shard(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """The fleet-sharded serving phase: the in-process 10M merge bench
+    plus the real-CLI HTTP drill — SIGKILL one shard mid-load (degraded
+    200s, never 5xx; recall recovers after restart), swap-under-load
+    through the shard-atomic stage/flip (zero wrong / mixed-iteration
+    answers), and a slow-loris shard (per-shard deadline fires, p99
+    stays bounded)."""
+    import threading
+
+    from gene2vec_tpu.obs import flight as flight_mod
+    from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    result: dict = {"bench": _shard_merge_bench(budget, smoke, seed)}
+
+    shards = int(budget.get("http_shards", 2))
+    vocab, dim, k = 60, 8, 4
+    export_dir = os.path.join(tmp, "shard_export")
+    _write_iteration(export_dir, 1, vocab_size=vocab, dim=dim)
+    # _write_iteration derives the table from RandomState(iteration):
+    # recompute it locally so the drill can referee every answer
+    embs = {1: np.random.RandomState(1).randn(vocab, dim)
+            .astype(np.float32)}
+    tokens = [f"G{i}" for i in range(vocab)]
+    duration_s = 6.0 if smoke else 10.0
+    workers = 3
+
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir,
+        "--shard-by-rows", str(shards),
+        "--port", "0", "--health-interval", "0.25",
+        "--unhealthy-after", "2", "--backoff-base", "0.3",
+        "--swap-interval", "0.4", "--scrape-interval", "0.5",
+        "--proxy-timeout-ms", "4000",
+        "--shard-deadline-ms", "1500",
+        "--seed", str(seed),
+    ]
+    log(f"spawning sharded fleet: {shards} row shards over "
+        f"{vocab} rows")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        ranges = [tuple(r) for r in info["shards"]["ranges"]]
+        assert info["shards"]["total_rows"] == vocab
+        log(f"sharded front door at {url}; ranges {ranges}")
+
+        client = ResilientClient(
+            [url],
+            RetryPolicy(
+                max_attempts=3, default_timeout_s=6.0,
+                read_timeout_s=6.0, trace_sample=1.0,
+            ),
+        )
+
+        def oracle(it, qvec, kk, live_shards, exclude_token=None):
+            cols = [
+                c for si in live_shards
+                for c in range(ranges[si][0], ranges[si][1])
+            ]
+            return _shard_oracle(
+                embs[it], tokens, qvec, kk, cols, exclude_token
+            )
+
+        query_genes = [f"G{i}" for i in range(0, vocab, 4)]
+        all_shards = list(range(shards))
+
+        # E2E merge sanity + qvec warm-up: the front door's answer for
+        # every query gene must equal the local exact oracle
+        for g in query_genes:
+            r = client.request(
+                "/v1/similar", {"genes": [g], "k": k}, timeout_s=10.0
+            )
+            assert r.ok, f"warmup query failed: {r.error_class}"
+            doc = r.doc
+            assert doc["degraded"] is False
+            got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+            want = oracle(
+                1, embs[1][int(g[1:])], k, all_shards, exclude_token=g
+            )
+            assert got == want, (
+                f"scatter answer for {g} diverges from the exact "
+                f"oracle: {got} vs {want}"
+            )
+        log(f"{len(query_genes)} scatter answers match the exact "
+            "oracle end-to-end")
+
+        # ---- sub-phase A: SIGKILL one shard mid-load ----------------
+        counts = {"total": 0, "ok": 0, "degraded": 0, "failed": 0,
+                  "wrong": 0, "mixed": 0, "server_5xx": 0,
+                  "degraded_wrong": 0, "unresolved": 0,
+                  "attempts": 0, "retries": 0}
+        degraded_recalls = []
+        trace_ids = []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + duration_s
+        victim_shard = 1
+        live_after_kill = [s for s in all_shards if s != victim_shard]
+
+        def check_answer(doc, it_expected, qvec, gene, killed) -> None:
+            """Referee one 200 body against the local oracle (full or
+            restricted to the shards that answered)."""
+            it = doc["model"]["iteration"]
+            if it != it_expected:
+                counts["mixed"] += 1
+                return
+            res0 = doc["results"][0]
+            got = [n["gene"] for n in res0["neighbors"]]
+            if doc.get("degraded"):
+                counts["degraded"] += 1
+                if res0.get("degraded") and not got:
+                    # honest empty answer: the query gene's owner is
+                    # dead and its vector was never cached — a partial
+                    # answer with nothing to merge, flagged as such
+                    counts["unresolved"] += 1
+                    counts["ok"] += 1
+                    return
+                answered = doc["shards"]["indexes"]
+                want = oracle(it, qvec, k, answered, exclude_token=gene)
+                if got != want:
+                    counts["degraded_wrong"] += 1
+                full = oracle(it, qvec, k, all_shards,
+                              exclude_token=gene)
+                degraded_recalls.append(
+                    len(set(got) & set(full)) / float(k)
+                )
+            else:
+                want = oracle(it, qvec, k, all_shards,
+                              exclude_token=gene)
+                if got != want:
+                    counts["wrong"] += 1
+                    return
+            counts["ok"] += 1
+
+        def worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while time.monotonic() < stop_at:
+                use_gene = wrng.rand() < 0.5
+                row = int(wrng.randint(vocab))
+                if use_gene:
+                    gene = tokens[row]
+                    body = {"genes": [gene], "k": k}
+                else:
+                    gene = None
+                    body = {"vectors": [[float(x)
+                                         for x in embs[1][row]]],
+                            "k": k}
+                r = client.request("/v1/similar", body, timeout_s=6.0)
+                with lock:
+                    counts["total"] += 1
+                    counts["attempts"] += r.attempts
+                    counts["retries"] += r.retries
+                    if r.trace_id:
+                        trace_ids.append(r.trace_id)
+                    if r.status >= 500 and r.target is not None:
+                        counts["server_5xx"] += 1
+                    if not r.ok or r.doc is None:
+                        counts["failed"] += 1
+                        continue
+                    check_answer(r.doc, 1, embs[1][row], gene,
+                                 killed=True)
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(duration_s / 3.0)
+        victim_pid = info["replica_pids"][victim_shard]
+        log(f"SIGKILL shard {victim_shard} (pid {victim_pid}) mid-load")
+        os.kill(victim_pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=duration_s + 30.0)
+
+        availability = counts["ok"] / max(counts["total"], 1)
+        prom = _parse_prom_counters(
+            urllib.request.urlopen(url + "/metrics", timeout=10.0)
+            .read().decode("utf-8")
+        )
+        amplification = (
+            (counts["attempts"] + prom.get(
+                "fleet_client_retries_total", 0.0
+            ) + prom.get("fleet_client_hedges_total", 0.0))
+            / max(counts["total"], 1)
+        )
+        dead_frac = (
+            (ranges[victim_shard][1] - ranges[victim_shard][0])
+            / float(vocab)
+        )
+        mean_deg_recall = (
+            float(np.mean(degraded_recalls)) if degraded_recalls
+            else None
+        )
+        log(
+            f"dead-shard window: availability {availability:.4f} over "
+            f"{counts['total']} requests, {counts['degraded']} "
+            f"degraded (mean recall {mean_deg_recall}), "
+            f"{counts['server_5xx']} server 5xx, amplification "
+            f"{amplification:.3f}"
+        )
+        assert counts["total"] >= workers * duration_s / 2, (
+            "suspiciously few requests completed — the load loop wedged"
+        )
+        assert counts["server_5xx"] == 0, (
+            f"{counts['server_5xx']} 5xx responses — a dead shard must "
+            "degrade, never fail the query"
+        )
+        assert counts["degraded"] > 0, (
+            "no degraded responses observed — the kill window missed"
+        )
+        assert counts["wrong"] == 0 and counts["degraded_wrong"] == 0, (
+            f"{counts['wrong']} full + {counts['degraded_wrong']} "
+            "degraded answers diverged from the exact oracle"
+        )
+        assert counts["mixed"] == 0, (
+            f"{counts['mixed']} answers claimed an unexpected "
+            "iteration during the dead-shard window"
+        )
+        assert availability >= float(budget["min_availability"]), (
+            f"availability {availability:.4f} below budget"
+        )
+        assert amplification <= float(
+            budget["max_retry_amplification"]
+        ), f"retry amplification {amplification:.3f} over budget"
+        if mean_deg_recall is not None and len(degraded_recalls) >= 20:
+            drop = 1.0 - mean_deg_recall
+            assert abs(drop - dead_frac) <= 0.35, (
+                f"degraded recall drop {drop:.3f} does not track the "
+                f"dead shard's row fraction {dead_frac:.3f}"
+            )
+
+        # recovery: the supervisor restarts the shard, the coordinator
+        # repairs its epoch, and FULL recall returns
+        def recovered():
+            try:
+                h = _http_json(url + "/healthz", timeout=5.0)
+            except Exception:
+                return False
+            if not all(s["up"] for s in h.get("shards", [])):
+                return False
+            r = client.request(
+                "/v1/similar",
+                {"genes": [query_genes[0]], "k": k}, timeout_s=6.0,
+            )
+            return bool(r.ok and r.doc and not r.doc["degraded"])
+
+        wait_until(recovered, 120.0, interval_s=0.5,
+                   what="dead shard restarted + full recall")
+        r = client.request(
+            "/v1/similar", {"genes": [query_genes[1]], "k": k},
+            timeout_s=6.0,
+        )
+        got = [n["gene"] for n in r.doc["results"][0]["neighbors"]]
+        g = query_genes[1]
+        assert got == oracle(1, embs[1][int(g[1:])], k, all_shards,
+                             exclude_token=g)
+        log("shard restarted; full recall recovered")
+
+        # ---- sub-phase B: shard-atomic swap under load --------------
+        swap_counts = {"total": 0, "ok": 0, "failed": 0, "wrong": 0,
+                       "mixed": 0, "degraded_wrong": 0, "degraded": 0,
+                       "server_5xx": 0, "attempts": 0, "retries": 0}
+        iterations_seen = set()
+        swap_window = 5.0 if smoke else 8.0
+        stop_at = time.monotonic() + swap_window
+        embs[2] = np.random.RandomState(2).randn(vocab, dim) \
+            .astype(np.float32)
+
+        def swap_check(doc, qvec_by_iter, gene) -> None:
+            it = doc["model"]["iteration"]
+            if it not in qvec_by_iter:
+                swap_counts["mixed"] += 1
+                return
+            iterations_seen.add(it)
+            got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+            live = (doc["shards"]["indexes"] if doc.get("degraded")
+                    else all_shards)
+            if doc.get("degraded"):
+                swap_counts["degraded"] += 1
+            want = oracle(it, qvec_by_iter[it], k, live,
+                          exclude_token=gene)
+            if got == want:
+                swap_counts["ok"] += 1
+            else:
+                # consistent with the OTHER iteration => a mixed-
+                # iteration merge leaked through the epoch fence
+                other = [i for i in qvec_by_iter if i != it]
+                if other and got == oracle(
+                    other[0], qvec_by_iter[other[0]], k, live,
+                    exclude_token=gene,
+                ):
+                    swap_counts["mixed"] += 1
+                else:
+                    key = ("degraded_wrong" if doc.get("degraded")
+                           else "wrong")
+                    swap_counts[key] += 1
+
+        def swap_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 100 + widx)
+            while time.monotonic() < stop_at:
+                row = int(wrng.randint(vocab))
+                gene = tokens[row]
+                # gene queries resolve per-epoch on the owner shard, so
+                # a swap mid-request exercises the whole fence
+                r = client.request(
+                    "/v1/similar", {"genes": [gene], "k": k},
+                    timeout_s=6.0,
+                )
+                with lock:
+                    swap_counts["total"] += 1
+                    swap_counts["attempts"] += r.attempts
+                    swap_counts["retries"] += r.retries
+                    if r.status >= 500 and r.target is not None:
+                        swap_counts["server_5xx"] += 1
+                    if not r.ok or r.doc is None:
+                        swap_counts["failed"] += 1
+                        continue
+                    swap_check(
+                        r.doc,
+                        {it: embs[it][row] for it in embs},
+                        gene,
+                    )
+
+        threads = [
+            threading.Thread(target=swap_worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        _write_iteration(export_dir, 2, vocab_size=vocab, dim=dim)
+        log("iteration 2 exported mid-load; coordinator should stage "
+            "+ flip every shard as one version")
+        for t in threads:
+            t.join(timeout=swap_window + 30.0)
+
+        def swapped():
+            r = client.request(
+                "/v1/similar", {"genes": [query_genes[0]], "k": k},
+                timeout_s=6.0,
+            )
+            return bool(
+                r.ok and r.doc
+                and r.doc["model"]["iteration"] == 2
+                and not r.doc["degraded"]
+            )
+
+        wait_until(swapped, 60.0, interval_s=0.5,
+                   what="shard-atomic swap to iteration 2")
+        prom = _parse_prom_counters(
+            urllib.request.urlopen(url + "/metrics", timeout=10.0)
+            .read().decode("utf-8")
+        )
+        assert prom.get("fleet_swap_flips_total", 0.0) >= 1, (
+            "the coordinator never flipped — swap did not happen "
+            "through the shard-atomic path"
+        )
+        log(
+            f"swap window: {swap_counts['total']} requests, "
+            f"iterations seen {sorted(iterations_seen)}, "
+            f"{swap_counts['mixed']} mixed, {swap_counts['wrong']} "
+            f"wrong, flips {int(prom.get('fleet_swap_flips_total', 0))}"
+        )
+        assert swap_counts["server_5xx"] == 0, (
+            f"{swap_counts['server_5xx']} 5xx during the swap window"
+        )
+        assert swap_counts["mixed"] == 0, (
+            f"{swap_counts['mixed']} answers crossed the epoch fence "
+            "(mixed-iteration merge)"
+        )
+        assert swap_counts["wrong"] == 0 and (
+            swap_counts["degraded_wrong"] == 0
+        ), "answers diverged from their claimed iteration's oracle"
+
+        # ---- trace: the scatter fan-out is one span tree ------------
+        time.sleep(1.0)
+        scatter_trace = None
+        for tid in trace_ids[-40:]:
+            doc = flight_mod.collect_trace(export_dir, tid)
+            names, _ = _trace_tree_facts(doc)
+            if {"proxy_scatter", "client_attempt",
+                    "serve_request"} <= names:
+                scatter_trace = tid
+                break
+        assert scatter_trace is not None, (
+            "no trace reassembled with proxy_scatter -> client_attempt "
+            "-> serve_request (the scatter fan-out is invisible)"
+        )
+        cli = subprocess.run(
+            [sys.executable, "-m", "gene2vec_tpu.cli.obs", "trace",
+             export_dir, scatter_trace],
+            capture_output=True, text=True, timeout=120,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        assert cli.returncode == 0 and "proxy_scatter" in cli.stdout, (
+            f"cli.obs trace missing the scatter span:\n{cli.stdout}"
+        )
+        log(f"scatter trace {scatter_trace} reassembled via cli.obs "
+            "trace (sibling shard attempts under proxy_scatter)")
+
+        result["drill"] = {
+            "shards": shards,
+            "vocab": vocab,
+            "duration_s": duration_s,
+            "requests": counts["total"],
+            "ok": counts["ok"],
+            "failed": counts["failed"],
+            "degraded_responses": counts["degraded"],
+            "unresolved_responses": counts["unresolved"],
+            "degraded_mean_recall": mean_deg_recall,
+            "dead_shard_row_fraction": round(dead_frac, 4),
+            "availability": round(availability, 5),
+            "server_5xx": counts["server_5xx"],
+            "wrong_answers": (
+                counts["wrong"] + counts["degraded_wrong"]
+                + swap_counts["wrong"] + swap_counts["degraded_wrong"]
+            ),
+            "mixed_iteration_answers": (
+                counts["mixed"] + swap_counts["mixed"]
+            ),
+            "retry_amplification": round(amplification, 4),
+            "recovered_full_recall": True,
+            "swap": {
+                "requests": swap_counts["total"],
+                "iterations_seen": sorted(iterations_seen),
+                "degraded": swap_counts["degraded"],
+                "flips": int(prom.get("fleet_swap_flips_total", 0)),
+                "stage_failures": int(
+                    prom.get("fleet_swap_stage_failures_total", 0)
+                ),
+            },
+            "scatter_trace_id": scatter_trace,
+        }
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    result["drill"]["slow_loris"] = _shard_slow_loris(
+        tmp, smoke, budget, seed
+    )
+    return result
+
+
+def _shard_slow_loris(tmp: str, smoke: bool, budget: dict,
+                      seed: int) -> dict:
+    """A SLOW shard (injected latency far past the per-shard deadline,
+    scoped to the scatter data plane so health probes stay green): the
+    per-shard deadline must fire, every answer degrades to the live
+    shards — never a 5xx — and p99 stays bounded by the deadline, not
+    the fault."""
+    from gene2vec_tpu.resilience.faults import FaultSpec
+    from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    shards = int(budget.get("http_shards", 2))
+    vocab, dim, k = 40, 8, 4
+    export_dir = os.path.join(tmp, "shard_loris_export")
+    _write_iteration(export_dir, 1, vocab_size=vocab, dim=dim)
+    deadline_ms = 600.0
+    faults = FaultSpec(
+        seed=seed, latency_p=1.0, latency_ms=3000.0,
+        route_prefix="/v1/shard/topk",
+    )
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir,
+        "--shard-by-rows", str(shards),
+        "--port", "0", "--health-interval", "0.25",
+        "--swap-interval", "0.5", "--scrape-interval", "0",
+        "--alert-rules", "none",
+        "--proxy-timeout-ms", "4000",
+        "--shard-deadline-ms", str(deadline_ms),
+        "--seed", str(seed),
+        "--replica-arg", "0:--faults",
+        "--replica-arg", f"0:{faults.to_json()}",
+    ]
+    log(f"slow-loris fleet: shard 0 injects {faults.latency_ms:.0f}ms "
+        f"on the scatter route; per-shard deadline {deadline_ms:.0f}ms")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        client = ResilientClient(
+            [url],
+            RetryPolicy(max_attempts=2, default_timeout_s=6.0,
+                        read_timeout_s=6.0),
+        )
+        n = 12 if smoke else 25
+        latencies = []
+        degraded = server_5xx = failed = 0
+        rng = np.random.RandomState(seed)
+        emb = np.random.RandomState(1).randn(vocab, dim) \
+            .astype(np.float32)
+        for i in range(n):
+            row = int(rng.randint(vocab))
+            r = client.request(
+                "/v1/similar",
+                {"vectors": [[float(x) for x in emb[row]]], "k": k},
+                timeout_s=6.0,
+            )
+            latencies.append(r.latency_s * 1000.0)
+            if r.status >= 500 and r.target is not None:
+                server_5xx += 1
+            if not r.ok or r.doc is None:
+                failed += 1
+                continue
+            if r.doc.get("degraded"):
+                degraded += 1
+        prom = _parse_prom_counters(
+            urllib.request.urlopen(url + "/metrics", timeout=10.0)
+            .read().decode("utf-8")
+        )
+        leg_deadlines = prom.get("fleet_shard_leg_deadline_total", 0.0)
+        arr = np.asarray(latencies)
+        p99 = float(np.percentile(arr, 99))
+        availability = (n - failed) / float(n)
+        log(
+            f"slow loris: {degraded}/{n} degraded, p99 {p99:.0f}ms, "
+            f"{int(leg_deadlines)} shard-leg deadlines, "
+            f"{server_5xx} server 5xx"
+        )
+        assert server_5xx == 0, "a slow shard must degrade, never 5xx"
+        assert degraded >= n * 0.8, (
+            f"only {degraded}/{n} answers degraded — the slow shard's "
+            "legs are not being reaped by the per-shard deadline"
+        )
+        assert leg_deadlines >= 1, (
+            "fleet_shard_leg_deadline_total never incremented"
+        )
+        assert availability >= float(budget["min_availability"]), (
+            f"slow-loris availability {availability:.4f} below budget"
+        )
+        # the whole point: p99 is bounded by the deadline machinery
+        # (deadline + retry + merge overhead), NOT the 3s fault
+        assert p99 <= 2900.0, (
+            f"p99 {p99:.0f}ms — the per-shard deadline is not bounding "
+            "the slow shard"
+        )
+        return {
+            "requests": n,
+            "degraded": degraded,
+            "availability": round(availability, 5),
+            "server_5xx": server_5xx,
+            "p99_ms": round(p99, 1),
+            "shard_leg_deadlines": int(leg_deadlines),
+            "injected_latency_ms": faults.latency_ms,
+            "shard_deadline_ms": deadline_ms,
+        }
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet", "alerts", "autoscale")
+          "fleet", "alerts", "autoscale", "shard")
 
 
 def main(argv=None) -> int:
@@ -1624,6 +2391,14 @@ def main(argv=None) -> int:
                          "(plus budget) as a standalone bench document, "
                          "e.g. BENCH_AUTOSCALE_r14.json — the record "
                          "analysis/passes_autoscale.py gates on")
+    ap.add_argument("--shard-out", default=None, metavar="PATH",
+                    help="also write the shard phase's results (the "
+                         "10M scatter-merge bench + HTTP drill) as a "
+                         "standalone bench document, e.g. "
+                         "BENCH_SHARD_r15.json — the record "
+                         "analysis/passes_shard.py gates on (run "
+                         "WITHOUT --smoke for the committed artifact; "
+                         "a smoke run is off the pinned recipe)")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -1653,6 +2428,7 @@ def main(argv=None) -> int:
     fleet_budget = budgets["fleet"]["chaos"]
     alerts_budget = budgets["alerts"]["detection"]
     autoscale_budget = budgets["autoscale"]["elasticity"]
+    shard_budget = budgets["shard"]["scatter"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -1691,6 +2467,10 @@ def main(argv=None) -> int:
             elif phase == "autoscale":
                 doc["phases"][phase] = drill_autoscale(
                     tmp, args.smoke, autoscale_budget, seed
+                )
+            elif phase == "shard":
+                doc["phases"][phase] = drill_shard(
+                    tmp, args.smoke, shard_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -1755,6 +2535,22 @@ def main(argv=None) -> int:
         with open(args.autoscale_out, "w") as f:
             f.write(json.dumps(autoscale_doc, indent=1) + "\n")
         log(f"wrote {args.autoscale_out}")
+    if args.shard_out and "shard" in doc["phases"]:
+        shard_doc = {
+            "schema": "gene2vec-tpu/bench-shard/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "shard_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["shard"],
+            "shard": doc["phases"]["shard"],
+        }
+        with open(args.shard_out, "w") as f:
+            f.write(json.dumps(shard_doc, indent=1) + "\n")
+        log(f"wrote {args.shard_out}")
     print(blob)
     log("DRILL PASSED" if doc["passed"] else "DRILL FAILED")
     return 0 if doc["passed"] else 1

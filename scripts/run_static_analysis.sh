@@ -152,11 +152,18 @@ if [ "$CHAOS" = "1" ]; then
   FLEET_OUT="${FLEET_DRILL_OUT:-/tmp/chaos_drill_fleet_smoke.json}"
   ALERTS_OUT="${ALERTS_DRILL_OUT:-/tmp/chaos_drill_alerts_smoke.json}"
   AUTOSCALE_OUT="${AUTOSCALE_DRILL_OUT:-/tmp/chaos_drill_autoscale_smoke.json}"
+  # the shard phase IS the reduced-size sharded-serving smoke: a 64k-
+  # row scatter-merge bench (4 shards) plus a 2-shard fleet with one
+  # SIGKILL mid-load, a swap-under-load, and a slow-loris shard (the
+  # committed BENCH_SHARD record comes from the full, non-smoke drill)
+  SHARD_OUT="${SHARD_DRILL_OUT:-/tmp/chaos_drill_shard_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
     --alerts-out "$ALERTS_OUT" --autoscale-out "$AUTOSCALE_OUT" \
+    --shard-out "$SHARD_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
-  echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT)" >&2
+  echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT," >&2
+  echo "  shard: $SHARD_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

@@ -138,6 +138,12 @@ class _Stats:
         self.connections_opened = 0
         self.wrong_answers = 0
         self.mixed_iteration_answers = 0
+        # sharded-fleet verification (serve/shardgroup.py): responses
+        # flagged `degraded` are partial by contract, scored against
+        # the reference RESTRICTED to the shards that answered — never
+        # counted wrong for missing the dead shard's rows
+        self.degraded = 0
+        self.degraded_wrong = 0
         self.traces: List[tuple] = []  # (latency_ms, status, trace_id)
         # --tenant mode: per-tenant sub-accounting so the isolation
         # story (availability/429s/p99 per tenant) survives the merge
@@ -190,6 +196,11 @@ class _Stats:
         with self.lock:
             self.wrong_answers += int(wrong)
             self.mixed_iteration_answers += int(mixed)
+
+    def count_degraded(self, wrong: bool) -> None:
+        with self.lock:
+            self.degraded += 1
+            self.degraded_wrong += int(wrong)
 
     @property
     def total(self) -> int:
@@ -253,8 +264,43 @@ class _KeepAliveConn:
         raise OSError("unreachable")  # pragma: no cover
 
 
+#: reserved key in the verify_ref dict carrying the sharded-fleet
+#: verification context ({"ranges": {index: (start, end)}, "row":
+#: {gene: global row}}); gene names can never collide with it
+SHARD_CTX_KEY = "__shard__"
+
+
+def _degraded_consistent(neighbors, ref_neighbors, shard_ctx,
+                         answered) -> bool:
+    """Whether a degraded answer is exactly what the reference implies
+    for the shards that answered: every returned row lives on an
+    answered shard, and the reference's surviving members lead the
+    list IN ORDER (the restricted top-k starts with exactly the live
+    members of the full top-k — anything else means the merge lost or
+    invented answers)."""
+    rows = shard_ctx.get("row", {})
+    ranges = shard_ctx.get("ranges", {})
+    live = [ranges[i] for i in answered if i in ranges]
+
+    def on_live_shard(gene) -> bool:
+        row = rows.get(gene)
+        if row is None:
+            return False
+        return any(s <= row < e for s, e in live)
+
+    if not all(on_live_shard(g) for g in neighbors):
+        return False
+    surviving = tuple(g for g in ref_neighbors if on_live_shard(g))
+    lead = surviving[: len(neighbors)]
+    return neighbors[: len(lead)] == lead
+
+
 def _check_answer(raw: bytes, verify_ref: Dict, stats: _Stats) -> None:
-    """Compare one 200 body against the pre-fetched reference."""
+    """Compare one 200 body against the pre-fetched reference.  A
+    response flagged ``degraded`` (sharded fleet, partial gather) is
+    scored against the reference restricted to the shards that
+    answered — it is counted in the degraded columns, never as a
+    wrong answer."""
     try:
         doc = json.loads(raw.decode("utf-8"))
         got_iter = doc["model"]["iteration"]
@@ -269,10 +315,24 @@ def _check_answer(raw: bytes, verify_ref: Dict, stats: _Stats) -> None:
         stats.count_integrity(wrong=True, mixed=False)
         return
     ref_iter, ref_neighbors = ref
-    mixed = got_iter != ref_iter
-    wrong = (not mixed) and neighbors != ref_neighbors
-    if wrong or mixed:
-        stats.count_integrity(wrong=wrong, mixed=mixed)
+    if got_iter != ref_iter:
+        stats.count_integrity(wrong=False, mixed=True)
+        return
+    if doc.get("degraded"):
+        shard_ctx = verify_ref.get(SHARD_CTX_KEY)
+        if not neighbors and res.get("degraded"):
+            # honest empty partial (the query gene's owner is down and
+            # its vector was never cached): degraded, nothing to score
+            stats.count_degraded(wrong=False)
+            return
+        answered = (doc.get("shards") or {}).get("indexes") or []
+        ok = shard_ctx is not None and _degraded_consistent(
+            neighbors, ref_neighbors, shard_ctx, answered
+        )
+        stats.count_degraded(wrong=not ok)
+        return
+    if neighbors != ref_neighbors:
+        stats.count_integrity(wrong=True, mixed=False)
 
 
 def parse_tenants(specs: List[str]) -> Optional[List[Tuple[str, float]]]:
@@ -505,6 +565,11 @@ def summarize(level: float, stats: _Stats, mode: str,
     if verify:
         row["wrong_answers"] = stats.wrong_answers
         row["mixed_iteration_answers"] = stats.mixed_iteration_answers
+        row["degraded"] = stats.degraded
+        row["degraded_rate"] = round(
+            stats.degraded / stats.total, 4
+        ) if stats.total else None
+        row["degraded_wrong"] = stats.degraded_wrong
     if stats.tenants:
         # per-tenant breakdown: isolation is invisible in the merged
         # row (the abuser's 429s and the victim's p99 cancel out)
@@ -590,6 +655,12 @@ def fetch_verify_ref(url: str, genes: List[str], k: int,
             {"genes": [gene], "k": k},
             timeout=timeout_s,
         )
+        if doc.get("degraded"):
+            raise RuntimeError(
+                f"reference answer for {gene!r} came back DEGRADED — "
+                "the sharded fleet is already partial; a bench "
+                "baseline needs every shard up"
+            )
         ref[gene] = (
             doc["model"]["iteration"],
             tuple(
@@ -597,6 +668,35 @@ def fetch_verify_ref(url: str, genes: List[str], k: int,
             ),
         )
     return ref
+
+
+def fetch_shard_ctx(url: str, health: Dict, timeout_s: float):
+    """Degraded-answer verification context from a SHARDED front door:
+    per-shard row ranges from /healthz plus the gene→global-row map
+    implied by /v1/genes order (vocab order IS row order).  None for
+    an unsharded target — verification then never consults it."""
+    shards = health.get("shards")
+    if not isinstance(shards, list) or not shards:
+        return None
+    ranges = {
+        int(s["index"]): tuple(s["rows"])
+        for s in shards if s.get("rows")
+    }
+    doc = _http_json(f"{url}/v1/genes?limit=1", timeout=timeout_s)
+    total = int(doc["total"])
+    rows: Dict[str, int] = {}
+    offset = 0
+    while offset < total:
+        page = _http_json(
+            f"{url}/v1/genes?limit=4096&offset={offset}",
+            timeout=timeout_s,
+        )["genes"]
+        if not page:
+            break
+        for i, g in enumerate(page):
+            rows[g] = offset + i
+        offset += len(page)
+    return {"ranges": ranges, "row": rows}
 
 
 def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
@@ -853,6 +953,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             verify_ref = fetch_verify_ref(url, genes, args.k,
                                           args.timeout)
+            shard_ctx = fetch_shard_ctx(url, health, args.timeout)
+            if shard_ctx is not None:
+                # sharded front door: degraded answers get scored
+                # against the reference restricted to live shards
+                verify_ref[SHARD_CTX_KEY] = shard_ctx
+                print(
+                    f"sharded target: {len(shard_ctx['ranges'])} "
+                    "shards; degraded answers verified against the "
+                    "restricted reference",
+                    file=sys.stderr,
+                )
 
         levels = [float(x) for x in args.levels.split(",") if x]
         trace_all = args.trace_sample > 0

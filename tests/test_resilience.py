@@ -564,6 +564,9 @@ def test_chaos_drill_smoke():
     assert proc.returncode == 0, proc.stdout + proc.stderr[-3000:]
     doc = json.loads(proc.stdout)
     assert doc["passed"] is True
-    assert set(doc["phases"]) == {
+    # the resilience core is a SUBSET: later PRs grew the drill
+    # (fleet/alerts/autoscale/shard phases, each with its own gated
+    # smoke in run_static_analysis.sh --with-chaos)
+    assert {
         "training_resume", "corruption", "serve", "async_overhead"
-    }
+    } <= set(doc["phases"])

@@ -1,0 +1,979 @@
+"""Fleet-sharded index serving: shard loading, cross-process merge
+parity vs the in-mesh two_stage_topk, scatter-gather degradation, the
+shard-atomic stage/flip swap, and the BENCH_SHARD gate.  Everything on
+the CPU backend with tiny tables (conftest pins JAX_PLATFORMS=cpu and
+8 virtual devices)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gene2vec_tpu.io.checkpoint import save_iteration
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.obs.registry import MetricsRegistry
+from gene2vec_tpu.parallel.sharding import (
+    merge_shard_topk,
+    shard_of_row,
+    shard_ranges,
+)
+from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy, TokenBucket
+from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.registry import ModelRegistry, l2_normalize
+from gene2vec_tpu.serve.server import ApiError, ServeApp, ServeConfig, make_server
+from gene2vec_tpu.serve.shardgroup import (
+    RoutingTable,
+    ShardGroup,
+    ShardGroupConfig,
+    SwapCoordinator,
+)
+from gene2vec_tpu.sgns.model import SGNSParams
+
+V, D = 24, 8
+
+
+def _write_iteration(export_dir, iteration, seed, vocab=V, dim=D):
+    rng = np.random.RandomState(seed)
+    voc = Vocab([f"G{i}" for i in range(vocab)],
+                np.arange(vocab, 0, -1))
+    emb = rng.randn(vocab, dim).astype(np.float32)
+    params = SGNSParams(
+        emb=jnp.asarray(emb),
+        ctx=jnp.asarray(np.zeros((vocab, dim), np.float32)),
+    )
+    save_iteration(str(export_dir), dim, iteration, params, voc)
+    return emb
+
+
+@pytest.fixture
+def export_dir(tmp_path):
+    d = tmp_path / "exports"
+    _write_iteration(d, 1, seed=1)
+    return d
+
+
+# -- shard range math --------------------------------------------------------
+
+
+def test_shard_ranges_cover_and_balance():
+    ranges = shard_ranges(13, 4)
+    assert ranges == [(0, 4), (4, 7), (7, 10), (10, 13)]
+    assert ranges[0][0] == 0 and ranges[-1][1] == 13
+    sizes = [e - s for s, e in ranges]
+    assert max(sizes) - min(sizes) <= 1
+    # the device layout: equal padded spans, overhang past total is pad
+    padded = shard_ranges(13, 8, pad_to_multiple=True)
+    assert padded == [(2 * i, 2 * i + 2) for i in range(8)]
+    assert shard_ranges(0, 3) == [(0, 0), (0, 0), (0, 0)]
+    with pytest.raises(ValueError):
+        shard_ranges(10, 0)
+
+
+def test_shard_of_row():
+    ranges = shard_ranges(13, 4)
+    assert shard_of_row(0, ranges) == 0
+    assert shard_of_row(6, ranges) == 1
+    assert shard_of_row(12, ranges) == 3
+    with pytest.raises(ValueError):
+        shard_of_row(13, ranges)
+
+
+# -- cross-process merge parity vs the in-mesh two_stage_topk ----------------
+
+
+def _mesh(p):
+    from gene2vec_tpu.config import MeshConfig
+    from gene2vec_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(MeshConfig(data=1, model=p))
+
+
+@pytest.mark.parametrize("vocab,k", [(13, 4), (13, 5), (16, 3), (9, 8)])
+def test_merge_bitwise_identical_to_two_stage_topk(vocab, k):
+    """The cross-process merge of per-shard local top-k must be
+    BITWISE-identical to the single-host shard_map two_stage_topk on
+    the same table — including pad-row masking at shard boundaries
+    (vocab not a multiple of the shard count) and k larger than a
+    shard's row count."""
+    from gene2vec_tpu.parallel.sharding import row_sharding
+
+    P = 8
+    rng = np.random.RandomState(vocab * 100 + k)
+    unit = l2_normalize(rng.randn(vocab, D).astype(np.float32))
+    queries = rng.randn(3, D).astype(np.float32)
+    pad = (-vocab) % P
+    padded = np.concatenate(
+        [unit, np.zeros((pad, D), np.float32)]
+    ) if pad else unit
+
+    mesh = _mesh(P)
+    import jax
+
+    sharded_engine = SimilarityEngine(max_batch=4, mesh=mesh)
+    unit_dev = jax.device_put(jnp.asarray(padded), row_sharding(mesh))
+    ref_scores, ref_idx = sharded_engine.top_k(
+        unit_dev, queries, k, valid=vocab
+    )
+
+    # each "process" computes its local top-k over its padded span with
+    # the SAME exact kernel a shard replica runs, then the front-door
+    # merge combines the candidate sets
+    local_engine = SimilarityEngine(max_batch=4)
+    parts = []
+    for start, end in shard_ranges(padded.shape[0], P,
+                                   pad_to_multiple=True):
+        local_valid = max(0, min(vocab, end) - start)
+        sl = padded[start:end]
+        lk = min(k, sl.shape[0])
+        s, i = local_engine.top_k(
+            jnp.asarray(sl), queries, lk, valid=local_valid or None
+        )
+        if local_valid == 0:
+            # a pure-pad shard: the mesh kernel masks it to -inf but
+            # still contributes candidates; emulate with -inf scores
+            s = np.full_like(s, -np.inf)
+        parts.append((s, i.astype(np.int64) + start))
+    got_scores, got_idx = merge_shard_topk(parts, k)
+
+    np.testing.assert_array_equal(got_scores, ref_scores)
+    np.testing.assert_array_equal(got_idx, ref_idx)
+
+
+def test_merge_matches_full_table_oracle_on_balanced_ranges():
+    """Balanced (serving-layout) shards: the merge equals the exact
+    full-table top-k, and dropping one shard equals the exact top-k
+    restricted to the live shards' rows — graceful degradation IS the
+    restricted oracle."""
+    rng = np.random.RandomState(7)
+    vocab, k, n_shards = 29, 6, 3
+    unit = l2_normalize(rng.randn(vocab, D).astype(np.float32))
+    queries = l2_normalize(rng.randn(4, D).astype(np.float32))
+    scores_full = queries @ unit.T
+
+    ranges = shard_ranges(vocab, n_shards)
+    engine = SimilarityEngine(max_batch=4)
+    parts = []
+    for start, end in ranges:
+        lk = min(k, end - start)
+        s, i = engine.top_k(jnp.asarray(unit[start:end]), queries, lk)
+        parts.append((s, i.astype(np.int64) + start))
+
+    def oracle(cols):
+        order = np.argsort(-scores_full[:, cols], axis=1,
+                           kind="stable")[:, :k]
+        return np.asarray(cols)[order]
+
+    _, merged = merge_shard_topk(parts, k)
+    np.testing.assert_array_equal(merged, oracle(np.arange(vocab)))
+
+    dead = 1
+    live_parts = [p for i, p in enumerate(parts) if i != dead]
+    live_cols = np.concatenate([
+        np.arange(s, e) for i, (s, e) in enumerate(ranges) if i != dead
+    ])
+    _, degraded = merge_shard_topk(live_parts, k)
+    np.testing.assert_array_equal(degraded, oracle(live_cols))
+
+
+def test_merge_needs_at_least_one_part():
+    with pytest.raises(ValueError):
+        merge_shard_topk([], 3)
+
+
+# -- sharded registry loading ------------------------------------------------
+
+
+def test_registry_loads_only_its_shard(export_dir):
+    full = ModelRegistry(str(export_dir))
+    assert full.refresh()
+    whole = full.model
+    reg = ModelRegistry(str(export_dir), shard=(1, 3))
+    assert reg.refresh()
+    m = reg.model
+    start, end = shard_ranges(V, 3)[1]
+    assert m.row_base == start and len(m) == end - start
+    assert m.total_rows == V
+    assert m.epoch == m.iteration
+    assert m.tokens == whole.tokens[start:end]
+    np.testing.assert_array_equal(m.emb, whole.emb[start:end])
+    # index maps LOCAL rows; non-owned genes are absent
+    assert m.index[whole.tokens[start]] == 0
+    assert whole.tokens[0] not in m.index
+
+
+def test_registry_shard_validation(export_dir):
+    with pytest.raises(ValueError):
+        ModelRegistry(str(export_dir), shard=(3, 3))
+    with pytest.raises(ValueError):
+        ModelRegistry(str(export_dir), shard=(0, 0))
+
+
+def test_stage_then_flip_is_atomic(export_dir):
+    reg = ModelRegistry(str(export_dir), shard=(0, 2))
+    assert reg.refresh()
+    assert reg.model.iteration == 1
+    _write_iteration(export_dir, 2, seed=2)
+    staged = reg.stage(D, 2)
+    assert staged.iteration == 2
+    assert reg.model.iteration == 1  # staged, not served
+    # flip requires a matching staged model
+    with pytest.raises(RuntimeError):
+        reg.flip(3)
+    m = reg.flip(2)
+    assert m.iteration == 2 and m.epoch == 2
+    assert reg.model.iteration == 2
+    # idempotent re-flip (a coordinator retry)
+    assert reg.flip(2).epoch == 2
+    # stage of a missing iteration fails loudly
+    with pytest.raises(FileNotFoundError):
+        reg.stage(D, 9)
+
+
+def test_flip_under_reader_never_shows_mixed_fields(export_dir):
+    reg = ModelRegistry(str(export_dir), shard=(0, 2))
+    reg.refresh()
+    _write_iteration(export_dir, 2, seed=2)
+    reg.stage(D, 2)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            m = reg.model  # one reference: all fields one iteration
+            if m.iteration not in (1, 2) or (
+                m.epoch is not None and m.epoch != m.iteration
+            ):
+                bad.append(m.version)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    reg.flip(2)
+    stop.set()
+    t.join(timeout=5)
+    assert not bad
+
+
+# -- shard routes on the replica ---------------------------------------------
+
+
+@pytest.fixture
+def shard_apps(export_dir):
+    """Two shard replicas over the same export, as in-process apps."""
+    apps = []
+    for i in range(2):
+        reg = ModelRegistry(str(export_dir), shard=(i, 2))
+        assert reg.refresh()
+        app = ServeApp(
+            reg, config=ServeConfig(max_delay_ms=1.0)
+        ).start()
+        apps.append(app)
+    yield apps
+    for app in apps:
+        app.stop()
+
+
+def test_shard_topk_returns_global_rows(shard_apps, export_dir):
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    unit = l2_normalize(full.model.emb)
+    q = unit[3]
+    docs = []
+    for app in shard_apps:
+        doc = app.shard_topk({"vectors": [list(map(float, q))], "k": 4})
+        assert doc["shard"]["num_shards"] == 2
+        docs.append(doc)
+    parts = [
+        (np.asarray([d["results"][0]["scores"]], np.float32),
+         np.asarray([d["results"][0]["rows"]]))
+        for d in docs
+    ]
+    _, merged = merge_shard_topk(parts, 4)
+    exact = np.argsort(-(unit @ q), kind="stable")[:4]
+    np.testing.assert_array_equal(merged[0], exact)
+    # tokens ride the candidates
+    for d in docs:
+        r = d["results"][0]
+        for row, tok in zip(r["rows"], r["tokens"]):
+            assert tok == full.model.tokens[row]
+
+
+def test_shard_topk_epoch_fence(shard_apps):
+    app = shard_apps[0]
+    cur = app.registry.model.epoch
+    with pytest.raises(ApiError) as e:
+        app.shard_topk({"vectors": [[0.0] * D], "k": 2,
+                        "epoch": cur + 1})
+    assert e.value.status == 409
+
+
+def test_shard_vectors_owned_and_not(shard_apps, export_dir):
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    start, end = shard_ranges(V, 2)[0]
+    owned = full.model.tokens[start]
+    foreign = full.model.tokens[end]
+    doc = shard_apps[0].shard_vectors({"genes": [owned]})
+    np.testing.assert_allclose(
+        doc["vectors"][0], full.model.emb[start], rtol=1e-6
+    )
+    with pytest.raises(ApiError) as e:
+        shard_apps[0].shard_vectors({"genes": [foreign]})
+    assert e.value.status == 400
+
+
+def test_shard_routes_404_on_unsharded_replica(export_dir):
+    reg = ModelRegistry(str(export_dir))
+    reg.refresh()
+    app = ServeApp(reg)
+    with pytest.raises(ApiError) as e:
+        app.shard_topk({"vectors": [[0.0] * D], "k": 2})
+    assert e.value.status == 404
+
+
+def test_shard_healthz_reports_shard_facts(shard_apps):
+    status, doc = shard_apps[1].healthz()
+    assert status == 200
+    start, end = shard_ranges(V, 2)[1]
+    assert doc["shard"]["rows"] == [start, end]
+    assert doc["shard"]["epoch"] == doc["shard"]["iteration"]
+
+
+# -- routing table -----------------------------------------------------------
+
+
+def test_routing_table_from_manifest(export_dir):
+    rt = RoutingTable(str(export_dir), 3)
+    assert rt.reload()
+    assert rt.total_rows == V and rt.dim == D
+    ranges = shard_ranges(V, 3)
+    for row, tok in enumerate(rt.tokens):
+        assert rt.owner(tok) == shard_of_row(row, ranges)
+    assert rt.owner("NOPE") is None
+    doc = rt.genes_doc(limit=5, offset=2)
+    assert doc["total"] == V and doc["genes"] == list(rt.tokens[2:7])
+
+
+# -- scatter-gather over live shard replicas ---------------------------------
+
+
+@pytest.fixture
+def shard_fleet(shard_apps, export_dir):
+    """The two shard apps behind real HTTP, plus a ShardGroup front."""
+    servers, urls = [], []
+    for app in shard_apps:
+        srv = make_server(app, "127.0.0.1", 0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        host, port = srv.server_address[:2]
+        servers.append(srv)
+        urls.append(f"http://{host}:{port}")
+    alive = [True, True]
+
+    routing = RoutingTable(str(export_dir), 2)
+    assert routing.reload()
+    metrics = MetricsRegistry()
+    group = ShardGroup(
+        ShardGroupConfig(num_shards=2, shard_deadline_s=2.0,
+                         default_timeout_s=5.0),
+        lambda i: urls[i] if alive[i] else None,
+        metrics=metrics,
+        policy=RetryPolicy(max_attempts=2, connect_timeout_s=0.5,
+                           default_timeout_s=2.0),
+        routing=routing,
+    )
+    group.current_epoch = 1
+    yield group, alive, metrics, urls, shard_apps
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _exact_reference(export_dir, gene, k):
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    m = full.model
+    unit = l2_normalize(m.emb)
+    q = unit[m.index[gene]]
+    order = np.argsort(-(unit @ q), kind="stable")
+    toks = [m.tokens[i] for i in order if m.tokens[i] != gene]
+    return toks[:k]
+
+
+def test_scatter_full_answer_matches_oracle(shard_fleet, export_dir):
+    group, _alive, metrics, _urls, _apps = shard_fleet
+    status, doc = group.similar({"genes": ["G3"], "k": 4})
+    assert status == 200
+    assert doc["degraded"] is False
+    assert doc["shards"] == {
+        "total": 2, "answered": 2, "indexes": [0, 1], "epoch": 1,
+    }
+    got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+    assert got == _exact_reference(export_dir, "G3", 4)
+    assert metrics.counter("fleet_degraded_responses_total").value == 0
+
+
+def test_scatter_vector_queries(shard_fleet, export_dir):
+    group, *_ = shard_fleet
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    q = list(map(float, full.model.emb[5]))
+    status, doc = group.similar({"vectors": [q], "k": 3})
+    assert status == 200 and not doc["degraded"]
+    assert len(doc["results"][0]["neighbors"]) == 3
+
+
+def test_scatter_validation_errors(shard_fleet):
+    group, *_ = shard_fleet
+    assert group.similar({"k": 2})[0] == 400
+    assert group.similar({"genes": [], "k": 2})[0] == 400
+    assert group.similar({"genes": ["G1"], "k": 0})[0] == 400
+    assert group.similar({"genes": ["NOPE"], "k": 2})[0] == 400
+    assert group.similar(
+        {"genes": ["G1"], "vectors": [[0.0]], "k": 2}
+    )[0] == 400
+
+
+def test_dead_shard_degrades_instead_of_failing(shard_fleet, export_dir):
+    group, alive, metrics, _urls, _apps = shard_fleet
+    alive[1] = False  # shard 1 leaves rotation (dead / ejected)
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    q = list(map(float, full.model.emb[2]))
+    status, doc = group.similar({"vectors": [q], "k": 4})
+    assert status == 200, "a dead shard must never 5xx the query"
+    assert doc["degraded"] is True
+    assert doc["shards"]["answered"] == 1
+    assert doc["shards"]["indexes"] == [0]
+    # every returned row belongs to the LIVE shard's range — and the
+    # answer is the exact oracle restricted to those rows
+    start, end = shard_ranges(V, 2)[0]
+    unit = l2_normalize(full.model.emb)
+    restricted = np.argsort(
+        -(unit[start:end] @ l2_normalize(np.asarray([q]))[0]),
+        kind="stable",
+    )[:4] + start
+    got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+    assert got == [full.model.tokens[i] for i in restricted]
+    assert metrics.counter("fleet_degraded_responses_total").value == 1
+
+
+def test_gene_owned_by_dead_shard_answers_from_cache(shard_fleet):
+    group, alive, _metrics, _urls, _apps = shard_fleet
+    # G20 lives on shard 1; warm the qvec cache, then kill the owner
+    start, _ = shard_ranges(V, 2)[1]
+    gene = f"G{start}"
+    status, doc = group.similar({"genes": [gene], "k": 3})
+    assert status == 200 and not doc["degraded"]
+    alive[1] = False
+    status, doc = group.similar({"genes": [gene], "k": 3})
+    assert status == 200
+    assert doc["degraded"] is True  # shard 1's rows are missing
+    assert doc["results"][0]["neighbors"], (
+        "warmed gene must still answer from the live shards"
+    )
+
+
+def test_cold_gene_on_dead_owner_is_degraded_not_5xx(shard_fleet):
+    group, alive, metrics, _urls, _apps = shard_fleet
+    alive[0] = False
+    start, _ = shard_ranges(V, 2)[0]
+    status, doc = group.similar({"genes": [f"G{start}"], "k": 3})
+    assert status == 200
+    assert doc["degraded"] is True
+    assert doc["results"][0]["neighbors"] == []
+    assert metrics.counter("fleet_qvec_unresolved_total").value == 1
+
+
+def test_all_shards_dead_is_503(shard_fleet, export_dir):
+    group, alive, *_ = shard_fleet
+    alive[0] = alive[1] = False
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    q = list(map(float, full.model.emb[0]))
+    status, doc = group.similar({"vectors": [q], "k": 2})
+    assert status == 503
+    assert doc["shards"]["answered"] == 0
+
+
+def test_mixed_epoch_gather_rescatters_once_and_fences(
+    shard_fleet, export_dir
+):
+    group, _alive, metrics, _urls, apps = shard_fleet
+    # shard 0 flips to iteration 2, shard 1 lags (mid-swap window)
+    _write_iteration(export_dir, 2, seed=2)
+    apps[0].registry.stage(D, 2)
+    apps[0].registry.flip(2)
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    q = list(map(float, full.model.emb[1]))
+    status, doc = group.similar({"vectors": [q], "k": 3})
+    assert status == 200
+    # merged ONLY from the newest epoch; the laggard is fenced out
+    assert doc["shards"]["epoch"] == 2
+    assert doc["shards"]["indexes"] == [0]
+    assert doc["degraded"] is True
+    assert metrics.counter(
+        "fleet_mixed_epoch_rescatter_total"
+    ).value == 1
+    start, end = shard_ranges(V, 2)[0]
+    for n in doc["results"][0]["neighbors"]:
+        row = full.model.index[n["gene"]]
+        assert start <= row < end
+
+
+def test_embedding_routes_to_owner(shard_fleet, export_dir):
+    group, alive, *_ = shard_fleet
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    status, doc = group.embedding({"genes": ["G1", "G20"]})
+    assert status == 200
+    np.testing.assert_allclose(
+        doc["embeddings"][0]["vector"], full.model.emb[1], rtol=1e-6
+    )
+    alive[1] = False
+    status, doc = group.embedding({"genes": ["G20"]})
+    assert status == 503  # point lookups have no partial semantics
+    assert group.embedding({"genes": ["NOPE"]})[0] == 400
+
+
+def test_scatter_shares_one_retry_budget(export_dir):
+    """A dead shard's retries draw down the SAME token bucket as every
+    other shard's — the fan-out cannot multiply attempts fleet-wide."""
+    routing = RoutingTable(str(export_dir), 2)
+    routing.reload()
+    group = ShardGroup(
+        ShardGroupConfig(num_shards=2, shard_deadline_s=0.2,
+                         default_timeout_s=0.5),
+        lambda i: "http://127.0.0.1:9",  # discard port: refused fast
+        policy=RetryPolicy(max_attempts=3, connect_timeout_s=0.2,
+                           default_timeout_s=0.2,
+                           retry_budget_ratio=0.0,
+                           retry_budget_burst=1.0),
+        routing=routing,
+    )
+    assert group.client(0).budget is group.client(1).budget
+    q = [0.0] * D
+    group.similar({"vectors": [q], "k": 2})
+    group.similar({"vectors": [q], "k": 2})
+    total_retries = sum(
+        group.client(i).stats["retries"] for i in range(2)
+    )
+    # one burst token across the WHOLE fan-out: at most 1 retry total,
+    # not max_attempts-1 per shard per request
+    assert total_retries <= 1
+
+
+def test_swap_coordinator_stages_then_flips_all(shard_fleet, export_dir):
+    group, _alive, metrics, _urls, apps = shard_fleet
+    coord = SwapCoordinator(
+        str(export_dir), group, interval_s=0.1, metrics=metrics
+    )
+    coord.tick()  # adopts the boot epoch
+    assert group.current_epoch == 1
+    _write_iteration(export_dir, 2, seed=2)
+    coord.tick()
+    assert group.current_epoch == 2
+    for app in apps:
+        assert app.registry.model.epoch == 2
+    assert metrics.counter("fleet_swap_flips_total").value == 1
+    # answers now come from the new iteration, complete again
+    status, doc = group.similar({"genes": ["G0"], "k": 2})
+    assert status == 200 and not doc["degraded"]
+    assert doc["model"]["iteration"] == 2
+
+
+def test_swap_deferred_while_a_shard_is_down(shard_fleet, export_dir):
+    group, alive, metrics, _urls, apps = shard_fleet
+    coord = SwapCoordinator(
+        str(export_dir), group, interval_s=0.1, metrics=metrics
+    )
+    coord.tick()
+    alive[1] = False
+    _write_iteration(export_dir, 2, seed=2)
+    coord.tick()
+    # half a fleet can never flip atomically: swap deferred, old epoch
+    # keeps serving as one logical version
+    assert group.current_epoch == 1
+    assert apps[0].registry.model.iteration == 1
+    assert metrics.counter("fleet_swap_deferred_total").value == 1
+    alive[1] = True
+    coord.tick()
+    assert group.current_epoch == 2
+
+
+def test_shard_states_for_healthz(shard_fleet):
+    group, alive, *_ = shard_fleet
+    alive[1] = False
+    states = group.shard_states()
+    assert [s["up"] for s in states] == [True, False]
+    assert states[0]["rows"] == list(shard_ranges(V, 2)[0])
+
+
+# -- the BENCH_SHARD gate ----------------------------------------------------
+
+
+def _good_shard_doc():
+    return {
+        "schema": "gene2vec-tpu/bench-shard/v1",
+        "passed": True,
+        "shard": {
+            "bench": {
+                "rows": 10000000, "dim": 64, "shards": 4, "k": 10,
+                "queries": 512, "index": "ivf", "nprobe": 32,
+                "rescore_mult": 4, "clusters": 4096,
+                "recall_at_10": 0.999, "degraded_recall_at_10": 0.76,
+                "dead_shard_row_fraction": 0.25,
+                "p50_ms": 20.0, "p99_ms": 60.0,
+            },
+            "drill": {
+                "shards": 2, "availability": 1.0, "server_5xx": 0,
+                "wrong_answers": 0, "mixed_iteration_answers": 0,
+                "retry_amplification": 1.05,
+            },
+        },
+    }
+
+
+def _findings(tmp_path, doc, name="BENCH_SHARD_r15.json"):
+    from gene2vec_tpu.analysis.passes_shard import shard_findings
+
+    (tmp_path / name).write_text(json.dumps(doc))
+    return shard_findings(root=str(tmp_path))
+
+
+def _gating(findings):
+    return [f for f in findings if f.severity in ("error", "warning")]
+
+
+def test_passes_shard_good_record_is_info(tmp_path):
+    fs = _findings(tmp_path, _good_shard_doc())
+    assert len(fs) == 1 and not _gating(fs)
+
+
+def test_passes_shard_missing_bench_is_info(tmp_path):
+    from gene2vec_tpu.analysis.passes_shard import shard_findings
+
+    fs = shard_findings(root=str(tmp_path))
+    assert len(fs) == 1 and fs[0].severity == "info"
+    assert "chaos_drill" in fs[0].message
+
+
+def test_passes_shard_low_recall_fires_once(tmp_path):
+    doc = _good_shard_doc()
+    doc["shard"]["bench"]["recall_at_10"] = 0.9
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "recall@10" in fs[0].message
+
+
+def test_passes_shard_off_recipe_fires(tmp_path):
+    doc = _good_shard_doc()
+    doc["shard"]["bench"]["rows"] = 64000  # a smoke run, not the gate
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "rows=64000" in fs[0].message
+
+
+def test_passes_shard_dropped_key_gates(tmp_path):
+    doc = _good_shard_doc()
+    del doc["shard"]["drill"]["mixed_iteration_answers"]
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "mixed_iteration_answers" in fs[0].message
+
+
+def test_passes_shard_5xx_and_mixed_gate(tmp_path):
+    doc = _good_shard_doc()
+    doc["shard"]["drill"]["server_5xx"] = 3
+    doc["shard"]["drill"]["mixed_iteration_answers"] = 1
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1
+    assert "5xx" in fs[0].message and "mixed" in fs[0].message
+
+
+def test_passes_shard_ungraceful_degradation_gates(tmp_path):
+    doc = _good_shard_doc()
+    # one dead shard of four costing 60% recall is NOT graceful
+    doc["shard"]["bench"]["degraded_recall_at_10"] = 0.4
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "row fraction" in fs[0].message
+
+
+def test_passes_shard_newest_round_wins(tmp_path):
+    bad = _good_shard_doc()
+    bad["shard"]["bench"]["recall_at_10"] = 0.5
+    _findings(tmp_path, _good_shard_doc(), name="BENCH_SHARD_r15.json")
+    fs = _findings(tmp_path, bad, name="BENCH_SHARD_r16.json")
+    assert len(_gating(fs)) == 1  # the violating r16 wins over r15
+
+
+def test_ledger_adapts_shard_family(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    path = tmp_path / "BENCH_SHARD_r15.json"
+    path.write_text(json.dumps(_good_shard_doc()))
+    rec = ledger.adapt_file(str(path))
+    assert rec["family"] == "shard"
+    assert rec["metrics"]["shard_recall_at_10"] == 0.999
+    assert rec["metrics"]["shard_p99_ms_10m"] == 60.0
+    assert rec["headline_metric"] == "shard_recall_at_10"
+
+
+# -- loadgen degraded-answer verification ------------------------------------
+
+
+def _loadgen():
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "serve_loadgen.py",
+    )
+    spec = importlib.util.spec_from_file_location("serve_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def loadgen():
+    return _loadgen()
+
+
+def _shard_ctx():
+    # 8 rows over 2 shards; gene Gi lives at row i
+    return {
+        "ranges": {0: (0, 4), 1: (4, 8)},
+        "row": {f"G{i}": i for i in range(8)},
+    }
+
+
+def test_degraded_consistent_prefix_rule(loadgen):
+    ctx = _shard_ctx()
+    ref = ("G5", "G1", "G6", "G2")  # the full-fleet reference
+    # shard 1 dead: survivors on shard 0 are G1, G2 — a correct
+    # restricted answer leads with them in order, then fill-ins
+    assert loadgen._degraded_consistent(
+        ("G1", "G2", "G0", "G3"), ref, ctx, answered=[0]
+    )
+    # a row from the DEAD shard in the answer is a merge bug
+    assert not loadgen._degraded_consistent(
+        ("G1", "G5", "G0", "G3"), ref, ctx, answered=[0]
+    )
+    # surviving reference members out of order is a merge bug
+    assert not loadgen._degraded_consistent(
+        ("G2", "G1", "G0", "G3"), ref, ctx, answered=[0]
+    )
+    # all shards answered: the full reference must lead verbatim
+    assert loadgen._degraded_consistent(ref, ref, ctx, answered=[0, 1])
+
+
+def test_check_answer_scores_degraded_against_restricted(loadgen):
+    verify_ref = {
+        "G0": (1, ("G5", "G1", "G6", "G2")),
+        loadgen.SHARD_CTX_KEY: _shard_ctx(),
+    }
+    stats = loadgen._Stats()
+    raw = json.dumps({
+        "model": {"dim": 8, "iteration": 1},
+        "degraded": True,
+        "shards": {"total": 2, "answered": 1, "indexes": [0]},
+        "results": [{"query": "G0", "neighbors": [
+            {"gene": "G1", "score": 0.9}, {"gene": "G2", "score": 0.8},
+            {"gene": "G3", "score": 0.1}, {"gene": "G0", "score": 0.0},
+        ]}],
+    }).encode()
+    loadgen._check_answer(raw, verify_ref, stats)
+    assert stats.degraded == 1
+    assert stats.degraded_wrong == 0
+    assert stats.wrong_answers == 0  # degraded is NEVER counted wrong
+
+
+def test_check_answer_degraded_wrong_and_unresolved(loadgen):
+    verify_ref = {
+        "G0": (1, ("G5", "G1", "G6", "G2")),
+        loadgen.SHARD_CTX_KEY: _shard_ctx(),
+    }
+    stats = loadgen._Stats()
+    # degraded answer containing a dead shard's row => degraded_wrong
+    raw = json.dumps({
+        "model": {"dim": 8, "iteration": 1},
+        "degraded": True,
+        "shards": {"total": 2, "answered": 1, "indexes": [0]},
+        "results": [{"query": "G0", "neighbors": [
+            {"gene": "G5", "score": 0.9},
+        ]}],
+    }).encode()
+    loadgen._check_answer(raw, verify_ref, stats)
+    assert stats.degraded == 1 and stats.degraded_wrong == 1
+    # honest empty partial (unresolved gene): degraded, not wrong
+    raw = json.dumps({
+        "model": {"dim": 8, "iteration": 1},
+        "degraded": True,
+        "shards": {"total": 2, "answered": 1, "indexes": [0]},
+        "results": [{"query": "G0", "neighbors": [],
+                     "degraded": True}],
+    }).encode()
+    loadgen._check_answer(raw, verify_ref, stats)
+    assert stats.degraded == 2 and stats.degraded_wrong == 1
+    # mixed-iteration degraded answers still count as mixed
+    raw = json.dumps({
+        "model": {"dim": 8, "iteration": 7},
+        "degraded": True,
+        "shards": {"total": 2, "answered": 1, "indexes": [0]},
+        "results": [{"query": "G0", "neighbors": []}],
+    }).encode()
+    loadgen._check_answer(raw, verify_ref, stats)
+    assert stats.mixed_iteration_answers == 1
+
+
+def test_check_answer_full_answers_unchanged(loadgen):
+    verify_ref = {"G0": (1, ("G5", "G1"))}
+    stats = loadgen._Stats()
+    good = json.dumps({
+        "model": {"dim": 8, "iteration": 1},
+        "results": [{"query": "G0", "neighbors": [
+            {"gene": "G5", "score": 0.9}, {"gene": "G1", "score": 0.8},
+        ]}],
+    }).encode()
+    loadgen._check_answer(good, verify_ref, stats)
+    assert stats.wrong_answers == 0 and stats.degraded == 0
+    bad = json.dumps({
+        "model": {"dim": 8, "iteration": 1},
+        "results": [{"query": "G0", "neighbors": [
+            {"gene": "G2", "score": 0.9},
+        ]}],
+    }).encode()
+    loadgen._check_answer(bad, verify_ref, stats)
+    assert stats.wrong_answers == 1
+
+
+# -- review-hardening regressions --------------------------------------------
+
+
+def test_read_npz_rows_partial_matches_full(export_dir):
+    from gene2vec_tpu.io.checkpoint import read_npz_rows
+    from gene2vec_tpu.serve.registry import discover_newest
+
+    _, _, path = discover_newest(str(export_dir))
+    with np.load(path) as z:
+        full = np.asarray(z["emb"])
+    probe, total = read_npz_rows(path, "emb", 0, 0)
+    assert total == V and probe.shape == (0, D)
+    rows, _ = read_npz_rows(path, "emb", 5, 17)
+    np.testing.assert_array_equal(rows, full[5:17])
+    # out-of-range clamps instead of over-reading
+    rows, _ = read_npz_rows(path, "emb", V - 2, V + 10)
+    np.testing.assert_array_equal(rows, full[V - 2:])
+    with pytest.raises(ValueError):
+        read_npz_rows(path, "nope", 0, 1)
+    # a compressed npz cannot be row-seeked: ValueError, so the
+    # registry falls back to the full load
+    comp = export_dir / "comp.npz"
+    np.savez_compressed(comp, emb=full)
+    with pytest.raises(ValueError):
+        read_npz_rows(str(comp), "emb", 0, 2)
+
+
+def test_shard_topk_accepts_front_door_k_headroom(shard_apps):
+    app = shard_apps[0]
+    max_k = app.config.max_k
+    q = [[0.0] * D]
+    # k = max_k + 1 is the front door's self-drop fetch for k=max_k —
+    # it must not 400 (the k is capped to the shard's rows internally)
+    doc = app.shard_topk({"vectors": q, "k": max_k + 1})
+    assert doc["results"][0]["rows"]
+    with pytest.raises(ApiError) as e:
+        app.shard_topk({"vectors": q, "k": max_k + 2})
+    assert e.value.status == 400
+
+
+def test_scatter_gene_query_at_max_k(shard_fleet):
+    group, *_ = shard_fleet
+    status, doc = group.similar(
+        {"genes": ["G3"], "k": group.config.max_k}
+    )
+    assert status == 200 and not doc["degraded"]
+    # vocab-capped, self-dropped: every other gene comes back
+    assert len(doc["results"][0]["neighbors"]) == V - 1
+
+
+def test_drop_malformed_legs_degrades_visibly(shard_fleet):
+    group, _alive, metrics, *_ = shard_fleet
+    good = {
+        "shard": {"epoch": 1},
+        "results": [{"rows": [1, 2], "scores": [0.9, 0.8],
+                     "tokens": ["G1", "G2"]}],
+    }
+    short = {"shard": {"epoch": 1}, "results": []}
+    ragged = {
+        "shard": {"epoch": 1},
+        "results": [{"rows": [1, 2], "scores": [0.9]}],
+    }
+    out = group._drop_malformed({0: good, 1: short}, 1)
+    assert list(out) == [0]
+    out = group._drop_malformed({0: ragged}, 1)
+    assert out == {}
+    assert metrics.counter("fleet_shard_malformed_total").value == 2
+
+
+def test_mixed_epoch_majority_wins_over_lone_upgraded_shard(
+    export_dir, tmp_path
+):
+    """Three shards, ONE restarts into a newer self-loaded iteration:
+    the gather must merge the two-shard OLD-epoch majority (degraded
+    by 1/3), not collapse every answer to the lone new shard."""
+    apps, servers, urls = [], [], []
+    for i in range(3):
+        reg = ModelRegistry(str(export_dir), shard=(i, 3))
+        assert reg.refresh()
+        app = ServeApp(reg, config=ServeConfig(max_delay_ms=1.0)).start()
+        srv = make_server(app, "127.0.0.1", 0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        host, port = srv.server_address[:2]
+        apps.append(app)
+        servers.append(srv)
+        urls.append(f"http://{host}:{port}")
+    try:
+        routing = RoutingTable(str(export_dir), 3)
+        assert routing.reload()
+        metrics = MetricsRegistry()
+        group = ShardGroup(
+            ShardGroupConfig(num_shards=3, shard_deadline_s=2.0),
+            lambda i: urls[i],
+            metrics=metrics,
+            policy=RetryPolicy(max_attempts=2, connect_timeout_s=0.5,
+                               default_timeout_s=2.0),
+            routing=routing,
+        )
+        group.current_epoch = 1
+        # shard 2 "restarted into" iteration 2 on its own
+        _write_iteration(export_dir, 2, seed=2)
+        apps[2].registry.stage(D, 2)
+        apps[2].registry.flip(2)
+        full = ModelRegistry(str(export_dir))
+        full.refresh()
+        q = list(map(float, full.model.emb[0]))
+        status, doc = group.similar({"vectors": [q], "k": 3})
+        assert status == 200
+        assert doc["shards"]["epoch"] == 1, (
+            "the lone upgraded shard must not win the epoch vote"
+        )
+        assert doc["shards"]["indexes"] == [0, 1]
+        assert doc["degraded"] is True
+    finally:
+        for app in apps:
+            app.stop()
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+
+
+def test_routing_table_snapshot_is_atomic(export_dir):
+    rt = RoutingTable(str(export_dir), 2)
+    assert rt.reload()
+    snap = rt._snap
+    # owner() reads ONE snapshot: index and ranges always agree
+    assert snap.index is rt.index and snap.ranges is rt.ranges
