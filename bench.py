@@ -487,11 +487,13 @@ def bench_stamp(doc: dict) -> dict:
     """Stamp provenance into a bench JSON: ``schema_version`` + the
     producing command + creation time, so the ledger
     (gene2vec_tpu/obs/ledger.py) can tell a freshly produced record
-    from a legacy unstamped artifact and reproduce it."""
-    doc.setdefault("schema_version", 1)
-    doc.setdefault("command", " ".join([sys.executable, *sys.argv]))
-    doc.setdefault("created_unix", time.time())
-    return doc
+    from a legacy unstamped artifact and reproduce it.  Delegates to
+    the ledger's canonical ``provenance_stamp`` — the quality-eval
+    producers (scripts/run_intrinsic.py, scripts/run_real_auc.py,
+    cli.evaluate) stamp through the same convention."""
+    from gene2vec_tpu.obs.ledger import provenance_stamp
+
+    return provenance_stamp(doc)
 
 
 def timeline_overhead(

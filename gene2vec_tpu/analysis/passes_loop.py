@@ -1,0 +1,230 @@
+"""Continuous-learning promotion gate: BENCH_LOOP vs budgets.json
+``loop``.
+
+The chaos drill's loop phase (``scripts/chaos_drill.py``, phase
+``loop``) rehearses the whole continuous-learning cycle against a real
+fleet: incremental ingest under the CRC-stamped cursor, warm-start
+continued SGNS from the latest verified checkpoint, the holdout
+quality gate, a shadow-traffic canary against live load, and gated
+promotion through the existing swap machinery — with a REAL SIGKILL
+injected in every loop state and the cycle resumed from its journal.
+Results land in ``BENCH_LOOP_r*.json``; this pass re-checks the NEWEST
+committed record against the ``loop`` section of ``budgets.json`` on
+every ``cli.analyze`` run — a loop that quietly starts promoting
+churn-heavy candidates, dropping bit-exact resume, or serving wrong or
+mixed-iteration answers through a promotion fails the analyzer exactly
+like a collective-bytes regression does.
+
+Deliberately jax-free and I/O-only (two small JSON reads): it rides
+the DEFAULT tier.  A missing bench file is an *info* finding (a fresh
+checkout must not fail lint before its first drill); a record that
+exists and violates — or omits — a budgeted quantity, or was measured
+off the pinned recipe, gates hard (the passes_obs recipe-pinning
+lesson).  ``GENE2VEC_TPU_LOOP_ROOT`` overrides the artifact root for
+the planted-violation fixtures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+LOOP_ROOT_ENV = "GENE2VEC_TPU_LOOP_ROOT"
+BENCH_LOOP_NAME = "BENCH_LOOP_r16.json"
+
+_PASS = "loop-promotion-budget"
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    if isinstance(v, bool):
+        return float(v)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_loop_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_LOOP_r*`` under ``root`` (highest round wins,
+    mtime breaks ties) — a violating r17 must beat a stale clean r16,
+    the round convention every bench family follows."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched and matched[0] == "loop":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime, path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def loop_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the recorded loop drill against the budget."""
+    budgets: Dict = load_budgets(budgets_path).get("loop", {})
+    if not budgets:
+        return []
+    root = root or os.environ.get(LOOP_ROOT_ENV) or REPO_ROOT
+    path = _newest_loop_bench(root) or os.path.join(root, BENCH_LOOP_NAME)
+    label = os.path.basename(path)
+    if not os.path.exists(path):
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no continuous-learning bench recorded yet ({label} "
+                "missing); run `python scripts/chaos_drill.py --only "
+                f"loop --loop-out {label}` to stamp one"
+            ),
+        )]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable continuous-learning bench: {e}",
+        )]
+
+    findings: List[Finding] = []
+    for name, budget in budgets.items():
+        if name.startswith("_"):
+            continue
+        section = bench.get("loop")
+        if not isinstance(section, dict):
+            findings.append(Finding(
+                pass_id=_PASS,
+                path=label,
+                message=(
+                    f"{label} has no 'loop' results section to check "
+                    f"against budget {name!r}"
+                ),
+            ))
+            continue
+        findings.extend(_check_one(name, budget, section, label))
+    return findings
+
+
+def _check_one(
+    name: str, budget: Dict, section: Dict, label: str
+) -> List[Finding]:
+    data: Dict = {"budget": name}
+    problems: List[str] = []
+
+    # every budgeted quantity must be PRESENT: a record missing a field
+    # must gate like a violation, or dropping the key becomes the way
+    # to pass (the passes_fleet/passes_autoscale lesson)
+    def bounded(key: str, bound_key: str, *, what: str) -> None:
+        bound = _get(budget, bound_key)
+        if bound is None:
+            return
+        measured = _get(section, key)
+        data[key] = measured
+        data[bound_key] = bound
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif measured > bound:
+            problems.append(
+                f"{key} {measured:g} > budget {bound:g} ({what})"
+            )
+
+    def required(key: str, require_key: str, *, what: str) -> None:
+        if not budget.get(require_key):
+            return
+        measured = _get(section, key)
+        data[key] = measured
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif measured != 1.0:
+            problems.append(f"{key} is false ({what})")
+
+    bounded(
+        "answer_churn", "max_answer_churn",
+        what="the promoted candidate reshuffles live answers",
+    )
+    bounded(
+        "shadow_p99_delta_ms", "max_shadow_p99_delta_ms",
+        what="the candidate arm is pathologically slower than live",
+    )
+    bounded(
+        "wrong_answers", "max_wrong_answers",
+        what="the promotion produced wrong answers",
+    )
+    bounded(
+        "mixed_iteration_answers", "max_mixed_iteration_answers",
+        what="the promotion mixed model iterations",
+    )
+    bounded(
+        "promotion_decision_s", "max_promotion_decision_s",
+        what="the shadow verdict took too long to reach the fleet",
+    )
+    required(
+        "promoted", "require_promoted",
+        what="the cycle never promoted — the loop is wedged",
+    )
+    required(
+        "resume_bit_exact", "require_resume_bit_exact",
+        what="a SIGKILL-resumed continuation diverged from the "
+             "uninterrupted control",
+    )
+    # the budget pins the drill RECIPE — a no-kill, no-shadow run must
+    # not pass a continuous-learning gate by construction
+    for key in (
+        "replicas", "train_iters", "shadow_sample",
+        "min_shadow_requests", "states_killed",
+    ):
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(section, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(f"{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"drill ran with {key}={measured:g} but the budget pins "
+                f"{key}={pinned:g} — re-run with the budgeted recipe"
+            )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                f"continuous-learning record violates budget {name!r}: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"continuous-learning loop within budget {name!r}: "
+            f"promoted with answer churn {data.get('answer_churn')}, "
+            f"shadow p99 delta {data.get('shadow_p99_delta_ms')} ms, "
+            "zero wrong/mixed answers, bit-exact resume through "
+            f"{data.get('states_killed')} injected SIGKILLs"
+        ),
+        data=data,
+    )]

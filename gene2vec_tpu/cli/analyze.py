@@ -190,6 +190,13 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_shard import shard_findings
 
         findings.extend(shard_findings())
+        # ... and the continuous-learning gate (BENCH_LOOP promotion
+        # integrity: churn/p99-delta budgets, zero wrong/mixed answers,
+        # bit-exact SIGKILL resume vs budgets.json "loop",
+        # recipe-pinned)
+        from gene2vec_tpu.analysis.passes_loop import loop_findings
+
+        findings.extend(loop_findings())
 
     if args.hlo:
         _pin_cpu_backend()

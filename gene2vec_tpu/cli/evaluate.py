@@ -3,11 +3,18 @@
 ``python -m gene2vec_tpu.cli.evaluate emb_file gmt_file`` prints the score
 the reference's ``src/evaluation_target_function.py`` computes (pathways
 over 50 genes skipped, fixed seed 35 for the random-pair denominator).
+
+``--json`` (optionally with ``--out PATH``) emits a provenance-stamped
+JSON product instead — ``schema_version``/``command``/``created_unix``
+through the ledger's canonical stamp (the same convention ``bench.py``'s
+``bench_stamp()`` uses), so a committed evaluation artifact ingests
+into the bench ledger with provenance instead of ``legacy_unstamped``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
@@ -30,6 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-pathway-genes", type=int, default=MAX_PATHWAY_GENES)
     p.add_argument("--num-random-genes", type=int, default=RANDOM_PAIR_GENES)
     p.add_argument("--seed", type=int, default=RANDOM_SEED)
+    p.add_argument("--json", action="store_true",
+                   help="emit a provenance-stamped JSON document "
+                        "(schema_version/command/created_unix) instead "
+                        "of the bare score")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="also write the stamped JSON document to PATH "
+                        "(implies --json semantics for the file; "
+                        "stdout format still follows --json)")
     return p
 
 
@@ -42,7 +57,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         num_random_genes=args.num_random_genes,
         seed=args.seed,
     )
-    print(score)
+    if args.json or args.out:
+        from gene2vec_tpu.obs.ledger import provenance_stamp
+
+        doc = provenance_stamp({
+            "schema": "gene2vec-tpu/intrinsic-eval/v1",
+            "trained_target_func_ratio": score,
+            "emb_file": args.emb_file,
+            "gmt_file": args.gmt_file,
+            "max_pathway_genes": args.max_pathway_genes,
+            "num_random_genes": args.num_random_genes,
+            "seed": args.seed,
+        })
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1)
+    if args.json:
+        print(json.dumps(doc))
+    else:
+        print(score)
     return 0
 
 

@@ -136,6 +136,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="extra flag for ONE replica, as <index>:<flag> "
                         "(repeatable; the drill injects faults into a "
                         "single replica this way)")
+    p.add_argument("--enable-shadow", action="store_true",
+                   help="enable the continuous-learning shadow canary "
+                        "(loop/shadow.py): the front door exposes "
+                        "/v1/shadow/start|stop|report and, while a "
+                        "canary is active, duplicates a sampled "
+                        "fraction of live /v1/similar traffic to the "
+                        "candidate replica off the caller's latency "
+                        "path (cli.loop drives this; "
+                        "docs/CONTINUOUS.md)")
     p.add_argument("--shard-by-rows", type=int, default=0, metavar="N",
                    help="fleet-sharded index serving: run N replicas "
                         "each owning a CONTIGUOUS row shard of the "
@@ -308,6 +317,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: fleet failed to start: {e!r}", file=sys.stderr)
         run.close()
         return 2
+    shadow = None
+    if args.enable_shadow:
+        from gene2vec_tpu.loop.shadow import ShadowManager
+
+        shadow = ShadowManager(metrics=run.registry)
     proxy = FleetProxy(
         supervisor,
         metrics=run.registry,
@@ -323,6 +337,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         proxy_workers=args.proxy_workers,
         acceptors=args.proxy_acceptors,
         alert_rules=alert_rules,
+        shadow=shadow,
     )
     coordinator = None
     if args.shard_by_rows:
@@ -408,6 +423,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "replica_urls": [r.url for r in supervisor.replicas],
                 "replica_pids": [r.pid for r in supervisor.replicas],
                 "run_dir": run.run_dir,
+                "shadow": bool(args.enable_shadow),
                 "autoscale": (
                     {
                         "min": autoscale_cfg.min_replicas,

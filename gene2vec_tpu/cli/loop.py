@@ -1,0 +1,458 @@
+"""Continuous-learning loop CLI: one ingest→train→shadow→promote cycle.
+
+::
+
+    python -m gene2vec_tpu.cli.loop \\
+        --loop-root loop/ --serving-export exports/ \\
+        --batch new_study_pairs.txt --batch-id geo_2026_08 \\
+        --fleet-url http://127.0.0.1:8100
+
+Drives the journaled state machine (``loop/promote.py``) against a
+REAL fleet started with ``cli.fleet --enable-shadow``:
+
+1. **INGESTING** — append the batch to the loop corpus under the
+   durable CRC-stamped cursor (``loop/ingest.py``; idempotent).
+2. **TRAINING** — warm-start continued SGNS from the serving export's
+   latest verified checkpoint into this cycle's candidate export
+   (``loop/trainer.py``; SIGKILL-resume bit-exact).
+3. **QUALITY_GATE** — holdout AUC band + intrinsic ratio; a failing
+   candidate is DEMOTED (quarantined) without seeing traffic.
+4. **SHADOWING** — spawn a candidate ``cli.serve`` replica, start the
+   fleet's shadow canary, wait for enough scored live-traffic
+   duplicates, and judge answer churn + p99 delta against the budgets.
+5. **PROMOTING** — publish the candidate iteration into the serving
+   export (manifest-committed LAST) and wait for the fleet to adopt it
+   through its existing swap machinery (per-replica atomic refresh, or
+   the shard-atomic stage/flip coordinator).
+6. **SERVING** — terminal; the cycle report goes to stdout as exactly
+   ONE JSON line (the machine contract, like every serve-family CLI).
+
+A SIGKILL anywhere resumes: re-run the same command and the journal
+(``<loop_root>/loop_runs/<batch-id>/loop.jsonl``) skips committed
+states.  ``--crash-at STATE`` is the chaos drill's fault hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="loop",
+        description="Continuous-learning cycle: incremental ingest -> "
+        "warm-start SGNS -> quality gate -> shadow canary -> gated "
+        "promotion (docs/CONTINUOUS.md).",
+    )
+    p.add_argument("--loop-root", required=True,
+                   help="loop state root (ingest store, candidate "
+                        "exports, journals, quarantine)")
+    p.add_argument("--serving-export", required=True,
+                   help="the export dir the fleet serves — warm-start "
+                        "source and promotion target")
+    p.add_argument("--batch", required=True,
+                   help="new study batch: a pair-lines file ('GENE_A "
+                        "GENE_B' per line), or a reference-format "
+                        "query dir to run through corpus/builder.py")
+    p.add_argument("--batch-id", default=None,
+                   help="stable batch id (default: the --batch "
+                        "basename); ingest and the journal are "
+                        "idempotent per id — rerunning a killed cycle "
+                        "resumes it")
+    p.add_argument("--seed-corpus", default=None,
+                   help="pair-lines file ingested as batch id 'seed' "
+                        "when the loop root is brand new (the corpus "
+                        "the serving model was trained on)")
+    p.add_argument("--fleet-url", required=True,
+                   help="front door of a cli.fleet started with "
+                        "--enable-shadow")
+    p.add_argument("--dim", type=int, default=None,
+                   help="table width (default: the serving export's "
+                        "newest checkpoint dim)")
+    p.add_argument("--train-iters", type=int, default=2,
+                   help="continued iterations per cycle")
+    p.add_argument("--batch-pairs", type=int, default=4096)
+    p.add_argument("--sgns-seed", type=int, default=1,
+                   help="SGNSConfig.seed — MUST match the serving "
+                        "model's training seed for the RNG cursor to "
+                        "line up")
+    p.add_argument("--holdout-frac", type=float, default=0.2,
+                   help="stable-hash held-out fraction feeding the "
+                        "quality gate (never trained on)")
+    p.add_argument("--min-auc", type=float, default=None,
+                   help="quality-gate AUC floor (default: the "
+                        "canonical eval/holdout.py band)")
+    p.add_argument("--max-auc", type=float, default=None,
+                   help="quality-gate AUC ceiling (degeneration "
+                        "guard; default: the canonical band)")
+    p.add_argument("--shadow-sample", type=float, default=0.5,
+                   help="fraction of live /v1/similar traffic "
+                        "duplicated to the candidate")
+    p.add_argument("--shadow-min-requests", type=int, default=50,
+                   help="scored shadow pairs required before a "
+                        "verdict; fewer within --shadow-max-wait "
+                        "demotes (insufficient evidence)")
+    p.add_argument("--shadow-max-wait", type=float, default=120.0,
+                   help="max seconds to wait for shadow evidence")
+    p.add_argument("--max-churn", type=float, default=0.25,
+                   help="promotion ceiling on mean top-k answer churn "
+                        "(Jaccard) between live and candidate")
+    p.add_argument("--max-p99-delta-ms", type=float, default=250.0,
+                   help="promotion ceiling on (shadow p99 - live p99)")
+    p.add_argument("--promote-timeout", type=float, default=120.0,
+                   help="max seconds to wait for the fleet to adopt "
+                        "the published iteration")
+    p.add_argument("--crash-at", default=None, metavar="STATE",
+                   help="chaos hook: SIGKILL self right after entering "
+                        "STATE (or 'TRAINING_MID' = after the first "
+                        "continued iteration completes); the drill "
+                        "injects crashes into every loop state this "
+                        "way ($GENE2VEC_TPU_LOOP_CRASH works too)")
+    return p
+
+
+def _log(msg: str) -> None:
+    print(f"[loop] {msg}", file=sys.stderr, flush=True)
+
+
+def _http_json(url: str, body: Optional[dict] = None,
+               timeout: float = 10.0) -> dict:
+    data = None
+    headers = {}
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _read_batch_lines(path: str) -> List[str]:
+    if os.path.isdir(path):
+        from gene2vec_tpu.loop.ingest import batch_from_study_dir
+
+        return batch_from_study_dir(path, log=_log)
+    with open(path, "r", encoding="utf-8") as f:
+        return [ln for ln in f.read().splitlines() if ln.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    crash_at = args.crash_at or os.environ.get("GENE2VEC_TPU_LOOP_CRASH")
+
+    import dataclasses
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.io import checkpoint as ckpt
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.loop import ingest as ingest_mod
+    from gene2vec_tpu.loop import trainer as trainer_mod
+    from gene2vec_tpu.loop.promote import (
+        CycleDriver,
+        LoopJournal,
+        LoopState,
+        journal_path,
+        quarantine_candidate,
+    )
+    from gene2vec_tpu.resilience.preempt import PreemptionHandler
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    batch_id = args.batch_id or os.path.basename(args.batch)
+    loop_root = args.loop_root
+    serving = args.serving_export
+    candidate_dir = os.path.join(loop_root, "candidates", batch_id)
+
+    newest = None
+    for d, it, path in ckpt.iter_checkpoints_newest_first(
+        serving, verified_only=True, dim=args.dim
+    ):
+        newest = (d, it, path)
+        break
+    if newest is None:
+        print(
+            f"error: no verified checkpoint in {serving!r} to "
+            "warm-start from",
+            file=sys.stderr,
+        )
+        return 2
+    dim, serving_iter, newest_path = newest
+    config = SGNSConfig(
+        dim=dim, batch_pairs=args.batch_pairs, seed=args.sgns_seed,
+        txt_output=False,
+    )
+
+    # loop-root bootstrap (idempotent): the serving vocab anchors every
+    # future row id; an optional seed batch carries the original corpus
+    if ingest_mod.init_ingest(
+        loop_root, Vocab.load(ckpt.vocab_path_for(newest_path))
+    ):
+        _log(f"initialized ingest store under {loop_root}")
+    if args.seed_corpus:
+        facts = ingest_mod.ingest_batch(
+            loop_root, "seed", _read_batch_lines(args.seed_corpus),
+            replaces_base_counts=True,
+        )
+        if not facts["skipped"]:
+            _log(f"seed corpus ingested: {facts['appended_pairs']} pairs")
+
+    journal = LoopJournal(journal_path(loop_root, batch_id), batch_id)
+    preempt = PreemptionHandler().install()
+
+    # -- the real steps ----------------------------------------------------
+
+    def step_ingest(context) -> dict:
+        return ingest_mod.ingest_batch(
+            loop_root, batch_id, _read_batch_lines(args.batch)
+        )
+
+    def step_train(context) -> dict:
+        corpus, held = ingest_mod.load_loop_corpus(
+            loop_root, args.holdout_frac
+        )
+        log = _log
+        if crash_at == "TRAINING_MID":
+            # mid-state chaos: a genuine SIGKILL after the FIRST
+            # continued iteration finishes (its checkpoint may or may
+            # not have committed — exactly the window resume must cover)
+            import signal as _signal
+
+            seen = {"n": 0}
+
+            def log(msg: str, _inner=_log) -> None:  # noqa: ANN001
+                _inner(msg)
+                if " done: " in msg:
+                    seen["n"] += 1
+                    if seen["n"] == 1:
+                        _inner("CHAOS: SIGKILL self mid-TRAINING")
+                        os.kill(os.getpid(), _signal.SIGKILL)
+
+        params, base_it, final_it = trainer_mod.train_candidate(
+            serving, candidate_dir, corpus, config, args.train_iters,
+            preempt=preempt, log=log,
+        )
+        if preempt.triggered:
+            raise SystemExit(113)  # drained; resume finishes the cycle
+        return {
+            "candidate_dir": candidate_dir,
+            "dim": dim,
+            "base_iteration": base_it,
+            "final_iteration": final_it,
+            "vocab_size": corpus.vocab_size,
+            "held_pairs": len(held),
+        }
+
+    def step_quality(context) -> dict:
+        final_it = context[LoopState.TRAINING]["final_iteration"]
+        params, vocab, _meta = ckpt.load_iteration(
+            candidate_dir, dim, final_it, table_dtype=None
+        )
+        import numpy as np
+
+        _corpus, held = ingest_mod.load_loop_corpus(
+            loop_root, args.holdout_frac
+        )
+        report = trainer_mod.quality_report(
+            vocab, np.asarray(params.emb), held,
+            min_auc=args.min_auc, max_auc=args.max_auc,
+        )
+        _log(f"quality gate: {report}")
+        return report
+
+    def _spawn_candidate(final_it: int) -> dict:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gene2vec_tpu.cli.serve",
+             "--export-dir", candidate_dir, "--port", "0",
+             "--poll-interval", "3600"],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "PYTHONUNBUFFERED": "1"},
+        )
+        info = read_contract_line(proc, 180.0)
+        if info.get("iteration") != final_it:
+            proc.kill()
+            raise RuntimeError(
+                f"candidate replica loaded iteration "
+                f"{info.get('iteration')}, expected {final_it}"
+            )
+        # warm the candidate's jit buckets BEFORE shadowing starts: the
+        # canary's p99 delta must measure the MODEL, not first-query
+        # compile time (a real rollout warms before it canaries)
+        try:
+            g = _http_json(
+                info["url"] + "/v1/genes?limit=1", timeout=30.0
+            )["genes"][0]
+            for k in (5, 10, 32):
+                _http_json(
+                    info["url"] + "/v1/similar",
+                    {"genes": [g], "k": k}, timeout=60.0,
+                )
+        except Exception as e:
+            _log(f"candidate warmup failed (continuing): {e!r}")
+        return {"url": info["url"], "pid": proc.pid}
+
+    def step_shadow(context) -> dict:
+        final_it = context[LoopState.TRAINING]["final_iteration"]
+        # reap any candidate a killed earlier attempt left behind (its
+        # pid was journaled the moment it spawned) before starting ours
+        for rec in journal.replay():
+            pid = (rec.get("facts", {}).get("candidate") or {}).get("pid")
+            if pid:
+                try:
+                    os.kill(int(pid), 15)
+                except (OSError, ValueError):
+                    pass
+        cand = _spawn_candidate(final_it)
+        # journal the spawn immediately — a SIGKILL between here and
+        # this state's "done" must not orphan a serving process
+        journal.enter(LoopState.SHADOWING, candidate=cand)
+        _log(f"candidate replica up at {cand['url']} (pid {cand['pid']})")
+        t0 = time.monotonic()
+        _http_json(
+            args.fleet_url + "/v1/shadow/start",
+            {"url": cand["url"], "sample": args.shadow_sample},
+        )
+        deadline = time.monotonic() + args.shadow_max_wait
+        report: dict = {}
+        while time.monotonic() < deadline:
+            doc = _http_json(args.fleet_url + "/v1/shadow/report")
+            report = doc.get("report", {})
+            if report.get("scored", 0) >= args.shadow_min_requests:
+                break
+            time.sleep(0.5)
+        _http_json(args.fleet_url + "/v1/shadow/stop", {})
+        facts = {
+            "candidate": cand,
+            "final_iteration": final_it,
+            "shadow_sample": args.shadow_sample,
+            "shadow_wait_s": round(time.monotonic() - t0, 3),
+            "report": report,
+        }
+        churn = report.get("answer_churn")
+        delta = report.get("p99_delta_ms")
+        scored = report.get("scored", 0)
+        if scored < args.shadow_min_requests:
+            facts.update(verdict="demote", reason=(
+                f"insufficient shadow evidence: {scored} scored < "
+                f"{args.shadow_min_requests} within "
+                f"{args.shadow_max_wait}s"
+            ))
+        elif churn is None or churn > args.max_churn:
+            facts.update(verdict="demote", reason=(
+                f"answer churn {churn} over the {args.max_churn} budget"
+            ))
+        elif delta is not None and delta > args.max_p99_delta_ms:
+            facts.update(verdict="demote", reason=(
+                f"shadow p99 delta {delta}ms over the "
+                f"{args.max_p99_delta_ms}ms budget"
+            ))
+        else:
+            facts["verdict"] = "promote"
+        _log(f"shadow verdict: {facts['verdict']}")
+        return facts
+
+    def _kill_candidate(context) -> None:
+        cand = (context.get(LoopState.SHADOWING) or {}).get("candidate")
+        if cand and cand.get("pid"):
+            try:
+                os.kill(int(cand["pid"]), 15)
+            except (OSError, ValueError):
+                pass
+
+    def step_promote(context) -> dict:
+        final_it = context[LoopState.TRAINING]["final_iteration"]
+        t0 = time.monotonic()
+        ckpt.publish_iteration(candidate_dir, serving, dim, final_it)
+        _log(f"published iteration {final_it} into {serving}")
+        deadline = time.monotonic() + args.promote_timeout
+        adopted = False
+        while time.monotonic() < deadline:
+            try:
+                health = _http_json(args.fleet_url + "/healthz")
+            except Exception:
+                time.sleep(0.5)
+                continue
+            if "shards" in health:
+                adopted = health.get("epoch") == final_it and all(
+                    s.get("epoch") == final_it
+                    for s in health.get("shards", [])
+                )
+            else:
+                urls = [
+                    r.get("url") for r in health.get("replicas", [])
+                    if r.get("state") == "up" and r.get("url")
+                ]
+                up_iters = []
+                for u in urls:
+                    try:
+                        h = _http_json(u + "/healthz", timeout=5.0)
+                        up_iters.append(
+                            (h.get("model") or {}).get("iteration")
+                        )
+                    except Exception:
+                        up_iters.append(None)
+                adopted = bool(up_iters) and all(
+                    it == final_it for it in up_iters
+                )
+            if adopted:
+                break
+            time.sleep(0.5)
+        if not adopted:
+            raise TimeoutError(
+                f"fleet did not adopt iteration {final_it} within "
+                f"{args.promote_timeout}s — journal holds at PROMOTING "
+                "(re-run to retry)"
+            )
+        return {
+            "promoted_iteration": final_it,
+            "adoption_s": round(time.monotonic() - t0, 3),
+        }
+
+    def step_serving(context) -> dict:
+        _kill_candidate(context)
+        return {
+            "promoted_iteration":
+                context[LoopState.PROMOTING]["promoted_iteration"],
+        }
+
+    def step_demote(context) -> dict:
+        _kill_candidate(context)
+        q = quarantine_candidate(loop_root, candidate_dir, batch_id)
+        return {"quarantined": q}
+
+    driver = CycleDriver(
+        journal,
+        steps={
+            LoopState.INGESTING: step_ingest,
+            LoopState.TRAINING: step_train,
+            LoopState.QUALITY_GATE: step_quality,
+            LoopState.SHADOWING: step_shadow,
+            LoopState.PROMOTING: step_promote,
+            LoopState.SERVING: step_serving,
+        },
+        demote_step=step_demote,
+        crash_at=crash_at,
+        log=_log,
+    )
+    result = driver.run()
+    walls = journal.state_walls()
+    contract = {
+        "batch_id": batch_id,
+        "state": result["state"],
+        "dim": dim,
+        "serving_iteration_before": serving_iter,
+        "journal": journal.path,
+        "facts": result["context"],
+        "state_walls": walls,
+    }
+    print(json.dumps(contract, default=str), flush=True)
+    return 0 if result["state"] == LoopState.SERVING else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,24 @@ Layout in <export_dir>:
     gene2vec_dim_<D>_iter_<N>.npz           emb, ctx, meta json
     gene2vec_dim_<D>_iter_<N>.txt           matrix-txt export
     gene2vec_dim_<D>_iter_<N>_w2v.txt       word2vec-format export
+    gene2vec_dim_<D>_iter_<N>.vocab.tsv     per-iteration vocab SIDECAR —
+                                            present only when this
+                                            iteration's vocab is a TAIL
+                                            EXTENSION of vocab.tsv (the
+                                            continuous-learning loop's
+                                            new-gene case, loop/ingest.py);
+                                            readers prefer it via
+                                            vocab_path_for()
     gene2vec_dim_<D>_iter_<N>.MANIFEST.json crc/size stamp (commit record)
+
+Vocab evolution (docs/CONTINUOUS.md): ``vocab.tsv`` is immutable once
+written — every older manifest CRC-covers it, so rewriting it would
+retroactively "tear" the whole export history.  An iteration whose
+vocab GREW (new genes appended at the tail; existing row ids stay
+stable) therefore carries its own ``<prefix>.vocab.tsv`` sidecar,
+covered by that iteration's manifest instead of the shared file.  Any
+other vocab difference is still refused — only tail extension keeps
+old row ids (and the fleet's gene→shard routing) meaningful.
 
 Crash safety (docs/RESILIENCE.md): every file is written to a temp name
 and atomically renamed into place, and the iteration's ``MANIFEST`` —
@@ -155,6 +172,35 @@ def ckpt_prefix(export_dir: str, dim: int, iteration: int) -> str:
     return os.path.join(export_dir, f"gene2vec_dim_{dim}_iter_{iteration}")
 
 
+def vocab_path_for(ckpt_path: str) -> str:
+    """The vocab file that describes ``ckpt_path``'s rows: the
+    per-iteration ``<prefix>.vocab.tsv`` sidecar when present (a
+    vocab-tail-extended iteration, see the module doc), else the export
+    dir's shared ``vocab.tsv``.  Accepts an ``.npz`` path, a
+    ``_w2v.txt`` path, or a bare checkpoint prefix."""
+    if ckpt_path.endswith(".npz"):
+        prefix = ckpt_path[: -len(".npz")]
+    elif ckpt_path.endswith("_w2v.txt"):
+        prefix = ckpt_path[: -len("_w2v.txt")]
+    else:
+        prefix = ckpt_path
+    sidecar = prefix + ".vocab.tsv"
+    if os.path.exists(sidecar):
+        return sidecar
+    return os.path.join(
+        os.path.dirname(os.path.abspath(ckpt_path)), "vocab.tsv"
+    )
+
+
+def is_tail_extension(old_tokens, new_tokens) -> bool:
+    """Whether ``new_tokens`` keeps every existing row id stable: the
+    old id order is an exact PREFIX and new genes only append."""
+    return (
+        len(new_tokens) >= len(old_tokens)
+        and list(new_tokens[: len(old_tokens)]) == list(old_tokens)
+    )
+
+
 def save_iteration(
     export_dir: str,
     dim: int,
@@ -165,18 +211,27 @@ def save_iteration(
     meta: Optional[dict] = None,
 ) -> str:
     os.makedirs(export_dir, exist_ok=True)
+    prefix = ckpt_prefix(export_dir, dim, iteration)
     vocab_path = os.path.join(export_dir, "vocab.tsv")
     if os.path.exists(vocab_path):
         existing = Vocab.load(vocab_path)
         if existing.id_to_token != vocab.id_to_token:
-            raise ValueError(
-                f"{vocab_path} was written for a different corpus "
-                f"({len(existing)} tokens vs {len(vocab)}); refusing to mix "
-                "checkpoints with mismatched vocabularies in one export dir"
-            )
+            if is_tail_extension(existing.id_to_token, vocab.id_to_token):
+                # vocab GREW at the tail (continuous-learning ingest):
+                # vocab.tsv must stay untouched — every older manifest
+                # CRC-covers it — so this iteration carries its own
+                # sidecar, which vocab_path_for() prefers at load time
+                vocab_path = prefix + ".vocab.tsv"
+                snap.atomic_write_via(vocab.save, vocab_path)
+            else:
+                raise ValueError(
+                    f"{vocab_path} was written for a different corpus "
+                    f"({len(existing)} tokens vs {len(vocab)}, not a "
+                    "tail extension); refusing to mix checkpoints with "
+                    "mismatched vocabularies in one export dir"
+                )
     else:
         snap.atomic_write_via(vocab.save, vocab_path)
-    prefix = ckpt_prefix(export_dir, dim, iteration)
     # npz has no bfloat16 dtype: store f32 (a lossless upcast of bf16
     # tables — every bf16 value is exactly representable) and record the
     # training width so load_iteration can restore it
@@ -212,6 +267,58 @@ def save_iteration(
         files += optional
     snap.write_manifest(prefix, files, meta=meta, optional=optional)
     return prefix + ".npz"
+
+
+def publish_iteration(
+    src_dir: str, dst_dir: str, dim: int, iteration: int
+) -> str:
+    """Atomically publish one VERIFIED iteration from ``src_dir`` (a
+    continuous-learning candidate export, loop/promote.py) into
+    ``dst_dir`` (the serving export the fleet watches).
+
+    The npz lands via the snapshot primitives and the manifest is
+    written LAST, so the serving watchers' manifest-verified discovery
+    only ever sees the iteration fully committed — promotion then rides
+    the existing swap machinery (per-replica atomic refresh, or the
+    fleet's shard-atomic stage/flip) unchanged.  A candidate whose
+    vocab tail-extends the serving vocab publishes a per-iteration
+    sidecar (see the module doc); any other vocab difference refuses.
+    Returns the destination npz path.  Raises if the source iteration
+    does not verify — a torn candidate must never be promoted."""
+    src_prefix = ckpt_prefix(src_dir, dim, iteration)
+    res = snap.verify_manifest(src_prefix)
+    if not res:
+        raise IOError(
+            f"refusing to publish unverified candidate "
+            f"dim={dim} iter={iteration} from {src_dir!r}: {res.reason}"
+        )
+    vocab = Vocab.load(vocab_path_for(src_prefix + ".npz"))
+    with np.load(src_prefix + ".npz") as z:
+        meta = json.loads(str(z["meta"])) if "meta" in z.files else {}
+    os.makedirs(dst_dir, exist_ok=True)
+    dst_prefix = ckpt_prefix(dst_dir, dim, iteration)
+    snap.atomic_copy(src_prefix + ".npz", dst_prefix + ".npz")
+    files = [dst_prefix + ".npz"]
+    dst_vocab = os.path.join(dst_dir, "vocab.tsv")
+    if not os.path.exists(dst_vocab):
+        snap.atomic_write_via(vocab.save, dst_vocab)
+        files.append(dst_vocab)
+    else:
+        existing = Vocab.load(dst_vocab)
+        if existing.id_to_token == vocab.id_to_token:
+            files.append(dst_vocab)
+        elif is_tail_extension(existing.id_to_token, vocab.id_to_token):
+            sidecar = dst_prefix + ".vocab.tsv"
+            snap.atomic_write_via(vocab.save, sidecar)
+            files.append(sidecar)
+        else:
+            raise ValueError(
+                f"candidate vocab ({len(vocab)} tokens) is not a tail "
+                f"extension of {dst_vocab} ({len(existing)} tokens) — "
+                "promotion would break existing row ids"
+            )
+    snap.write_manifest(dst_prefix, files, meta=meta)
+    return dst_prefix + ".npz"
 
 
 def read_npz_rows(path: str, name: str, start: int,
@@ -321,7 +428,9 @@ def load_iteration(
         dtype = jnp.dtype(table_dtype if table_dtype is not None else saved)
         emb = jnp.asarray(z["emb"], dtype=dtype)
         ctx = jnp.asarray(z["ctx"], dtype=dtype)
-    vocab = Vocab.load(os.path.join(export_dir, "vocab.tsv"))
+    # per-iteration sidecar vocab (tail-extended iterations) wins over
+    # the shared vocab.tsv — the rows being loaded were trained on it
+    vocab = Vocab.load(vocab_path_for(prefix + ".npz"))
     return SGNSParams(emb=emb, ctx=ctx), vocab, meta
 
 

@@ -1,0 +1,25 @@
+"""Continuous-learning loop (docs/CONTINUOUS.md).
+
+The reference is a one-shot batch pipeline; production is a loop:
+
+    ingest.py   — incremental study-batch ingest with a durable,
+                  CRC-stamped cursor (SIGKILL mid-append never produces
+                  a half-counted batch; new genes extend the vocab TAIL
+                  so existing row ids stay stable)
+    trainer.py  — warm-start continued SGNS from the latest verified
+                  checkpoint (bit-exact with an uninterrupted run, new
+                  gene rows seeded deterministically) + the intrinsic/
+                  holdout quality gate
+    shadow.py   — shadow-traffic canary: the fleet front door
+                  duplicates a sample of live /v1/similar traffic to a
+                  candidate replica off the caller's latency path and
+                  diffs answer churn + latency between arms
+    promote.py  — the journaled state machine (INGESTING → TRAINING →
+                  QUALITY_GATE → SHADOWING → PROMOTING → SERVING, or
+                  DEMOTED) that promotes through the existing swap
+                  protocols only inside budgets.json "loop" bounds
+
+``python -m gene2vec_tpu.cli.loop`` drives one cycle against a real
+fleet; ``scripts/chaos_drill.py --only loop`` rehearses it with a
+SIGKILL in every state.
+"""

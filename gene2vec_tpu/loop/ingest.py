@@ -1,0 +1,329 @@
+"""Incremental pair-corpus ingest with a durable, CRC-stamped cursor.
+
+New GEO study batches arrive continuously; each is appended to ONE
+append-only pair corpus (``<loop_root>/ingest/pairs.txt``) under a
+commit protocol built on the resilience snapshot primitives
+(docs/RESILIENCE.md failure model — a writer can die at ANY
+instruction):
+
+1. **Recover** — if ``pairs.txt`` is longer than the cursor's committed
+   byte offset, a previous appender died mid-write: truncate back to
+   the committed prefix (whose rolling CRC32 the cursor stamps, so
+   post-commit rot is detected too, not just torn appends).
+2. **Append** — the batch's pair lines are appended and fsync'd.
+3. **Commit** — a new ``CURSOR.json`` (batch id, corpus byte offset,
+   rolling corpus CRC32, vocab size — self-CRC-stamped, previous cursor
+   kept as ``CURSOR.prev.json``) is written atomically LAST.  A SIGKILL
+   anywhere before this leaves the batch uncommitted; the next attempt
+   truncates and replays it.  Batch ids make replay idempotent.
+
+**Vocab stability is the point.**  The vocabulary is always derived
+deterministically as ``BASE_VOCAB.tsv`` (the serving model's vocab at
+loop init — its id order IS the serving table's row order and the
+fleet's gene→shard routing) extended by scanning the committed corpus
+prefix in order: existing genes keep their ids (counts accumulate), new
+genes append at the TAIL in first-appearance order.  Existing row ids
+never move, so a warm-started candidate's first ``len(base)`` rows stay
+aligned with the serving table.  When the ORIGINAL training corpus is
+re-ingested as a batch (``replaces_base_counts=True`` — the CLI's
+``--seed-corpus`` flow), the base counts are dropped and counts come
+from the corpus scan alone: base counts already reflect that corpus,
+and adding both would double every pre-existing gene's frequency and
+skew the negative-sampling unigram distribution against new genes.
+
+Study batches can come straight from ``corpus/builder.py``
+(:func:`batch_from_study_dir` runs the per-study co-expression
+thresholding pipeline) or as pre-built pair lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.resilience import snapshot as snap
+
+CURSOR_SCHEMA = "gene2vec-tpu/loop-ingest-cursor/v1"
+CURSOR_NAME = "CURSOR.json"
+CURSOR_PREV_NAME = "CURSOR.prev.json"
+BASE_VOCAB_NAME = "BASE_VOCAB.tsv"
+PAIRS_NAME = "pairs.txt"
+
+#: stable held-out fraction denominator for the quality gate's split
+HOLDOUT_MOD = 1000
+
+
+def ingest_dir(loop_root: str) -> str:
+    return os.path.join(loop_root, "ingest")
+
+
+def _cursor_payload_crc(doc: Dict) -> int:
+    body = {k: v for k, v in sorted(doc.items()) if k != "cursor_crc32"}
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True).encode("utf-8")
+    ) & 0xFFFFFFFF
+
+
+def _empty_cursor() -> Dict:
+    return {
+        "schema": CURSOR_SCHEMA,
+        "batches": [],
+        "corpus_bytes": 0,
+        "corpus_crc32": 0,
+        "vocab_size": 0,
+    }
+
+
+def _write_cursor(idir: str, doc: Dict) -> None:
+    doc = dict(doc)
+    doc["cursor_crc32"] = _cursor_payload_crc(doc)
+    cur = os.path.join(idir, CURSOR_NAME)
+    if os.path.exists(cur):
+        # keep the last good cursor: a cursor torn by post-write rot
+        # falls back one commit instead of losing the whole offset
+        with open(cur, "rb") as f:
+            snap.atomic_write_bytes(
+                os.path.join(idir, CURSOR_PREV_NAME), f.read()
+            )
+    snap.atomic_write_json(cur, doc)
+
+
+def load_cursor(loop_root: str) -> Dict:
+    """The newest readable, self-CRC-valid cursor (falling back to the
+    previous commit, then to an empty cursor — an absent ingest store
+    simply has nothing committed).  A store that clearly HAS committed
+    data (non-empty ``pairs.txt``) but no valid cursor raises instead:
+    treating it as fresh would let :func:`_recover` truncate the whole
+    committed corpus to the empty cursor's zero offset."""
+    idir = ingest_dir(loop_root)
+    for name in (CURSOR_NAME, CURSOR_PREV_NAME):
+        path = os.path.join(idir, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("cursor_crc32") != _cursor_payload_crc(doc):
+            continue
+        return doc
+    pairs = os.path.join(idir, PAIRS_NAME)
+    if os.path.exists(pairs) and os.path.getsize(pairs) > 0:
+        raise IOError(
+            f"{idir}: committed corpus present but no readable "
+            "self-CRC-valid cursor (both CURSOR.json and "
+            "CURSOR.prev.json lost/rotted) — restore a cursor before "
+            "ingesting; proceeding would truncate the corpus"
+        )
+    return _empty_cursor()
+
+
+def _recover(idir: str, cursor: Dict) -> None:
+    """Enforce the cursor's committed prefix: truncate a torn append,
+    verify the prefix CRC (post-commit rot raises — the corpus is the
+    training input; training on rotted bytes silently would be worse
+    than stopping)."""
+    pairs = os.path.join(idir, PAIRS_NAME)
+    committed = int(cursor.get("corpus_bytes", 0))
+    size = os.path.getsize(pairs) if os.path.exists(pairs) else 0
+    if size > committed:
+        with open(pairs, "r+b") as f:
+            f.truncate(committed)
+            f.flush()
+            os.fsync(f.fileno())
+    elif size < committed:
+        raise IOError(
+            f"{pairs}: {size} bytes on disk but the cursor committed "
+            f"{committed} — the corpus was truncated after commit"
+        )
+    if committed:
+        crc = 0
+        with open(pairs, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+        if (crc & 0xFFFFFFFF) != int(cursor.get("corpus_crc32", 0)):
+            raise IOError(
+                f"{pairs}: committed prefix CRC mismatch — the corpus "
+                "rotted after commit; restore it before ingesting"
+            )
+
+
+def init_ingest(loop_root: str, base_vocab: Vocab) -> bool:
+    """Create the ingest store (idempotent).  ``base_vocab`` is the
+    SERVING model's vocab — its id order anchors every future row id.
+    Returns whether this call created the store."""
+    idir = ingest_dir(loop_root)
+    os.makedirs(idir, exist_ok=True)
+    base_path = os.path.join(idir, BASE_VOCAB_NAME)
+    if os.path.exists(base_path):
+        return False
+    snap.atomic_write_via(base_vocab.save, base_path)
+    pairs = os.path.join(idir, PAIRS_NAME)
+    if not os.path.exists(pairs):
+        with open(pairs, "wb") as f:
+            f.flush()
+            os.fsync(f.fileno())
+    _write_cursor(idir, _empty_cursor())
+    return True
+
+
+def ingest_batch(
+    loop_root: str, batch_id: str, lines: Sequence[str],
+    replaces_base_counts: bool = False,
+) -> Dict:
+    """Append one study batch under the commit protocol (module doc).
+    Idempotent by ``batch_id``: a committed batch is skipped, so a
+    resumed loop cycle replays this step for free.  Returns the batch
+    facts (pairs appended, new genes, committed corpus offset).
+
+    ``replaces_base_counts`` marks this batch as a re-ingest of the
+    corpus the serving model was trained on; once committed,
+    :func:`loop_vocab` takes counts from the corpus scan alone (module
+    doc).  The flag is sticky in the cursor — it survives SIGKILL and
+    later batches."""
+    idir = ingest_dir(loop_root)
+    if not os.path.exists(os.path.join(idir, BASE_VOCAB_NAME)):
+        raise FileNotFoundError(
+            f"no ingest store under {loop_root!r} — call init_ingest "
+            "with the serving model's vocab first"
+        )
+    cursor = load_cursor(loop_root)
+    if batch_id in cursor.get("batches", []):
+        # the cursor already committed this batch's vocab size — no
+        # need to re-scan the whole (ever-growing) corpus on replay
+        return {
+            "batch_id": batch_id,
+            "skipped": True,
+            "appended_pairs": 0,
+            "new_genes": 0,
+            "vocab_size": int(cursor["vocab_size"]),
+            "corpus_bytes": int(cursor["corpus_bytes"]),
+        }
+    _recover(idir, cursor)
+    before = loop_vocab(loop_root)
+    clean = [ln.strip() for ln in lines if ln.strip()]
+    data = ("\n".join(clean) + "\n").encode("utf-8") if clean else b""
+    pairs = os.path.join(idir, PAIRS_NAME)
+    with open(pairs, "ab") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    snap.fsync_dir(idir)
+    new_tokens = set()
+    for ln in clean:
+        for tok in ln.split():
+            if tok not in before.token_to_id:
+                new_tokens.add(tok)
+    new_cursor = {
+        "schema": CURSOR_SCHEMA,
+        "batches": list(cursor.get("batches", [])) + [batch_id],
+        "corpus_bytes": int(cursor["corpus_bytes"]) + len(data),
+        "corpus_crc32": zlib.crc32(
+            data, int(cursor.get("corpus_crc32", 0))
+        ) & 0xFFFFFFFF,
+        "vocab_size": len(before) + len(new_tokens),
+        "base_counts_replaced": bool(
+            cursor.get("base_counts_replaced")
+        ) or replaces_base_counts,
+    }
+    _write_cursor(idir, new_cursor)
+    return {
+        "batch_id": batch_id,
+        "skipped": False,
+        "appended_pairs": len(clean),
+        "new_genes": len(new_tokens),
+        "vocab_size": new_cursor["vocab_size"],
+        "corpus_bytes": new_cursor["corpus_bytes"],
+    }
+
+
+def _committed_lines(loop_root: str, cursor: Dict) -> List[List[str]]:
+    """Token pairs from the committed corpus prefix only (bytes past
+    the cursor belong to an uncommitted append and must not train)."""
+    pairs = os.path.join(ingest_dir(loop_root), PAIRS_NAME)
+    committed = int(cursor.get("corpus_bytes", 0))
+    if committed == 0 or not os.path.exists(pairs):
+        return []
+    with open(pairs, "rb") as f:
+        blob = f.read(committed)
+    out = []
+    for ln in blob.decode("utf-8").splitlines():
+        toks = ln.split()
+        if len(toks) >= 2:
+            out.append(toks[:2])
+    return out
+
+
+def loop_vocab(loop_root: str, cursor: Optional[Dict] = None) -> Vocab:
+    """The deterministic loop vocabulary: BASE_VOCAB's id order
+    (counts included unless a ``replaces_base_counts`` batch committed
+    — module doc), extended by the committed corpus in order —
+    existing genes accumulate counts in place, new genes append at the
+    tail in first-appearance order.  Recomputable from disk at any
+    time, so a SIGKILL can never leave a half-extended vocab behind."""
+    idir = ingest_dir(loop_root)
+    base = Vocab.load(os.path.join(idir, BASE_VOCAB_NAME))
+    cursor = cursor if cursor is not None else load_cursor(loop_root)
+    tokens = list(base.id_to_token)
+    if cursor.get("base_counts_replaced"):
+        # the committed corpus contains the serving model's original
+        # corpus (a replaces_base_counts batch): base supplies only the
+        # id order — adding its counts too would double-count every
+        # pre-existing gene (module doc)
+        counts = {t: 0 for t in tokens}
+    else:
+        counts = {
+            t: int(c) for t, c in zip(base.id_to_token, base.counts)
+        }
+    for a, b in _committed_lines(loop_root, cursor):
+        for tok in (a, b):
+            if tok not in counts:
+                tokens.append(tok)
+                counts[tok] = 0
+            counts[tok] += 1
+    return Vocab(tokens, np.asarray([counts[t] for t in tokens]))
+
+
+def pair_held(a: str, b: str, fraction: float, salt: str = "loop") -> bool:
+    """Stable holdout membership for the quality gate: keyed on the
+    UNORDERED pair (both directions of one biological pair are held
+    together — no leakage) and on the gene names, so the split never
+    shifts as the corpus grows."""
+    lo, hi = sorted((a, b))
+    h = zlib.crc32(f"{salt}:{lo} {hi}".encode("utf-8")) % HOLDOUT_MOD
+    return h < int(fraction * HOLDOUT_MOD)
+
+
+def load_loop_corpus(
+    loop_root: str, holdout_fraction: float = 0.2
+) -> Tuple["object", List[List[str]]]:
+    """(training PairCorpus, held-out pair list) over the committed
+    corpus.  The held fraction (stable hash split, :func:`pair_held`)
+    never trains — it is the quality gate's evaluation set."""
+    from gene2vec_tpu.data.pipeline import PairCorpus
+
+    cursor = load_cursor(loop_root)
+    vocab = loop_vocab(loop_root, cursor)
+    lines = _committed_lines(loop_root, cursor)
+    train = [p for p in lines if not pair_held(*p, holdout_fraction)]
+    held = [p for p in lines if pair_held(*p, holdout_fraction)]
+    return PairCorpus(vocab, vocab.encode_pairs(train)), held
+
+
+def batch_from_study_dir(query_dir: str, **build_kwargs) -> List[str]:
+    """One study batch straight from the reference-format query dir via
+    the corpus builder's per-study co-expression pipeline
+    (``corpus/builder.py build_pairs`` — TPU-path correlation, same
+    thresholding recipe as the original one-shot build)."""
+    from gene2vec_tpu.corpus.builder import build_pairs
+
+    return build_pairs(query_dir, out_path=None, **build_kwargs)

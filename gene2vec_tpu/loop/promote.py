@@ -1,0 +1,263 @@
+"""The promotion controller: a journaled, resumable loop state machine.
+
+States, in order::
+
+    INGESTING → TRAINING → QUALITY_GATE → SHADOWING → PROMOTING → SERVING
+
+with ``DEMOTED`` as the terminal failure branch (a candidate that
+fails the quality gate or the shadow budgets is quarantined — moved
+under ``<loop_root>/quarantine/`` — and the fleet keeps serving the
+live iteration untouched).
+
+Every transition is journaled to ``<loop_root>/loop_runs/<cycle>/
+loop.jsonl`` — one JSON record per line, appended + fsync'd, a torn
+final line (SIGKILL mid-append) ignored on replay.  The journal is the
+resume cursor: a killed cycle re-runs only the states that never
+recorded ``done``, and each state's step is itself idempotent (the
+ingest cursor, the checkpoint resume machinery, the epoch-token swap),
+so a SIGKILL in ANY state resumes instead of retraining from scratch.
+
+The driver is deliberately process-agnostic: ``cli.loop`` wires the
+real steps (ingest store, warm-start trainer, fleet shadow admin,
+publish + swap-wait) and tests wire fakes.  ``crash_at`` is the chaos
+hook the drill uses — a REAL ``SIGKILL`` to our own pid immediately
+after the state's ``enter`` record commits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class LoopState:
+    INGESTING = "INGESTING"
+    TRAINING = "TRAINING"
+    QUALITY_GATE = "QUALITY_GATE"
+    SHADOWING = "SHADOWING"
+    PROMOTING = "PROMOTING"
+    SERVING = "SERVING"
+    DEMOTED = "DEMOTED"
+
+
+STATE_ORDER = (
+    LoopState.INGESTING,
+    LoopState.TRAINING,
+    LoopState.QUALITY_GATE,
+    LoopState.SHADOWING,
+    LoopState.PROMOTING,
+    LoopState.SERVING,
+)
+
+JOURNAL_NAME = "loop.jsonl"
+JOURNAL_SCHEMA = "gene2vec-tpu/loop-journal/v1"
+
+
+def journal_path(loop_root: str, cycle_id: str) -> str:
+    return os.path.join(loop_root, "loop_runs", cycle_id, JOURNAL_NAME)
+
+
+class LoopJournal:
+    """Append-only transition log; the cycle's durable resume cursor.
+
+    Records: ``{"schema", "cycle", "seq", "wall", "state", "event":
+    "enter"|"done", "facts": {...}}``.  Appends fsync before returning
+    — a record the caller saw committed survives a SIGKILL; a torn
+    final line is dropped by :meth:`replay` (it was never committed)."""
+
+    def __init__(self, path: str, cycle_id: str):
+        self.path = path
+        self.cycle_id = cycle_id
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._seq = len(self.replay())
+
+    def _repair_tail(self) -> None:
+        # A writer SIGKILLed mid-append leaves a torn final line with
+        # no trailing newline; appending onto it would merge two
+        # records into one line and turn a droppable tear into
+        # pre-final corruption that replay() must raise on.  Truncate
+        # back to the last committed (newline-terminated) record.
+        try:
+            if os.path.getsize(self.path) == 0:
+                return
+        except OSError:
+            return
+        with open(self.path, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            f.seek(0)
+            f.truncate(f.read().rfind(b"\n") + 1)
+
+    def _append(self, record: Dict) -> None:
+        line = json.dumps(record, default=str)
+        self._repair_tail()
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._seq += 1
+
+    def enter(self, state: str, **facts) -> None:
+        self._append({
+            "schema": JOURNAL_SCHEMA, "cycle": self.cycle_id,
+            "seq": self._seq, "wall": time.time(),
+            "state": state, "event": "enter", "facts": facts,
+        })
+
+    def done(self, state: str, **facts) -> None:
+        self._append({
+            "schema": JOURNAL_SCHEMA, "cycle": self.cycle_id,
+            "seq": self._seq, "wall": time.time(),
+            "state": state, "event": "done", "facts": facts,
+        })
+
+    def replay(self) -> List[Dict]:
+        """Committed records, oldest first.  A torn/unparseable final
+        line is ignored — the writer died mid-append and the record
+        never committed; a torn line anywhere EARLIER means post-commit
+        corruption and raises."""
+        if not os.path.exists(self.path):
+            return []
+        out: List[Dict] = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                if i == len(lines) - 1:
+                    break
+                raise IOError(
+                    f"{self.path}:{i + 1}: corrupt journal record "
+                    "before the final line"
+                )
+        return out
+
+    def done_facts(self) -> Dict[str, Dict]:
+        """state → facts of its committed ``done`` record."""
+        return {
+            r["state"]: r.get("facts", {})
+            for r in self.replay() if r.get("event") == "done"
+        }
+
+    def state_walls(self) -> Dict[str, Dict[str, float]]:
+        """state → {"enter": wall, "done": wall} (for latency facts)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for r in self.replay():
+            out.setdefault(r["state"], {})[r["event"]] = r.get("wall")
+        return out
+
+
+def quarantine_candidate(loop_root: str, candidate_dir: str,
+                         cycle_id: str) -> Optional[str]:
+    """Move a demoted candidate export under ``<loop_root>/quarantine``
+    — it must never become discoverable by serving, but the bytes stay
+    for the post-mortem."""
+    if not os.path.isdir(candidate_dir):
+        return None
+    qdir = os.path.join(loop_root, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    dst = os.path.join(qdir, f"{cycle_id}_{int(time.time())}")
+    shutil.move(candidate_dir, dst)
+    return dst
+
+
+class CycleDriver:
+    """Run (or resume) one loop cycle.
+
+    ``steps`` maps each state in :data:`STATE_ORDER` to a callable
+    ``fn(context) -> facts`` where ``context`` carries every earlier
+    state's committed facts (keyed by state name).  QUALITY_GATE facts
+    must include ``passed``; SHADOWING facts must include ``verdict``
+    (``"promote"`` | ``"demote"``) — a failing gate or a demote verdict
+    branches to DEMOTED, which runs the optional ``demote`` step
+    (quarantine) and terminates the cycle.
+
+    ``crash_at`` (the chaos drill's hook): SIGKILL our own process the
+    moment the named state's ``enter`` record commits — a genuine
+    crash, not an exception path.
+    """
+
+    def __init__(
+        self,
+        journal: LoopJournal,
+        steps: Dict[str, Callable[[Dict], Dict]],
+        demote_step: Optional[Callable[[Dict], Dict]] = None,
+        crash_at: Optional[str] = None,
+        log: Callable[[str], None] = lambda s: None,
+    ):
+        self.journal = journal
+        self.steps = steps
+        self.demote_step = demote_step
+        self.crash_at = crash_at
+        self.log = log
+
+    def _maybe_crash(self, state: str) -> None:
+        if self.crash_at == state:
+            import signal
+
+            self.log(f"CHAOS: SIGKILL self at {state}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _demote(self, context: Dict, reason: str) -> Dict:
+        self.journal.enter(LoopState.DEMOTED, reason=reason)
+        self._maybe_crash(LoopState.DEMOTED)
+        facts = (
+            self.demote_step(context) if self.demote_step is not None
+            else {}
+        )
+        facts = dict(facts, reason=reason)
+        self.journal.done(LoopState.DEMOTED, **facts)
+        context[LoopState.DEMOTED] = facts
+        return {"state": LoopState.DEMOTED, "context": context}
+
+    def run(self) -> Dict:
+        """Advance to a terminal state (SERVING or DEMOTED); returns
+        ``{"state": terminal, "context": {state: facts}}``."""
+        done = self.journal.done_facts()
+        context: Dict[str, Dict] = dict(done)
+        if LoopState.DEMOTED in done:
+            return {"state": LoopState.DEMOTED, "context": context}
+        if LoopState.SERVING in done:
+            return {"state": LoopState.SERVING, "context": context}
+        for state in STATE_ORDER:
+            if state in context:
+                # committed by an earlier attempt: honor its branch,
+                # never re-run the work
+                facts = context[state]
+                if state == LoopState.QUALITY_GATE and not facts.get(
+                    "passed"
+                ):
+                    return self._demote(
+                        context, facts.get("reason", "quality gate failed")
+                    )
+                if state == LoopState.SHADOWING and facts.get(
+                    "verdict"
+                ) != "promote":
+                    return self._demote(
+                        context, facts.get("reason", "shadow verdict demote")
+                    )
+                continue
+            self.log(f"state: {state}")
+            self.journal.enter(state)
+            self._maybe_crash(state)
+            facts = self.steps[state](context) or {}
+            self.journal.done(state, **facts)
+            context[state] = facts
+            if state == LoopState.QUALITY_GATE and not facts.get("passed"):
+                return self._demote(
+                    context, facts.get("reason", "quality gate failed")
+                )
+            if state == LoopState.SHADOWING and facts.get(
+                "verdict"
+            ) != "promote":
+                return self._demote(
+                    context, facts.get("reason", "shadow verdict demote")
+                )
+        return {"state": LoopState.SERVING, "context": context}

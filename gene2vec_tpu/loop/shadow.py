@@ -1,0 +1,352 @@
+"""Shadow-traffic canary: duplicate live traffic to a candidate replica.
+
+The fleet front door (``serve/fleet.py``) calls
+:meth:`ShadowManager.observe` after every successfully forwarded
+``/v1/similar`` response.  A configurable sample of those requests is
+duplicated to the CANDIDATE replica — a ``cli.serve`` process loaded
+with the not-yet-promoted iteration — with three hard properties:
+
+* **fire-and-forget**: the duplicate is enqueued onto a bounded worker
+  queue; a full queue drops the sample (counted) and the live caller's
+  latency path never pays a microsecond of shadow work;
+* **same trace**: the shadow leg carries a child context of the live
+  request's traceparent, so ``cli.obs trace`` renders live and shadow
+  as sibling subtrees of one request;
+* **scored**: a :class:`ShadowScorer` diffs each pair of answers —
+  top-k Jaccard answer churn, rank displacement over the common
+  neighbors — and tracks both arms' latency distributions, so the
+  promotion gate reads answer churn and p99 delta straight off the
+  report.
+
+The manager doubles as the front door's ``/v1/shadow/*`` admin surface
+(start/stop/report), which is how ``cli.loop`` drives a canary inside
+a running fleet without restarting it.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_mod
+import random
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+from gene2vec_tpu.obs.tracecontext import TraceContext
+
+
+def _default_fetch(
+    url: str, method: str, target: str, body: Optional[dict],
+    headers: Dict[str, str], timeout_s: float,
+) -> Tuple[int, bytes]:
+    data = None
+    if method == "POST":
+        data = json.dumps(body or {}).encode("utf-8")
+        headers = {**headers, "Content-Type": "application/json"}
+    req = urllib.request.Request(
+        url + target, data=data, headers=headers, method=method
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.status, resp.read()
+
+
+def topk_churn(
+    live: List[str], shadow: List[str]
+) -> Tuple[float, Optional[float]]:
+    """(Jaccard answer churn, mean rank displacement / k over the
+    common neighbors).  Churn 0.0 = identical sets, 1.0 = disjoint;
+    displacement None when the arms share nothing."""
+    ls, ss = set(live), set(shadow)
+    union = ls | ss
+    if not union:
+        return 0.0, 0.0
+    churn = 1.0 - len(ls & ss) / len(union)
+    common = ls & ss
+    if not common:
+        return churn, None
+    k = max(len(live), len(shadow), 1)
+    li = {g: i for i, g in enumerate(live)}
+    si = {g: i for i, g in enumerate(shadow)}
+    disp = sum(abs(li[g] - si[g]) for g in common) / (len(common) * k)
+    return churn, disp
+
+
+def _p99(samples: Iterable[float]) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+class ShadowScorer:
+    """Aggregates per-request live-vs-shadow diffs.  Thread-safe;
+    bounded rings so a long canary window cannot grow without limit."""
+
+    def __init__(self, max_samples: int = 4096):
+        self.max_samples = int(max_samples)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.scored = 0
+            self.errors = 0
+            self.churn_sum = 0.0
+            self.churn_max = 0.0
+            self.churn_n = 0
+            self.disp_sum = 0.0
+            self.disp_n = 0
+            # deque rings: a window longer than max_samples keeps the
+            # NEWEST latencies (a candidate that degrades late must
+            # show in p99), not the first-N frozen snapshot
+            self.live_s: Deque[float] = deque(maxlen=self.max_samples)
+            self.shadow_s: Deque[float] = deque(maxlen=self.max_samples)
+            self.live_iterations: set = set()
+            self.shadow_iterations: set = set()
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def score(
+        self, live_doc: dict, shadow_doc: dict,
+        live_s: float, shadow_s: float,
+    ) -> None:
+        """Diff one pair of /v1/similar response documents."""
+        lr = live_doc.get("results") or []
+        sr = shadow_doc.get("results") or []
+        churns: List[float] = []
+        disps: List[float] = []
+        for lq, sq in zip(lr, sr):
+            ln = [n.get("gene") for n in (lq.get("neighbors") or [])]
+            sn = [n.get("gene") for n in (sq.get("neighbors") or [])]
+            c, d = topk_churn(ln, sn)
+            churns.append(c)
+            if d is not None:
+                disps.append(d)
+        with self._lock:
+            self.scored += 1
+            for c in churns:
+                self.churn_sum += c
+                self.churn_max = max(self.churn_max, c)
+            self.churn_n += len(churns)
+            self.disp_n += len(disps)
+            for d in disps:
+                self.disp_sum += d
+            self.live_s.append(live_s)
+            self.shadow_s.append(shadow_s)
+            lit = (live_doc.get("model") or {}).get("iteration")
+            sit = (shadow_doc.get("model") or {}).get("iteration")
+            if lit is not None:
+                self.live_iterations.add(lit)
+            if sit is not None:
+                self.shadow_iterations.add(sit)
+
+    def report(self) -> dict:
+        with self._lock:
+            p99_live = _p99(self.live_s)
+            p99_shadow = _p99(self.shadow_s)
+            return {
+                "scored": self.scored,
+                "errors": self.errors,
+                "answer_churn": (
+                    round(self.churn_sum / self.churn_n, 4)
+                    if self.churn_n else None
+                ),
+                "answer_churn_max": round(self.churn_max, 4),
+                "rank_displacement": (
+                    round(self.disp_sum / self.disp_n, 4)
+                    if self.disp_n else None
+                ),
+                "p99_live_ms": (
+                    round(p99_live * 1000.0, 3)
+                    if p99_live is not None else None
+                ),
+                "p99_shadow_ms": (
+                    round(p99_shadow * 1000.0, 3)
+                    if p99_shadow is not None else None
+                ),
+                "p99_delta_ms": (
+                    round((p99_shadow - p99_live) * 1000.0, 3)
+                    if p99_live is not None and p99_shadow is not None
+                    else None
+                ),
+                "live_iterations": sorted(self.live_iterations),
+                "shadow_iterations": sorted(self.shadow_iterations),
+            }
+
+
+class ShadowManager:
+    """The fleet front door's canary engine + ``/v1/shadow/*`` admin
+    surface.  Inactive (no target) until ``start`` — observe() is then
+    a single predicate, so a fleet with shadowing enabled but no
+    canary in flight pays nothing."""
+
+    def __init__(
+        self,
+        metrics=None,
+        workers: int = 2,
+        queue_max: int = 256,
+        fetch=_default_fetch,
+        shadow_timeout_s: float = 5.0,
+    ):
+        self.metrics = metrics
+        self.fetch = fetch
+        self.shadow_timeout_s = shadow_timeout_s
+        self.queue_max = int(queue_max)
+        self.scorer = ShadowScorer()
+        self._rng = random.Random()
+        self._lock = threading.Lock()
+        self._target: Optional[str] = None
+        self._sample = 0.0
+        # canary-window generation: bumped on every start/stop so jobs
+        # enqueued (or in flight) for a previous window can never score
+        # into a freshly reset scorecard
+        self._gen = 0
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=queue_max)
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        for i in range(int(workers)):
+            t = threading.Thread(
+                target=self._worker, name=f"shadow-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc(n)
+
+    # -- admin surface (the proxy's /v1/shadow/* routes) -------------------
+
+    def start(self, url: str, sample: float = 0.1) -> dict:
+        """Point the canary at a candidate replica and reset the
+        scorecard.  ``sample`` is the duplicated fraction of live
+        /v1/similar traffic."""
+        if not isinstance(url, str) or not url.startswith("http"):
+            raise ValueError(f"bad shadow target url {url!r}")
+        sample = float(sample)
+        if not 0.0 < sample <= 1.0:
+            raise ValueError("sample must be in (0, 1]")
+        with self._lock:
+            self._target = url.rstrip("/")
+            self._sample = sample
+            self._gen += 1
+            # reset INSIDE the lock: the workers' gen-check + score is
+            # also lock-held, so a stale worker can never interleave
+            # between the bump and the reset
+            self.scorer.reset()
+        if self.metrics is not None:
+            self.metrics.gauge("shadow_active").set(1)
+        return {"shadowing": True, "url": self._target, "sample": sample}
+
+    def stop(self) -> dict:
+        with self._lock:
+            self._target = None
+            self._sample = 0.0
+            self._gen += 1
+        if self.metrics is not None:
+            self.metrics.gauge("shadow_active").set(0)
+        return {"shadowing": False, "report": self.scorer.report()}
+
+    def report(self) -> dict:
+        with self._lock:
+            target, sample = self._target, self._sample
+        return {
+            "shadowing": target is not None,
+            "url": target,
+            "sample": sample,
+            "report": self.scorer.report(),
+        }
+
+    def admin(self, method: str, route: str,
+              body: Optional[dict]) -> Tuple[int, dict]:
+        """Dispatch one /v1/shadow/* admin request."""
+        try:
+            if method == "POST" and route == "/v1/shadow/start":
+                body = body or {}
+                return 200, self.start(
+                    body.get("url"), body.get("sample", 0.1)
+                )
+            if method == "POST" and route == "/v1/shadow/stop":
+                return 200, self.stop()
+            if method == "GET" and route == "/v1/shadow/report":
+                return 200, self.report()
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        return 404, {"error": f"no shadow route {method} {route}"}
+
+    # -- the data path ------------------------------------------------------
+
+    def observe(
+        self,
+        method: str,
+        target: str,
+        body: Optional[dict],
+        live_raw: Optional[bytes],
+        live_s: float,
+        ctx: Optional[TraceContext],
+    ) -> None:
+        """Called by the proxy AFTER a successful live forward.  Cheap
+        by contract: one predicate + one bounded put; everything
+        heavier happens on the worker threads."""
+        with self._lock:
+            url, sample, gen = self._target, self._sample, self._gen
+        if url is None or self._rng.random() >= sample:
+            return
+        try:
+            self._q.put_nowait(
+                (gen, url, method, target, body, live_raw, live_s, ctx)
+            )
+        except queue_mod.Full:
+            self._count("shadow_dropped_total")
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._q.get(timeout=0.25)
+            except queue_mod.Empty:
+                continue
+            gen, url, method, target, body, live_raw, live_s, ctx = job
+            with self._lock:
+                if gen != self._gen:
+                    # stale job from a previous canary window — fetching
+                    # a gone candidate or scoring an old target's answer
+                    # would contaminate the new window's verdict
+                    continue
+            self._count("shadow_requests_total")
+            headers: Dict[str, str] = {}
+            if ctx is not None:
+                # sibling subtree of the live request: same trace id,
+                # child span — cli.obs trace renders both arms together
+                headers["traceparent"] = ctx.child().to_header()
+            t0 = time.monotonic()
+            try:
+                status, raw = self.fetch(
+                    url, method, target, body, headers,
+                    self.shadow_timeout_s,
+                )
+                shadow_s = time.monotonic() - t0
+                if not 200 <= status < 300:
+                    raise IOError(f"shadow leg status {status}")
+                live_doc = json.loads((live_raw or b"{}").decode("utf-8"))
+                shadow_doc = json.loads(raw.decode("utf-8"))
+                with self._lock:
+                    if gen != self._gen:
+                        continue  # window turned over mid-fetch
+                    self.scorer.score(
+                        live_doc, shadow_doc, live_s, shadow_s
+                    )
+            except Exception:
+                with self._lock:
+                    if gen != self._gen:
+                        continue  # stale window's error is not evidence
+                    self._count("shadow_errors_total")
+                    self.scorer.record_error()
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)

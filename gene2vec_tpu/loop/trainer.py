@@ -1,0 +1,277 @@
+"""Warm-start continued SGNS training + the candidate quality gate.
+
+**Adoption makes warm start bit-exact for free.**  A continuation
+cycle first *adopts* the serving export's latest VERIFIED iteration
+into the cycle's candidate export dir: the tables are loaded
+(manifest-checked), new-gene rows are seeded **deterministically**
+(the `init_params(PRNGKey(seed), V_new, dim)` slice `[V_old:]` — a
+pure function of (seed, V_new, dim), so every attempt at this cycle
+seeds identical rows), and the result is saved as the SAME iteration
+number under the candidate dir with the extended vocab.  Continued
+training is then literally ``SGNSTrainer.run`` resuming from that
+checkpoint — the RNG/config cursor in the manifest (seed, iteration →
+``fold_in(PRNGKey(seed), it)``) replays the exact stream an
+uninterrupted run would, so a SIGKILL anywhere mid-continuation
+resumes bit-exact through the machinery the chaos drill has gated
+since PR 4.  Adoption itself is idempotent (a candidate dir that
+already has checkpoints skips it), so the whole step is re-entrant.
+
+**Quality gate.**  Before a candidate is even eligible for shadowing
+it must pass the intrinsic/holdout gate: holdout cosine AUC over the
+ingest store's held-out pairs (stable hash split, loop/ingest.py)
+against sampled negatives — two-sided, defaulting to the canonical
+``eval/holdout.py`` band (``auc_in_gate_band``: scores far ABOVE the
+oracle signal co-occurrence degeneration, not better embeddings) —
+plus the reference's intrinsic target-function ratio
+(``eval/target_function.py``) over held-out neighborhood sets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from gene2vec_tpu.config import SGNSConfig
+from gene2vec_tpu.io import checkpoint as ckpt
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+def extend_params(
+    params: SGNSParams, new_vocab: int, config: SGNSConfig
+) -> SGNSParams:
+    """Seed rows for genes the checkpoint has never seen.  The new
+    rows come from the init distribution at the NEW vocab size — a
+    deterministic function of (config.seed, new_vocab, dim), so a
+    resumed adoption and an uninterrupted one seed identical rows
+    (the bit-exactness contract).  Existing rows pass through
+    untouched; ctx rows init to zero exactly like a fresh table's."""
+    import jax
+
+    old = int(np.asarray(params.emb).shape[0])
+    if new_vocab < old:
+        raise ValueError(
+            f"vocab shrank ({old} -> {new_vocab}); the loop only ever "
+            "tail-extends"
+        )
+    if new_vocab == old:
+        return params
+    from gene2vec_tpu.sgns.model import init_params
+
+    dim = int(np.asarray(params.emb).shape[1])
+    full = init_params(
+        jax.random.PRNGKey(config.seed), new_vocab, dim,
+        np.asarray(params.emb).dtype,
+    )
+    emb = np.concatenate(
+        [np.asarray(params.emb), np.asarray(full.emb)[old:]]
+    )
+    ctx = np.concatenate(
+        [np.asarray(params.ctx),
+         np.zeros((new_vocab - old, dim), np.asarray(params.ctx).dtype)]
+    )
+    return SGNSParams(emb=emb, ctx=ctx)
+
+
+def candidate_base_iteration(
+    candidate_dir: str, dim: int
+) -> Optional[int]:
+    """The iteration the candidate dir was ADOPTED at (its lowest
+    checkpoint) — the warm-start anchor continued iteration counts
+    derive from.  None for an un-adopted (empty) candidate dir."""
+    its = [
+        it for d, it, _ in ckpt.iter_checkpoints(
+            candidate_dir, verified_only=True
+        )
+        if d == dim
+    ]
+    return min(its) if its else None
+
+
+def adopt_checkpoint(
+    serving_dir: str,
+    candidate_dir: str,
+    vocab: Vocab,
+    config: SGNSConfig,
+    log: Callable[[str], None] = lambda s: None,
+) -> int:
+    """Copy the serving export's latest verified iteration into the
+    candidate dir with the (possibly tail-extended) loop vocab and
+    deterministically seeded new-gene rows.  Idempotent: an already-
+    adopted candidate dir returns its anchor unchanged.  Returns the
+    adopted iteration number."""
+    existing = candidate_base_iteration(candidate_dir, config.dim)
+    if existing is not None:
+        return existing
+    base_it = ckpt.latest_iteration(serving_dir, config.dim)
+    if base_it == 0:
+        raise FileNotFoundError(
+            f"no verified dim={config.dim} checkpoint in "
+            f"{serving_dir!r} to warm-start from"
+        )
+    params, src_vocab, meta = ckpt.load_iteration(
+        serving_dir, config.dim, base_it, table_dtype=config.table_dtype
+    )
+    if not ckpt.is_tail_extension(src_vocab.id_to_token, vocab.id_to_token):
+        raise ValueError(
+            "loop vocab is not a tail extension of the serving vocab — "
+            "row ids would move; re-init the ingest store from the "
+            "current serving model"
+        )
+    params = extend_params(params, len(vocab), config)
+    ckpt.save_iteration(
+        candidate_dir, config.dim, base_it, params, vocab,
+        txt_output=config.txt_output,
+        meta={
+            **{k: v for k, v in meta.items() if k in ("rng", "config_hash")},
+            "warm_start": {
+                "adopted_from": os.path.abspath(serving_dir),
+                "adopted_iteration": base_it,
+                "base_vocab_size": len(src_vocab),
+                "new_genes": len(vocab) - len(src_vocab),
+            },
+        },
+    )
+    log(
+        f"adopted iteration {base_it} from {serving_dir} "
+        f"({len(src_vocab)} -> {len(vocab)} genes)"
+    )
+    return base_it
+
+
+def train_candidate(
+    serving_dir: str,
+    candidate_dir: str,
+    corpus,
+    config: SGNSConfig,
+    train_iters: int,
+    preempt=None,
+    log: Callable[[str], None] = lambda s: None,
+) -> Tuple[SGNSParams, int, int]:
+    """Warm-start + continue: adopt (idempotent), then run the standard
+    trainer until ``anchor + train_iters`` — which IS the bit-exact
+    resume path, so a SIGKILL mid-continuation and a fresh uninterrupted
+    continuation converge on identical bytes.  Returns
+    (final params, anchor iteration, final iteration)."""
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    base_it = adopt_checkpoint(
+        serving_dir, candidate_dir, corpus.vocab, config, log=log
+    )
+    target = base_it + int(train_iters)
+    cfg = dataclasses.replace(config, num_iters=target)
+    trainer = SGNSTrainer(corpus, cfg)
+    params = trainer.run(candidate_dir, log=log, preempt=preempt)
+    return params, base_it, target
+
+
+# -- the quality gate --------------------------------------------------------
+
+
+def _negative_pairs(
+    vocab: Vocab, positives: List[List[str]], n: int, seed: int
+) -> List[List[str]]:
+    """Seeded random in-vocab gene pairs excluding known positives —
+    the AUC's negative class."""
+    known = {tuple(sorted(p)) for p in positives}
+    rng = np.random.RandomState(seed)
+    tokens = vocab.id_to_token
+    out: List[List[str]] = []
+    guard = 0
+    while len(out) < n and guard < 50 * n:
+        guard += 1
+        i, j = rng.randint(0, len(tokens), size=2)
+        if i == j:
+            continue
+        a, b = tokens[i], tokens[j]
+        if tuple(sorted((a, b))) in known:
+            continue
+        out.append([a, b])
+    return out
+
+
+def quality_report(
+    vocab: Vocab,
+    emb: np.ndarray,
+    held_pairs: List[List[str]],
+    min_auc: Optional[float] = None,
+    max_auc: Optional[float] = None,
+    seed: int = 7,
+) -> dict:
+    """The candidate's eligibility report: held-out cosine AUC (two-
+    sided band; defaults to the canonical ``eval/holdout.py`` gate
+    band) + the intrinsic target-function ratio over held-out
+    neighborhood sets.  ``passed`` gates SHADOWING — a candidate that
+    fails here is demoted without ever seeing traffic."""
+    from gene2vec_tpu.eval.holdout import (
+        GATE_MAX_AUC,
+        GATE_MIN_AUC,
+        cosine_scores,
+    )
+    from gene2vec_tpu.eval.metrics import roc_auc_score
+
+    min_auc = GATE_MIN_AUC if min_auc is None else float(min_auc)
+    max_auc = GATE_MAX_AUC if max_auc is None else float(max_auc)
+    # de-duplicate direction twins: the builder emits (a,b) AND (b,a)
+    uniq = sorted({tuple(sorted(p)) for p in held_pairs})
+    positives = [list(p) for p in uniq]
+    report: dict = {
+        "held_pairs": len(positives),
+        "min_auc": min_auc,
+        "max_auc": max_auc,
+        "auc": None,
+        "intrinsic_ratio": None,
+        "passed": False,
+    }
+    if len(positives) < 5:
+        report["reason"] = (
+            f"only {len(positives)} held-out pairs — not enough "
+            "evidence to gate on"
+        )
+        return report
+    negatives = _negative_pairs(vocab, positives, len(positives), seed)
+    pairs = positives + negatives
+    labels = np.asarray([1] * len(positives) + [0] * len(negatives))
+    scores, mask = cosine_scores(vocab.token_to_id, emb, pairs)
+    if mask.sum() < 10 or len(set(labels[mask].tolist())) < 2:
+        report["reason"] = "too few in-vocab scored pairs"
+        return report
+    auc = float(roc_auc_score(labels[mask], scores[mask]))
+    report["auc"] = round(auc, 4)
+    # intrinsic ratio (reference targetFunc semantics) over held-out
+    # neighborhood sets — informational unless degenerate, the AUC band
+    # is the gate (QUALITY_NOTES §8: this ratio is undefined noise for
+    # small set collections, so it cannot gate alone)
+    try:
+        from collections import defaultdict
+
+        from gene2vec_tpu.eval.target_function import (
+            pathway_similarities,
+            random_pair_similarity,
+        )
+
+        nbrs = defaultdict(set)
+        for a, b in positives:
+            nbrs[a].add(b)
+            nbrs[b].add(a)
+        sets = {
+            f"HELD_{g}": sorted(p)[:50]
+            for g, p in nbrs.items() if len(p) >= 2
+        }
+        if sets:
+            num, _ = pathway_similarities(vocab.id_to_token, emb, sets)
+            den = random_pair_similarity(vocab.id_to_token, emb)
+            if abs(den) > 1e-6:
+                report["intrinsic_ratio"] = round(num / den, 4)
+    except ValueError:
+        pass
+    report["passed"] = bool(min_auc <= auc <= max_auc)
+    if not report["passed"]:
+        report["reason"] = (
+            f"holdout AUC {auc:.4f} outside the gate band "
+            f"[{min_auc}, {max_auc}]"
+        )
+    return report

@@ -266,6 +266,13 @@ class FleetAggregator:
         # per-target consecutive-miss counts (exported as
         # fleet_scrape_staleness{target=}); >= stale_after -> stale
         self._missed: Dict[str, int] = {}
+        # targets whose per-replica model facts are currently exported
+        # (fleet_model_iteration{target=}); departures retire them
+        self._model_targets: set = set()
+        # last-known model facts per target — carried through missed
+        # scrapes until the target goes stale, so the skew/age headline
+        # doesn't flicker on a single flaky scrape
+        self._model_facts: Dict[str, Dict[str, float]] = {}
         # bounded ring of RAW per-target scrapes — the UN-merged series
         # an incident bundle files so per-replica attribution survives
         self._raw_ring: "collections.deque" = collections.deque(
@@ -393,6 +400,96 @@ class FleetAggregator:
                 self.view.gauge(
                     "fleet_scrape_staleness", labels={"target": url}
                 ).set(self._missed.get(url, 0))
+            # per-replica served-model facts (docs/CONTINUOUS.md): which
+            # iteration each target serves and how old its artifact is.
+            # A fleet silently wedged on an old iteration (quarantined
+            # candidate, half-finished promotion) shows up here as
+            # iteration skew / growing age — the default staleness and
+            # skew alert rules watch the fleet-level reductions below.
+            for url in target_list:
+                samples = results.get(url)
+                if samples is None:
+                    # missed scrape: keep the last-known facts until the
+                    # target goes STALE (same tolerance as the quantile
+                    # machinery) — one flaky scrape must not zero the
+                    # skew headline and reset a skew alert's debounce
+                    # exactly when a wedged replica matters most
+                    if url in stale:
+                        self._model_facts.pop(url, None)
+                    continue
+                facts: Dict[str, float] = {}
+                for s in samples:
+                    if s.name in (
+                        "model_iteration", "model_age_seconds"
+                    ) and not s.labels:
+                        facts[s.name] = s.value
+                if facts:
+                    self._model_facts[url] = facts
+                else:
+                    # scraped fine but reports no model facts (replica
+                    # restarted unloaded): genuinely gone, retire both
+                    # the cached facts and the per-target series
+                    self._model_facts.pop(url, None)
+                    for gauge in (
+                        "fleet_model_iteration",
+                        "fleet_model_age_seconds",
+                    ):
+                        self.view.remove(gauge, labels={"target": url})
+                for name, gauge in (
+                    ("model_iteration", "fleet_model_iteration"),
+                    ("model_age_seconds", "fleet_model_age_seconds"),
+                ):
+                    if name in facts:
+                        self.view.gauge(
+                            gauge, labels={"target": url}
+                        ).set(facts[name])
+            model_facts = {
+                u: f for u, f in self._model_facts.items()
+                if u in set(target_list)
+            }
+            for url in [
+                u for u in self._model_targets
+                if u not in set(target_list)
+            ]:
+                # departed targets retire their labeled series like the
+                # staleness gauges do — ephemeral ports never recur
+                for gauge in (
+                    "fleet_model_iteration", "fleet_model_age_seconds"
+                ):
+                    self.view.remove(gauge, labels={"target": url})
+                self._model_facts.pop(url, None)
+            self._model_targets = set(target_list)
+            iters = [
+                f["model_iteration"] for f in model_facts.values()
+                if "model_iteration" in f
+            ]
+            ages = [
+                f["model_age_seconds"] for f in model_facts.values()
+                if "model_age_seconds" in f
+            ]
+            model_headline: Dict[str, float] = {}
+            if iters:
+                model_headline["fleet_model_iteration_min"] = min(iters)
+                model_headline["fleet_model_iteration_max"] = max(iters)
+                model_headline["fleet_model_iteration_skew"] = (
+                    max(iters) - min(iters)
+                )
+            if ages:
+                model_headline["fleet_model_age_seconds_max"] = max(ages)
+            for key in (
+                "fleet_model_iteration_min",
+                "fleet_model_iteration_max",
+                "fleet_model_iteration_skew",
+                "fleet_model_age_seconds_max",
+            ):
+                if key in model_headline:
+                    self.view.gauge(key).set(model_headline[key])
+                else:
+                    # model facts gone (every scrape missed, or the
+                    # replicas restarted unloaded): retire the headline
+                    # like the per-target series — a stale skew gauge
+                    # would hold a skew alert firing forever
+                    self.view.remove(key)
             # fold state for targets no longer LISTED into the retired
             # baseline (caveat: a target re-listed later under the SAME
             # url restarts from its current raw value — supervisor
@@ -522,6 +619,7 @@ class FleetAggregator:
                 "fleet_rejected": rejected,
             }
             snapshot.update(headline)
+            snapshot.update(model_headline)
             snapshot.update({
                 "fleet_ok": ok_total,
                 "fleet_responses": total,

@@ -225,6 +225,28 @@ def default_rules() -> List[AlertRule]:
             for_s=5.0, clear_for_s=60.0,
         ),
         AlertRule(
+            name="model-staleness", kind="threshold", severity="warn",
+            # continuous-learning freshness (docs/CONTINUOUS.md): the
+            # oldest served artifact across the fleet.  Two days is
+            # deliberately generous — the loop retrains on study-batch
+            # cadence, and a fleet quietly pinned to an old iteration
+            # (every candidate quarantined, promotion wedged) must
+            # FIRE, not linger; override per deployment cadence.
+            metric="fleet_model_age_seconds_max",
+            op=">", value=2 * 86400.0, clear_value=86400.0,
+            for_s=60.0, clear_for_s=60.0,
+        ),
+        AlertRule(
+            name="model-iteration-skew", kind="threshold",
+            severity="warn",
+            # replicas serving DIFFERENT iterations: normal for the
+            # seconds a swap wave takes, never for minutes — a wedged
+            # promotion (one replica quarantined its candidate, the
+            # rest flipped) is exactly this signal held high
+            metric="fleet_model_iteration_skew",
+            op=">", value=0.0, for_s=120.0, clear_for_s=30.0,
+        ),
+        AlertRule(
             name="queue-depth", kind="threshold", severity="warn",
             metric="fleet_queue_depth",
             op=">", value=192.0, clear_value=64.0,

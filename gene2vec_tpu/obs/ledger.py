@@ -370,10 +370,40 @@ def _adapt_shard(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "shard_recall_at_10"
 
 
+def _adapt_loop(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_LOOP_* (chaos_drill.py --only loop --loop-out): the
+    continuous-learning cycle end to end — ingest→promoted wall time,
+    shadow answer churn / p99 delta between the live and candidate
+    arms, promotion decision latency, and the zero-wrong/zero-mixed
+    answer integrity held through a SIGKILL in every loop state.  The
+    ``perf.regression`` rules watch churn and cycle wall time."""
+    m: Dict[str, float] = {}
+    section = doc.get("loop")
+    section = section if isinstance(section, dict) else {}
+    for key in (
+        "answer_churn",
+        "shadow_p99_delta_ms",
+        "ingest_to_promoted_s",
+        "promotion_decision_s",
+        "wrong_answers",
+        "mixed_iteration_answers",
+        "resume_bit_exact",
+        "promoted",
+        "states_killed",
+        "shadow_scored",
+        "quality_auc",
+        "new_genes",
+    ):
+        _put(m, f"loop_{key}", section.get(key))
+    _put(m, "passed", doc.get("passed"))
+    return m, "loop_answer_churn"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
+    (re.compile(r"^BENCH_LOOP_\w*\.json$"), "loop", _adapt_loop),
     (re.compile(r"^BENCH_SHARD_\w*\.json$"), "shard", _adapt_shard),
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),
     (re.compile(r"^BENCH_ALERTS_\w*\.json$"), "alerts", _adapt_alerts),
@@ -394,6 +424,23 @@ ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
     (re.compile(r"^INTRINSIC_\w*\.json$"), "intrinsic", _adapt_intrinsic),
     (re.compile(r"^REAL_AUC\.json$"), "real_auc", _adapt_real_auc),
 )
+
+
+def provenance_stamp(doc: Dict) -> Dict:
+    """Stamp ``schema_version`` / ``command`` / ``created_unix`` into a
+    bench or quality-eval JSON product so :func:`adapt_file` ingests it
+    with provenance instead of marking it ``legacy_unstamped``.  The
+    canonical implementation behind ``bench.py``'s ``bench_stamp()`` —
+    one stamping convention, wherever the artifact is produced
+    (bench.py, scripts/run_intrinsic.py, scripts/run_real_auc.py,
+    ``cli.evaluate --json``)."""
+    import sys
+    import time
+
+    doc.setdefault("schema_version", 1)
+    doc.setdefault("command", " ".join([sys.executable, *sys.argv]))
+    doc.setdefault("created_unix", time.time())
+    return doc
 
 
 def match_family(filename: str) -> Optional[Tuple[str, Callable]]:

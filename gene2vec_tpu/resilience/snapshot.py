@@ -120,6 +120,20 @@ def atomic_savez(path: str, **arrays) -> None:
             os.unlink(tmp)
 
 
+def atomic_copy(src: str, path: str, chunk: int = 1 << 20) -> None:
+    """Streaming file copy with atomic visibility — constant memory,
+    so copying a production-size table never materializes the whole
+    artifact as one bytes object."""
+    def _copy(tmp: str) -> None:
+        with open(src, "rb") as fin, open(tmp, "wb") as fout:
+            while True:
+                buf = fin.read(chunk)
+                if not buf:
+                    break
+                fout.write(buf)
+    atomic_write_via(_copy, path)
+
+
 def atomic_write_via(write_fn, path: str) -> None:
     """Run a ``write_fn(path)``-style writer (e.g. the io/emb_io text
     exporters, ``Vocab.save``) against a temp path, then atomically

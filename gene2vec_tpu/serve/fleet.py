@@ -690,6 +690,30 @@ class _ProxyAdapter:
                 _PROM_CT,
             ))
             return
+        if route.startswith("/v1/shadow"):
+            # the continuous-learning canary's admin surface
+            # (loop/shadow.py): start/stop/report a shadow-traffic
+            # window against a candidate replica.  Handled HERE — never
+            # forwarded — so the candidate is driven by duplicated live
+            # traffic, not by clients discovering an admin route.
+            if proxy.shadow is None:
+                peer.respond(Response(
+                    404,
+                    b'{"error": "shadow canary disabled '
+                    b'(--enable-shadow)"}',
+                ))
+                return
+            sbody: Optional[dict] = None
+            if req.method == "POST":
+                sbody, err = parse_json_body(req)
+                if err is not None:
+                    peer.respond(err)
+                    return
+            status, doc = proxy.shadow.admin(req.method, route, sbody)
+            peer.respond(Response(
+                status, json.dumps(doc).encode("utf-8")
+            ))
+            return
         if not route.startswith("/v1/"):
             peer.respond(Response(
                 404,
@@ -780,11 +804,22 @@ class _ProxyAdapter:
                         "error": f"no route {req.method} {route}"
                     }
                 span["status"] = status
-        proxy.account(route, status, time.monotonic() - t0,
+        dur = time.monotonic() - t0
+        proxy.account(route, status, dur,
                       ctx.trace_id if ctx is not None else None)
-        peer.respond(Response(
-            status, json.dumps(doc).encode("utf-8")
-        ))
+        payload = json.dumps(doc).encode("utf-8")
+        if (
+            proxy.shadow is not None and route == "/v1/similar"
+            and 200 <= status < 300
+        ):
+            # same canary hook as _forward: a --shard-by-rows fleet
+            # must feed the shadow scorer too, or a canary against a
+            # sharded fleet starves of evidence and demotes a healthy
+            # candidate
+            proxy.shadow.observe(
+                req.method, req.target, body, payload, dur, ctx
+            )
+        peer.respond(Response(status, payload))
 
     def _forward(self, req: HTTPRequest, peer: ConnHandle, route: str,
                  body: Optional[dict]) -> None:
@@ -840,8 +875,21 @@ class _ProxyAdapter:
         # account BEFORE the reply write can fail: a client gone mid-
         # reply (broken pipe during an incident) must still count in
         # the availability view and the flight ring
-        proxy.account(route, status, time.monotonic() - t0,
+        dur = time.monotonic() - t0
+        proxy.account(route, status, dur,
                       ctx.trace_id if ctx is not None else None)
+        if (
+            proxy.shadow is not None and route == "/v1/similar"
+            and 200 <= status < 300
+        ):
+            # shadow-traffic canary (loop/shadow.py): maybe duplicate
+            # this request to the candidate replica — fire-and-forget,
+            # off this caller's latency path (one predicate + a
+            # bounded enqueue), same trace id so the arms render as
+            # siblings in cli.obs trace
+            proxy.shadow.observe(
+                req.method, req.target, body, payload, dur, ctx
+            )
         peer.respond(Response(status, payload))
 
 
@@ -865,9 +913,15 @@ class FleetProxy:
         acceptors: int = 1,
         alert_rules=None,
         shard_group=None,
+        shadow=None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
+        #: loop/shadow.py ShadowManager — set when the fleet runs with
+        #: the continuous-learning canary enabled (cli.fleet
+        #: --enable-shadow); owns the /v1/shadow/* admin surface and
+        #: the off-path duplication of sampled /v1/similar traffic
+        self.shadow = shadow
         #: serve/shardgroup.py ShardGroup — set when the fleet serves
         #: row SHARDS of one table instead of N identical replicas;
         #: flips the /v1 surface from round-robin forwarding to
@@ -1032,6 +1086,8 @@ class FleetProxy:
     def stop(self) -> None:
         if self.aggregator is not None:
             self.aggregator.stop()
+        if self.shadow is not None:
+            self.shadow.close()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

@@ -107,6 +107,10 @@ class LoadedModel:
     #: carrying different epochs — that is the whole no-mixed-
     #: iteration contract, made checkable per response.
     epoch: Optional[int] = None
+    #: checkpoint artifact mtime at load — the numerator of the
+    #: ``model_age_seconds`` gauge (a fleet silently wedged on an old
+    #: iteration must be *visible*, docs/CONTINUOUS.md)
+    created_unix: float = 0.0
 
     @property
     def version(self) -> Tuple[int, int]:
@@ -139,9 +143,13 @@ def discover_newest(
 
 
 def _read_vocab_tokens(ckpt_path: str) -> List[str]:
-    """The vocab.tsv token list next to a checkpoint — id order IS
-    global row order (the routing-table contract)."""
-    vocab_path = os.path.join(os.path.dirname(ckpt_path), "vocab.tsv")
+    """The vocab token list for a checkpoint — id order IS global row
+    order (the routing-table contract).  Reads the per-iteration
+    ``.vocab.tsv`` sidecar when the iteration's vocab tail-extended the
+    shared vocab.tsv (io/checkpoint.py vocab_path_for)."""
+    from gene2vec_tpu.io.checkpoint import vocab_path_for
+
+    vocab_path = vocab_path_for(ckpt_path)
     tokens: List[str] = []
     with open(vocab_path, "r", encoding="utf-8") as f:
         for line in f:
@@ -162,6 +170,16 @@ def _load_npz(path: str) -> Tuple[List[str], np.ndarray, Dict]:
             "vocab tokens in vocab.tsv"
         )
     return tokens, emb, meta
+
+
+def _file_age_base(path: str) -> float:
+    """Artifact creation wall time for the model-age gauge (mtime of
+    the checkpoint file; 0.0 when unreadable — age then reads as
+    since-epoch-huge, which errs loud, not silent)."""
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
 
 
 class ModelRegistry:
@@ -403,6 +421,7 @@ class ModelRegistry:
             row_base=row_base,
             total_rows=total_rows,
             epoch=epoch,
+            created_unix=_file_age_base(path),
         )
 
     @staticmethod

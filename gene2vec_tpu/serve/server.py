@@ -700,6 +700,17 @@ class ServeApp:
                 self.metrics.gauge(
                     "engine_jit_cache_entries", labels={"mode": mode}
                 ).set(size)
+        # served-model freshness facts, refreshed per scrape: the fleet
+        # aggregator lifts these into fleet_model_iteration{target=} /
+        # fleet_model_age_seconds{target=} and the default staleness
+        # alert rule watches the fleet-wide max — a fleet silently
+        # stuck on an old iteration (quarantined candidate, wedged
+        # promotion) must fire, not linger (docs/CONTINUOUS.md)
+        if self.registry.loaded:
+            model = self.registry.model
+            self.metrics.gauge("model_age_seconds").set(
+                max(0.0, time.time() - model.created_unix)
+            )
 
     def livez(self) -> dict:
         """Liveness: the process answers HTTP.  Never inspects the

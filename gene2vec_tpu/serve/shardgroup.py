@@ -179,9 +179,12 @@ class RoutingTable:
     @staticmethod
     def _read_tokens(ckpt_path: str) -> List[str]:
         if ckpt_path.endswith(".npz"):
-            vocab_path = os.path.join(
-                os.path.dirname(ckpt_path), "vocab.tsv"
-            )
+            # sidecar-aware: a vocab-tail-extended iteration routes by
+            # ITS vocab, not the dir's frozen vocab.tsv (the loop's
+            # new-gene promotion case, io/checkpoint.py vocab_path_for)
+            from gene2vec_tpu.io.checkpoint import vocab_path_for
+
+            vocab_path = vocab_path_for(ckpt_path)
             tokens: List[str] = []
             with open(vocab_path, "r", encoding="utf-8") as f:
                 for line in f:

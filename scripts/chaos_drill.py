@@ -68,6 +68,18 @@ Five phases (docs/RESILIENCE.md runbook):
   bounded by the per-shard deadline, not the fault).  Stamped into
   ``BENCH_SHARD_r15.json`` via ``--shard-out`` and gated by
   ``analysis/passes_shard.py`` (budgets.json ``shard``).
+* **loop** — the continuous-learning cycle (docs/CONTINUOUS.md):
+  pretrain a serving model, spawn ``cli.fleet --enable-shadow``, keep
+  verified light load flowing, then drive a full
+  ingest → warm-start train → quality gate → shadow canary → promote
+  cycle through ``cli.loop`` with a REAL SIGKILL injected in every
+  loop state (resumed from the journal each time).  Assert the fleet
+  adopts the promoted iteration (new genes included) with ZERO wrong
+  or mixed-iteration answers, the resumed candidate is BIT-exact vs an
+  uninterrupted control, and churn / shadow p99 delta / promotion
+  decision latency land inside budgets.json ``loop``.  Stamped into
+  ``BENCH_LOOP_r16.json`` via ``--loop-out`` and gated by
+  ``analysis/passes_loop.py``.
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -2363,8 +2375,386 @@ def _shard_slow_loris(tmp: str, smoke: bool, budget: dict,
             proc.wait(timeout=30)
 
 
+# -- phase: the continuous-learning loop -------------------------------------
+
+
+def _loop_topk_reference(url: str, genes, k: int = 5) -> dict:
+    """gene -> (iteration, neighbor tuple) straight from the fleet —
+    the per-iteration answer oracle the loop phase verifies against."""
+    out = {}
+    for g in genes:
+        doc = _post_json(
+            url + "/v1/similar", {"genes": [g], "k": k}, timeout=15.0
+        )
+        out[g] = (
+            doc["model"]["iteration"],
+            tuple(n["gene"] for n in doc["results"][0]["neighbors"]),
+        )
+    return out
+
+
+def _post_json(url: str, body: dict, timeout: float = 10.0) -> dict:
+    import urllib.request as _rq
+
+    req = _rq.Request(
+        url, data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with _rq.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def drill_loop(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """End-to-end continuous-learning cycle against a real fleet, with
+    a REAL SIGKILL injected in every loop state (docs/CONTINUOUS.md):
+
+    1. pretrain a serving model, spawn ``cli.fleet --enable-shadow``
+       over it, and start continuous verified light load;
+    2. compute an IN-PROCESS control continuation (the deterministic
+       adopt+train path ``cli.loop`` runs) — the bit-exactness oracle;
+    3. run ``cli.loop`` once per kill state (``--crash-at`` SIGKILLs
+       the process the moment that state's journal record commits;
+       ``TRAINING_MID`` kills after the first continued iteration),
+       resuming from the journal each time, then once to completion;
+    4. assert: the fleet adopted the promoted iteration (including a
+       gene the old model had never seen), the resumed candidate table
+       is BIT-exact vs the control, every load answer matched its
+       iteration's reference (ZERO wrong, ZERO mixed), and churn/p99
+       delta/decision latency landed inside budgets.json "loop".
+    """
+    import threading
+
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.io import checkpoint as ckpt_mod
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.loop import ingest as ing
+    from gene2vec_tpu.loop import trainer as ltr
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    os.makedirs(tmp, exist_ok=True)
+    serving = os.path.join(tmp, "loop_serving")
+    loop_root = os.path.join(tmp, "loop_root")
+    replicas = int(budget.get("replicas", 2))
+    train_iters = int(budget.get("train_iters", 2))
+    shadow_sample = float(budget.get("shadow_sample", 1.0))
+    min_shadow = int(budget.get("min_shadow_requests", 30))
+    dim, pre_iters, batch_pairs = 16, 10, 256
+
+    # clustered corpus: 3 clusters of 10 genes — enough structure that
+    # the tiny-geometry candidate separates held-out pairs well above
+    # the 0.7 gate floor and top-k neighborhoods stay mostly stable
+    # through two continued iterations (measured churn ~0.3)
+    rng = np.random.RandomState(seed)
+
+    def cluster_lines(n: int) -> list:
+        out = []
+        for _ in range(n):
+            c = rng.randint(3)
+            a, b = rng.choice(10, 2, replace=False) + 10 * c
+            out.append(f"G{a} G{b}")
+        return out
+
+    base_lines = cluster_lines(800)
+    batch_lines = cluster_lines(80) + [
+        "GNEW0 G0", "GNEW0 G3", "GNEW1 G12", "GNEW1 G15",
+    ] * 4
+    base_file = os.path.join(tmp, "loop_base_pairs.txt")
+    batch_file = os.path.join(tmp, "loop_batch_pairs.txt")
+    with open(base_file, "w") as f:
+        f.write("\n".join(base_lines) + "\n")
+    with open(batch_file, "w") as f:
+        f.write("\n".join(batch_lines) + "\n")
+
+    cfg = SGNSConfig(
+        dim=dim, batch_pairs=batch_pairs, num_iters=pre_iters,
+        txt_output=False, seed=1,
+    )
+    vocab = Vocab.from_pairs([ln.split() for ln in base_lines])
+    corpus = PairCorpus(
+        vocab, vocab.encode_pairs([ln.split() for ln in base_lines])
+    )
+    log(f"pretraining serving model ({pre_iters} iters, dim {dim})")
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    SGNSTrainer(corpus, cfg).run(serving, log=lambda s: None)
+
+    # the in-process CONTROL continuation: exactly the deterministic
+    # adopt+train path cli.loop runs, against a SEPARATE loop root —
+    # whatever bytes the kill-riddled chaos cycle converges on must be
+    # bit-identical to these
+    control_root = os.path.join(tmp, "loop_control_root")
+    ing.init_ingest(control_root, vocab)
+    ing.ingest_batch(control_root, "seed", base_lines,
+                     replaces_base_counts=True)
+    ing.ingest_batch(control_root, "b1", batch_lines)
+    control_corpus, _held = ing.load_loop_corpus(control_root, 0.2)
+    control_cand = os.path.join(tmp, "loop_control_cand")
+    control_params, _cb, control_final = ltr.train_candidate(
+        serving, control_cand, control_corpus, cfg, train_iters,
+        log=lambda s: None,
+    )
+    control_emb = np.asarray(control_params.emb)
+    control_ctx = np.asarray(control_params.ctx)
+
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", serving, "--replicas", str(replicas),
+        "--port", "0", "--health-interval", "0.25",
+        "--scrape-interval", "0.5", "--enable-shadow",
+        "--seed", str(seed),
+        # fast self-swap polls: promotion latency should measure the
+        # loop, not a 5 s default poll cadence
+        "--serve-arg=--poll-interval", "--serve-arg=0.5",
+    ]
+    log(f"spawning fleet: {replicas} replicas, shadow canary enabled")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    loop_env = chaos.child_env()
+    try:
+        info = read_contract_line(proc, 180.0)
+        url = info["url"]
+        assert info.get("shadow"), "fleet contract missing shadow=true"
+        log(f"fleet front door at {url}")
+
+        query_genes = [f"G{i}" for i in range(0, 30, 4)]
+        reference_old = _loop_topk_reference(url, query_genes)
+        old_iter = next(iter(reference_old.values()))[0]
+        assert old_iter == pre_iters
+
+        # continuous verified light load through the WHOLE cycle: every
+        # answer is later checked against its iteration's reference —
+        # zero wrong, zero mixed-iteration
+        records = []
+        rec_lock = threading.Lock()
+        stop_load = threading.Event()
+
+        def load_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 100 + widx)
+            while not stop_load.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                try:
+                    doc = _post_json(
+                        url + "/v1/similar", {"genes": [g], "k": 5},
+                        timeout=10.0,
+                    )
+                    with rec_lock:
+                        records.append((
+                            g,
+                            doc["model"]["iteration"],
+                            tuple(
+                                n["gene"]
+                                for n in doc["results"][0]["neighbors"]
+                            ),
+                        ))
+                except Exception:
+                    with rec_lock:
+                        records.append((g, None, None))
+                time.sleep(0.05)
+
+        workers = [
+            threading.Thread(target=load_worker, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for w in workers:
+            w.start()
+
+        loop_argv = [
+            sys.executable, "-m", "gene2vec_tpu.cli.loop",
+            "--loop-root", loop_root, "--serving-export", serving,
+            "--batch", batch_file, "--batch-id", "b1",
+            "--seed-corpus", base_file,
+            "--fleet-url", url,
+            "--dim", str(dim), "--train-iters", str(train_iters),
+            "--batch-pairs", str(batch_pairs), "--sgns-seed", "1",
+            "--holdout-frac", "0.2",
+            # synthetic-corpus gate band: the canonical [0.862, 0.92]
+            # band is calibrated for the real protocol; this geometry
+            # measures 0.88 +- 0.03 with a wide floor at 0.7
+            "--min-auc", "0.7", "--max-auc", "1.01",
+            "--shadow-sample", str(shadow_sample),
+            "--shadow-min-requests", str(min_shadow),
+            "--shadow-max-wait", "90",
+            "--max-churn", str(budget.get("max_answer_churn", 0.5)),
+            "--max-p99-delta-ms",
+            str(budget.get("max_shadow_p99_delta_ms", 500.0)),
+            "--promote-timeout", "90",
+        ]
+        kill_states = [
+            "INGESTING", "TRAINING_MID", "SHADOWING", "PROMOTING",
+        ]
+        t_cycle0 = time.monotonic()
+        for state in kill_states:
+            log(f"cycle attempt with SIGKILL at {state}")
+            # capture stdout: a run that demotes before its kill state
+            # would otherwise print ITS contract JSON into the drill's
+            # stdout, breaking the one-JSON-document machine contract
+            r = subprocess.run(
+                loop_argv + ["--crash-at", state],
+                timeout=420, env=loop_env, cwd=REPO,
+                stdout=subprocess.PIPE, text=True,
+            )
+            # died-by-signal (< 0) is the ONLY acceptable outcome: a
+            # plain nonzero exit (e.g. rc=3 pre-crash demotion) would
+            # journal DEMOTED for this batch id and poison every
+            # later attempt with a misleading final-cycle failure
+            assert r.returncode < 0, (
+                f"--crash-at {state} run exited {r.returncode} "
+                f"instead of dying by SIGKILL:\n{(r.stdout or '')[-2000:]}"
+            )
+        log("final cycle attempt (no kill) — resuming from the journal")
+        r = subprocess.run(
+            loop_argv, timeout=420, env=loop_env, cwd=REPO,
+            stdout=subprocess.PIPE, text=True,
+        )
+        assert r.returncode == 0, (
+            f"final loop cycle failed rc={r.returncode}"
+        )
+        contract = json.loads(r.stdout.strip().splitlines()[-1])
+        assert contract["state"] == "SERVING", contract["state"]
+        facts = contract["facts"]
+        promoted_iter = facts["PROMOTING"]["promoted_iteration"]
+        ingest_to_promoted_s = time.monotonic() - t_cycle0
+        assert promoted_iter == control_final
+
+        # the fleet now answers from the new iteration — including a
+        # gene the old model had never seen (vocab tail extension end
+        # to end)
+        reference_new = _loop_topk_reference(url, query_genes)
+        for g in query_genes:
+            assert reference_new[g][0] == promoted_iter, (
+                f"{g}: fleet still on {reference_new[g][0]}"
+            )
+        new_gene_doc = _post_json(
+            url + "/v1/similar", {"genes": ["GNEW0"], "k": 5},
+            timeout=15.0,
+        )
+        assert new_gene_doc["model"]["iteration"] == promoted_iter
+        assert new_gene_doc["results"][0]["neighbors"], (
+            "new gene answered with no neighbors"
+        )
+
+        time.sleep(1.0)
+        stop_load.set()
+        for w in workers:
+            w.join(timeout=10.0)
+
+        # answer integrity: every recorded answer must match ITS
+        # iteration's reference exactly — a new-iteration tag with
+        # old-iteration neighbors (or vice versa) is a mixed answer
+        wrong = mixed = failed = 0
+        for g, it, neigh in records:
+            if it is None:
+                failed += 1
+                continue
+            if it == old_iter:
+                ref = reference_old[g][1]
+            elif it == promoted_iter:
+                ref = reference_new[g][1]
+            else:
+                mixed += 1
+                continue
+            if neigh != ref:
+                wrong += 1
+
+        # bit-exactness: the kill-riddled cycle's candidate table ==
+        # the uninterrupted in-process control, byte for byte
+        cand_dir = os.path.join(loop_root, "candidates", "b1")
+        chaos_params, _v, _m = ckpt_mod.load_iteration(
+            cand_dir, dim, promoted_iter, table_dtype=None
+        )
+        resume_bit_exact = bool(
+            np.array_equal(np.asarray(chaos_params.emb), control_emb)
+            and np.array_equal(np.asarray(chaos_params.ctx), control_ctx)
+        )
+
+        shadow_report = (facts.get("SHADOWING") or {}).get("report", {})
+        quality = facts.get("QUALITY_GATE") or {}
+        walls = contract.get("state_walls", {})
+        promoting = walls.get("PROMOTING", {})
+        promotion_decision_s = (
+            round(promoting["done"] - promoting["enter"], 3)
+            if "done" in promoting and "enter" in promoting else None
+        )
+
+        result = {
+            "replicas": replicas,
+            "train_iters": train_iters,
+            "shadow_sample": shadow_sample,
+            "min_shadow_requests": min_shadow,
+            "states_killed": len(kill_states),
+            "kill_states": kill_states,
+            "promoted": True,
+            "promoted_iteration": promoted_iter,
+            "new_genes": 2,
+            "new_gene_served": True,
+            "resume_bit_exact": resume_bit_exact,
+            "ingest_to_promoted_s": round(ingest_to_promoted_s, 2),
+            "promotion_decision_s": promotion_decision_s,
+            "answer_churn": shadow_report.get("answer_churn"),
+            "answer_churn_max": shadow_report.get("answer_churn_max"),
+            "shadow_p99_delta_ms": shadow_report.get("p99_delta_ms"),
+            "shadow_p99_live_ms": shadow_report.get("p99_live_ms"),
+            "shadow_p99_shadow_ms": shadow_report.get("p99_shadow_ms"),
+            "shadow_scored": shadow_report.get("scored"),
+            "quality_auc": quality.get("auc"),
+            "verified_requests": len(records),
+            "failed_requests": failed,
+            "wrong_answers": wrong,
+            "mixed_iteration_answers": mixed,
+        }
+        log(f"loop cycle: {json.dumps(result)}")
+        assert resume_bit_exact, (
+            "SIGKILL-resumed candidate diverged from the uninterrupted "
+            "control"
+        )
+        assert wrong == 0, f"{wrong} wrong answers during the cycle"
+        assert mixed == 0, f"{mixed} mixed-iteration answers"
+        churn = result["answer_churn"]
+        assert churn is not None and churn <= float(
+            budget.get("max_answer_churn", 0.5)
+        ), f"answer churn {churn} over budget"
+        delta = result["shadow_p99_delta_ms"]
+        assert delta is not None and delta <= float(
+            budget.get("max_shadow_p99_delta_ms", 500.0)
+        ), f"shadow p99 delta {delta} over budget"
+        assert promotion_decision_s is not None and (
+            promotion_decision_s
+            <= float(budget.get("max_promotion_decision_s", 60.0))
+        ), f"promotion decision latency {promotion_decision_s}s over budget"
+        assert result["shadow_scored"] >= min_shadow
+        return result
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+        # reap any candidate replica a killed cli.loop attempt left
+        # behind (pids are journaled the moment they spawn)
+        try:
+            from gene2vec_tpu.loop.promote import LoopJournal, journal_path
+
+            for rec in LoopJournal(
+                journal_path(loop_root, "b1"), "b1"
+            ).replay():
+                pid = (
+                    rec.get("facts", {}).get("candidate") or {}
+                ).get("pid")
+                if pid:
+                    try:
+                        os.kill(int(pid), signal.SIGKILL)
+                    except (OSError, ValueError):
+                        pass
+        except Exception:
+            pass
+
+
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet", "alerts", "autoscale", "shard")
+          "fleet", "alerts", "autoscale", "shard", "loop")
 
 
 def main(argv=None) -> int:
@@ -2399,6 +2789,14 @@ def main(argv=None) -> int:
                          "analysis/passes_shard.py gates on (run "
                          "WITHOUT --smoke for the committed artifact; "
                          "a smoke run is off the pinned recipe)")
+    ap.add_argument("--loop-out", default=None, metavar="PATH",
+                    help="also write the loop phase's results (the "
+                         "continuous-learning cycle: ingest -> warm "
+                         "start -> quality gate -> shadow -> promote "
+                         "with a SIGKILL in every state) as a "
+                         "standalone bench document, e.g. "
+                         "BENCH_LOOP_r16.json — the record "
+                         "analysis/passes_loop.py gates on")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -2429,6 +2827,7 @@ def main(argv=None) -> int:
     alerts_budget = budgets["alerts"]["detection"]
     autoscale_budget = budgets["autoscale"]["elasticity"]
     shard_budget = budgets["shard"]["scatter"]
+    loop_budget = budgets["loop"]["promotion"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -2471,6 +2870,10 @@ def main(argv=None) -> int:
             elif phase == "shard":
                 doc["phases"][phase] = drill_shard(
                     tmp, args.smoke, shard_budget, seed
+                )
+            elif phase == "loop":
+                doc["phases"][phase] = drill_loop(
+                    tmp, args.smoke, loop_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -2535,6 +2938,22 @@ def main(argv=None) -> int:
         with open(args.autoscale_out, "w") as f:
             f.write(json.dumps(autoscale_doc, indent=1) + "\n")
         log(f"wrote {args.autoscale_out}")
+    if args.loop_out and "loop" in doc["phases"]:
+        loop_doc = {
+            "schema": "gene2vec-tpu/bench-loop/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "loop_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["loop"],
+            "loop": doc["phases"]["loop"],
+        }
+        with open(args.loop_out, "w") as f:
+            f.write(json.dumps(loop_doc, indent=1) + "\n")
+        log(f"wrote {args.loop_out}")
     if args.shard_out and "shard" in doc["phases"]:
         shard_doc = {
             "schema": "gene2vec-tpu/bench-shard/v1",

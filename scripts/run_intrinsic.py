@@ -171,6 +171,11 @@ def main():
         "random_table.intra_set_cos_real_sets (no geometry at all); "
         "trained_target_func_ratio is the reference-comparable number."
     )
+    # provenance stamp (the ledger contract, docs/BENCHMARKS.md): the
+    # committed INTRINSIC_* record must not ingest as legacy_unstamped
+    from bench import bench_stamp
+
+    bench_stamp(out)
     with open(os.path.join(REPO, "INTRINSIC_r05.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))

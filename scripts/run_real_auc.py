@@ -301,6 +301,11 @@ def main() -> None:
     if args.protocol in ("both", "reference"):
         run_reference(args, results)
 
+    # provenance stamp (the ledger contract, docs/BENCHMARKS.md): the
+    # committed REAL_AUC.json must not ingest as legacy_unstamped
+    from bench import bench_stamp
+
+    bench_stamp(results)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results))

@@ -40,9 +40,16 @@
 #                                                  # under a short ramp with
 #                                                  # a zero-drop drain and
 #                                                  # abusive-tenant isolation;
+#                                                  # AND the loop smoke: a
+#                                                  # full continuous-learning
+#                                                  # cycle (ingest -> warm
+#                                                  # start -> quality gate ->
+#                                                  # shadow -> promote) with a
+#                                                  # SIGKILL in every state;
 #                                                  # docs/RESILIENCE.md +
 #                                                  # docs/OBSERVABILITY.md +
-#                                                  # docs/SERVING.md)
+#                                                  # docs/SERVING.md +
+#                                                  # docs/CONTINUOUS.md)
 #   scripts/run_static_analysis.sh --tsan-raw      # unsuppressed TSAN run
 #                                                  # (expect intended-race
 #                                                  # reports; for auditing
@@ -157,13 +164,19 @@ if [ "$CHAOS" = "1" ]; then
   # SIGKILL mid-load, a swap-under-load, and a slow-loris shard (the
   # committed BENCH_SHARD record comes from the full, non-smoke drill)
   SHARD_OUT="${SHARD_DRILL_OUT:-/tmp/chaos_drill_shard_smoke.json}"
+  # the loop phase IS the continuous-learning smoke: a full
+  # ingest -> warm-start -> quality gate -> shadow canary -> promote
+  # cycle against a real 2-replica fleet with a SIGKILL injected in
+  # every loop state and bit-exact resume asserted against an
+  # uninterrupted control (docs/CONTINUOUS.md)
+  LOOP_OUT="${LOOP_DRILL_OUT:-/tmp/chaos_drill_loop_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
     --alerts-out "$ALERTS_OUT" --autoscale-out "$AUTOSCALE_OUT" \
-    --shard-out "$SHARD_OUT" \
+    --shard-out "$SHARD_OUT" --loop-out "$LOOP_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
   echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT," >&2
-  echo "  shard: $SHARD_OUT)" >&2
+  echo "  shard: $SHARD_OUT, loop: $LOOP_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

@@ -1,0 +1,752 @@
+"""Continuous-learning loop: ingest cursor, journal/state machine,
+warm-start bit-exactness (vocab extension included), shadow scoring,
+publish/promotion plumbing, model-freshness telemetry, and the
+passes_loop budget gate (docs/CONTINUOUS.md)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.loop import ingest as ing
+from gene2vec_tpu.loop.promote import (
+    CycleDriver,
+    LoopJournal,
+    LoopState,
+    journal_path,
+    quarantine_candidate,
+)
+from gene2vec_tpu.loop.shadow import ShadowManager, ShadowScorer, topk_churn
+
+
+def _mk_vocab(tokens):
+    return Vocab(list(tokens), np.arange(len(tokens), 0, -1))
+
+
+def _lines(n, seed=0, clusters=3, per=6):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        c = rng.randint(clusters)
+        a, b = rng.choice(per, 2, replace=False) + per * c
+        out.append(f"G{a} G{b}")
+    return out
+
+
+# -- ingest cursor -----------------------------------------------------------
+
+
+def test_ingest_commit_idempotent_and_torn_append_recovery(tmp_path):
+    root = str(tmp_path / "loop")
+    base = _mk_vocab([f"G{i}" for i in range(6)])
+    assert ing.init_ingest(root, base)
+    assert not ing.init_ingest(root, base)  # idempotent
+
+    f1 = ing.ingest_batch(root, "b1", ["G0 G1", "G2 G3"])
+    assert f1["appended_pairs"] == 2 and not f1["skipped"]
+    # idempotent replay
+    f2 = ing.ingest_batch(root, "b1", ["G0 G1", "G2 G3"])
+    assert f2["skipped"] and f2["corpus_bytes"] == f1["corpus_bytes"]
+
+    # torn append: bytes past the committed offset (a SIGKILL mid-
+    # write) are truncated away on the next ingest — the half-counted
+    # batch never existed
+    pairs = os.path.join(ing.ingest_dir(root), ing.PAIRS_NAME)
+    with open(pairs, "ab") as f:
+        f.write(b"G4 G5\nGARBAGE")
+    f3 = ing.ingest_batch(root, "b2", ["G4 G5"])
+    assert f3["appended_pairs"] == 1
+    corpus, _held = ing.load_loop_corpus(root, holdout_fraction=0.0)
+    assert corpus.num_pairs == 3  # b1's 2 + b2's 1, garbage gone
+
+
+def test_ingest_cursor_self_crc_and_prev_fallback(tmp_path):
+    root = str(tmp_path / "loop")
+    ing.init_ingest(root, _mk_vocab(["A", "B"]))
+    ing.ingest_batch(root, "b1", ["A B"])
+    good = ing.load_cursor(root)
+    cur = os.path.join(ing.ingest_dir(root), ing.CURSOR_NAME)
+    # bit-rot the live cursor: load falls back to the prev commit
+    with open(cur, "r+") as f:
+        doc = json.load(f)
+        doc["corpus_bytes"] = 999999
+        f.seek(0)
+        json.dump(doc, f)
+        f.truncate()
+    fallback = ing.load_cursor(root)
+    assert fallback["corpus_bytes"] != 999999
+    assert fallback["batches"] in ([], good["batches"][:-1], good["batches"])
+
+
+def test_ingest_post_commit_corpus_rot_detected(tmp_path):
+    root = str(tmp_path / "loop")
+    ing.init_ingest(root, _mk_vocab(["A", "B", "C"]))
+    ing.ingest_batch(root, "b1", ["A B", "B C"])
+    pairs = os.path.join(ing.ingest_dir(root), ing.PAIRS_NAME)
+    with open(pairs, "r+b") as f:
+        f.seek(0)
+        f.write(b"X")
+    with pytest.raises(IOError, match="CRC"):
+        ing.ingest_batch(root, "b2", ["A C"])
+
+
+def test_loop_vocab_tail_extension_is_stable(tmp_path):
+    root = str(tmp_path / "loop")
+    base = _mk_vocab(["G0", "G1", "G2"])
+    ing.init_ingest(root, base)
+    ing.ingest_batch(root, "b1", ["G0 NEWB", "NEWA G1", "NEWB G2"])
+    v = ing.loop_vocab(root)
+    # base ids untouched; new genes appended in FIRST-APPEARANCE order
+    assert v.id_to_token[:3] == ["G0", "G1", "G2"]
+    assert v.id_to_token[3:] == ["NEWB", "NEWA"]
+    # counts accumulate on top of the base counts
+    assert v.counts[v.token_to_id["G0"]] == base.counts[0] + 1
+    assert v.counts[v.token_to_id["NEWB"]] == 2
+    # a second batch keeps earlier extensions' ids stable
+    ing.ingest_batch(root, "b2", ["NEWC G0"])
+    v2 = ing.loop_vocab(root)
+    assert v2.id_to_token[:5] == v.id_to_token
+    assert v2.id_to_token[5] == "NEWC"
+
+
+def test_seed_reingest_does_not_double_count_base_vocab(tmp_path):
+    # the serving vocab's counts already reflect the original corpus;
+    # re-ingesting that corpus as the seed batch must REPLACE the base
+    # counts, not stack on top of them — a double count would skew the
+    # negative-sampling unigram distribution against new genes
+    root = str(tmp_path / "loop")
+    base = _mk_vocab(["G0", "G1", "G2"])  # counts 3, 2, 1
+    ing.init_ingest(root, base)
+    ing.ingest_batch(root, "seed", ["G0 G1", "G0 G2"],
+                     replaces_base_counts=True)
+    ing.ingest_batch(root, "b1", ["G0 NEW"])
+    v = ing.loop_vocab(root)
+    # counts come from the committed corpus alone (3x G0, 1x each
+    # other), never base + corpus; the flag survives later batches
+    assert v.counts[v.token_to_id["G0"]] == 3
+    assert v.counts[v.token_to_id["G1"]] == 1
+    assert v.counts[v.token_to_id["G2"]] == 1
+    assert v.counts[v.token_to_id["NEW"]] == 1
+    # id order still anchored by the base vocab
+    assert v.id_to_token == ["G0", "G1", "G2", "NEW"]
+
+
+def test_pair_held_is_stable_and_direction_symmetric():
+    assert ing.pair_held("A", "B", 0.2) == ing.pair_held("B", "A", 0.2)
+    held = [p for p in _lines(500, seed=3)
+            if ing.pair_held(*p.split(), 0.2)]
+    assert 0.05 < len(held) / 500 < 0.45  # roughly the asked fraction
+    # and membership never flips between calls
+    assert held == [p for p in _lines(500, seed=3)
+                    if ing.pair_held(*p.split(), 0.2)]
+
+
+# -- journal + state machine -------------------------------------------------
+
+
+def test_journal_replay_ignores_torn_tail_only(tmp_path):
+    path = journal_path(str(tmp_path), "c1")
+    j = LoopJournal(path, "c1")
+    j.enter(LoopState.INGESTING)
+    j.done(LoopState.INGESTING, appended_pairs=3)
+    with open(path, "a") as f:
+        f.write('{"torn": tru')  # SIGKILL mid-append
+    j2 = LoopJournal(path, "c1")
+    assert [r["event"] for r in j2.replay()] == ["enter", "done"]
+    assert j2.done_facts()[LoopState.INGESTING]["appended_pairs"] == 3
+    # a torn record BEFORE the tail is post-commit corruption: raise
+    with open(path, "w") as f:
+        f.write('{"torn": tru\n')
+        f.write(json.dumps({"state": "X", "event": "done"}) + "\n")
+    with pytest.raises(IOError):
+        LoopJournal(path, "c1").replay()
+
+
+def _steps(trace, **overrides):
+    def mk(state, facts=None):
+        def fn(context):
+            trace.append(state)
+            return dict(facts or {})
+        return fn
+
+    steps = {
+        LoopState.INGESTING: mk(LoopState.INGESTING),
+        LoopState.TRAINING: mk(
+            LoopState.TRAINING, {"final_iteration": 5}
+        ),
+        LoopState.QUALITY_GATE: mk(
+            LoopState.QUALITY_GATE, {"passed": True}
+        ),
+        LoopState.SHADOWING: mk(
+            LoopState.SHADOWING, {"verdict": "promote"}
+        ),
+        LoopState.PROMOTING: mk(LoopState.PROMOTING),
+        LoopState.SERVING: mk(LoopState.SERVING),
+    }
+    steps.update(overrides)
+    return steps
+
+
+def test_cycle_driver_runs_to_serving_and_resume_skips_done(tmp_path):
+    path = journal_path(str(tmp_path), "c1")
+    trace = []
+    out = CycleDriver(LoopJournal(path, "c1"), _steps(trace)).run()
+    assert out["state"] == LoopState.SERVING
+    assert trace == list(
+        s for s in
+        (LoopState.INGESTING, LoopState.TRAINING,
+         LoopState.QUALITY_GATE, LoopState.SHADOWING,
+         LoopState.PROMOTING, LoopState.SERVING)
+    )
+    # resume: every state is committed — nothing re-runs
+    trace2 = []
+    out2 = CycleDriver(LoopJournal(path, "c1"), _steps(trace2)).run()
+    assert out2["state"] == LoopState.SERVING and trace2 == []
+
+
+def test_cycle_driver_resumes_mid_cycle(tmp_path):
+    path = journal_path(str(tmp_path), "c2")
+    trace = []
+
+    def boom(context):
+        trace.append("boom")
+        raise RuntimeError("killed mid-state")
+
+    with pytest.raises(RuntimeError):
+        CycleDriver(
+            LoopJournal(path, "c2"),
+            _steps(trace, **{LoopState.SHADOWING: boom}),
+        ).run()
+    # resume re-runs ONLY the un-committed states
+    trace2 = []
+    out = CycleDriver(LoopJournal(path, "c2"), _steps(trace2)).run()
+    assert out["state"] == LoopState.SERVING
+    assert trace2 == [
+        LoopState.SHADOWING, LoopState.PROMOTING, LoopState.SERVING
+    ]
+
+
+def test_cycle_driver_demotes_on_failed_gate_and_shadow(tmp_path):
+    for cid, overrides, reason_frag in (
+        ("q", {LoopState.QUALITY_GATE: lambda c: {
+            "passed": False, "reason": "auc low"}}, "auc low"),
+        ("s", {LoopState.SHADOWING: lambda c: {
+            "verdict": "demote", "reason": "churny"}}, "churny"),
+    ):
+        path = journal_path(str(tmp_path), cid)
+        trace = []
+        demoted = []
+        out = CycleDriver(
+            LoopJournal(path, cid), _steps(trace, **overrides),
+            demote_step=lambda c: demoted.append(1) or {"quarantined": "q"},
+        ).run()
+        assert out["state"] == LoopState.DEMOTED
+        assert demoted == [1]
+        assert reason_frag in out["context"][LoopState.DEMOTED]["reason"]
+        assert LoopState.PROMOTING not in trace
+        # resume of a demoted cycle is terminal, no re-run
+        out2 = CycleDriver(LoopJournal(path, cid), _steps([])).run()
+        assert out2["state"] == LoopState.DEMOTED
+
+
+def test_quarantine_candidate_moves_dir(tmp_path):
+    cand = tmp_path / "candidates" / "b1"
+    cand.mkdir(parents=True)
+    (cand / "x.npz").write_bytes(b"data")
+    dst = quarantine_candidate(str(tmp_path), str(cand), "b1")
+    assert dst and os.path.exists(os.path.join(dst, "x.npz"))
+    assert not cand.exists()
+    assert quarantine_candidate(str(tmp_path), str(cand), "b1") is None
+
+
+# -- warm-start bit-exactness (the satellite contract) -----------------------
+
+
+def _train_serving(tmp_path, lines, cfg):
+    from gene2vec_tpu.data.pipeline import PairCorpus
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    vocab = Vocab.from_pairs([ln.split() for ln in lines])
+    corpus = PairCorpus(vocab, vocab.encode_pairs(
+        [ln.split() for ln in lines]
+    ))
+    serving = str(tmp_path / "serving")
+    SGNSTrainer(corpus, cfg).run(serving, log=lambda s: None)
+    return serving, vocab
+
+
+def test_warm_start_continuation_bit_exact_with_vocab_extension(tmp_path):
+    """Continuation from iteration N equals an uninterrupted run to
+    N+k bit-for-bit — including the new-gene vocab-extension case and
+    a kill-between-iterations resume (the on-disk state a SIGKILL
+    mid-continuation leaves behind)."""
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.io import checkpoint as ckpt
+    from gene2vec_tpu.loop import trainer as ltr
+
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=64, num_iters=2, txt_output=False, seed=1
+    )
+    lines = _lines(200, seed=5)
+    serving, base_vocab = _train_serving(tmp_path, lines, cfg)
+
+    root = str(tmp_path / "loop")
+    ing.init_ingest(root, base_vocab)
+    ing.ingest_batch(root, "seed", lines)
+    ing.ingest_batch(
+        root, "b1", ["GNEWX G0", "GNEWX G2", "GNEWY G7", "GNEWY G8"] * 3
+    )
+    corpus, _held = ing.load_loop_corpus(root, holdout_fraction=0.2)
+    assert corpus.vocab_size == len(base_vocab) + 2
+
+    # uninterrupted continuation
+    cand_a = str(tmp_path / "cand_a")
+    pa, base_a, fin_a = ltr.train_candidate(
+        serving, cand_a, corpus, cfg, 2, log=lambda s: None
+    )
+    # interrupted continuation: stop after 1 iter (= the committed
+    # state a SIGKILL leaves), then resume to the same target
+    cand_b = str(tmp_path / "cand_b")
+    ltr.train_candidate(serving, cand_b, corpus, cfg, 1,
+                        log=lambda s: None)
+    pb, base_b, fin_b = ltr.train_candidate(
+        serving, cand_b, corpus, cfg, 2, log=lambda s: None
+    )
+    assert (base_a, fin_a) == (base_b, fin_b)
+    assert np.array_equal(np.asarray(pa.emb), np.asarray(pb.emb))
+    assert np.array_equal(np.asarray(pa.ctx), np.asarray(pb.ctx))
+
+    # adoption seeded the extension deterministically: base rows are
+    # the serving table bit-for-bit, new rows the init-slice
+    adopted, avocab, meta = ckpt.load_iteration(
+        cand_a, cfg.dim, base_a, table_dtype=None
+    )
+    src, _sv, _sm = ckpt.load_iteration(
+        serving, cfg.dim, base_a, table_dtype=None
+    )
+    assert np.array_equal(
+        np.asarray(adopted.emb)[: len(base_vocab)], np.asarray(src.emb)
+    )
+    assert meta["warm_start"]["new_genes"] == 2
+    assert avocab.id_to_token[: len(base_vocab)] == base_vocab.id_to_token
+
+
+def test_extend_params_is_deterministic_and_guards_shrink():
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.loop.trainer import extend_params
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    cfg = SGNSConfig(seed=3)
+    p = SGNSParams(
+        emb=np.ones((4, 8), np.float32), ctx=np.zeros((4, 8), np.float32)
+    )
+    a = extend_params(p, 6, cfg)
+    b = extend_params(p, 6, cfg)
+    assert np.array_equal(np.asarray(a.emb), np.asarray(b.emb))
+    assert np.array_equal(np.asarray(a.emb)[:4], p.emb)
+    assert np.all(np.asarray(a.ctx)[4:] == 0)
+    assert extend_params(p, 4, cfg) is p
+    with pytest.raises(ValueError, match="shrank"):
+        extend_params(p, 2, cfg)
+
+
+def test_quality_report_gate_band(tmp_path):
+    from gene2vec_tpu.loop.trainer import quality_report
+
+    tokens = [f"G{i}" for i in range(18)]
+    vocab = _mk_vocab(tokens)
+    # 3 tight clusters: held intra-cluster pairs separate cleanly
+    rng = np.random.RandomState(0)
+    centers = rng.randn(3, 12) * 5
+    emb = np.vstack([
+        centers[i // 6] + 0.05 * rng.randn(12) for i in range(18)
+    ]).astype(np.float32)
+    held = [[f"G{a}", f"G{b}"] for c in range(3)
+            for a, b in [(c * 6, c * 6 + 1), (c * 6 + 2, c * 6 + 3),
+                         (c * 6 + 4, c * 6 + 5)]]
+    rep = quality_report(vocab, emb, held, min_auc=0.6, max_auc=1.01)
+    assert rep["passed"] and rep["auc"] > 0.8
+    # a random table fails the floor
+    bad = quality_report(
+        vocab, rng.randn(18, 12).astype(np.float32), held,
+        min_auc=0.95, max_auc=1.01,
+    )
+    assert not bad["passed"] and "outside the gate band" in bad["reason"]
+    # too little evidence refuses to pass
+    thin = quality_report(vocab, emb, held[:2], min_auc=0.1, max_auc=1.0)
+    assert not thin["passed"]
+
+
+# -- shadow scoring ----------------------------------------------------------
+
+
+def test_topk_churn_and_rank_displacement():
+    assert topk_churn(["a", "b", "c"], ["a", "b", "c"]) == (0.0, 0.0)
+    c, d = topk_churn(["a", "b"], ["x", "y"])
+    assert c == 1.0 and d is None
+    c, d = topk_churn(["a", "b", "c", "d"], ["b", "a", "c", "d"])
+    assert 0.0 < 1.0 and c == 0.0 and d == pytest.approx(0.125)
+    c, _ = topk_churn(["a", "b", "c", "d"], ["a", "b", "c", "x"])
+    assert c == pytest.approx(1 - 3 / 5)
+
+
+def _similar_doc(iteration, neighbors):
+    return {
+        "model": {"dim": 8, "iteration": iteration},
+        "results": [{
+            "query": "G0",
+            "neighbors": [{"gene": g, "score": 0.5} for g in neighbors],
+        }],
+    }
+
+
+def test_shadow_scorer_aggregates():
+    s = ShadowScorer()
+    s.score(_similar_doc(1, ["a", "b", "c"]),
+            _similar_doc(2, ["a", "b", "c"]), 0.010, 0.012)
+    s.score(_similar_doc(1, ["a", "b", "c"]),
+            _similar_doc(2, ["a", "b", "x"]), 0.010, 0.030)
+    s.record_error()
+    rep = s.report()
+    assert rep["scored"] == 2 and rep["errors"] == 1
+    assert rep["answer_churn"] == pytest.approx((0.0 + 0.5) / 2)
+    assert rep["p99_live_ms"] == pytest.approx(10.0)
+    assert rep["p99_shadow_ms"] == pytest.approx(30.0)
+    assert rep["p99_delta_ms"] == pytest.approx(20.0)
+    assert rep["live_iterations"] == [1]
+    assert rep["shadow_iterations"] == [2]
+
+
+def test_shadow_manager_samples_and_scores():
+    calls = []
+
+    def fake_fetch(url, method, target, body, headers, timeout_s):
+        calls.append((url, method, target, headers.get("traceparent")))
+        return 200, json.dumps(_similar_doc(2, ["a", "b"])).encode()
+
+    m = ShadowManager(fetch=fake_fetch, workers=1)
+    try:
+        # inactive: observe is a no-op
+        m.observe("POST", "/v1/similar", {"genes": ["G0"]},
+                  b"{}", 0.01, None)
+        assert m.report()["report"]["scored"] == 0
+        with pytest.raises(ValueError):
+            m.start("not-a-url")
+        m.start("http://cand:1", sample=1.0)
+        live = json.dumps(_similar_doc(1, ["a", "b"])).encode()
+        from gene2vec_tpu.obs.tracecontext import new_trace
+
+        ctx = new_trace()
+        for _ in range(5):
+            m.observe("POST", "/v1/similar", {"genes": ["G0"]},
+                      live, 0.01, ctx)
+        deadline = time.monotonic() + 5.0
+        while (m.scorer.scored < 5 and time.monotonic() < deadline):
+            time.sleep(0.02)
+        rep = m.stop()["report"]
+        assert rep["scored"] == 5 and rep["answer_churn"] == 0.0
+        # shadow legs carried the live request's trace id
+        assert all(c[3] and c[3].split("-")[1] == ctx.trace_id
+                   for c in calls)
+    finally:
+        m.close()
+
+
+def test_shadow_manager_counts_errors():
+    def bad_fetch(*a, **k):
+        raise IOError("down")
+
+    m = ShadowManager(fetch=bad_fetch, workers=1)
+    try:
+        m.start("http://cand:1", sample=1.0)
+        m.observe("POST", "/v1/similar", {}, b"{}", 0.01, None)
+        deadline = time.monotonic() + 5.0
+        while m.scorer.errors < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert m.scorer.errors == 1 and m.scorer.scored == 0
+    finally:
+        m.close()
+
+
+# -- publish + promotion plumbing --------------------------------------------
+
+
+def test_publish_iteration_sidecar_registry_and_routing(tmp_path):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.io import checkpoint as ckpt
+    from gene2vec_tpu.loop import trainer as ltr
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.shardgroup import RoutingTable
+
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=64, num_iters=1, txt_output=False, seed=1
+    )
+    lines = _lines(120, seed=9)
+    serving, base_vocab = _train_serving(tmp_path, lines, cfg)
+    root = str(tmp_path / "loop")
+    ing.init_ingest(root, base_vocab)
+    ing.ingest_batch(root, "seed", lines)
+    ing.ingest_batch(root, "b1", ["GNEWP G0", "GNEWP G1"] * 3)
+    corpus, _ = ing.load_loop_corpus(root, holdout_fraction=0.0)
+    cand = str(tmp_path / "cand")
+    _p, _b, fin = ltr.train_candidate(
+        serving, cand, corpus, cfg, 1, log=lambda s: None
+    )
+    # publish: npz + per-iteration vocab sidecar + manifest LAST
+    dst = ckpt.publish_iteration(cand, serving, cfg.dim, fin)
+    assert os.path.exists(dst)
+    sidecar = dst[: -len(".npz")] + ".vocab.tsv"
+    assert os.path.exists(sidecar), "tail-extended vocab needs a sidecar"
+    # vocab.tsv untouched: older manifests still verify
+    from gene2vec_tpu.resilience import snapshot as snap
+
+    assert snap.verify_manifest(
+        ckpt.ckpt_prefix(serving, cfg.dim, 1), use_cache=False
+    )
+    assert ckpt.latest_iteration(serving, cfg.dim) == fin
+    # the registry serves the promoted iteration with the extended vocab
+    reg = ModelRegistry(serving)
+    assert reg.refresh()
+    m = reg.model
+    assert m.iteration == fin and len(m) == corpus.vocab_size
+    assert "GNEWP" in m.index and m.created_unix > 0
+    # the routing table routes the NEW gene (sidecar-aware)
+    rt = RoutingTable(serving, num_shards=2)
+    assert rt.reload()
+    assert rt.total_rows == corpus.vocab_size
+    assert rt.owner("GNEWP") is not None
+
+
+def test_publish_refuses_unverified_and_non_extension(tmp_path):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.io import checkpoint as ckpt
+
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=64, num_iters=1, txt_output=False, seed=1
+    )
+    serving, _ = _train_serving(tmp_path, _lines(80, seed=2), cfg)
+    with pytest.raises(IOError, match="unverified"):
+        ckpt.publish_iteration(
+            str(tmp_path / "nowhere"), serving, cfg.dim, 1
+        )
+    # a source whose vocab is NOT a tail extension refuses
+    other_dir, _ = _train_serving(
+        tmp_path / "other", ["X0 X1", "X1 X2", "X2 X0"] * 20, cfg
+    )
+    with pytest.raises(ValueError, match="tail extension"):
+        ckpt.publish_iteration(other_dir, serving, cfg.dim, 1)
+
+
+# -- model freshness telemetry (satellite 2) ---------------------------------
+
+
+def test_aggregator_exports_per_replica_model_facts():
+    from gene2vec_tpu.obs.aggregate import FleetAggregator
+
+    texts = {
+        "http://a": "model_iteration 3\nmodel_age_seconds 120.5\n",
+        "http://b": "model_iteration 5\nmodel_age_seconds 12.0\n",
+    }
+    captured = {}
+
+    class Ev:
+        def observe(self, snapshot, wall=None):
+            captured.update(snapshot)
+
+    agg = FleetAggregator(
+        ["http://a", "http://b"],
+        fetch=lambda url, t: texts[url],
+        evaluator=Ev(),
+    )
+    agg.scrape_once()
+    view = agg.fleet_text()
+    assert 'fleet_model_iteration{target="http://a"} 3' in view
+    assert 'fleet_model_age_seconds{target="http://b"} 12' in view
+    assert captured["fleet_model_iteration_min"] == 3.0
+    assert captured["fleet_model_iteration_max"] == 5.0
+    assert captured["fleet_model_iteration_skew"] == 2.0
+    assert captured["fleet_model_age_seconds_max"] == 120.5
+
+
+def test_default_rules_cover_model_freshness():
+    from gene2vec_tpu.obs.alerts import default_rules
+
+    by_name = {r.name: r for r in default_rules()}
+    stale = by_name["model-staleness"]
+    assert stale.metric == "fleet_model_age_seconds_max"
+    skew = by_name["model-iteration-skew"]
+    assert skew.metric == "fleet_model_iteration_skew"
+    # a swap wave must not page: skew needs to HOLD for for_s
+    assert skew.for_s >= 60.0
+    for r in (stale, skew):
+        r.validate()
+
+
+def test_replica_exports_model_age(tmp_path):
+    from gene2vec_tpu.config import SGNSConfig
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig
+
+    cfg = SGNSConfig(
+        dim=8, batch_pairs=64, num_iters=1, txt_output=False, seed=1
+    )
+    serving, _ = _train_serving(tmp_path, _lines(80, seed=4), cfg)
+    reg = ModelRegistry(serving)
+    assert reg.refresh()
+    metrics = MetricsRegistry()
+    app = ServeApp(reg, config=ServeConfig(), metrics=metrics)
+    try:
+        app.publish_engine_metrics()
+        age = metrics.gauge("model_age_seconds").value
+        assert 0.0 <= age < 3600.0
+    finally:
+        app.stop()
+
+
+# -- the budget gate (passes_loop) -------------------------------------------
+
+
+def _loop_doc(**over):
+    section = {
+        "replicas": 2,
+        "train_iters": 2,
+        "shadow_sample": 1.0,
+        "min_shadow_requests": 30,
+        "states_killed": 4,
+        "answer_churn": 0.3,
+        "shadow_p99_delta_ms": 40.0,
+        "wrong_answers": 0,
+        "mixed_iteration_answers": 0,
+        "promotion_decision_s": 8.0,
+        "promoted": True,
+        "resume_bit_exact": True,
+    }
+    for k, v in over.items():
+        if v is None:
+            section.pop(k, None)
+        else:
+            section[k] = v
+    return {"schema_version": 1, "loop": section}
+
+
+def test_passes_loop_budget_gate(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_loop import loop_findings
+
+    # missing bench = info (fresh checkout must not fail lint)
+    missing = loop_findings(root=str(tmp_path / "absent"))
+    assert [f.severity for f in missing] == ["info"]
+
+    def run(doc):
+        root = tmp_path / "root"
+        root.mkdir(exist_ok=True)
+        with open(root / "BENCH_LOOP_r16.json", "w") as f:
+            json.dump(doc, f)
+        return loop_findings(root=str(root))
+
+    fs = run(_loop_doc())
+    assert gating(fs) == [], [f.format() for f in fs]
+
+    # each planted violation fires EXACTLY once
+    for doc in (
+        _loop_doc(answer_churn=0.9),                # reshuffled answers
+        _loop_doc(shadow_p99_delta_ms=5000.0),      # slow candidate
+        _loop_doc(wrong_answers=1),
+        _loop_doc(mixed_iteration_answers=1),
+        _loop_doc(promotion_decision_s=600.0),      # wedged promotion
+        _loop_doc(promoted=False),                  # never promoted
+        _loop_doc(resume_bit_exact=False),          # resume diverged
+        _loop_doc(answer_churn=None),               # dropped key
+        _loop_doc(states_killed=0),                 # off-recipe: no kills
+        _loop_doc(shadow_sample=0.01),              # off-recipe
+        {"schema_version": 1},                      # no section
+    ):
+        fs = run(doc)
+        assert len(gating(fs)) == 1, doc
+
+    # the newest round wins: a violating r17 beats a stale clean r16
+    root = tmp_path / "root"
+    with open(root / "BENCH_LOOP_r17.json", "w") as f:
+        json.dump(_loop_doc(wrong_answers=3), f)
+    with open(root / "BENCH_LOOP_r16.json", "w") as f:
+        json.dump(_loop_doc(), f)
+    fs = loop_findings(root=str(root))
+    assert len(gating(fs)) == 1
+    assert gating(fs)[0].path == "BENCH_LOOP_r17.json"
+
+
+def test_cli_analyze_gates_on_planted_loop_violation(tmp_path):
+    """The env-override path: a violating BENCH_LOOP under
+    GENE2VEC_TPU_LOOP_ROOT makes the real cli.analyze exit 1 with
+    exactly one loop-promotion-budget finding."""
+    root = tmp_path / "root"
+    root.mkdir()
+    with open(root / "BENCH_LOOP_r16.json", "w") as f:
+        json.dump(_loop_doc(resume_bit_exact=False), f)
+    env = {**os.environ, "GENE2VEC_TPU_LOOP_ROOT": str(root)}
+    r = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 1, r.stderr
+    doc = json.loads(r.stdout)
+    mine = [f for f in doc["findings"]
+            if f["pass"] == "loop-promotion-budget"
+            and f["severity"] != "info"]
+    assert len(mine) == 1
+    assert "resume_bit_exact" in mine[0]["message"]
+
+
+def test_ledger_adapts_bench_loop(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    path = tmp_path / "BENCH_LOOP_r16.json"
+    doc = _loop_doc()
+    doc["loop"].update(ingest_to_promoted_s=55.0, shadow_scored=40)
+    doc["passed"] = True
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    rec = ledger.adapt_file(str(path))
+    assert rec is not None and rec["family"] == "loop"
+    assert rec["round"] == 16
+    assert not rec["legacy_unstamped"]
+    assert rec["headline_metric"] == "loop_answer_churn"
+    m = rec["metrics"]
+    assert m["loop_answer_churn"] == 0.3
+    assert m["loop_ingest_to_promoted_s"] == 55.0
+    assert m["loop_resume_bit_exact"] == 1.0
+
+
+def test_evaluate_cli_stamps_json_product(tmp_path):
+    """cli.evaluate --json emits a provenance-stamped document (the
+    ledger contract: schema_version/command/created_unix present)."""
+    from gene2vec_tpu.io.emb_io import write_word2vec_format
+
+    emb = tmp_path / "emb_w2v.txt"
+    rng = np.random.RandomState(0)
+    write_word2vec_format(
+        str(emb), [f"G{i}" for i in range(8)],
+        rng.randn(8, 4).astype(np.float32),
+    )
+    gmt = tmp_path / "sets.gmt"
+    gmt.write_text(
+        "SET_A\turl\tG0\tG1\tG2\nSET_B\turl\tG3\tG4\tG5\n"
+    )
+    out = tmp_path / "eval.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.evaluate",
+         str(emb), str(gmt), "--json", "--out", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["schema_version"] == 1
+    assert "command" in doc and "created_unix" in doc
+    assert isinstance(doc["trained_target_func_ratio"], float)
+    assert json.loads(out.read_text())["schema_version"] == 1
