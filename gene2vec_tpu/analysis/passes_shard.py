@@ -249,6 +249,95 @@ def shard_findings(
                 f"{http_shards:g}"
             )
 
+    # -- the failover half: replicated shards (replica groups) ----------
+    # A dead replica with a live SIBLING must cost nothing: zero
+    # degraded answers, availability intact, p99 bounded — and with the
+    # whole group dead the PR-13 degraded contract must be unchanged.
+    fo_budget = budget.get("failover")
+    if isinstance(fo_budget, dict):
+        fo = drill.get("failover")
+        fo = fo if isinstance(fo, dict) else {}
+        if not fo:
+            problems.append(
+                "record has no drill.failover section — re-run the "
+                "shard drill (it now includes the replicated-shard "
+                "scenario)"
+            )
+        rps = _get(fo_budget, "replicas_per_shard")
+        if rps is not None:
+            got = _get(fo, "replicas_per_shard")
+            data["failover_replicas_per_shard"] = got
+            if got is None:
+                problems.append(
+                    "drill.failover.replicas_per_shard missing from "
+                    "the record"
+                )
+            elif got != rps:
+                problems.append(
+                    f"failover drill ran {got:g} replicas per shard "
+                    f"but the budget pins {rps:g}"
+                )
+        fo_avail = _get(fo, "availability")
+        fo_floor = _get(fo_budget, "min_availability")
+        data["failover_availability"] = fo_avail
+        if fo_floor is not None:
+            if fo_avail is None:
+                problems.append(
+                    "drill.failover.availability missing from the "
+                    "record"
+                )
+            elif fo_avail < fo_floor:
+                problems.append(
+                    f"failover availability {fo_avail:g} < budget "
+                    f"{fo_floor:g} — a sibling was live the whole time"
+                )
+        deg = _get(fo, "degraded_responses")
+        deg_max = _get(fo_budget, "max_degraded_with_live_replica")
+        data["failover_degraded_responses"] = deg
+        if deg_max is not None:
+            if deg is None:
+                problems.append(
+                    "drill.failover.degraded_responses missing from "
+                    "the record"
+                )
+            elif deg > deg_max:
+                problems.append(
+                    f"{int(deg)} degraded responses with a LIVE "
+                    f"sibling (budget {int(deg_max)}) — failover must "
+                    "absorb a single replica death entirely"
+                )
+        fo_p99 = _get(fo, "p99_ms")
+        p99_max = _get(fo_budget, "max_failover_p99_ms")
+        data["failover_p99_ms"] = fo_p99
+        if p99_max is not None:
+            if fo_p99 is None:
+                problems.append(
+                    "drill.failover.p99_ms missing from the record"
+                )
+            elif fo_p99 > p99_max:
+                problems.append(
+                    f"failover-window p99 {fo_p99:g} ms > budget "
+                    f"{p99_max:g} ms — failing over eats the deadline"
+                )
+        both = fo.get("both_dead")
+        both = both if isinstance(both, dict) else {}
+        both_min = _get(fo_budget, "min_both_dead_degraded")
+        both_deg = _get(both, "degraded_responses")
+        data["both_dead_degraded_responses"] = both_deg
+        if both_min is not None:
+            if both_deg is None:
+                problems.append(
+                    "drill.failover.both_dead.degraded_responses "
+                    "missing from the record"
+                )
+            elif both_deg < both_min:
+                problems.append(
+                    f"only {int(both_deg)} degraded responses with the "
+                    "whole replica group dead (budget >= "
+                    f"{int(both_min)}) — the both-dead window never "
+                    "landed, the degraded contract went unverified"
+                )
+
     if problems:
         return [Finding(
             pass_id=_PASS,

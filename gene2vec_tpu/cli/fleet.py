@@ -146,18 +146,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "path (cli.loop drives this; "
                         "docs/CONTINUOUS.md)")
     p.add_argument("--shard-by-rows", type=int, default=0, metavar="N",
-                   help="fleet-sharded index serving: run N replicas "
-                        "each owning a CONTIGUOUS row shard of the "
+                   help="fleet-sharded index serving: run N row shards "
+                        "each owning a CONTIGUOUS row range of the "
                         "table (+ its inverted lists), with the front "
                         "door scatter-gathering /v1/similar across all "
                         "shards and merging shard-local top-k "
                         "(serve/shardgroup.py; docs/SERVING.md"
                         "#sharded-index-serving).  Overrides "
-                        "--replicas; incompatible with --max-replicas "
-                        "(shards are a partition, not a pool).  Hot "
-                        "swap becomes shard-ATOMIC: every shard stages "
-                        "the new iteration, then all flip under one "
-                        "epoch token")
+                        "--replicas (total = N x --replicas-per-shard)."
+                        "  With --max-replicas the bounds apply PER "
+                        "SHARD POOL (shard-aware autoscaling).  Hot "
+                        "swap becomes shard-ATOMIC: every (shard, "
+                        "replica) cell stages the new iteration, then "
+                        "all flip under one epoch token")
+    p.add_argument("--replicas-per-shard", type=int, default=1,
+                   metavar="R",
+                   help="replica GROUP size per row shard (sharded "
+                        "mode only): the front door scatters each "
+                        "shard leg to any live sibling and fails over "
+                        "within the leg's deadline, so a single "
+                        "replica death costs zero degraded answers "
+                        "(docs/SERVING.md#replicated-shards)")
+    p.add_argument("--ggipnn-checkpoint", default=None,
+                   help="models/ggipnn_obs checkpoint npz backing the "
+                        "FRONT DOOR's cross-shard /v1/interaction "
+                        "scorer (sharded mode; without it the MLP head "
+                        "keeps its random init and trained_head is "
+                        "echoed false).  Unsharded fleets pass the "
+                        "flag to replicas via --serve-arg instead")
     p.add_argument("--shard-deadline-ms", type=float, default=2000.0,
                    help="per-shard scatter-leg deadline; a dead or "
                         "slow shard costs at most this before the "
@@ -199,19 +215,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.shard_by_rows < 0:
         print("error: --shard-by-rows must be >= 0", file=sys.stderr)
         return 2
-    if args.shard_by_rows and args.max_replicas > 0:
+    if args.replicas_per_shard < 1:
+        print("error: --replicas-per-shard must be >= 1",
+              file=sys.stderr)
+        return 2
+    if args.replicas_per_shard > 1 and not args.shard_by_rows:
         print(
-            "error: --shard-by-rows and --max-replicas are "
-            "incompatible — shards partition one table (a fixed set), "
-            "autoscaling grows a pool of identical replicas",
+            "error: --replicas-per-shard needs --shard-by-rows (an "
+            "unsharded fleet's replicas are already one "
+            "interchangeable pool; use --replicas)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.ggipnn_checkpoint and not os.path.isfile(
+        args.ggipnn_checkpoint
+    ):
+        print(
+            f"error: --ggipnn-checkpoint {args.ggipnn_checkpoint!r} "
+            "does not exist",
             file=sys.stderr,
         )
         return 2
     if args.shard_by_rows:
-        args.replicas = args.shard_by_rows
+        args.replicas = args.shard_by_rows * args.replicas_per_shard
 
-    # validate the autoscale flags BEFORE paying N replica spawns
+    # validate the autoscale flags BEFORE paying N replica spawns.  In
+    # sharded mode the min/max bounds apply to each SHARD's replica
+    # pool: the scaler grows the hot shard's group, never the shard
+    # count (shards partition one table — a fixed set)
     autoscale_cfg = None
+    pool_base = (
+        args.replicas_per_shard if args.shard_by_rows
+        else args.replicas
+    )
     if args.max_replicas > 0:
         from gene2vec_tpu.serve.autoscale import AutoscaleConfig
 
@@ -225,7 +261,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         try:
             autoscale_cfg = AutoscaleConfig(
-                min_replicas=args.min_replicas or args.replicas,
+                min_replicas=args.min_replicas or pool_base,
                 max_replicas=args.max_replicas,
                 up_queue_per_replica=args.scale_up_queue,
                 up_rejection_rate=args.scale_up_rejection,
@@ -237,11 +273,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"error: bad autoscale flags: {e}", file=sys.stderr)
             return 2
-        if args.replicas < autoscale_cfg.min_replicas or (
-            args.replicas > autoscale_cfg.max_replicas
+        if pool_base < autoscale_cfg.min_replicas or (
+            pool_base > autoscale_cfg.max_replicas
         ):
+            what = (
+                "--replicas-per-shard" if args.shard_by_rows
+                else "--replicas"
+            )
             print(
-                f"error: --replicas {args.replicas} outside "
+                f"error: {what} {pool_base} outside "
                 f"[{autoscale_cfg.min_replicas}, "
                 f"{autoscale_cfg.max_replicas}]",
                 file=sys.stderr,
@@ -261,15 +301,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_term)
     replica_args = parse_replica_args(args.replica_arg)
+    shard_of = None
+    shard_args = None
     if args.shard_by_rows:
-        # replica slot i IS shard i: the per-index args survive
-        # supervisor restarts, so a respawned replica reloads exactly
-        # its own row range
-        for i in range(args.shard_by_rows):
-            replica_args.setdefault(i, []).extend(
-                ["--shard-index", str(i),
-                 "--num-shards", str(args.shard_by_rows)]
-            )
+        # the (shard, replica) grid: slot i serves shard i // R —
+        # shard flags are keyed by SHARD (not slot), so supervisor
+        # restarts AND elastically-added siblings reload exactly their
+        # shard's row range
+        shard_of = {
+            i: i // args.replicas_per_shard
+            for i in range(args.replicas)
+        }
+        shard_args = {
+            s: ["--shard-index", str(s),
+                "--num-shards", str(args.shard_by_rows)]
+            for s in range(args.shard_by_rows)
+        }
     supervisor = FleetSupervisor(
         args.export_dir,
         config=FleetConfig(
@@ -285,6 +332,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         replica_args=replica_args,
         metrics=run.registry,
         rng=random.Random(args.seed),
+        shard_of=shard_of,
+        shard_args=shard_args,
     )
     # validate the alert rules BEFORE paying N replica spawns — a typo'd
     # alerts.json must fail in milliseconds
@@ -340,23 +389,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         shadow=shadow,
     )
     coordinator = None
+    group = None
     if args.shard_by_rows:
-        from gene2vec_tpu.serve.fleet import ReplicaState
         from gene2vec_tpu.serve.shardgroup import (
             RoutingTable,
             ShardGroup,
             ShardGroupConfig,
             SwapCoordinator,
         )
-
-        def shard_url(i: int):
-            for r in supervisor.replicas:
-                if (
-                    r.index == i and r.state == ReplicaState.UP
-                    and r.url
-                ):
-                    return r.url
-            return None
 
         routing = RoutingTable(
             args.export_dir, args.shard_by_rows, dim=None
@@ -376,7 +416,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 shard_deadline_s=args.shard_deadline_ms / 1000.0,
                 default_timeout_s=args.proxy_timeout_ms / 1000.0,
             ),
-            shard_url,
+            # the whole replica GROUP per shard: the client round-
+            # robins siblings and fails over within the leg deadline
+            supervisor.shard_urls,
             metrics=run.registry,
             policy=RetryPolicy(
                 max_attempts=args.proxy_attempts,
@@ -385,8 +427,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             ),
             inflight=proxy.inflight,
             routing=routing,
+            ggipnn_checkpoint=args.ggipnn_checkpoint,
         )
         proxy.shard_group = group
+        if proxy.aggregator is not None:
+            # per-shard telemetry projections + the redundancy view
+            def _shard_of(url: str):
+                u = url.rstrip("/")
+                for r in supervisor.replicas:
+                    if r.url == u:
+                        return r.shard
+                return None
+
+            proxy.aggregator.shard_of = _shard_of
+            # supervisor-truth redundancy: desired tracks the CURRENT
+            # per-shard promise (drained slots excluded), so a
+            # deliberate autoscale scale-down below the boot-time
+            # --replicas-per-shard does not page shard-redundancy-lost
+            proxy.aggregator.shard_facts = (
+                supervisor.shard_redundancy_facts
+            )
         coordinator = SwapCoordinator(
             args.export_dir,
             group,
@@ -396,15 +456,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         coordinator.start()
     controller = None
     if autoscale_cfg is not None:
-        from gene2vec_tpu.serve.autoscale import ElasticController
+        if args.shard_by_rows:
+            from gene2vec_tpu.serve.autoscale import (
+                ShardElasticController,
+            )
 
-        controller = ElasticController(
-            supervisor,
-            proxy,
-            autoscale_cfg,
-            metrics=run.registry,
-            drain_timeout_s=args.drain_timeout,
-        )
+            controller = ShardElasticController(
+                supervisor,
+                proxy,
+                autoscale_cfg,
+                num_shards=args.shard_by_rows,
+                metrics=run.registry,
+                drain_timeout_s=args.drain_timeout,
+            )
+        else:
+            from gene2vec_tpu.serve.autoscale import ElasticController
+
+            controller = ElasticController(
+                supervisor,
+                proxy,
+                autoscale_cfg,
+                metrics=run.registry,
+                drain_timeout_s=args.drain_timeout,
+            )
         # the scaler rides the aggregator's scrape tick, after the
         # alert evaluator — same snapshot, zero serve-path cost
         assert proxy.aggregator is not None
@@ -434,12 +508,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "shards": (
                     {
                         "num_shards": args.shard_by_rows,
+                        "replicas_per_shard": args.replicas_per_shard,
                         "total_rows": proxy.shard_group.routing
                         .total_rows,
                         "ranges": [
                             list(r) for r in
                             proxy.shard_group.routing.ranges
                         ],
+                        # slot indices per shard — the drill SIGKILLs
+                        # one sibling of a group by these
+                        "groups": {
+                            str(s): [
+                                r.index for r in supervisor.replicas
+                                if r.shard == s
+                            ]
+                            for s in range(args.shard_by_rows)
+                        },
                     }
                     if args.shard_by_rows else None
                 ),

@@ -250,6 +250,24 @@ class FleetAggregator:
         self._fetch = fetch
         #: optional obs.alerts.AlertEvaluator fed one snapshot per tick
         self.evaluator = evaluator
+        #: sharded-fleet hooks (cli.fleet wires them for
+        #: --shard-by-rows): ``shard_of(url) -> shard index`` maps a
+        #: scrape target onto its row shard so per-shard queue depth
+        #: and scatter p99 can be projected out of the merge, and
+        #: ``shard_facts() -> {shard: {"up": n, "desired": r}}`` is the
+        #: supervisor's redundancy view behind
+        #: ``fleet_shard_replicas_up{shard=}`` and the
+        #: ``shard-redundancy-lost`` alert.  Both None on an unsharded
+        #: fleet — no per-shard series exist and the alert rule holds.
+        self.shard_of: Optional[Callable[[str], Optional[int]]] = None
+        self.shard_facts: Optional[Callable[[], Dict]] = None
+        #: shards whose queue/p99 series were published last round —
+        #: a shard that stops reporting (every replica down or stale)
+        #: has its labeled gauges RETIRED, not frozen: a dead shard
+        #: showing its last queue depth on /metrics is the stale-skew
+        #: trap the model-fact gauges already guard against
+        self._shard_queue_series: set = set()
+        self._shard_p99_series: set = set()
         #: additional per-tick snapshot consumers, called AFTER the
         #: evaluator with the same (snapshot, wall) — the autoscaler
         #: (serve/autoscale.py ElasticController.observe) registers
@@ -518,6 +536,10 @@ class FleetAggregator:
             # satellite's contract); fleet SUMS still include every
             # accumulation so counters never go backward
             fresh_hist: Dict[Tuple[str, LabelKey], float] = {}
+            # per-shard projections (replicated-shard fleets): the same
+            # fresh-histogram rule, bucketed by the target's shard, so
+            # the per-shard autoscaler sees ITS pool's scatter latency
+            shard_hist: Dict[int, Dict[Tuple[str, LabelKey], float]] = {}
             for (
                 (target, name, labels), (_last, acc)
             ) in self._counter_state.items():
@@ -527,8 +549,29 @@ class FleetAggregator:
                     self.ROUTE_HISTOGRAM
                 ):
                     fresh_hist[key] = fresh_hist.get(key, 0.0) + acc
+                    if self.shard_of is not None:
+                        s = self.shard_of(target)
+                        if s is not None:
+                            h = shard_hist.setdefault(s, {})
+                            h[key] = h.get(key, 0.0) + acc
             for rkey, acc in self._retired.items():
                 merged[rkey] = merged.get(rkey, 0.0) + acc
+            shard_queue: Dict[int, float] = {}
+            if self.shard_of is not None:
+                # live-only like the fleet queue gauge: this round's
+                # successful scrapes, summed per shard
+                for url in target_list:
+                    samples = results.get(url)
+                    if samples is None:
+                        continue
+                    s = self.shard_of(url)
+                    if s is None:
+                        continue
+                    for smp in samples:
+                        if smp.name == "serve_queue_depth":
+                            shard_queue[s] = (
+                                shard_queue.get(s, 0.0) + smp.value
+                            )
 
         def msum(name: str) -> float:
             return sum(
@@ -610,6 +653,69 @@ class FleetAggregator:
                             )
                         )
                         snapshot[f"{gauge_name}{{{suffix}}}"] = quant
+            # per-shard pool signals + the redundancy view
+            # (docs/SERVING.md#replicated-shards): queue depth and
+            # scatter p99 per shard feed the per-shard autoscaler;
+            # fleet_shard_replicas_up{shard=} + the
+            # fleet_shards_redundancy_lost headline feed the
+            # shard-redundancy-lost alert — the page that precedes the
+            # recall-degradation page
+            if self.shard_of is not None:
+                pub_queue: set = set()
+                pub_p99: set = set()
+                for s, q in sorted(shard_queue.items()):
+                    v.gauge(
+                        "fleet_shard_queue_depth",
+                        labels={"shard": str(s)},
+                    ).set(q)
+                    snapshot[f"fleet_shard_queue_depth{{shard={s}}}"] = q
+                    pub_queue.add(s)
+                topk_labels = (("route", "/v1/shard/topk"),)
+                for s, hist in sorted(shard_hist.items()):
+                    quant = histogram_quantile(
+                        hist, self.ROUTE_HISTOGRAM, topk_labels, 0.99
+                    )
+                    if quant is not None and math.isfinite(quant):
+                        v.gauge(
+                            "fleet_shard_p99_seconds",
+                            labels={"shard": str(s)},
+                        ).set(quant)
+                        snapshot[
+                            f"fleet_shard_p99_seconds{{shard={s}}}"
+                        ] = quant
+                        pub_p99.add(s)
+                # a shard with no fresh evidence this round retires its
+                # series (the snapshot above is already rebuilt fresh,
+                # so this only stops /metrics/fleet from freezing a
+                # dead shard's last queue/p99 forever)
+                for name, pub, prev in (
+                    ("fleet_shard_queue_depth", pub_queue,
+                     self._shard_queue_series),
+                    ("fleet_shard_p99_seconds", pub_p99,
+                     self._shard_p99_series),
+                ):
+                    for s in prev - pub:
+                        v.remove(name, labels={"shard": str(s)})
+                self._shard_queue_series = pub_queue
+                self._shard_p99_series = pub_p99
+            if self.shard_facts is not None:
+                try:
+                    facts = self.shard_facts() or {}
+                except Exception:
+                    facts = {}
+                lost = 0
+                for s, f in sorted(facts.items()):
+                    up = float(f.get("up", 0))
+                    v.gauge(
+                        "fleet_shard_replicas_up",
+                        labels={"shard": str(s)},
+                    ).set(up)
+                    snapshot[f"fleet_shard_replicas_up{{shard={s}}}"] = up
+                    if float(f.get("desired", 1)) >= 2 and up < 2:
+                        lost += 1
+                if facts:
+                    v.gauge("fleet_shards_redundancy_lost").set(lost)
+                    snapshot["fleet_shards_redundancy_lost"] = float(lost)
             headline = {
                 "fleet_availability": availability,
                 "fleet_queue_depth": queue_depth,

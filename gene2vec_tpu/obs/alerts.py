@@ -219,6 +219,25 @@ def default_rules() -> List[AlertRule]:
             min_fresh_targets=0,
         ),
         AlertRule(
+            name="shard-redundancy-lost", kind="threshold",
+            severity="page",
+            # replicated-shard fleets (--replicas-per-shard >= 2): some
+            # shard's live replica count dropped to 1 (or 0) — ONE more
+            # failure costs that shard's row fraction of recall.  This
+            # is the page that precedes the degraded-burn page: fire
+            # immediately (redundancy is already gone), clear only
+            # after the supervisor re-admits a sibling and holds it.
+            # The gauge counts shards below their configured redundancy
+            # and exists only on sharded fleets — elsewhere the
+            # selector is absent and the rule holds forever.
+            metric="fleet_shards_redundancy_lost",
+            op=">", value=0.0, for_s=0.0, clear_for_s=10.0,
+            # supervisor-truth via the proxy process, not a replica
+            # scrape: stays fresh during exactly the all-replicas-down
+            # window it pages on
+            min_fresh_targets=0,
+        ),
+        AlertRule(
             name="rejection-rate", kind="threshold", severity="warn",
             metric="fleet_rejection_rate",
             op=">", value=0.05, clear_value=0.01,

@@ -366,6 +366,14 @@ def _adapt_shard(doc: Dict) -> Tuple[Dict[str, float], str]:
         _put(m, "shard_server_5xx", drill.get("server_5xx"))
         _put(m, "shard_retry_amplification",
              drill.get("retry_amplification"))
+        # replicated-shard failover scenario (PR 15): a dead sibling
+        # must cost zero degraded answers and bounded latency
+        fo = drill.get("failover")
+        if isinstance(fo, dict):
+            _put(m, "failover_degraded_responses",
+                 fo.get("degraded_responses"))
+            _put(m, "failover_p99_ms", fo.get("p99_ms"))
+            _put(m, "failover_availability", fo.get("availability"))
     _put(m, "passed", doc.get("passed"))
     return m, "shard_recall_at_10"
 

@@ -58,13 +58,16 @@ import dataclasses
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 __all__ = [
     "AutoscaleConfig",
     "AutoscalePolicy",
     "ElasticController",
     "ScaleDecision",
+    "ShardAutoscalePolicy",
+    "ShardElasticController",
+    "shard_snapshot",
 ]
 
 
@@ -101,13 +104,16 @@ class AutoscaleConfig:
 @dataclasses.dataclass(frozen=True)
 class ScaleDecision:
     """One tick's verdict.  ``target`` is the replica count the fleet
-    should move to (== ``current`` on hold)."""
+    should move to (== ``current`` on hold).  ``shard`` scopes the
+    action to one shard's replica pool (None = the whole fleet — the
+    unsharded pool model)."""
 
     action: str                # "up" | "down" | "hold"
     target: int
     reason: str
     breach_ticks: int = 0
     clear_ticks: int = 0
+    shard: Optional[int] = None
 
 
 def _route_key(route: str) -> str:
@@ -355,15 +361,31 @@ class ElasticController:
 
     # -- aggregator observer ----------------------------------------------
 
+    def _decide(
+        self, snapshot: Dict[str, float], now: float
+    ) -> Tuple[ScaleDecision, int]:
+        """Policy seam: this tick's decision + the capacity it was
+        made against.  The shard subclass swaps in the per-shard grid
+        here; everything else — the one-action gate, counters, drain
+        path — is shared."""
+        current = self.supervisor.active_count()
+        decision = self.policy.observe(
+            snapshot, now=now, current=current
+        )
+        return decision, current
+
+    def _describe(self, decision: ScaleDecision) -> str:
+        return (
+            f"{decision.action} -> {decision.target} replicas "
+            f"({decision.reason})"
+        )
+
     def observe(self, snapshot: Dict[str, float], wall=None) -> None:
         del wall  # the policy runs on the monotonic clock
         with self._lock:
             if self._busy or self._stopped:
                 return
-        current = self.supervisor.active_count()
-        decision = self.policy.observe(
-            snapshot, now=time.monotonic(), current=current
-        )
+        decision, current = self._decide(snapshot, time.monotonic())
         self._publish(decision, current)
         if decision.action == "hold":
             return
@@ -375,11 +397,7 @@ class ElasticController:
         # drill measures how fast the loop NOTICED, not how fast a jax
         # import finishes
         self._count(f"fleet_scale_{decision.action}_total")
-        print(
-            f"autoscale: {decision.action} -> {decision.target} "
-            f"replicas ({decision.reason})",
-            file=sys.stderr,
-        )
+        print(f"autoscale: {self._describe(decision)}", file=sys.stderr)
         threading.Thread(
             target=self._apply, args=(decision,),
             name=f"fleet-scale-{decision.action}", daemon=True,
@@ -394,9 +412,9 @@ class ElasticController:
     def _apply(self, decision: ScaleDecision) -> None:
         try:
             if decision.action == "up":
-                self._scale_up()
+                self._scale_up(decision.shard)
             else:
-                self._scale_down()
+                self._scale_down(decision.shard)
         except Exception as e:
             self._count("fleet_scale_failures_total")
             print(f"autoscale: {decision.action} failed: {e!r}",
@@ -412,8 +430,13 @@ class ElasticController:
                     self.supervisor.active_count()
                 )
 
-    def _scale_up(self) -> None:
-        replica = self.supervisor.scale_up()
+    def _scale_up(self, shard: Optional[int] = None) -> None:
+        # keyword passed only when set: unsharded supervisors (and the
+        # test fakes) keep their no-arg signature
+        replica = (
+            self.supervisor.scale_up(shard=shard)
+            if shard is not None else self.supervisor.scale_up()
+        )
         # hold the action slot until the new replica actually serves
         # (or demonstrably cannot): the breach persists while it warms
         # up, and releasing early would spawn a second replica for the
@@ -433,8 +456,12 @@ class ElasticController:
                 break
             time.sleep(0.1)
 
-    def _scale_down(self) -> None:
-        victim = self.supervisor.pick_drain_victim()
+    def _scale_down(self, shard: Optional[int] = None) -> None:
+        victim = (
+            self.supervisor.pick_drain_victim(shard=shard)
+            if shard is not None
+            else self.supervisor.pick_drain_victim()
+        )
         if victim is None:
             return
         self.supervisor.begin_drain(victim)
@@ -463,3 +490,170 @@ class ElasticController:
                     file=sys.stderr,
                 )
         self.supervisor.finish_drain(victim)
+
+
+# -- the per-shard pool model (replicated row shards) ------------------------
+
+
+def shard_snapshot(snapshot: Dict[str, float], shard: int,
+                   p99_route: str) -> Dict[str, float]:
+    """Project one shard's signals out of the aggregator's flat
+    snapshot into the key names :class:`AutoscalePolicy` reads — the
+    per-shard policies are plain AutoscalePolicy instances evaluating
+    their own shard's queue depth and scatter p99.  The fleet-wide
+    counter pairs are deliberately ABSENT: rejection/availability rates
+    then carry no evidence (None) and neither breach nor block a clear,
+    so a shard pool scales on ITS load, not on another shard's burn."""
+    sub: Dict[str, float] = {}
+    fresh = snapshot.get("_fresh_targets")
+    if fresh is not None:
+        sub["_fresh_targets"] = fresh
+    q = snapshot.get(f"fleet_shard_queue_depth{{shard={shard}}}")
+    if q is None:
+        # no queue evidence from ANY of this shard's replicas this
+        # round (every scrape missed — the aggregator only publishes
+        # the key from successful scrapes): the fleet-wide freshness
+        # guard can't see a single dark shard, so zero THIS pool's
+        # freshness — the policy must HOLD, not read "idle" and drain
+        # capacity from exactly the pool it is blind to
+        sub["_fresh_targets"] = 0.0
+        sub["fleet_queue_depth"] = 0.0
+    else:
+        sub["fleet_queue_depth"] = float(q)
+    p99 = snapshot.get(f"fleet_shard_p99_seconds{{shard={shard}}}")
+    if p99 is not None:
+        sub[_route_key(p99_route)] = float(p99)
+    return sub
+
+
+class ShardAutoscalePolicy:
+    """Per-shard pool model: one :class:`AutoscalePolicy` per row
+    shard, each fed its own shard's signals, deciding that shard's
+    replica count inside [min_replicas, max_replicas].  Pure like the
+    underlying policies; one :meth:`observe` per scrape tick returns
+    at most ONE non-hold decision (scale-ups first, hottest-queue
+    shard wins ties) because the controller applies one action at a
+    time anyway — a shard whose decision lost the tie re-breaches and
+    wins a later tick (its breach window re-accumulates under the
+    fleet-wide cooldown, the same anti-flap the single pool has)."""
+
+    def __init__(self, config: AutoscaleConfig, num_shards: int):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.config = config
+        self.num_shards = int(num_shards)
+        self.policies = {
+            s: AutoscalePolicy(config) for s in range(self.num_shards)
+        }
+
+    def note_action_done(self, now: float) -> None:
+        # cooldown is FLEET-wide: every pool re-arms, or two shards
+        # could interleave actions faster than any one pool allows
+        for p in self.policies.values():
+            p.note_action_done(now)
+
+    def observe(
+        self,
+        snapshot: Dict[str, float],
+        now: float,
+        current_of: Dict[int, int],
+    ) -> ScaleDecision:
+        decisions: Dict[int, ScaleDecision] = {}
+        for s, policy in self.policies.items():
+            sub = shard_snapshot(snapshot, s, self.config.p99_route)
+            decisions[s] = policy.observe(
+                sub, now=now, current=current_of.get(s, 0)
+            )
+
+        def queue_of(s: int) -> float:
+            return float(snapshot.get(
+                f"fleet_shard_queue_depth{{shard={s}}}", 0.0
+            ))
+
+        for action in ("up", "down"):
+            picked = [
+                s for s, d in decisions.items() if d.action == action
+            ]
+            if picked:
+                s = max(picked, key=queue_of) if action == "up" else (
+                    min(picked, key=queue_of)
+                )
+                d = decisions[s]
+                return dataclasses.replace(
+                    d, shard=s, reason=f"shard {s}: {d.reason}"
+                )
+        # all holds: surface the busiest shard's reason for telemetry
+        s = max(decisions, key=queue_of)
+        d = decisions[s]
+        return dataclasses.replace(
+            d, shard=s, reason=f"shard {s}: {d.reason}"
+        )
+
+
+class ShardElasticController(ElasticController):
+    """The elastic controller for a replicated-shard fleet: the same
+    one-action-at-a-time shell, drain path, and metrics, driving a
+    :class:`ShardAutoscalePolicy` — scale-up spawns a SIBLING into the
+    hot shard's replica group (``FleetSupervisor.scale_up(shard=)``),
+    scale-down drains the newest sibling of an idle shard and never
+    its last UP replica (``pick_drain_victim(shard=)`` — the shard's
+    rows must stay served)."""
+
+    def __init__(self, supervisor, proxy, config: AutoscaleConfig,
+                 num_shards: int, metrics=None, **kw):
+        super().__init__(
+            supervisor, proxy, config, metrics=metrics,
+            # the grid IS the controller's policy: the base class's
+            # note_action_done in _apply's finally re-arms every pool's
+            # cooldown (ShardAutoscalePolicy fans it out), and _apply
+            # already threads decision.shard through scale_up/drain
+            policy=ShardAutoscalePolicy(config, num_shards),
+            **kw,
+        )
+        self.shard_policy = self.policy
+        self.num_shards = int(num_shards)
+        # the deciding shard's pool size at _decide time, consumed by
+        # _publish in the same tick (observe is single-threaded per
+        # aggregator tick) to translate the pool target fleet-wide
+        self._decision_pool = 0
+
+    def _decide(
+        self, snapshot: Dict[str, float], now: float
+    ) -> Tuple[ScaleDecision, int]:
+        current_of = {
+            s: self.supervisor.active_count(shard=s)
+            for s in range(self.num_shards)
+        }
+        decision = self.shard_policy.observe(
+            snapshot, now=now, current_of=current_of,
+        )
+        self._decision_pool = current_of.get(decision.shard, 0)
+        if self.metrics is not None:
+            # every pool, every tick — publishing only the deciding
+            # shard would freeze the other pools' gauges at whatever
+            # size they had the last time they happened to decide
+            for s, n in current_of.items():
+                self.metrics.gauge(
+                    "fleet_shard_replicas_active",
+                    labels={"shard": str(s)},
+                ).set(n)
+        return decision, sum(current_of.values())
+
+    def _publish(self, decision: ScaleDecision, current: int) -> None:
+        # decision.target is the chosen SHARD pool's target; the
+        # fleet_replicas_active/fleet_replicas_target gauge pair is
+        # documented as comparable (docs/SERVING.md), so export the
+        # post-action FLEET-wide total instead of one pool's target —
+        # a hot-shard 2->3 on a 4x2 grid must read 8->9, not 8->3
+        if decision.shard is not None:
+            decision = dataclasses.replace(
+                decision,
+                target=current + (decision.target - self._decision_pool),
+            )
+        super()._publish(decision, current)
+
+    def _describe(self, decision: ScaleDecision) -> str:
+        return (
+            f"{decision.action} shard {decision.shard} -> "
+            f"{decision.target} replicas ({decision.reason})"
+        )

@@ -111,10 +111,16 @@ class FleetConfig:
 
 
 class Replica:
-    """One supervised ``cli.serve`` child and its rotation state."""
+    """One supervised ``cli.serve`` child and its rotation state.
 
-    def __init__(self, index: int):
+    ``shard`` is the row shard this slot serves (None in an unsharded
+    fleet).  With ``--replicas-per-shard`` several slots share one
+    shard — the (shard, replica) grid — and the front door's scatter
+    treats them as interchangeable siblings."""
+
+    def __init__(self, index: int, shard: Optional[int] = None):
         self.index = index
+        self.shard = shard
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.state = ReplicaState.STARTING
@@ -179,6 +185,8 @@ class FleetSupervisor:
         metrics=None,
         env: Optional[Dict[str, str]] = None,
         rng: Optional[random.Random] = None,
+        shard_of: Optional[Dict[int, int]] = None,
+        shard_args: Optional[Dict[int, Sequence[str]]] = None,
     ):
         self.export_dir = export_dir
         self.config = config
@@ -189,7 +197,20 @@ class FleetSupervisor:
         self.metrics = metrics
         self.env = env
         self._rng = rng if rng is not None else random.Random()
-        self.replicas = [Replica(i) for i in range(config.replicas)]
+        # the (shard, replica) grid: slot index -> shard index, and the
+        # per-SHARD extra flags every slot of that shard spawns with
+        # (--shard-index/--num-shards) — keyed by shard, not slot, so an
+        # elastically-added sibling inherits its shard's exact flags
+        self._shard_of: Dict[int, int] = {
+            int(k): int(v) for k, v in (shard_of or {}).items()
+        }
+        self._shard_args: Dict[int, List[str]] = {
+            int(k): list(v) for k, v in (shard_args or {}).items()
+        }
+        self.replicas = [
+            Replica(i, shard=self._shard_of.get(i))
+            for i in range(config.replicas)
+        ]
         #: next index for an elastically-added replica — indices are
         #: never reused, so per-replica metrics/log lines stay unambiguous
         self._next_index = config.replicas
@@ -219,10 +240,15 @@ class FleetSupervisor:
     # -- spawning ----------------------------------------------------------
 
     def _argv(self, index: int) -> List[str]:
+        shard = self._shard_of.get(index)
+        shard_flags = (
+            self._shard_args.get(shard, []) if shard is not None else []
+        )
         return [
             sys.executable, "-m", "gene2vec_tpu.cli.serve",
             "--export-dir", self.export_dir, "--port", "0",
-            *self.serve_args, *self.replica_args.get(index, []),
+            *self.serve_args, *shard_flags,
+            *self.replica_args.get(index, []),
         ]
 
     def _spawn(self, replica: Replica) -> None:
@@ -350,35 +376,99 @@ class FleetSupervisor:
                     "state": r.state,
                     "url": r.url,
                     "pid": r.pid,
+                    "shard": r.shard,
                     "restarts": r.restarts,
                     "last_error": r.last_error,
                 }
                 for r in self.replicas
             ]
 
+    # -- the (shard, replica) grid -----------------------------------------
+
+    def shard_urls(self, shard: int) -> List[str]:
+        """Every UP replica of one shard — the target list the front
+        door's per-shard client fails over across.  A dead sibling
+        leaves this list on the next supervisor tick; until then the
+        client's breakers and retry-safe failover absorb it."""
+        with self._lock:
+            return [
+                r.url for r in self.replicas
+                if r.shard == shard and r.state == ReplicaState.UP
+                and r.url
+            ]
+
+    def shard_up_counts(self) -> Dict[int, int]:
+        """UP replicas per shard — the redundancy view behind
+        ``fleet_shard_replicas_up{shard=}`` and the
+        ``shard-redundancy-lost`` alert."""
+        with self._lock:
+            out: Dict[int, int] = {}
+            for r in self.replicas:
+                if r.shard is None:
+                    continue
+                out.setdefault(r.shard, 0)
+                if r.state == ReplicaState.UP:
+                    out[r.shard] += 1
+            return out
+
+    def shard_redundancy_facts(self) -> Dict[int, Dict[str, int]]:
+        """Per-shard ``{"up", "desired"}`` for the aggregator's
+        ``shard_facts`` hook.  ``desired`` is the shard's CURRENT
+        redundancy promise, not the boot-time ``--replicas-per-shard``:
+        a slot the elastic controller is deliberately DRAINING has left
+        the promise (scaling an idle pool down is policy, not an
+        incident to page on), while a dead slot in backoff, an ejected
+        replica, and a storm-abandoned FAILED slot all still count —
+        those are the involuntary losses the ``shard-redundancy-lost``
+        page exists for.  A brand-new slot joins the promise only once
+        it has been admitted (STARTING with ``restarts == 0`` is the
+        scale-up/boot spawn window, not a loss; a RESPAWNING slot keeps
+        counting so the page holds until its sibling is truly back)."""
+        with self._lock:
+            out: Dict[int, Dict[str, int]] = {}
+            for r in self.replicas:
+                if r.shard is None:
+                    continue
+                f = out.setdefault(r.shard, {"up": 0, "desired": 0})
+                if r.state == ReplicaState.UP:
+                    f["up"] += 1
+                if r.state == ReplicaState.DRAINING or (
+                    r.state == ReplicaState.STARTING
+                    and r.restarts == 0
+                ):
+                    continue
+                f["desired"] += 1
+            return out
+
     # -- elasticity (serve/autoscale.py ElasticController) -----------------
 
-    def active_count(self) -> int:
+    def active_count(self, shard: Optional[int] = None) -> int:
         """Replica slots that count toward capacity: everything except
         abandoned (FAILED) and departing (DRAINING) slots — a dead slot
         in backoff still counts, because a restart is coming and
-        scaling on top of it would double-provision."""
+        scaling on top of it would double-provision.  ``shard``
+        restricts the count to one shard's pool (the per-shard
+        autoscaler's notion of "current")."""
         with self._lock:
             return sum(
                 1 for r in self.replicas
                 if r.state not in (
                     ReplicaState.FAILED, ReplicaState.DRAINING
-                )
+                ) and (shard is None or r.shard == shard)
             )
 
-    def scale_up(self) -> Replica:
+    def scale_up(self, shard: Optional[int] = None) -> Replica:
         """Spawn one NEW replica slot (never reusing an index).  Blocks
         on the child's startup contract line; the monitor loop admits
         it to rotation once readiness probes pass.  A spawn failure
         removes the slot again and propagates — the policy's cooldown
-        decides when to try again."""
+        decides when to try again.  ``shard`` spawns the slot into one
+        shard's pool: it inherits that shard's flags and joins its
+        scatter rotation on readiness."""
         with self._lock:
-            replica = Replica(self._next_index)
+            replica = Replica(self._next_index, shard=shard)
+            if shard is not None:
+                self._shard_of[replica.index] = shard
             self._next_index += 1
             replica.spawning = True
             self.replicas.append(replica)
@@ -404,18 +494,23 @@ class FleetSupervisor:
         self._publish()
         return replica
 
-    def pick_drain_victim(self) -> Optional[Replica]:
+    def pick_drain_victim(self, shard: Optional[int] = None
+                          ) -> Optional[Replica]:
         """The replica a scale-down should remove: a dead/not-ready
         slot first (removing one is trivially zero-drop), else the
         NEWEST serving replica — and never the last one in rotation.
         A slot with a respawn in flight is not a candidate: draining
-        it would race the spawn and orphan the freshly-forked child."""
+        it would race the spawn and orphan the freshly-forked child.
+        ``shard`` scopes the choice to one shard's pool; "last in
+        rotation" then means the last UP replica of THAT shard —
+        draining it would un-serve the shard's rows."""
         with self._lock:
             candidates = [
                 r for r in self.replicas
                 if r.state not in (
                     ReplicaState.FAILED, ReplicaState.DRAINING
                 ) and not r.spawning
+                and (shard is None or r.shard == shard)
             ]
             not_up = [
                 r for r in candidates if r.state != ReplicaState.UP
@@ -791,14 +886,13 @@ class _ProxyAdapter:
                         status, doc = 200, group.routing.genes_doc(
                             limit, offset
                         )
-                elif route == "/v1/interaction":
-                    status, doc = 501, {
-                        "error": (
-                            "/v1/interaction is not supported with "
-                            "--shard-by-rows (gene pairs span shards; "
-                            "docs/SERVING.md#sharded-index-serving)"
-                        ),
-                    }
+                elif route == "/v1/interaction" and req.method == "POST":
+                    # cross-shard pair scoring: each gene's vector is
+                    # resolved from its OWNER shard's replica group and
+                    # the GGIPNN head runs at the front door — same
+                    # degraded contract as /v1/similar when an owner
+                    # group is fully down (serve/shardgroup.py)
+                    status, doc = group.interaction(body or {})
                 else:
                     status, doc = 404, {
                         "error": f"no route {req.method} {route}"
@@ -1025,17 +1119,30 @@ class FleetProxy:
             "replicas": states,
         }
         if self.shard_group is not None:
-            # per-shard state: row range, rotation membership, and the
-            # epoch each shard was last seen serving — the operator's
-            # one-look view of a degraded or mid-swap fleet
-            up_idx = {
-                s["index"] for s in states
-                if s["state"] == ReplicaState.UP
-            }
-            doc["shards"] = self.shard_group.shard_states(
-                up_for=lambda i: i in up_idx
+            # per-shard state: row range, replica-GROUP membership, and
+            # the epoch each cell was last seen serving — the operator's
+            # one-look view of a degraded or mid-swap fleet.  A shard is
+            # "up" when ANY replica of its group is in rotation; the
+            # per-replica rows let loadgen/--verify and the drill learn
+            # the whole (shard, replica) grid from one probe.
+            group = self.shard_group
+            by_shard: Dict[int, List[Dict]] = {}
+            for s in states:
+                if s.get("shard") is None:
+                    continue
+                by_shard.setdefault(s["shard"], []).append({
+                    "index": s["index"],
+                    "up": s["state"] == ReplicaState.UP,
+                    "pid": s["pid"],
+                    "epoch": group.replica_epoch(s["url"]),
+                })
+            doc["shards"] = group.shard_states(
+                up_for=lambda i: any(
+                    r["up"] for r in by_shard.get(i, [])
+                ),
+                replicas_for=lambda i: by_shard.get(i, []),
             )
-            doc["epoch"] = self.shard_group.current_epoch
+            doc["epoch"] = group.current_epoch
         return (200 if up else 503), doc
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:

@@ -113,3 +113,96 @@ class InteractionScorer:
         ids = self.encode(pairs)
         scores, _, _ = self.trainer.predict(self.params, ids)
         return [float(s) for s in scores[:, 1]]
+
+
+class CrossShardScorer:
+    """GGIPNN pair scoring from raw VECTORS — the sharded fleet's
+    front-door scorer (``serve/shardgroup.py:ShardGroup.interaction``).
+
+    On a ``--shard-by-rows`` fleet no single process holds the whole
+    table, so the front door resolves each gene's vector from its owner
+    shard's replica group and scores here.  The math is exactly
+    :class:`InteractionScorer`'s: the same :class:`GGIPNNTrainer`
+    predict path runs over a fixed-shape SCRATCH embedding table
+    (``2 * max_pairs`` rows) whose rows are filled with the resolved
+    vectors per call — pair *i* looks up rows ``(2i, 2i+1)``.  The
+    fixed shape keeps the jit cache at one entry no matter how many
+    pairs a request carries; identical inputs to an identical MLP make
+    parity with the single-replica scorer structural, not numerical
+    luck (``tests/test_shard.py`` asserts it).
+
+    The head loads from the same ``ggipnn_obs`` checkpoint format;
+    without one it keeps its deterministic random init and ``trained``
+    stays false — the front door echoes it so untrained scores cannot
+    masquerade, exactly the replica contract."""
+
+    def __init__(
+        self,
+        dim: int,
+        checkpoint_path: Optional[str] = None,
+        max_pairs: int = 64,
+        batch_size: int = 64,
+    ):
+        import jax.numpy as jnp
+
+        self.dim = int(dim)
+        self.max_pairs = int(max_pairs)
+        rows = 2 * self.max_pairs
+        vocab = PairTextVocab()
+        vocab.token_to_id = {f"_slot{i}": i for i in range(rows)}
+        vocab.id_to_token = [f"_slot{i}" for i in range(rows)]
+        config = GGIPNNConfig(
+            embedding_dim=self.dim, batch_size=batch_size
+        )
+        self.trainer = GGIPNNTrainer(config, vocab)
+        params, _ = self.trainer.init_state()
+        params = dict(params)
+        self._scratch_shape = tuple(params["embedding"].shape)
+        self.trained = False
+        if checkpoint_path is not None:
+            loaded = unflatten_params(load_checkpoint(checkpoint_path))
+            emb = loaded.get("embedding")
+            if emb is not None and emb.shape[1] != self.dim:
+                raise ValueError(
+                    f"{checkpoint_path}: head trained at dim "
+                    f"{emb.shape[1]}, the served table is dim "
+                    f"{self.dim}"
+                )
+            for name, value in loaded.items():
+                # head weights only — the embedding rows are per-call
+                # scratch filled from the shards (the checkpoint's own
+                # table is row-ordered by its TRAINING vocab and can
+                # never be indexed by scratch slots)
+                if name == "embedding":
+                    continue
+                params[name] = (
+                    jnp.asarray(value) if not isinstance(value, dict)
+                    else value
+                )
+            self.trained = True
+        self.params = params
+        self._jnp = jnp
+
+    def score_vectors(
+        self, vec_pairs: Sequence[Tuple[np.ndarray, np.ndarray]]
+    ) -> List[float]:
+        """Positive-class softmax score per (vector, vector) pair —
+        ``InteractionScorer.score`` with the table lookup replaced by
+        caller-resolved vectors."""
+        if not vec_pairs:
+            return []
+        if len(vec_pairs) > self.max_pairs:
+            raise ValueError(
+                f"at most {self.max_pairs} pairs per call"
+            )
+        table = np.zeros(self._scratch_shape, np.float32)
+        for i, (a, b) in enumerate(vec_pairs):
+            table[2 * i] = np.asarray(a, np.float32)
+            table[2 * i + 1] = np.asarray(b, np.float32)
+        params = dict(self.params)
+        params["embedding"] = self._jnp.asarray(table)
+        ids = np.arange(
+            2 * len(vec_pairs), dtype=np.int32
+        ).reshape(-1, 2)
+        scores, _, _ = self.trainer.predict(params, ids)
+        return [float(s) for s in scores[:, 1]]

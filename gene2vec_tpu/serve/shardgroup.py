@@ -15,11 +15,18 @@ the front door's half of the story:
 * :class:`ShardGroup` — scatter-gather ``/v1/similar``: fan each query
   to every shard with a PER-SHARD deadline through per-shard
   :class:`~gene2vec_tpu.serve.client.ResilientClient` instances (per-
-  shard circuit breakers; ONE shared retry token bucket across the
+  REPLICA circuit breakers; ONE shared retry token bucket across the
   whole fan-out, so a dead shard cannot amplify attempts fleet-wide),
   then merge the shard-local top-k candidate sets with
   ``parallel/sharding.py:merge_shard_topk`` — the ``two_stage_topk``
-  merge lifted from cross-device to cross-process.
+  merge lifted from cross-device to cross-process.  With
+  ``--replicas-per-shard R`` each shard is a replica GROUP: the leg's
+  client round-robins the live siblings and fails over between them
+  within the leg's deadline, so a single replica death produces zero
+  degraded answers (docs/SERVING.md#replicated-shards).  Cross-shard
+  ``/v1/interaction`` resolves each gene's vector from its owner
+  group and scores at the front door
+  (``serve/interaction.py:CrossShardScorer``).
 
   **Robustness is the contract.**  A shard that is dead or misses its
   deadline yields a *partial* answer: the response carries
@@ -38,13 +45,16 @@ the front door's half of the story:
 * :class:`SwapCoordinator` — shard-atomic hot swap.  Replicas in shard
   mode never self-swap (``cli.serve`` disables the registry watcher);
   instead the coordinator polls the export dir, and for a new verified
-  iteration STAGES it on every shard (``POST /v1/shard/stage`` — the
-  load path is manifest-CRC-verified), then FLIPS all shards under a
-  single epoch token (``POST /v1/shard/flip``; the token is the
-  iteration number).  No shard flips unless every shard staged; a
-  shard that restarts mid-swap is repaired (re-staged + flipped) on
-  the next tick.  A swap is deferred while any shard is down — a
-  half-fleet flip could never be atomic.
+  iteration STAGES it on every live (shard, replica) CELL
+  (``POST /v1/shard/stage`` — the load path is manifest-CRC-verified),
+  then FLIPS all cells under a single epoch token
+  (``POST /v1/shard/flip``; the token is the iteration number).  No
+  cell flips unless every cell staged; a cell that restarts mid-swap
+  is repaired (re-staged + flipped) on the next tick.  A swap is
+  deferred while any whole replica GROUP is down — a half-fleet flip
+  could never be atomic — but a dead replica with a live sibling does
+  not defer (the sibling flips with the fleet; the dead cell repairs
+  on return).
 
 Everything here runs in the fleet front-door process (``cli.fleet``)
 and is stdlib+numpy only; the heavy tables live in the shard replicas.
@@ -58,7 +68,7 @@ import os
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -232,11 +242,17 @@ class ApiReject(Exception):
 
 
 class ShardGroup:
-    """The front door's scatter-gather engine over N shard replicas.
+    """The front door's scatter-gather engine over N shard replica
+    GROUPS.
 
-    ``url_for(i)`` returns shard *i*'s current base URL (None while it
-    is down/restarting) — ``cli.fleet`` wires the supervisor's replica
-    slots in; ejection and restart apply on the very next scatter.
+    ``url_for(i)`` returns shard *i*'s current live base URL(s): a
+    list (the replica group — ``cli.fleet`` wires the supervisor's UP
+    slots of that shard in), a single URL, or None while the whole
+    group is down.  Each shard's :class:`ResilientClient` round-robins
+    the group and FAILS OVER between siblings within the leg's
+    deadline (retry-safe failover + per-replica breakers), so a single
+    replica death produces zero degraded answers — the shard counts as
+    unanswered only when no sibling can answer in time.
     All per-shard clients share ONE retry token bucket and the proxy's
     :class:`InFlightTracker`, so the drain contract and the retry-
     amplification bound both hold across the fan-out."""
@@ -244,11 +260,13 @@ class ShardGroup:
     def __init__(
         self,
         config: ShardGroupConfig,
-        url_for: Callable[[int], Optional[str]],
+        url_for: Callable[[int], Union[Optional[str], Sequence[str]]],
         metrics=None,
         policy: Optional[RetryPolicy] = None,
         inflight: Optional[InFlightTracker] = None,
         routing: Optional[RoutingTable] = None,
+        transport: Optional[Callable] = None,
+        ggipnn_checkpoint: Optional[str] = None,
     ):
         self.config = config
         self.url_for = url_for
@@ -266,11 +284,19 @@ class ShardGroup:
             self.policy.retry_budget_burst,
         )
         self.inflight = inflight
+        self._transport = transport
         self._clients: Dict[int, ResilientClient] = {}
         self._clients_lock = threading.Lock()
         #: last epoch each shard was SEEN serving (scatter answers +
         #: coordinator probes feed this; /healthz renders it)
         self._epochs: Dict[int, Optional[int]] = {}
+        #: last epoch each replica CELL (by URL) was seen serving —
+        #: scatter answers carry the answering target, the swap
+        #: coordinator probes every cell; /healthz renders the grid.
+        #: BOUNDED (LRU): every respawn binds a fresh ephemeral port,
+        #: so a plain dict keyed by URL would leak one entry per
+        #: restart for the front door's whole lifetime
+        self._replica_epochs = LRUCache(256)
         #: the fleet's current logical version (the coordinator owns
         #: writes; None until the first tick adopts the boot state)
         self.current_epoch: Optional[int] = None
@@ -279,21 +305,40 @@ class ShardGroup:
         # against iteration-2 shards would be a wrong answer the epoch
         # check cannot see.  Reuses the batcher's bounded LRU.
         self._qvecs = LRUCache(config.qvec_cache_size)
+        #: models/ggipnn_obs head checkpoint backing cross-shard
+        #: /v1/interaction (cli.fleet --ggipnn-checkpoint); without it
+        #: the head keeps its deterministic random init and
+        #: ``trained_head`` is echoed false, like a replica's scorer
+        self.ggipnn_checkpoint = ggipnn_checkpoint
+        self._interaction_scorer = None
+        self._scorer_lock = threading.Lock()
 
     # -- plumbing ----------------------------------------------------------
+
+    def urls_of(self, shard: int) -> List[str]:
+        """Shard *i*'s live replica group, normalized to a list
+        (``url_for`` may return a list, one URL, or None)."""
+        u = self.url_for(shard)
+        if u is None:
+            return []
+        if isinstance(u, str):
+            return [u]
+        return [x for x in u if x]
 
     def client(self, shard: int) -> ResilientClient:
         with self._clients_lock:
             c = self._clients.get(shard)
             if c is None:
+                kwargs = {}
+                if self._transport is not None:
+                    kwargs["transport"] = self._transport
                 c = ResilientClient(
-                    lambda s=shard: (
-                        [u] if (u := self.url_for(s)) else []
-                    ),
+                    lambda s=shard: self.urls_of(s),
                     policy=self.policy,
                     metrics=self.metrics,
                     inflight=self.inflight,
                     budget=self.budget,
+                    **kwargs,
                 )
                 self._clients[shard] = c
             return c
@@ -302,25 +347,44 @@ class ShardGroup:
         if self.metrics is not None:
             self.metrics.counter(name).inc(amount)
 
-    def note_epoch(self, shard: int, epoch) -> None:
+    def note_epoch(self, shard: int, epoch,
+                   url: Optional[str] = None) -> None:
         self._epochs[shard] = epoch
+        if url is not None:
+            self._replica_epochs.put(url.rstrip("/"), epoch)
 
-    def shard_states(self, up_for: Optional[Callable[[int], bool]] = None
-                     ) -> List[dict]:
+    def replica_epoch(self, url: Optional[str]):
+        """Last epoch one replica cell was seen serving (None before
+        any scatter answer or coordinator probe touched it)."""
+        if url is None:
+            return None
+        return self._replica_epochs.get(url.rstrip("/"))
+
+    def shard_states(
+        self,
+        up_for: Optional[Callable[[int], bool]] = None,
+        replicas_for: Optional[Callable[[int], List[dict]]] = None,
+    ) -> List[dict]:
         """Per-shard facts for the front door's /healthz: row range,
-        rotation state, last-seen epoch."""
+        rotation state, last-seen epoch — plus the replica GROUP
+        (``replicas: [{index, up, epoch}]``) when the caller can
+        enumerate it (the proxy passes the supervisor's grid)."""
         ranges = self.routing.ranges if self.routing is not None else []
         out = []
         for i in range(self.config.num_shards):
-            out.append({
+            urls = self.urls_of(i)
+            doc = {
                 "index": i,
                 "rows": list(ranges[i]) if i < len(ranges) else None,
                 "up": bool(up_for(i)) if up_for is not None else (
-                    self.url_for(i) is not None
+                    bool(urls)
                 ),
                 "epoch": self._epochs.get(i),
-                "url": self.url_for(i),
-            })
+                "url": urls[0] if urls else None,
+            }
+            if replicas_for is not None:
+                doc["replicas"] = replicas_for(i)
+            out.append(doc)
         return out
 
     # -- the scatter -------------------------------------------------------
@@ -362,7 +426,7 @@ class ShardGroup:
                 doc = r.doc
                 if isinstance(doc, dict):
                     epoch = (doc.get("shard") or {}).get("epoch")
-                    self.note_epoch(shard, epoch)
+                    self.note_epoch(shard, epoch, url=r.target)
                     with lock:
                         results[shard] = doc
 
@@ -854,6 +918,167 @@ class ShardGroup:
             ],
         }
 
+    # -- cross-shard /v1/interaction ---------------------------------------
+
+    def _scorer(self):
+        """The front-door GGIPNN pair scorer, built lazily on first use
+        (it imports jax; the fleet process stays light until the route
+        is actually exercised).  Vectors come from the shards, so the
+        scorer needs only the dim and the head checkpoint."""
+        with self._scorer_lock:
+            if self._interaction_scorer is None:
+                from gene2vec_tpu.serve.interaction import (
+                    CrossShardScorer,
+                )
+
+                dim = self.routing.dim if self.routing is not None else None
+                if dim is None:
+                    raise ApiReject(
+                        503, "no routing table loaded; cannot score"
+                    )
+                self._interaction_scorer = CrossShardScorer(
+                    dim,
+                    checkpoint_path=self.ggipnn_checkpoint,
+                    max_pairs=self.config.max_queries_per_request,
+                )
+            return self._interaction_scorer
+
+    def interaction(self, body: dict) -> Tuple[int, dict]:
+        """Cross-shard GGIPNN pair scoring — the paper's extrinsic
+        workload on a sharded fleet.  Each gene's raw vector is
+        resolved from its OWNER shard's replica group
+        (``/v1/shard/vectors``, qvec-cached per epoch) and the MLP head
+        runs at the front door, so a pair spanning shards scores
+        exactly like on a single replica.  Degraded-contract honesty:
+        a pair whose owner group is fully down gets ``score: null`` +
+        ``degraded: true`` in a 200 — never a 5xx, never a silently
+        missing pair."""
+        try:
+            return self._interaction(body)
+        except ApiReject as e:
+            self._count(f"fleet_http_{e.status}_total")
+            return e.status, {"error": str(e)}
+        except Exception as e:
+            # a scorer that cannot build (e.g. a head checkpoint
+            # trained at a different dim) or a scoring crash must
+            # ANSWER — the proxy's handler pool swallows exceptions,
+            # so raising here would hang the client until its timeout
+            # with no counter and no trace status
+            self._count("fleet_interaction_errors_total")
+            return 500, {
+                "error": f"interaction scoring failed: {e!r}",
+            }
+
+    def _interaction(self, body: dict) -> Tuple[int, dict]:
+        pairs = body.get("pairs")
+        if not isinstance(pairs, list) or not pairs or not all(
+            isinstance(p, list) and len(p) == 2
+            and all(isinstance(g, str) for g in p)
+            for p in pairs
+        ):
+            # string-ness is part of the 400 contract: a non-string
+            # element would TypeError in the dedup set below and turn
+            # a client mistake into a 500 server-error signal
+            raise ApiReject(
+                400,
+                "'pairs' must be a non-empty list of [gene, gene] "
+                "name pairs",
+            )
+        if len(pairs) > self.config.max_queries_per_request:
+            raise ApiReject(
+                400,
+                f"at most {self.config.max_queries_per_request} pairs "
+                "per request",
+            )
+        timeout = body.get("timeout_ms")
+        if timeout is not None and (
+            not isinstance(timeout, (int, float)) or timeout <= 0
+        ):
+            raise ApiReject(400, "timeout_ms must be a positive number")
+        timeout_s = (
+            float(timeout) / 1000.0 if timeout is not None
+            else self.config.default_timeout_s
+        )
+        scorer = self._scorer()
+        deadline = time.monotonic() + timeout_s
+        # one resolution per distinct gene; unknown genes 400 exactly
+        # like the single-replica scorer's KeyError path
+        genes = []
+        seen = set()
+        for a, b in pairs:
+            for g in (a, b):
+                if g not in seen:
+                    seen.add(g)
+                    genes.append(g)
+        epoch_hint = self.current_epoch
+        for fence_try in (0, 1):
+            vecs, epochs, unresolved = self._resolve_vectors(
+                genes, deadline, epoch_hint
+            )
+            resolved_epochs = {e for e in epochs if e is not None}
+            if len(resolved_epochs) > 1 and fence_try == 0:
+                # a swap landed mid-resolution: retry once pinned to
+                # the newest epoch — scoring a pair from two different
+                # iterations' tables would be a wrong answer
+                self._count("fleet_epoch_race_retries_total")
+                epoch_hint = max(resolved_epochs)
+                continue
+            break
+        merged_epoch = (
+            max(resolved_epochs) if resolved_epochs else self.current_epoch
+        )
+        by_gene = {}
+        for g, v, e in zip(genes, vecs, epochs):
+            # still racing after the retry: the minority-epoch vector
+            # degrades to unresolved rather than crossing iterations
+            if v is not None and e is not None and e != merged_epoch:
+                self._count("fleet_qvec_unresolved_total")
+                unresolved = True
+                v = None
+            by_gene[g] = v
+        scorable = [
+            (i, p) for i, p in enumerate(pairs)
+            if by_gene[p[0]] is not None and by_gene[p[1]] is not None
+        ]
+        if all(v is None for v in by_gene.values()):
+            # no owner group answered anything: the one non-partial case
+            self._count("fleet_scatter_unanswered_total")
+            return 503, {
+                "error": "no owner shard answered the vector scatter",
+                "shards": {"total": self.config.num_shards,
+                           "answered": 0},
+            }
+        scores = scorer.score_vectors(
+            [
+                (np.asarray(by_gene[a], np.float32),
+                 np.asarray(by_gene[b], np.float32))
+                for _, (a, b) in scorable
+            ]
+        )
+        out: List[dict] = [
+            {"pair": list(p), "score": None, "degraded": True}
+            for p in pairs
+        ]
+        for (i, p), s in zip(scorable, scores):
+            out[i] = {"pair": list(p), "score": round(float(s), 6)}
+        degraded = bool(unresolved)
+        if degraded:
+            self._count("fleet_degraded_responses_total")
+        self._count("fleet_interaction_pairs_total", len(pairs))
+        return 200, {
+            "model": {
+                "dim": (
+                    self.routing.dim if self.routing is not None
+                    else None
+                ),
+                "iteration": merged_epoch,
+            },
+            "trained_head": scorer.trained,
+            "scores": out,
+            "degraded": degraded,
+            "shards": {"total": self.config.num_shards},
+        }
+
 
 class SwapCoordinator:
     """Drives the shard-atomic hot swap from the front-door process.
@@ -933,32 +1158,42 @@ class SwapCoordinator:
         else:
             self._repair(dim, iteration)
 
-    def _urls(self) -> List[Optional[str]]:
-        return [
-            self.group.url_for(i)
-            for i in range(self.group.config.num_shards)
-        ]
+    def _cells(self) -> List[Tuple[int, str]]:
+        """Every live (shard, replica-URL) cell of the grid — the swap
+        protocol's unit.  With ``--replicas-per-shard 1`` this is the
+        PR-13 one-URL-per-shard list, unchanged."""
+        out: List[Tuple[int, str]] = []
+        for i in range(self.group.config.num_shards):
+            for url in self.group.urls_of(i):
+                out.append((i, url))
+        return out
 
     def _swap(self, dim: int, iteration: int) -> None:
-        """STAGE everywhere, then FLIP everywhere under one token.
-        Deferred while any shard is down: flipping half a fleet can
-        never be atomic, and the supervisor's restart is coming."""
-        urls = self._urls()
-        if any(u is None for u in urls):
+        """STAGE every (shard, replica) cell, then FLIP all under one
+        token.  Deferred while any shard GROUP is fully down: flipping
+        half a fleet can never be atomic, and the supervisor's restart
+        is coming.  A single dead replica with a live sibling does NOT
+        defer — the sibling flips with the fleet, and the dead cell is
+        repaired (re-staged + flipped) when it returns."""
+        cells = self._cells()
+        covered = {i for i, _ in cells}
+        if any(
+            i not in covered
+            for i in range(self.group.config.num_shards)
+        ):
             self._count("fleet_swap_deferred_total")
             return
-        staged: List[bool] = []
         threads = []
-        results: Dict[int, Optional[dict]] = {}
+        results: Dict[Tuple[int, str], Optional[dict]] = {}
 
         def stage(i: int, url: str) -> None:
-            results[i] = self._post(
+            results[(i, url)] = self._post(
                 url, "/v1/shard/stage",
                 {"dim": dim, "iteration": iteration},
                 self.stage_timeout_s,
             )
 
-        for i, url in enumerate(urls):
+        for i, url in cells:
             t = threading.Thread(
                 target=stage, args=(i, url), daemon=True,
                 name=f"swap-stage-{i}",
@@ -968,16 +1203,17 @@ class SwapCoordinator:
         for t in threads:
             t.join(timeout=self.stage_timeout_s + 10.0)
         staged = [
-            isinstance(results.get(i), dict) and "staged" in results[i]
-            for i in range(len(urls))
+            isinstance(results.get(cell), dict)
+            and "staged" in results[cell]
+            for cell in cells
         ]
         if not all(staged):
-            # NO shard flips: the fleet keeps serving the old epoch as
+            # NO cell flips: the fleet keeps serving the old epoch as
             # one logical version; retry next tick
             self._count("fleet_swap_stage_failures_total")
             return
         flips_ok = True
-        for i, url in enumerate(urls):
+        for i, url in cells:
             doc = self._post(
                 url, "/v1/shard/flip", {"epoch": iteration}, 30.0
             )
@@ -985,10 +1221,10 @@ class SwapCoordinator:
                 flips_ok = False
             else:
                 self.group.note_epoch(
-                    i, (doc.get("shard") or {}).get("epoch")
+                    i, (doc.get("shard") or {}).get("epoch"), url=url
                 )
         # the fleet's logical version moves forward once the flip wave
-        # has been ISSUED: stragglers (a shard that died mid-flip) are
+        # has been ISSUED: stragglers (a cell that died mid-flip) are
         # epoch-fenced out of merges and repaired next tick
         self.group.current_epoch = iteration
         if self.group.routing is not None:
@@ -998,14 +1234,12 @@ class SwapCoordinator:
             self._count("fleet_swap_flip_failures_total")
 
     def _repair(self, dim: int, iteration: int) -> None:
-        """Converge shards serving a different epoch than the fleet's
+        """Converge cells serving a different epoch than the fleet's
         (typically a replica the supervisor restarted mid-history):
         stage + flip just those."""
-        for i, url in enumerate(self._urls()):
-            if url is None:
-                continue
+        for i, url in self._cells():
             epoch = self._probe_epoch(url)
-            self.group.note_epoch(i, epoch)
+            self.group.note_epoch(i, epoch, url=url)
             if epoch == iteration or epoch is None:
                 continue
             doc = self._post(
@@ -1019,7 +1253,8 @@ class SwapCoordinator:
                 )
                 if flipped is not None:
                     self.group.note_epoch(
-                        i, (flipped.get("shard") or {}).get("epoch")
+                        i, (flipped.get("shard") or {}).get("epoch"),
+                        url=url,
                     )
                     self._count("fleet_swap_repairs_total")
 

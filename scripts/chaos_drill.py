@@ -1811,8 +1811,10 @@ def drill_shard(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
     plus the real-CLI HTTP drill — SIGKILL one shard mid-load (degraded
     200s, never 5xx; recall recovers after restart), swap-under-load
     through the shard-atomic stage/flip (zero wrong / mixed-iteration
-    answers), and a slow-loris shard (per-shard deadline fires, p99
-    stays bounded)."""
+    answers), a slow-loris shard (per-shard deadline fires, p99 stays
+    bounded), and the replicated-shard failover scenario
+    (_shard_failover_drill: one dead sibling costs NOTHING, a dead
+    group degrades honestly)."""
     import threading
 
     from gene2vec_tpu.obs import flight as flight_mod
@@ -2255,6 +2257,22 @@ def drill_shard(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
     result["drill"]["slow_loris"] = _shard_slow_loris(
         tmp, smoke, budget, seed
     )
+    fo = _shard_failover_drill(tmp, smoke, budget, seed)
+    result["drill"]["failover"] = fo
+    # the drill-wide integrity tallies cover the failover windows too:
+    # passes_shard gates these sums, so a wrong answer in the
+    # replicated grid can never hide behind its own sub-section
+    result["drill"]["wrong_answers"] += (
+        fo["wrong_answers"] + fo["both_dead"]["degraded_wrong"]
+        + fo["both_dead"]["wrong_answers"]
+    )
+    result["drill"]["mixed_iteration_answers"] += (
+        fo["mixed_iteration_answers"]
+        + fo["both_dead"]["mixed_iteration_answers"]
+    )
+    result["drill"]["server_5xx"] += (
+        fo["server_5xx"] + fo["both_dead"]["server_5xx"]
+    )
     return result
 
 
@@ -2373,6 +2391,451 @@ def _shard_slow_loris(tmp: str, smoke: bool, budget: dict,
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=30)
+
+
+def _shard_failover_drill(tmp: str, smoke: bool, budget: dict,
+                          seed: int) -> dict:
+    """Replicated shards (--replicas-per-shard): SIGKILL one sibling of
+    a 2-replica shard under verified load — the scatter must fail over
+    to the live sibling within the leg deadline (availability 1.0,
+    ZERO degraded answers, 0 wrong/mixed, the shard-redundancy-lost
+    alert fires and clears) — then kill BOTH siblings and the PR-13
+    degraded contract must hold unchanged.  Also exercises the
+    cross-shard /v1/interaction path end-to-end (the 501 is gone)."""
+    import threading
+
+    from gene2vec_tpu.obs import flight as flight_mod
+    from gene2vec_tpu.serve.client import ResilientClient, RetryPolicy
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    fo_budget = budget.get("failover") or {}
+    shards = int(budget.get("http_shards", 2))
+    rps = int(fo_budget.get("replicas_per_shard", 2))
+    vocab, dim, k = 60, 8, 4
+    export_dir = os.path.join(tmp, "shard_failover_export")
+    _write_iteration(export_dir, 1, vocab_size=vocab, dim=dim)
+    embs = {1: np.random.RandomState(1).randn(vocab, dim)
+            .astype(np.float32)}
+    tokens = [f"G{i}" for i in range(vocab)]
+    duration_s = 5.0 if smoke else 8.0
+    workers = 3
+
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_dir,
+        "--shard-by-rows", str(shards),
+        "--replicas-per-shard", str(rps),
+        "--port", "0", "--health-interval", "0.25",
+        "--unhealthy-after", "2", "--backoff-base", "0.3",
+        "--swap-interval", "0.5", "--scrape-interval", "0.25",
+        "--proxy-timeout-ms", "4000",
+        "--shard-deadline-ms", "1500",
+        "--seed", str(seed),
+    ]
+    log(f"spawning replicated-shard fleet: {shards} shards x {rps} "
+        f"replicas over {vocab} rows")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    try:
+        info = read_contract_line(proc, 240.0)
+        url = info["url"]
+        run_dir = info["run_dir"]
+        ranges = [tuple(r) for r in info["shards"]["ranges"]]
+        groups = {
+            int(s): slots
+            for s, slots in info["shards"]["groups"].items()
+        }
+        assert info["shards"]["replicas_per_shard"] == rps
+        assert all(len(slots) == rps for slots in groups.values()), (
+            f"grid accounting broke: {groups}"
+        )
+        log(f"replicated front door at {url}; groups {groups}")
+
+        client = ResilientClient(
+            [url],
+            RetryPolicy(
+                max_attempts=3, default_timeout_s=6.0,
+                read_timeout_s=6.0, trace_sample=1.0,
+            ),
+        )
+
+        def oracle(it, qvec, kk, live_shards, exclude_token=None):
+            cols = [
+                c for si in live_shards
+                for c in range(ranges[si][0], ranges[si][1])
+            ]
+            return _shard_oracle(
+                embs[it], tokens, qvec, kk, cols, exclude_token
+            )
+
+        all_shards = list(range(shards))
+        query_genes = [f"G{i}" for i in range(0, vocab, 4)]
+        # two warm passes: round-robin spreads legs over BOTH siblings
+        # of each shard, so every cell's engine is jit-warm before the
+        # failover window (a cold sibling would smear the p99)
+        for _pass in range(2):
+            for g in query_genes:
+                r = client.request(
+                    "/v1/similar", {"genes": [g], "k": k},
+                    timeout_s=10.0,
+                )
+                assert r.ok and not r.doc["degraded"]
+                got = [n["gene"]
+                       for n in r.doc["results"][0]["neighbors"]]
+                want = oracle(1, embs[1][int(g[1:])], k, all_shards,
+                              exclude_token=g)
+                assert got == want, f"warmup diverged for {g}"
+
+        # cross-shard interaction: a pair spanning shard boundaries
+        # scores at the front door — the PR-13 501 is gone
+        cross_pair = [tokens[0], tokens[-1]]
+        r = client.request(
+            "/v1/interaction",
+            {"pairs": [cross_pair, [tokens[1], tokens[2]]]},
+            timeout_s=10.0,
+        )
+        assert r.ok, (
+            f"/v1/interaction failed on the sharded fleet: "
+            f"{r.status} {r.error_class}"
+        )
+        idoc = r.doc
+        assert not idoc.get("degraded")
+        assert "trained_head" in idoc
+        assert len(idoc["scores"]) == 2 and all(
+            isinstance(s["score"], float) and 0.0 <= s["score"] <= 1.0
+            for s in idoc["scores"]
+        ), f"malformed interaction scores: {idoc['scores']}"
+        log(f"cross-shard /v1/interaction answered: {idoc['scores']}")
+
+        # ---- window 1: SIGKILL one sibling; ZERO degraded allowed ---
+        counts = {"total": 0, "ok": 0, "degraded": 0, "failed": 0,
+                  "wrong": 0, "mixed": 0, "server_5xx": 0,
+                  "attempts": 0, "retries": 0}
+        latencies: list = []
+        trace_ids: list = []
+        lock = threading.Lock()
+        stop_at = time.monotonic() + duration_s
+        victim_shard = 1
+        kill_at = time.monotonic() + duration_s / 3.0
+
+        def worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while time.monotonic() < stop_at:
+                row = int(wrng.randint(vocab))
+                use_gene = wrng.rand() < 0.5
+                gene = tokens[row] if use_gene else None
+                body = (
+                    {"genes": [gene], "k": k} if use_gene
+                    else {"vectors": [[float(x) for x in embs[1][row]]],
+                          "k": k}
+                )
+                r = client.request("/v1/similar", body, timeout_s=6.0)
+                with lock:
+                    counts["total"] += 1
+                    counts["attempts"] += r.attempts
+                    counts["retries"] += r.retries
+                    latencies.append(r.latency_s * 1000.0)
+                    if r.trace_id:
+                        # the failover happens INSIDE the fleet process
+                        # (scatter-leg sibling attempts), invisible in
+                        # this client's attempt count — keep timestamps
+                        # so the search below targets the kill window
+                        trace_ids.append(
+                            (time.monotonic(), r.trace_id)
+                        )
+                    if r.status >= 500 and r.target is not None:
+                        counts["server_5xx"] += 1
+                    if not r.ok or r.doc is None:
+                        counts["failed"] += 1
+                        continue
+                    doc = r.doc
+                    if doc["model"]["iteration"] != 1:
+                        counts["mixed"] += 1
+                        continue
+                    if doc.get("degraded"):
+                        counts["degraded"] += 1
+                        continue
+                    got = [n["gene"]
+                           for n in doc["results"][0]["neighbors"]]
+                    want = oracle(1, embs[1][row], k, all_shards,
+                                  exclude_token=gene)
+                    if got != want:
+                        counts["wrong"] += 1
+                    else:
+                        counts["ok"] += 1
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(max(0.0, kill_at - time.monotonic()))
+        victim_slot = groups[victim_shard][0]
+        victim_pid = info["replica_pids"][victim_slot]
+        log(f"SIGKILL replica slot {victim_slot} (shard {victim_shard},"
+            f" pid {victim_pid}) — its sibling must absorb everything")
+        killed_at = time.monotonic()
+        os.kill(victim_pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=duration_s + 30.0)
+
+        # degraded answers are NOT ok in this window — with a live
+        # sibling the contract is zero degradation, so availability
+        # here counts exact answers only (no lenient ok+degraded
+        # variant: it would be one careless edit away from weakening
+        # the zero-degradation assert below)
+        strict_availability = counts["ok"] / max(counts["total"], 1)
+        p99 = float(np.percentile(np.asarray(latencies), 99))
+        log(
+            f"failover window: {counts['total']} requests, "
+            f"{counts['degraded']} degraded, {counts['failed']} "
+            f"failed, {counts['server_5xx']} 5xx, p99 {p99:.1f}ms"
+        )
+        assert counts["total"] >= workers * duration_s / 2, (
+            "suspiciously few requests — the load loop wedged"
+        )
+        assert counts["degraded"] <= int(
+            fo_budget.get("max_degraded_with_live_replica", 0)
+        ), (
+            f"{counts['degraded']} degraded responses while a sibling "
+            "was live — failover must absorb a single replica death"
+        )
+        assert counts["server_5xx"] == 0
+        assert counts["wrong"] == 0 and counts["mixed"] == 0, (
+            f"{counts['wrong']} wrong / {counts['mixed']} mixed "
+            "answers during failover"
+        )
+        min_avail = float(fo_budget.get("min_availability", 1.0))
+        assert strict_availability >= min_avail, (
+            f"failover availability {strict_availability:.4f} < "
+            f"{min_avail} — requests were lost, not failed over"
+        )
+        max_p99 = float(fo_budget.get("max_failover_p99_ms", 2000.0))
+        assert p99 <= max_p99, (
+            f"failover-window p99 {p99:.1f}ms over budget {max_p99}"
+        )
+
+        # the redundancy page fired: shard-redundancy-lost in the
+        # fleet run dir's alerts.jsonl (the page that PRECEDES the
+        # recall-degradation page — window 1 never degraded)
+        def redundancy_fired():
+            path = os.path.join(run_dir, "alerts.jsonl")
+            if not os.path.exists(path):
+                return False
+            with open(path) as f:
+                return any(
+                    '"shard-redundancy-lost"' in line
+                    and '"firing"' in line
+                    for line in f
+                )
+
+        wait_until(redundancy_fired, 30.0, interval_s=0.5,
+                   what="shard-redundancy-lost alert firing")
+        log("shard-redundancy-lost fired on the sibling's death")
+
+        # a failover trace: one proxy_scatter span whose shard leg
+        # carries >= 2 sibling client_attempt hops (the dead pick and
+        # the failover) — satellite: cli.obs trace renders the grid
+        time.sleep(1.0)
+        failover_trace = None
+        window_ids = [
+            tid for ts, tid in trace_ids
+            if killed_at - 0.5 <= ts <= killed_at + 3.0
+        ]
+        for tid in window_ids:
+            doc = flight_mod.collect_trace(export_dir, tid)
+            if _scatter_failover_attempts(doc) >= 2:
+                failover_trace = tid
+                break
+        assert failover_trace is not None, (
+            "no reassembled trace shows >= 2 sibling client_attempts "
+            "under one proxy_scatter span"
+        )
+        cli = subprocess.run(
+            [sys.executable, "-m", "gene2vec_tpu.cli.obs", "trace",
+             export_dir, failover_trace],
+            capture_output=True, text=True, timeout=120,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        assert cli.returncode == 0 and "proxy_scatter" in cli.stdout
+        assert cli.stdout.count("client_attempt") >= 2, (
+            f"cli.obs trace hides the failover leg:\n{cli.stdout}"
+        )
+        log(f"failover trace {failover_trace} rendered: sibling "
+            "attempts under one proxy_scatter")
+
+        # recovery: the supervisor respawns the sibling, redundancy
+        # returns, the alert clears
+        def grid_recovered():
+            try:
+                h = _http_json(url + "/healthz", timeout=5.0)
+            except Exception:
+                return False
+            return all(
+                r["up"]
+                for s in h.get("shards", [])
+                for r in s.get("replicas", [])
+            )
+
+        wait_until(grid_recovered, 120.0, interval_s=0.5,
+                   what="killed sibling respawned (full grid up)")
+
+        def redundancy_cleared():
+            with open(os.path.join(run_dir, "alerts.jsonl")) as f:
+                return any(
+                    '"shard-redundancy-lost"' in line
+                    and '"inactive"' in line
+                    for line in f
+                )
+
+        wait_until(redundancy_cleared, 60.0, interval_s=0.5,
+                   what="shard-redundancy-lost clearing on re-admit")
+        log("grid recovered; shard-redundancy-lost cleared")
+
+        # ---- window 2: kill BOTH siblings — the PR-13 degraded
+        # contract must be unchanged ----------------------------------
+        h = _http_json(url + "/healthz", timeout=5.0)
+        shard_entry = next(
+            s for s in h["shards"] if s["index"] == victim_shard
+        )
+        pids = [r["pid"] for r in shard_entry["replicas"] if r["up"]]
+        assert len(pids) == rps
+        both = {"total": 0, "ok": 0, "degraded": 0, "failed": 0,
+                "wrong": 0, "mixed": 0, "server_5xx": 0,
+                "degraded_wrong": 0, "unresolved": 0}
+        stop_at = time.monotonic() + duration_s
+        log(f"SIGKILL BOTH siblings of shard {victim_shard} "
+            f"(pids {pids})")
+        for pid in pids:
+            os.kill(pid, signal.SIGKILL)
+
+        def both_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 50 + widx)
+            while time.monotonic() < stop_at:
+                row = int(wrng.randint(vocab))
+                r = client.request(
+                    "/v1/similar",
+                    {"vectors": [[float(x) for x in embs[1][row]]],
+                     "k": k},
+                    timeout_s=6.0,
+                )
+                with lock:
+                    both["total"] += 1
+                    if r.status >= 500 and r.target is not None:
+                        both["server_5xx"] += 1
+                    if not r.ok or r.doc is None:
+                        both["failed"] += 1
+                        continue
+                    doc = r.doc
+                    if doc["model"]["iteration"] != 1:
+                        both["mixed"] += 1
+                        continue
+                    got = [n["gene"]
+                           for n in doc["results"][0]["neighbors"]]
+                    if doc.get("degraded"):
+                        both["degraded"] += 1
+                        answered = doc["shards"]["indexes"]
+                        want = oracle(1, embs[1][row], k, answered)
+                        if got != want:
+                            both["degraded_wrong"] += 1
+                        else:
+                            both["ok"] += 1
+                    else:
+                        want = oracle(1, embs[1][row], k, all_shards)
+                        if got != want:
+                            both["wrong"] += 1
+                        else:
+                            both["ok"] += 1
+
+        threads = [
+            threading.Thread(target=both_worker, args=(w,), daemon=True)
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s + 30.0)
+        log(
+            f"both-dead window: {both['total']} requests, "
+            f"{both['degraded']} degraded, {both['degraded_wrong']} "
+            f"degraded-wrong, {both['server_5xx']} 5xx"
+        )
+        assert both["server_5xx"] == 0, (
+            "a fully-dead shard group must degrade, never 5xx"
+        )
+        assert both["degraded"] >= int(
+            fo_budget.get("min_both_dead_degraded", 1)
+        ), "the both-dead window never landed"
+        assert both["wrong"] == 0 and both["degraded_wrong"] == 0
+        assert both["mixed"] == 0
+
+        return {
+            "shards": shards,
+            "replicas_per_shard": rps,
+            "duration_s": duration_s,
+            "requests": counts["total"],
+            "availability": round(strict_availability, 5),
+            "degraded_responses": counts["degraded"],
+            "p99_ms": round(p99, 1),
+            "server_5xx": counts["server_5xx"],
+            "wrong_answers": counts["wrong"],
+            "mixed_iteration_answers": counts["mixed"],
+            "retry_amplification": round(
+                counts["attempts"] / max(counts["total"], 1), 4
+            ),
+            "redundancy_alert_fired": True,
+            "redundancy_alert_cleared": True,
+            "failover_trace_id": failover_trace,
+            "interaction_scores": [
+                s["score"] for s in idoc["scores"]
+            ],
+            "both_dead": {
+                "requests": both["total"],
+                "degraded_responses": both["degraded"],
+                "degraded_wrong": both["degraded_wrong"],
+                "server_5xx": both["server_5xx"],
+                "wrong_answers": both["wrong"],
+                "mixed_iteration_answers": both["mixed"],
+            },
+        }
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def _scatter_failover_attempts(doc: dict) -> int:
+    """Max client_attempt count under any single proxy_scatter span of
+    a reassembled trace — >= 2 means a scatter leg failed over between
+    siblings (or retried), rendered under ONE fan-out span."""
+    best = 0
+
+    def attempts_below(node: dict) -> int:
+        n = 1 if node.get("name") == "client_attempt" else 0
+        for sub in node.get("process_spans", []):
+            n += attempts_below(sub)
+        for child in node.get("children", []):
+            n += attempts_below(child)
+        return n
+
+    def walk(node: dict) -> None:
+        nonlocal best
+        if node.get("name") == "proxy_scatter":
+            best = max(best, attempts_below(node))
+        for sub in node.get("process_spans", []):
+            walk(sub)
+        for child in node.get("children", []):
+            walk(child)
+
+    for root in doc.get("roots", []):
+        walk(root)
+    return best
 
 
 # -- phase: the continuous-learning loop -------------------------------------

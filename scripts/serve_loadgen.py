@@ -670,11 +670,12 @@ def fetch_verify_ref(url: str, genes: List[str], k: int,
     return ref
 
 
-def fetch_shard_ctx(url: str, health: Dict, timeout_s: float):
-    """Degraded-answer verification context from a SHARDED front door:
-    per-shard row ranges from /healthz plus the gene→global-row map
-    implied by /v1/genes order (vocab order IS row order).  None for
-    an unsharded target — verification then never consults it."""
+def parse_shard_grid(health: Dict):
+    """The (shard, replica) grid from a sharded front door's /healthz:
+    ``(ranges, replicas)`` — per-shard row ranges plus each shard's
+    replica-group size (``replicas: [{up, epoch}]`` per shard entry; a
+    pre-grid fleet without the key reads as one replica per shard).
+    None for an unsharded target."""
     shards = health.get("shards")
     if not isinstance(shards, list) or not shards:
         return None
@@ -682,6 +683,28 @@ def fetch_shard_ctx(url: str, health: Dict, timeout_s: float):
         int(s["index"]): tuple(s["rows"])
         for s in shards if s.get("rows")
     }
+    replicas = {
+        int(s["index"]): (
+            len(s["replicas"]) if isinstance(s.get("replicas"), list)
+            else 1
+        )
+        for s in shards
+    }
+    return ranges, replicas
+
+
+def fetch_shard_ctx(url: str, health: Dict, timeout_s: float):
+    """Degraded-answer verification context from a SHARDED front door:
+    the (shard, replica) grid from /healthz plus the gene→global-row
+    map implied by /v1/genes order (vocab order IS row order).  None
+    for an unsharded target — verification then never consults it.
+    Degraded scoring restricts the reference by SHARD (the unit of row
+    coverage), never by replica — any live sibling serves the same
+    rows, so which cell answered is irrelevant to correctness."""
+    grid = parse_shard_grid(health)
+    if grid is None:
+        return None
+    ranges, replicas = grid
     doc = _http_json(f"{url}/v1/genes?limit=1", timeout=timeout_s)
     total = int(doc["total"])
     rows: Dict[str, int] = {}
@@ -696,7 +719,7 @@ def fetch_shard_ctx(url: str, health: Dict, timeout_s: float):
         for i, g in enumerate(page):
             rows[g] = offset + i
         offset += len(page)
-    return {"ranges": ranges, "row": rows}
+    return {"ranges": ranges, "row": rows, "replicas": replicas}
 
 
 def spawn_server(export_dir: str, extra: List[str]) -> "tuple":
@@ -958,10 +981,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # sharded front door: degraded answers get scored
                 # against the reference restricted to live shards
                 verify_ref[SHARD_CTX_KEY] = shard_ctx
+                grid = shard_ctx.get("replicas") or {}
                 print(
                     f"sharded target: {len(shard_ctx['ranges'])} "
-                    "shards; degraded answers verified against the "
-                    "restricted reference",
+                    "shards x "
+                    f"{max(grid.values()) if grid else 1} replicas; "
+                    "degraded answers verified against the reference "
+                    "restricted by SHARD",
                     file=sys.stderr,
                 )
 

@@ -633,6 +633,17 @@ def _good_shard_doc():
                 "shards": 2, "availability": 1.0, "server_5xx": 0,
                 "wrong_answers": 0, "mixed_iteration_answers": 0,
                 "retry_amplification": 1.05,
+                "failover": {
+                    "replicas_per_shard": 2,
+                    "availability": 1.0,
+                    "degraded_responses": 0,
+                    "p99_ms": 40.0,
+                    "server_5xx": 0,
+                    "both_dead": {
+                        "degraded_responses": 30,
+                        "server_5xx": 0,
+                    },
+                },
             },
         },
     }
@@ -717,6 +728,8 @@ def test_ledger_adapts_shard_family(tmp_path):
     assert rec["family"] == "shard"
     assert rec["metrics"]["shard_recall_at_10"] == 0.999
     assert rec["metrics"]["shard_p99_ms_10m"] == 60.0
+    assert rec["metrics"]["failover_degraded_responses"] == 0.0
+    assert rec["metrics"]["failover_p99_ms"] == 40.0
     assert rec["headline_metric"] == "shard_recall_at_10"
 
 
@@ -977,3 +990,981 @@ def test_routing_table_snapshot_is_atomic(export_dir):
     snap = rt._snap
     # owner() reads ONE snapshot: index and ranges always agree
     assert snap.index is rt.index and snap.ranges is rt.ranges
+
+
+# -- PR 15: replicated shards — (shard, replica) grid ------------------------
+
+
+def _grid_supervisor(export_dir, n_shards=2, rps=2):
+    """An UNSTARTED FleetSupervisor over a 2x2 grid — slot accounting
+    is pure state, no child processes needed."""
+    from gene2vec_tpu.serve.fleet import FleetConfig, FleetSupervisor
+
+    total = n_shards * rps
+    sup = FleetSupervisor(
+        str(export_dir),
+        config=FleetConfig(replicas=total),
+        serve_args=["--cache-size", "0"],
+        replica_args={3: ["--faults"]},
+        shard_of={i: i // rps for i in range(total)},
+        shard_args={
+            s: ["--shard-index", str(s), "--num-shards", str(n_shards)]
+            for s in range(n_shards)
+        },
+    )
+    return sup
+
+
+def test_grid_slot_accounting(export_dir):
+    from gene2vec_tpu.serve.fleet import ReplicaState
+
+    sup = _grid_supervisor(export_dir)
+    assert [r.shard for r in sup.replicas] == [0, 0, 1, 1]
+    # every slot of a shard spawns with ITS shard's flags; per-slot
+    # args ride after them
+    argv3 = sup._argv(3)
+    i = argv3.index("--shard-index")
+    assert argv3[i:i + 4] == ["--shard-index", "1",
+                              "--num-shards", "2"]
+    assert argv3[-1] == "--faults"
+    assert "--shard-index" in sup._argv(0)
+    # rotation/redundancy accounting over fabricated states
+    for r, (url, state) in zip(sup.replicas, [
+        ("http://a", ReplicaState.UP),
+        ("http://b", ReplicaState.UP),
+        ("http://c", ReplicaState.UP),
+        ("http://d", ReplicaState.BACKOFF),
+    ]):
+        r.url, r.state = url, state
+    assert sup.shard_urls(0) == ["http://a", "http://b"]
+    assert sup.shard_urls(1) == ["http://c"]
+    assert sup.shard_up_counts() == {0: 2, 1: 1}
+    assert sup.active_count() == 4         # backoff still provisioned
+    assert sup.active_count(shard=1) == 2
+    states = sup.states()
+    assert [s["shard"] for s in states] == [0, 0, 1, 1]
+
+
+def test_shard_redundancy_facts_track_current_promise(export_dir):
+    """desired is supervisor-truth, not the boot-time R: a DRAINING
+    slot (deliberate scale-down) leaves the promise so the page never
+    fires on policy, while backoff/ejected/FAILED slots keep counting
+    — and a brand-new scale-up spawn (STARTING, restarts == 0) is not
+    yet a promise, so growing a pool cannot fire the page either."""
+    from gene2vec_tpu.serve.fleet import Replica, ReplicaState
+
+    sup = _grid_supervisor(export_dir)
+    for r, state in zip(sup.replicas, [
+        ReplicaState.UP, ReplicaState.UP,
+        ReplicaState.UP, ReplicaState.BACKOFF,
+    ]):
+        r.state = state
+    # involuntary loss: dead sibling in backoff stays desired -> lost
+    facts = sup.shard_redundancy_facts()
+    assert facts == {0: {"up": 2, "desired": 2},
+                     1: {"up": 1, "desired": 2}}
+    # deliberate scale-down: the drained slot leaves the promise
+    sup.replicas[1].state = ReplicaState.DRAINING
+    facts = sup.shard_redundancy_facts()
+    assert facts[0] == {"up": 1, "desired": 1}
+    # storm-abandoned slot is a PERMANENT involuntary loss: keep paging
+    sup.replicas[3].state = ReplicaState.FAILED
+    assert sup.shard_redundancy_facts()[1] == {"up": 1, "desired": 2}
+    # a scale-up spawn in its boot window is not yet part of the
+    # promise (it has never served) ...
+    new = Replica(4, shard=1)
+    sup.replicas.append(new)
+    assert sup.shard_redundancy_facts()[1] == {"up": 1, "desired": 2}
+    # ... but a RESPAWNING slot (restarts > 0) holds the page until
+    # its sibling is truly back
+    new.restarts = 1
+    assert sup.shard_redundancy_facts()[1] == {"up": 1, "desired": 3}
+
+
+def test_grid_drain_victim_is_shard_scoped(export_dir):
+    from gene2vec_tpu.serve.fleet import ReplicaState
+
+    sup = _grid_supervisor(export_dir)
+    for r in sup.replicas:
+        r.url, r.state = f"http://r{r.index}", ReplicaState.UP
+    # newest UP sibling of the requested shard — never another shard's
+    v = sup.pick_drain_victim(shard=0)
+    assert v is not None and v.index == 1 and v.shard == 0
+    # the LAST up replica of a shard is never a victim: its rows must
+    # stay served even if the whole fleet has spare capacity elsewhere
+    sup.replicas[1].state = ReplicaState.DRAINING
+    assert sup.pick_drain_victim(shard=0) is None
+    # a dead sibling is the preferred (trivially zero-drop) victim
+    sup.replicas[3].state = ReplicaState.BACKOFF
+    v = sup.pick_drain_victim(shard=1)
+    assert v is not None and v.index == 3
+
+
+def test_grid_scale_up_joins_shard_pool(export_dir):
+    """scale_up(shard=) registers the new slot in the shard's pool
+    (spawn intercepted — slot accounting is the contract here)."""
+    from gene2vec_tpu.serve.fleet import ReplicaState
+
+    sup = _grid_supervisor(export_dir)
+    for r in sup.replicas:
+        r.url, r.state = f"http://r{r.index}", ReplicaState.UP
+
+    spawned = []
+
+    def fake_spawn(replica):
+        spawned.append(replica.index)
+        replica.url = f"http://new{replica.index}"
+        replica.state = ReplicaState.STARTING
+
+    sup._spawn = fake_spawn
+    replica = sup.scale_up(shard=1)
+    assert replica.shard == 1 and replica.index == 4
+    assert spawned == [4]
+    # the new slot inherits shard 1's flags for any future respawn
+    assert sup._argv(4)[sup._argv(4).index("--shard-index") + 1] == "1"
+    assert sup.active_count(shard=1) == 3
+    replica.state = ReplicaState.UP
+    assert sup.shard_urls(1) == ["http://r2", "http://r3",
+                                 "http://new4"]
+
+
+# -- within-deadline failover on a scatter leg (fake transport) --------------
+
+
+def _topk_doc(epoch, rows, scores, tokens):
+    return {
+        "shard": {"index": 0, "num_shards": 1, "epoch": epoch,
+                  "iteration": epoch},
+        "results": [{"rows": rows, "scores": scores,
+                     "tokens": tokens}],
+    }
+
+
+def test_scatter_leg_fails_over_to_sibling_within_deadline(export_dir):
+    """A dead replica with a live SIBLING: the leg's client retries
+    retry-safely onto the sibling inside the same leg deadline — the
+    answer is complete, never degraded."""
+    calls = []
+
+    def transport(base_url, method, path, body, ct, rt, headers=None):
+        calls.append((base_url, path))
+        if "dead" in base_url:
+            raise ConnectionRefusedError("sibling died")
+        return 200, json.dumps(_topk_doc(
+            1, [1, 2], [0.9, 0.8], ["G1", "G2"]
+        )).encode()
+
+    routing = RoutingTable(str(export_dir), 1)
+    assert routing.reload()
+    metrics = MetricsRegistry()
+    group = ShardGroup(
+        ShardGroupConfig(num_shards=1, shard_deadline_s=2.0,
+                         default_timeout_s=5.0),
+        lambda i: ["http://dead:1", "http://live:1"],
+        metrics=metrics,
+        policy=RetryPolicy(max_attempts=2, connect_timeout_s=0.5,
+                           default_timeout_s=2.0, backoff_base_s=0.0),
+        routing=routing,
+        transport=transport,
+    )
+    group.current_epoch = 1
+    status, doc = group.similar({"vectors": [[0.0] * D], "k": 2})
+    assert status == 200
+    assert doc["degraded"] is False, (
+        "a single replica death with a live sibling must cost nothing"
+    )
+    assert doc["shards"]["answered"] == 1
+    assert [c[0] for c in calls] == ["http://dead:1", "http://live:1"]
+    assert metrics.counter("fleet_degraded_responses_total").value == 0
+
+
+def test_scatter_all_siblings_dead_still_degrades(export_dir):
+    """The whole replica group down: the PR-13 degraded contract is
+    unchanged — the shard counts as unanswered, never a 5xx."""
+    def transport(base_url, method, path, body, ct, rt, headers=None):
+        if path == "/v1/shard/topk" and "s1" in base_url:
+            raise ConnectionRefusedError("group fully down")
+        return 200, json.dumps(_topk_doc(
+            1, [1, 2], [0.9, 0.8], ["G1", "G2"]
+        )).encode()
+
+    routing = RoutingTable(str(export_dir), 2)
+    assert routing.reload()
+    metrics = MetricsRegistry()
+    group = ShardGroup(
+        ShardGroupConfig(num_shards=2, shard_deadline_s=1.0,
+                         default_timeout_s=3.0),
+        lambda i: [f"http://s{i}a:1", f"http://s{i}b:1"],
+        metrics=metrics,
+        policy=RetryPolicy(max_attempts=2, connect_timeout_s=0.2,
+                           default_timeout_s=1.0, backoff_base_s=0.0),
+        routing=routing,
+        transport=transport,
+    )
+    group.current_epoch = 1
+    status, doc = group.similar({"vectors": [[0.0] * D], "k": 2})
+    assert status == 200
+    assert doc["degraded"] is True
+    assert doc["shards"]["answered"] == 1
+    assert doc["shards"]["indexes"] == [0]
+
+
+# -- the replicated fleet over real HTTP -------------------------------------
+
+
+@pytest.fixture
+def replicated_fleet(export_dir):
+    """2 shards x 2 replicas as in-process HTTP apps + a ShardGroup
+    whose per-shard target list is the live sibling set."""
+    apps, servers, urls = [], [], {}
+    for shard in range(2):
+        for rep in range(2):
+            reg = ModelRegistry(str(export_dir), shard=(shard, 2))
+            assert reg.refresh()
+            app = ServeApp(
+                reg, config=ServeConfig(max_delay_ms=1.0)
+            ).start()
+            srv = make_server(app, "127.0.0.1", 0)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            host, port = srv.server_address[:2]
+            apps.append(app)
+            servers.append(srv)
+            urls.setdefault(shard, []).append(f"http://{host}:{port}")
+    alive = {(s, r): True for s in range(2) for r in range(2)}
+
+    routing = RoutingTable(str(export_dir), 2)
+    assert routing.reload()
+    metrics = MetricsRegistry()
+    group = ShardGroup(
+        ShardGroupConfig(num_shards=2, shard_deadline_s=2.0,
+                         default_timeout_s=5.0),
+        lambda i: [
+            urls[i][r] for r in range(2) if alive[(i, r)]
+        ],
+        metrics=metrics,
+        policy=RetryPolicy(max_attempts=2, connect_timeout_s=0.5,
+                           default_timeout_s=2.0, backoff_base_s=0.0),
+        routing=routing,
+    )
+    group.current_epoch = 1
+    yield group, alive, metrics, urls, apps
+    for app in apps:
+        app.stop()
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_replicated_fleet_sibling_death_is_invisible(
+    replicated_fleet, export_dir
+):
+    group, alive, metrics, _urls, _apps = replicated_fleet
+    alive[(1, 0)] = False  # one sibling of shard 1 dies
+    status, doc = group.similar({"genes": ["G3"], "k": 4})
+    assert status == 200
+    assert doc["degraded"] is False
+    assert doc["shards"]["answered"] == 2
+    got = [n["gene"] for n in doc["results"][0]["neighbors"]]
+    assert got == _exact_reference(export_dir, "G3", 4)
+    assert metrics.counter("fleet_degraded_responses_total").value == 0
+
+
+def test_replicated_fleet_group_death_degrades(replicated_fleet,
+                                               export_dir):
+    group, alive, metrics, _urls, _apps = replicated_fleet
+    alive[(1, 0)] = alive[(1, 1)] = False
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    q = list(map(float, full.model.emb[2]))
+    status, doc = group.similar({"vectors": [q], "k": 4})
+    assert status == 200
+    assert doc["degraded"] is True
+    assert doc["shards"]["indexes"] == [0]
+    assert metrics.counter("fleet_degraded_responses_total").value == 1
+
+
+def test_failover_leg_renders_as_siblings_under_proxy_scatter(
+    replicated_fleet, tmp_path
+):
+    """The trace satellite: a failover scatter leg = TWO sibling
+    client_attempt hops under ONE proxy_scatter span, and cli.obs
+    trace's renderer shows both."""
+    from gene2vec_tpu.obs import tracecontext as tc
+    from gene2vec_tpu.obs.flight import collect_trace, format_trace
+    from gene2vec_tpu.obs.trace import Tracer, set_tracer
+
+    group, alive, _metrics, urls, _apps = replicated_fleet
+    # shard 0's group: a refused-port "sibling" FIRST, so the leg's
+    # round-robin pick hits it and fails over to the live one
+    dead_first = dict(urls)
+    dead_first[0] = ["http://127.0.0.1:9", urls[0][0]]
+    group.url_for = lambda i: dead_first[i]
+    run_dir = tmp_path / "trace_run"
+    tracer = Tracer(str(run_dir / "events.jsonl"))
+    set_tracer(tracer)
+    try:
+        full = ModelRegistry(str(group.routing.export_dir))
+        full.refresh()
+        q = [float(x) for x in full.model.emb[3]]
+        ctx = tc.new_trace(sampled=True)
+        with tc.use(ctx):
+            # a VECTOR query: the whole request is one topk scatter, so
+            # the shard-0 failover pair lands under proxy_scatter (a
+            # gene query's resolution round would advance the client's
+            # round-robin past the dead sibling first)
+            status, doc = group.similar({"vectors": [q], "k": 3})
+        assert status == 200 and not doc["degraded"]
+    finally:
+        set_tracer(None)
+        tracer.close()
+    trace = collect_trace(str(tmp_path), ctx.trace_id)
+    assert trace["roots"], "trace did not reassemble"
+
+    def scatter_attempts(node):
+        found = []
+
+        def walk(n, under_scatter):
+            name = n.get("name")
+            if name == "client_attempt" and under_scatter:
+                found.append(n)
+            nxt = under_scatter or name == "proxy_scatter"
+            for s in n.get("process_spans", []):
+                walk(s, nxt)
+            for c in n.get("children", []):
+                walk(c, nxt)
+
+        walk(node, False)
+        return found
+
+    attempts = [
+        a for root in trace["roots"] for a in scatter_attempts(root)
+    ]
+    # >= 2 on the failed-over shard 0 leg + 1 on shard 1's leg; the
+    # failover pair shares the scatter ancestor, i.e. siblings
+    assert len(attempts) >= 3, (
+        f"expected the failover pair + shard 1's leg, got "
+        f"{len(attempts)} client_attempts"
+    )
+    statuses = sorted(
+        (a.get("attrs") or {}).get("status", -1) for a in attempts
+    )
+    assert 0 in statuses and 200 in statuses, (
+        "the dead-pick attempt (status 0) and the sibling's success "
+        f"must BOTH render (got {statuses})"
+    )
+    rendered = format_trace(trace)
+    assert "proxy_scatter" in rendered
+    assert rendered.count("client_attempt") >= 3
+
+
+def test_swap_stages_and_flips_every_grid_cell(replicated_fleet,
+                                               export_dir):
+    group, _alive, metrics, _urls, apps = replicated_fleet
+    coord = SwapCoordinator(
+        str(export_dir), group, interval_s=0.1, metrics=metrics
+    )
+    coord.tick()
+    assert group.current_epoch == 1
+    _write_iteration(export_dir, 2, seed=2)
+    coord.tick()
+    assert group.current_epoch == 2
+    for app in apps:  # all FOUR cells flipped under the one token
+        assert app.registry.model.epoch == 2
+    assert metrics.counter("fleet_swap_flips_total").value == 1
+
+
+def test_swap_proceeds_with_dead_sibling_then_repairs(
+    replicated_fleet, export_dir
+):
+    """One replica down with a live sibling does NOT defer the swap
+    (the sibling flips with the fleet); the dead cell is repaired —
+    staged + flipped to the fleet epoch — once it returns."""
+    group, alive, metrics, _urls, apps = replicated_fleet
+    coord = SwapCoordinator(
+        str(export_dir), group, interval_s=0.1, metrics=metrics
+    )
+    coord.tick()
+    alive[(0, 1)] = False  # one sibling of shard 0 is down
+    _write_iteration(export_dir, 2, seed=2)
+    coord.tick()
+    assert group.current_epoch == 2, (
+        "a dead REPLICA with a live sibling must not defer the swap"
+    )
+    assert metrics.counter("fleet_swap_deferred_total").value == 0
+    # cells: shard0-rep0, shard1-rep0, shard1-rep1 flipped; the dead
+    # sibling still serves the old epoch
+    assert apps[0].registry.model.epoch == 2
+    assert apps[2].registry.model.epoch == 2
+    assert apps[3].registry.model.epoch == 2
+    assert apps[1].registry.model.epoch == 1
+    # it returns: the repair pass converges it without a new swap
+    alive[(0, 1)] = True
+    coord.tick()
+    assert apps[1].registry.model.epoch == 2
+    assert metrics.counter("fleet_swap_repairs_total").value == 1
+
+
+def test_swap_deferred_while_whole_group_down(replicated_fleet,
+                                              export_dir):
+    group, alive, metrics, _urls, apps = replicated_fleet
+    coord = SwapCoordinator(
+        str(export_dir), group, interval_s=0.1, metrics=metrics
+    )
+    coord.tick()
+    alive[(1, 0)] = alive[(1, 1)] = False
+    _write_iteration(export_dir, 2, seed=2)
+    coord.tick()
+    assert group.current_epoch == 1
+    assert metrics.counter("fleet_swap_deferred_total").value == 1
+    for app in apps:
+        assert app.registry.model.epoch == 1
+
+
+def test_shard_states_carry_replica_groups(replicated_fleet):
+    group, alive, *_ = replicated_fleet
+    alive[(1, 1)] = False
+    states = group.shard_states(
+        replicas_for=lambda i: [
+            {"index": 2 * i + r, "up": alive[(i, r)], "epoch": 1}
+            for r in range(2)
+        ],
+    )
+    assert [s["up"] for s in states] == [True, True]
+    assert [r["up"] for r in states[1]["replicas"]] == [True, False]
+    assert states[0]["rows"] == list(shard_ranges(V, 2)[0])
+
+
+# -- cross-shard /v1/interaction ---------------------------------------------
+
+
+def _save_head_checkpoint(tmp_path, export_dir, batch_size=8):
+    """A ggipnn_obs-format checkpoint whose head weights are real
+    (trainer-initialized) values — the parity tests load it on BOTH
+    scorers so the heads are identical by construction."""
+    from gene2vec_tpu.config import GGIPNNConfig
+    from gene2vec_tpu.models.ggipnn_data import PairTextVocab
+    from gene2vec_tpu.models.ggipnn_obs import _flatten_params
+    from gene2vec_tpu.models.ggipnn_train import GGIPNNTrainer
+
+    full = ModelRegistry(str(export_dir))
+    full.refresh()
+    m = full.model
+    vocab = PairTextVocab()
+    vocab.token_to_id = dict(m.index)
+    vocab.id_to_token = list(m.tokens)
+    trainer = GGIPNNTrainer(
+        GGIPNNConfig(embedding_dim=D, batch_size=batch_size, seed=7),
+        vocab,
+    )
+    params, _ = trainer.init_state()
+    flat = _flatten_params(dict(params))
+    path = tmp_path / "ggipnn_head.npz"
+    np.savez(str(path), **{k: np.asarray(v) for k, v in flat.items()})
+    return str(path), m
+
+
+def test_cross_shard_scorer_parity_with_unsharded(tmp_path, export_dir):
+    """The acceptance bar: CrossShardScorer over shard-resolved
+    vectors == InteractionScorer over the full served table, same
+    head checkpoint, same pairs."""
+    from gene2vec_tpu.serve.interaction import (
+        CrossShardScorer,
+        InteractionScorer,
+    )
+
+    ckpt, m = _save_head_checkpoint(tmp_path, export_dir)
+    ref = InteractionScorer(m, checkpoint_path=ckpt)
+    assert ref.trained
+    pairs = [("G0", "G23"), ("G5", "G12"), ("G7", "G7")]
+    want = ref.score(pairs)
+
+    xs = CrossShardScorer(D, checkpoint_path=ckpt, max_pairs=8,
+                          batch_size=8)
+    assert xs.trained
+    got = xs.score_vectors([
+        (m.emb[m.index[a]], m.emb[m.index[b]]) for a, b in pairs
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_shard_scorer_rejects_wrong_dim(tmp_path, export_dir):
+    from gene2vec_tpu.serve.interaction import CrossShardScorer
+
+    ckpt, _ = _save_head_checkpoint(tmp_path, export_dir)
+    with pytest.raises(ValueError):
+        CrossShardScorer(D + 1, checkpoint_path=ckpt)
+
+
+def test_group_interaction_parity_over_live_shards(
+    replicated_fleet, tmp_path, export_dir
+):
+    """End to end over real HTTP: the front door resolves each gene's
+    vector from its owner group and scores — equal to the unsharded
+    replica's answer for pairs that SPAN shards."""
+    from gene2vec_tpu.serve.interaction import InteractionScorer
+
+    group, _alive, metrics, _urls, _apps = replicated_fleet
+    ckpt, m = _save_head_checkpoint(tmp_path, export_dir)
+    group.ggipnn_checkpoint = ckpt
+    # G1 owns shard 0, G20 shard 1: the pair spans the partition
+    pairs = [["G1", "G20"], ["G0", "G3"], ["G22", "G23"]]
+    status, doc = group.interaction({"pairs": pairs})
+    assert status == 200
+    assert doc["trained_head"] is True
+    assert doc.get("degraded") is False
+    assert doc["model"]["iteration"] == 1
+    ref = InteractionScorer(m, checkpoint_path=ckpt)
+    want = ref.score([tuple(p) for p in pairs])
+    got = [s["score"] for s in doc["scores"]]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert metrics.counter(
+        "fleet_interaction_pairs_total"
+    ).value == len(pairs)
+
+
+def test_group_interaction_degrades_when_owner_group_down(
+    replicated_fleet, tmp_path, export_dir
+):
+    group, alive, metrics, _urls, _apps = replicated_fleet
+    ckpt, _ = _save_head_checkpoint(tmp_path, export_dir)
+    group.ggipnn_checkpoint = ckpt
+    alive[(1, 0)] = alive[(1, 1)] = False  # shard 1's group fully down
+    status, doc = group.interaction(
+        {"pairs": [["G1", "G20"], ["G0", "G3"]]}
+    )
+    assert status == 200, "an owner-group outage must not 5xx"
+    assert doc["degraded"] is True
+    # the cross-partition pair is honestly unscored; the shard-0 pair
+    # still answers
+    assert doc["scores"][0]["score"] is None
+    assert doc["scores"][0]["degraded"] is True
+    assert isinstance(doc["scores"][1]["score"], float)
+    assert metrics.counter(
+        "fleet_degraded_responses_total"
+    ).value == 1
+
+
+def test_group_interaction_validation_and_unknown_gene(
+    replicated_fleet, tmp_path, export_dir
+):
+    group, _alive, *_ = replicated_fleet
+    ckpt, _ = _save_head_checkpoint(tmp_path, export_dir)
+    group.ggipnn_checkpoint = ckpt
+    assert group.interaction({})[0] == 400
+    assert group.interaction({"pairs": []})[0] == 400
+    assert group.interaction({"pairs": [["G1"]]})[0] == 400
+    # non-string pair elements are a CLIENT error: without the
+    # string check they'd TypeError in the dedup set and surface as
+    # a 500 server-error signal
+    assert group.interaction({"pairs": [[["G1"], "G2"]]})[0] == 400
+    assert group.interaction({"pairs": [["G1", 7]]})[0] == 400
+    status, doc = group.interaction({"pairs": [["G1", "NOPE"]]})
+    assert status == 400 and "NOPE" in doc["error"]
+
+
+def test_group_interaction_all_owners_dead_is_503(
+    replicated_fleet, tmp_path, export_dir
+):
+    group, alive, *_ = replicated_fleet
+    ckpt, _ = _save_head_checkpoint(tmp_path, export_dir)
+    group.ggipnn_checkpoint = ckpt
+    for key in alive:
+        alive[key] = False
+    status, doc = group.interaction({"pairs": [["G1", "G20"]]})
+    assert status == 503
+    assert doc["shards"]["answered"] == 0
+
+
+# -- per-shard autoscaling ---------------------------------------------------
+
+
+def _shard_snap(q0=0.0, q1=0.0, fresh=4.0, p99=None):
+    snap = {
+        "fleet_shard_queue_depth{shard=0}": q0,
+        "fleet_shard_queue_depth{shard=1}": q1,
+        "_fresh_targets": fresh,
+    }
+    if p99 is not None:
+        snap["fleet_shard_p99_seconds{shard=0}"] = p99
+    return snap
+
+
+def test_shard_policy_scales_the_hot_shard_only():
+    from gene2vec_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        ShardAutoscalePolicy,
+    )
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_per_replica=8.0,
+        up_after_ticks=2, down_after_ticks=5, cooldown_s=0.0,
+    )
+    pol = ShardAutoscalePolicy(cfg, num_shards=2)
+    current = {0: 2, 1: 2}
+    # tick 1 seeds baselines, tick 2-3 breach shard 1 only
+    for t in range(3):
+        d = pol.observe(
+            _shard_snap(q0=1.0 * 2, q1=40.0), now=float(t),
+            current_of=current,
+        )
+    assert d.action == "up" and d.shard == 1 and d.target == 3
+    # shard 0 never breached: its policy holds
+    d0 = pol.policies[0].observe(
+        {"fleet_queue_depth": 2.0, "_fresh_targets": 4.0},
+        now=4.0, current=2,
+    )
+    assert d0.action == "hold"
+
+
+def test_shard_policy_scale_down_never_below_min():
+    from gene2vec_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        ShardAutoscalePolicy,
+    )
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_per_replica=8.0,
+        down_queue_per_replica=1.0, up_after_ticks=2,
+        down_after_ticks=3, cooldown_s=0.0,
+    )
+    pol = ShardAutoscalePolicy(cfg, num_shards=2)
+    # shard 0 idle with 2 replicas, shard 1 idle with 1 (at min)
+    d = None
+    for t in range(5):
+        d = pol.observe(
+            _shard_snap(q0=0.0, q1=0.0), now=float(t),
+            current_of={0: 2, 1: 1},
+        )
+        if d.action != "hold":
+            break
+    assert d.action == "down" and d.shard == 0 and d.target == 1
+    # with every pool at min, clear windows decide nothing
+    pol2 = ShardAutoscalePolicy(cfg, num_shards=2)
+    for t in range(6):
+        d = pol2.observe(
+            _shard_snap(), now=float(t), current_of={0: 1, 1: 1}
+        )
+    assert d.action == "hold"
+
+
+def test_shard_policy_dark_shard_holds_not_drains():
+    """A shard whose replicas all stop reporting (its queue key is
+    ABSENT from the snapshot, not 0.0) must HOLD: the fleet-wide
+    freshness guard can't see one dark shard among fresh ones, and
+    reading absence as 'idle' would drain exactly the pool the
+    controller is blind to."""
+    from gene2vec_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        ShardAutoscalePolicy,
+        shard_snapshot,
+    )
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_per_replica=8.0,
+        down_queue_per_replica=1.0, up_after_ticks=2,
+        down_after_ticks=3, cooldown_s=0.0,
+    )
+    # shard 1 dark: only shard 0's key exists; fleet freshness is high
+    snap = {"fleet_shard_queue_depth{shard=0}": 0.0,
+            "_fresh_targets": 4.0}
+    sub = shard_snapshot(snap, 1, cfg.p99_route)
+    assert sub["_fresh_targets"] == 0.0
+    pol = ShardAutoscalePolicy(cfg, num_shards=2)
+    d = None
+    for t in range(6):
+        d = pol.observe(snap, now=float(t), current_of={0: 2, 1: 2})
+        if d.action != "hold":
+            break
+    # the observable idle pool scales down; the dark one never does
+    assert d.action == "down" and d.shard == 0
+    assert pol.policies[1].observe(
+        sub, now=99.0, current=2
+    ).action == "hold"
+
+
+def test_shard_policy_stale_snapshot_holds():
+    from gene2vec_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        ShardAutoscalePolicy,
+    )
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_after_ticks=2,
+        cooldown_s=0.0,
+    )
+    pol = ShardAutoscalePolicy(cfg, num_shards=2)
+    for t in range(4):
+        d = pol.observe(
+            _shard_snap(q1=100.0, fresh=0.0), now=float(t),
+            current_of={0: 1, 1: 1},
+        )
+        assert d.action == "hold"
+        assert "stale" in d.reason
+
+
+def test_shard_controller_applies_shard_scoped_actions():
+    from gene2vec_tpu.obs.registry import MetricsRegistry as MR
+    from gene2vec_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        ShardElasticController,
+    )
+    from gene2vec_tpu.serve.client import InFlightTracker
+    from gene2vec_tpu.serve.fleet import ReplicaState
+
+    class GridFake:
+        def __init__(self):
+            self.counts = {0: 1, 1: 1}
+            self.calls = []
+            from gene2vec_tpu.serve.fleet import FleetConfig
+            self.config = FleetConfig(contract_timeout_s=2.0)
+
+        def active_count(self, shard=None):
+            if shard is None:
+                return sum(self.counts.values())
+            return self.counts[shard]
+
+        def scale_up(self, shard=None):
+            self.calls.append(("up", shard))
+            self.counts[shard] += 1
+            return type("R", (), {
+                "url": "http://new", "state": ReplicaState.UP,
+                "alive": True, "spawning": False, "index": 9,
+                "shard": shard,
+            })()
+
+        def pick_drain_victim(self, shard=None):
+            self.calls.append(("victim", shard))
+            return None
+
+    class P:
+        inflight = InFlightTracker()
+
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=2, up_queue_per_replica=8.0,
+        up_after_ticks=2, cooldown_s=0.0,
+    )
+    sup = GridFake()
+    mr = MR()
+    ctrl = ShardElasticController(
+        sup, P(), cfg, num_shards=2, metrics=mr,
+    )
+    import time as _t
+    for _ in range(3):
+        ctrl.observe(_shard_snap(q1=50.0))
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline and ("up", 1) not in sup.calls:
+        _t.sleep(0.01)
+    assert ("up", 1) in sup.calls, (
+        "the hot shard's pool never got its sibling"
+    )
+    assert sup.counts == {0: 1, 1: 2}
+    # the gauge pair stays fleet-wide comparable: shard 1's pool
+    # target 1 -> 2 publishes as fleet 2 -> 3, never active=2/target=2
+    # of one pool masquerading as the fleet
+    assert mr.gauge("fleet_replicas_target").value == 3
+    # every pool's active gauge refreshes on the next tick — not just
+    # the deciding shard's, and not frozen at the pre-action size
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline:
+        ctrl.observe(_shard_snap())
+        if mr.gauge("fleet_shard_replicas_active",
+                    labels={"shard": "1"}).value == 2:
+            break
+        _t.sleep(0.01)
+    assert mr.gauge("fleet_shard_replicas_active",
+                    labels={"shard": "0"}).value == 1
+    assert mr.gauge("fleet_shard_replicas_active",
+                    labels={"shard": "1"}).value == 2
+    ctrl.stop()
+
+
+# -- aggregator per-shard signals + the redundancy alert ---------------------
+
+
+def test_aggregator_exports_per_shard_signals():
+    from gene2vec_tpu.obs.aggregate import FleetAggregator
+
+    expos = {
+        "http://s0a": (
+            "serve_queue_depth 3\n"
+            'serve_route_seconds_bucket{route="/v1/shard/topk",'
+            'le="0.1"} 90\n'
+            'serve_route_seconds_bucket{route="/v1/shard/topk",'
+            'le="+Inf"} 100\n'
+        ),
+        "http://s0b": "serve_queue_depth 2\n",
+        "http://s1a": (
+            "serve_queue_depth 10\n"
+            'serve_route_seconds_bucket{route="/v1/shard/topk",'
+            'le="0.1"} 5\n'
+            'serve_route_seconds_bucket{route="/v1/shard/topk",'
+            'le="0.5"} 20\n'
+            'serve_route_seconds_bucket{route="/v1/shard/topk",'
+            'le="+Inf"} 20\n'
+        ),
+    }
+    agg = FleetAggregator(
+        list(expos), interval_s=0, fetch=lambda u, t: expos[u],
+    )
+    agg.shard_of = lambda u: 0 if "s0" in u else 1
+    agg.shard_facts = lambda: {
+        0: {"up": 2, "desired": 2}, 1: {"up": 1, "desired": 2},
+    }
+    snaps = []
+    agg.observers.append(lambda snap, wall=None: snaps.append(snap))
+    agg.scrape_once()
+    snap = snaps[-1]
+    assert snap["fleet_shard_queue_depth{shard=0}"] == 5.0
+    assert snap["fleet_shard_queue_depth{shard=1}"] == 10.0
+    # shard 0's p99 lands in the first bucket; shard 1's in the second
+    assert snap["fleet_shard_p99_seconds{shard=0}"] == 0.1
+    assert snap["fleet_shard_p99_seconds{shard=1}"] == 0.5
+    assert snap["fleet_shard_replicas_up{shard=0}"] == 2.0
+    assert snap["fleet_shard_replicas_up{shard=1}"] == 1.0
+    # shard 1 is one failure from recall loss: redundancy lost
+    assert snap["fleet_shards_redundancy_lost"] == 1.0
+    text = agg.fleet_text()
+    assert 'fleet_shard_replicas_up{shard="1"} 1' in text
+    assert "fleet_shards_redundancy_lost 1" in text
+    # shard 1 stops reporting (its only target dies): the queue gauge
+    # retires on the first missed round, the p99 gauge once the target
+    # goes stale — a dead shard must not freeze its last values on
+    # /metrics/fleet (supervisor-truth replicas_up stays)
+    expos.pop("http://s1a")
+    for _ in range(4):
+        agg.scrape_once()
+    text = agg.fleet_text()
+    assert 'fleet_shard_queue_depth{shard="1"}' not in text
+    assert 'fleet_shard_p99_seconds{shard="1"}' not in text
+    assert 'fleet_shard_queue_depth{shard="0"}' in text
+    assert 'fleet_shard_replicas_up{shard="1"}' in text
+
+
+def test_aggregator_without_shard_hooks_emits_no_shard_keys():
+    from gene2vec_tpu.obs.aggregate import FleetAggregator
+
+    agg = FleetAggregator(
+        ["http://a"], interval_s=0,
+        fetch=lambda u, t: "serve_queue_depth 1\n",
+    )
+    snaps = []
+    agg.observers.append(lambda snap, wall=None: snaps.append(snap))
+    agg.scrape_once()
+    assert not any("shard" in k for k in snaps[-1])
+
+
+def test_shard_redundancy_lost_rule_fires_and_clears():
+    from gene2vec_tpu.obs.alerts import AlertEvaluator, default_rules
+
+    rules = [
+        r for r in default_rules()
+        if r.name == "shard-redundancy-lost"
+    ]
+    assert rules, "default rules lost the shard-redundancy-lost rule"
+    clock = {"t": 0.0}
+    ev = AlertEvaluator(rules, clock=lambda: clock["t"])
+
+    def tick(value, dt=1.0):
+        clock["t"] += dt
+        snap = {"_fresh_targets": 2.0}
+        if value is not None:
+            snap["fleet_shards_redundancy_lost"] = value
+        return ev.observe(snap, now=clock["t"])
+
+    # unsharded fleet: the selector is absent — holds forever
+    assert tick(None) == []
+    assert ev.states()["shard-redundancy-lost"] == "inactive"
+    # a sibling dies: fires immediately (for_s = 0)
+    recs = tick(1.0)
+    assert any(r["to"] == "firing" for r in recs)
+    # still down during the full-group outage: keeps firing
+    assert tick(2.0) == []
+    assert ev.states()["shard-redundancy-lost"] == "firing"
+    # re-admit: clears after the clear window
+    tick(0.0)
+    recs = tick(0.0, dt=15.0)
+    assert any(r["to"] == "inactive" for r in recs)
+
+
+# -- the failover gate -------------------------------------------------------
+
+
+def test_passes_shard_failover_degraded_with_live_replica_gates(
+    tmp_path,
+):
+    doc = _good_shard_doc()
+    doc["shard"]["drill"]["failover"]["degraded_responses"] = 3
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "LIVE" in fs[0].message
+
+
+def test_passes_shard_missing_failover_section_gates(tmp_path):
+    doc = _good_shard_doc()
+    del doc["shard"]["drill"]["failover"]
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "failover" in fs[0].message
+
+
+def test_passes_shard_failover_p99_and_both_dead_gate(tmp_path):
+    doc = _good_shard_doc()
+    doc["shard"]["drill"]["failover"]["p99_ms"] = 9000.0
+    doc["shard"]["drill"]["failover"]["both_dead"][
+        "degraded_responses"] = 0
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1
+    assert "p99" in fs[0].message and "both-dead" in fs[0].message
+
+
+def test_passes_shard_failover_off_recipe_gates(tmp_path):
+    doc = _good_shard_doc()
+    doc["shard"]["drill"]["failover"]["replicas_per_shard"] = 1
+    fs = _gating(_findings(tmp_path, doc))
+    assert len(fs) == 1 and "replicas per shard" in fs[0].message
+
+
+# -- loadgen grid parsing ----------------------------------------------------
+
+
+def test_parse_shard_grid_learns_replica_groups(loadgen):
+    health = {
+        "shards": [
+            {"index": 0, "rows": [0, 12], "up": True,
+             "replicas": [{"index": 0, "up": True, "epoch": 1},
+                          {"index": 1, "up": False, "epoch": 1}]},
+            {"index": 1, "rows": [12, 24], "up": True,
+             "replicas": [{"index": 2, "up": True, "epoch": 1},
+                          {"index": 3, "up": True, "epoch": 1}]},
+        ],
+    }
+    ranges, replicas = loadgen.parse_shard_grid(health)
+    assert ranges == {0: (0, 12), 1: (12, 24)}
+    assert replicas == {0: 2, 1: 2}
+    # pre-grid healthz (no replicas key): one replica per shard
+    for s in health["shards"]:
+        del s["replicas"]
+    _, replicas = loadgen.parse_shard_grid(health)
+    assert replicas == {0: 1, 1: 1}
+    assert loadgen.parse_shard_grid({"status": "ok"}) is None
+
+
+def test_fleet_cli_validates_grid_flags(tmp_path, capsys):
+    from gene2vec_tpu.cli import fleet as fleet_cli
+
+    base = ["--export-dir", str(tmp_path)]
+    # replicas-per-shard needs shard mode
+    assert fleet_cli.main(base + ["--replicas-per-shard", "2"]) == 2
+    assert fleet_cli.main(
+        base + ["--shard-by-rows", "2", "--replicas-per-shard", "0"]
+    ) == 2
+    # sharded autoscale bounds apply PER SHARD POOL
+    assert fleet_cli.main(
+        base + ["--shard-by-rows", "2", "--replicas-per-shard", "3",
+                "--max-replicas", "2"]
+    ) == 2
+    # a missing head checkpoint fails in milliseconds, not after spawns
+    assert fleet_cli.main(
+        base + ["--shard-by-rows", "2",
+                "--ggipnn-checkpoint", str(tmp_path / "nope.npz")]
+    ) == 2
+    capsys.readouterr()
