@@ -568,6 +568,248 @@ def timeline_overhead(
     return bench_stamp(doc)
 
 
+def kernel_profile_bench(recipe: dict) -> dict:
+    """Kernel cost-attribution bench (``--kernel-profile``): roofline
+    records for every registered compute hot path at the pinned recipe
+    (budgets.json ``kernels.profile``), stamped into
+    ``BENCH_KERNELS_r*.json`` and gated by ``analysis/passes_kernels.py``.
+
+    Two halves.  (1) ATTRIBUTION: each kernel is AOT lowered+compiled
+    (static FLOPs / bytes accessed / peak memory from XLA's
+    compiled-computation cost analysis, plus lowering/compile wall
+    seconds) and then executed through its PRODUCTION entry point —
+    donated-buffer epoch fns are timed by threading state through
+    real epochs, never by replaying consumed args — deriving
+    achieved-vs-peak utilization against the per-backend peak table
+    (obs/profiler.py).  (2) OVERHEAD: the profiler's only steady-state
+    cost is one ``kp.observe`` per epoch (attribution is warm-time;
+    nothing runs per batch inside the scan), measured with the
+    BENCH_OBS/BENCH_PERF methodology — one warmed trainer, alternating
+    off/on window pairs, median per arm."""
+    import jax
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.config import GGIPNNConfig, SGNSConfig
+    from gene2vec_tpu.models.ggipnn_data import PairTextVocab
+    from gene2vec_tpu.models.ggipnn_train import GGIPNNTrainer
+    from gene2vec_tpu.obs import profiler as prof
+    from gene2vec_tpu.serve import ann as ann_mod
+    from gene2vec_tpu.serve.engine import BucketedTopKEngine
+    from gene2vec_tpu.sgns.cbow_hs import CBOWHSTrainer
+    from gene2vec_tpu.sgns.train import SGNSTrainer
+
+    dim = int(recipe.get("dim", 64))
+    vocab = int(recipe.get("vocab", 2048))
+    num_pairs = int(recipe.get("num_pairs", 65536))
+    batch_pairs = int(recipe.get("batch_pairs", 2048))
+    serve_rows = int(recipe.get("serve_rows", 2048))
+    serve_dim = int(recipe.get("serve_dim", 64))
+    serve_batch = int(recipe.get("serve_batch", 16))
+    serve_k = int(recipe.get("serve_k", 16))
+    serve_clusters = int(recipe.get("serve_clusters", 64))
+    ggipnn_pairs = int(recipe.get("ggipnn_pairs", 8192))
+    ggipnn_batch = int(recipe.get("ggipnn_batch", 512))
+    rounds = int(recipe.get("rounds", 5))
+    epochs_per_window = int(recipe.get("epochs_per_window", 2))
+
+    p = prof.KernelProfiler()
+    key = jax.random.PRNGKey(0)
+
+    # --- sgns_train_step: attribute the epoch fn, then time REAL epochs
+    # threading params (the epoch fn donates its buffers — replaying a
+    # consumed params arg would crash, docs/PERF_NOTES.md)
+    log("=== kernel profile: sgns_train_step ===")
+    corpus = synth_corpus(vocab, num_pairs)
+    trainer = SGNSTrainer(
+        corpus, SGNSConfig(dim=dim, batch_pairs=batch_pairs)
+    )
+    params = trainer.init()
+    p.attribute(
+        "sgns_train_step", trainer._epoch_fn,
+        (params, trainer.pairs, trainer.noise, jax.random.fold_in(key, 0)),
+    )
+    for w in range(2):  # epoch 1 compiles, epoch 2 pays the relayout
+        params, loss = trainer.train_epoch(params, jax.random.fold_in(key, w))
+        float(loss)
+    for e in range(3):
+        t0 = time.perf_counter()
+        params, loss = trainer.train_epoch(
+            params, jax.random.fold_in(key, 100 + e)
+        )
+        float(loss)
+        p.observe("sgns_train_step", time.perf_counter() - t0)
+
+    # --- cbow_hs_step: same discipline via the trainer's profile hook
+    log("=== kernel profile: cbow_hs_step ===")
+    ctrainer = CBOWHSTrainer(
+        corpus, SGNSConfig(
+            dim=dim, batch_pairs=batch_pairs, objective="cbow_hs"
+        )
+    )
+    cparams = ctrainer.init()
+    ctrainer.profile_kernel(p, params=cparams)
+    for w in range(2):
+        cparams, loss = ctrainer.train_epoch(
+            cparams, jax.random.fold_in(key, w)
+        )
+        float(loss)
+    for e in range(3):
+        t0 = time.perf_counter()
+        cparams, loss = ctrainer.train_epoch(
+            cparams, jax.random.fold_in(key, 100 + e)
+        )
+        float(loss)
+        p.observe("cbow_hs_step", time.perf_counter() - t0)
+
+    # --- ggipnn_step: static cost is ONE train step (the trainer's
+    # profile hook jits the non-donating step impl); dynamic epochs are
+    # divided back to per-step via observe(calls=num_batches)
+    log("=== kernel profile: ggipnn_step ===")
+    rng = np.random.RandomState(0)
+    gx = jnp.asarray(
+        rng.randint(0, vocab, (ggipnn_pairs, 2)).astype(np.int32)
+    )
+    gy = jnp.asarray(
+        np.eye(2, dtype=np.float32)[rng.randint(0, 2, ggipnn_pairs)]
+    )
+    gvocab = PairTextVocab().fit(f"G{i} G{i}" for i in range(vocab))
+    gtrainer = GGIPNNTrainer(
+        GGIPNNConfig(batch_size=ggipnn_batch, num_epochs=1, scan_fit=True),
+        gvocab,
+    )
+    gparams, gopt = gtrainer.init_state()
+    gtrainer.profile_kernel(
+        p, gparams, gopt, gx[:ggipnn_batch], gy[:ggipnn_batch]
+    )
+    gnb = ggipnn_pairs // ggipnn_batch
+    for w in range(2):
+        gparams, gopt, loss, _ = gtrainer.fit_epoch(
+            gparams, gopt, gx, gy, jax.random.fold_in(key, w)
+        )
+        float(loss)
+    for e in range(3):
+        t0 = time.perf_counter()
+        gparams, gopt, loss, _ = gtrainer.fit_epoch(
+            gparams, gopt, gx, gy, jax.random.fold_in(key, 100 + e)
+        )
+        float(loss)
+        p.observe("ggipnn_step", time.perf_counter() - t0, calls=gnb)
+
+    # --- serve top-k bucket per index mode + the raw int8 ANN scan
+    log("=== kernel profile: serve engine buckets ===")
+    table = _ann_clustered_table(serve_rows, serve_dim, serve_clusters, 0)
+    unit = jnp.asarray(table)
+    unit.block_until_ready()
+    quant = ann_mod.build_index(table, "quant")
+    ivf = ann_mod.build_index(table, "ivf", clusters=serve_clusters, seed=0)
+    qs = table[:serve_batch]
+    for mode, idx in (("exact", None), ("quant", quant), ("ivf", ivf)):
+        eng = BucketedTopKEngine(max_batch=serve_batch, index=mode)
+        recs = eng.profile_buckets(
+            unit, k=serve_k, ann_index=idx, buckets=[serve_batch]
+        )
+        rec = next(iter(recs.values()))
+        name = f"serve_topk_{mode}"
+        p.register_costs(name, {
+            f: rec.get(f) for f in (
+                "flops", "bytes_accessed", "peak_memory_bytes",
+                "lower_s", "compile_s",
+            )
+        })
+        if mode == "exact":
+            call = lambda: eng.top_k(unit, qs, serve_k)  # noqa: E731
+        else:
+            call = (  # noqa: E731
+                lambda i=idx, e=eng: e.top_k_ann(i, unit, qs, serve_k)
+            )
+        call()  # warm this bucket (returns host arrays: synced)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            call()
+            p.observe(name, time.perf_counter() - t0)
+    scan = jax.jit(ann_mod._approx_scores)
+    scan_args = (jnp.asarray(qs), quant.table_q, quant.scale)
+    p.attribute("ann_int8_scan", scan, scan_args)
+    p.measure("ann_int8_scan", scan, scan_args, iters=3, warmup=1)
+
+    # --- overhead: profiler-on vs profiler-off SGNS windows, the
+    # timeline_overhead methodology (alternating arm order, median per
+    # arm); the ON arm's whole steady-state cost is one observe/epoch
+    log("=== kernel profile: overhead windows ===")
+    pairs_per_epoch = trainer.num_batches * trainer.config.batch_pairs
+    kp_arm = prof.KernelProfiler()
+    rates: dict = {False: [], True: []}
+    e = 0
+    for r in range(rounds):
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for arm in order:
+            t0 = time.perf_counter()
+            for _ in range(epochs_per_window):
+                te = time.perf_counter()
+                params, loss = trainer.train_epoch(
+                    params, jax.random.fold_in(key, 200 + e)
+                )
+                float(loss)
+                if arm:
+                    kp_arm.observe(
+                        "sgns_train_step", time.perf_counter() - te
+                    )
+                e += 1
+            dt = time.perf_counter() - t0
+            rates[arm].append(pairs_per_epoch * epochs_per_window / dt)
+    off = float(np.median(rates[False]))
+    on = float(np.median(rates[True]))
+    overhead = {
+        "window_rates_off": [round(v, 1) for v in rates[False]],
+        "window_rates_on": [round(v, 1) for v in rates[True]],
+        "rate_profile_off": round(off, 1),
+        "rate_profile_on": round(on, 1),
+        "regression_frac": round((off - on) / off, 4) if off > 0 else None,
+    }
+    log(
+        f"kernel-profile overhead: off {off:,.0f} on {on:,.0f} pairs/s, "
+        f"regression {overhead['regression_frac']}"
+    )
+
+    kernels: dict = {}
+    for rec in p.records():
+        kernels[rec["name"]] = {
+            "flops": rec["flops"],
+            "bytes_accessed": rec["bytes_accessed"],
+            "peak_memory_bytes": rec["peak_memory_bytes"],
+            "lower_s": rec["lower_s"],
+            "compile_s": rec["compile_s"],
+            "calls": rec["calls"],
+            # the pinned-shape headline: best observed per-call wall
+            "wall_s": rec["best_wall_s"],
+            "achieved_flops_per_sec": rec["achieved_flops_per_sec"],
+            "achieved_bytes_per_sec": rec["achieved_bytes_per_sec"],
+            "flops_util": rec["flops_util"],
+            "bytes_util": rec["bytes_util"],
+            "utilization": rec["utilization"],
+            "bound": rec["bound"],
+        }
+        log(
+            f"{rec['name']}: flops {rec['flops']}  bytes "
+            f"{rec['bytes_accessed']}  best "
+            f"{rec['best_wall_s']}s  util {rec['utilization']}"
+        )
+    doc = {
+        "bench": "kernels",
+        "recipe": {
+            "dim": dim, "vocab": vocab, "num_pairs": num_pairs,
+            "batch_pairs": batch_pairs, "serve_rows": serve_rows,
+            "serve_dim": serve_dim, "serve_batch": serve_batch,
+            "serve_k": serve_k, "serve_clusters": serve_clusters,
+            "rounds": rounds, "epochs_per_window": epochs_per_window,
+        },
+        "backend": {**p.backend, **p.peaks},
+        "kernels": kernels,
+        "overhead": overhead,
+    }
+    return bench_stamp(doc)
+
+
 def _ann_clustered_table(
     rows: int, dim: int, clusters: int, seed: int, spread: float = 0.35
 ) -> np.ndarray:
@@ -887,7 +1129,31 @@ def main() -> None:
                     "this on either table")
     ap.add_argument("--ann-out", default="BENCH_ANN_r12.json",
                     help="output path for --ann")
+    ap.add_argument("--kernel-profile", action="store_true",
+                    help="attribute static XLA costs (flops/bytes/peak "
+                    "memory + compile seconds) and timed achieved "
+                    "throughput for every registered compute hot path "
+                    "(SGNS/CBOW-HS/GGIPNN steps, serve top-k per index "
+                    "mode, int8 ANN scan) at the recipe pinned in "
+                    "budgets.json 'kernels', plus the profiling-overhead "
+                    "windows, and write --kernels-out (the BENCH_KERNELS "
+                    "artifact analysis/passes_kernels.py gates); skips "
+                    "the normal bench pipeline")
+    ap.add_argument("--kernels-out", default="BENCH_KERNELS_r18.json",
+                    help="output path for --kernel-profile")
     args = ap.parse_args()
+
+    if args.kernel_profile:
+        from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+        recipe = load_budgets().get("kernels", {}).get("profile", {})
+        doc = kernel_profile_bench(recipe)
+        with open(args.kernels_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        log(f"wrote {args.kernels_out}")
+        print(json.dumps(doc))
+        return
 
     if args.ann:
         from gene2vec_tpu.analysis.passes_hlo import load_budgets

@@ -677,6 +677,70 @@ class EventLoopBlockingPass(Pass):
                     )
 
 
+_PROFILER_TIME_CALLS = {
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.process_time",
+    "time.time",
+}
+
+
+class ProfilerHookInJitPass(TracedScopePass):
+    """Profiling/timing hooks inside traced code: ``time.perf_counter``
+    and friends, ``kernel_span`` wrappers, and compiled-computation
+    ``cost_analysis`` probes.  Inside a traced body the clock is read
+    ONCE at trace time and baked into the compiled program as a
+    constant — the "measurement" never moves again — and a
+    cost-analysis hook traced into the program recompiles it.  The
+    attribution plane (obs/profiler.py) is warm-time/epoch-level by
+    contract (budgets.json ``kernels.profile``): attribute at compile,
+    observe OUTSIDE the traced step, never per batch inside the scan.
+    """
+
+    id = "profiler-hook-in-jit"
+    title = "profiling/timing hook inside jit/scan"
+
+    def check(self, mod, imports, tf, params):
+        for node in _iter_own_body(tf.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "cost_analysis":
+                yield self.finding(
+                    mod, node,
+                    f".cost_analysis() inside traced function "
+                    f"'{tf.name}' traces the probe into the compiled "
+                    "program (traced via "
+                    f"{tf.reason}); attribute AOT at warm time "
+                    "(obs/profiler.py KernelProfiler.attribute)",
+                )
+                continue
+            chain = chain_of(fn)
+            if chain is None:
+                continue
+            resolved = resolve_chain(chain, imports)
+            if resolved in _PROFILER_TIME_CALLS:
+                yield self.finding(
+                    mod, node,
+                    f"{chain}() inside traced function '{tf.name}' "
+                    "reads the host clock once at trace time and bakes "
+                    "it in as a constant (traced via "
+                    f"{tf.reason}); time the call site outside the "
+                    "trace and feed KernelProfiler.observe",
+                )
+            elif chain.split(".")[-1] == "kernel_span":
+                yield self.finding(
+                    mod, node,
+                    f"kernel_span(...) inside traced function "
+                    f"'{tf.name}' puts the attribution hook on the "
+                    "traced path (traced via "
+                    f"{tf.reason}); kernel attribution is warm-time/"
+                    "epoch-level, never per-batch inside the scan",
+                )
+
+
 ALL_PASSES = (
     BarePrintPass(),
     HostSyncInJitPass(),
@@ -687,4 +751,5 @@ ALL_PASSES = (
     CkptBlockingIOPass(),
     SpanHygienePass(),
     EventLoopBlockingPass(),
+    ProfilerHookInJitPass(),
 )

@@ -1,0 +1,204 @@
+"""Kernel-attribution budget gate: the committed BENCH_KERNELS record
+vs budgets.json ``kernels.profile``.
+
+jax-free and I/O-only so it rides the DEFAULT ``cli.analyze`` tier
+(the passes_perf / passes_obs shape): ``python bench.py
+--kernel-profile`` attributes static XLA costs (flops / bytes accessed
+/ peak memory + compile seconds) and timed achieved throughput for
+every registered compute hot path at the recipe pinned in
+``kernels.profile``, measures the profiling overhead with the
+alternating-window methodology, and stamps ``BENCH_KERNELS_r*.json``;
+this pass re-checks the committed record.
+
+* a MISSING bench is an *info* finding (a fresh checkout must not fail
+  lint before its first bench);
+* an unreadable record, a record missing a required kernel or a
+  required per-kernel field (``require_kernels`` / ``require_fields``
+  — a bench that silently drops a kernel or a cost column must gate
+  like a regression), a record measured off-recipe, or a profiling
+  overhead past ``max_overhead_fraction`` gates hard.
+
+The per-kernel trajectory (utilization, overhead) is additionally
+watched by the ``perf.regression`` rules through the ledger's
+``kernels`` family (:mod:`gene2vec_tpu.obs.ledger`).
+
+``GENE2VEC_TPU_KERNELS_ROOT`` overrides the artifact root (planted
+fixtures and CI sandboxes point it at a staged directory).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.runner import REPO_ROOT
+
+KERNELS_ROOT_ENV = "GENE2VEC_TPU_KERNELS_ROOT"
+BENCH_KERNELS_NAME = "BENCH_KERNELS_r18.json"
+
+_PASS = "kernels-attribution-budget"
+
+#: recipe keys the budget pins — geometry AND window shape must match
+#: the committed record, or a lucky tiny window passes the overhead
+#: gate by variance (the passes_obs lesson)
+_RECIPE_KEYS = (
+    "dim", "vocab", "num_pairs", "batch_pairs", "serve_rows",
+    "serve_dim", "serve_batch", "serve_k", "serve_clusters",
+    "rounds", "epochs_per_window",
+)
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def kernels_root() -> str:
+    return os.environ.get(KERNELS_ROOT_ENV) or REPO_ROOT
+
+
+def _newest_kernels_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_KERNELS_r*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties) — round convention, like the
+    ledger, not one filename pinned forever."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        if ledger.match_family(name) and name.startswith("BENCH_KERNELS"):
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime, path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def kernels_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Check the newest committed BENCH_KERNELS record against the
+    ``kernels.profile`` budget."""
+    budget = load_budgets(budgets_path).get("kernels", {}).get("profile")
+    if not isinstance(budget, dict):
+        return []
+    root = root or kernels_root()
+    path = _newest_kernels_bench(root) or os.path.join(
+        root, BENCH_KERNELS_NAME
+    )
+    label = os.path.basename(path)
+    if not os.path.exists(path):
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path=label,
+            message=(
+                f"no kernel-attribution bench recorded yet ({label} "
+                "missing); run `python bench.py --kernel-profile` (it "
+                "reads the pinned recipe from budgets.json 'kernels') "
+                "to stamp one"
+            ),
+        )]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable kernel-attribution bench: {e}",
+        )]
+
+    kernels = bench.get("kernels")
+    kernels = kernels if isinstance(kernels, dict) else {}
+    overhead = bench.get("overhead")
+    overhead = overhead if isinstance(overhead, dict) else {}
+    recipe = bench.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    ceiling = float(budget.get("max_overhead_fraction", 0.02))
+    regression = _get(overhead, "regression_frac")
+    require_kernels = [
+        str(k) for k in budget.get("require_kernels", [])
+    ]
+    require_fields = [
+        str(k) for k in budget.get("require_fields", [])
+    ]
+    data: Dict = {
+        "kernels": sorted(kernels),
+        "regression_frac": regression,
+        "max_overhead_fraction": ceiling,
+        "recipe": recipe,
+    }
+    problems: List[str] = []
+    # the artifact CONTRACT: every required kernel present with every
+    # required field — a bench that drops serve_topk_ivf or stops
+    # recording utilization must gate, not silently shrink coverage
+    for name in require_kernels:
+        rec = kernels.get(name)
+        if not isinstance(rec, dict):
+            problems.append(f"required kernel {name!r} missing")
+            continue
+        for field in require_fields:
+            if _get(rec, field) is None:
+                problems.append(
+                    f"kernel {name!r} missing required field {field!r}"
+                )
+    if regression is None:
+        problems.append(
+            "overhead.regression_frac missing from the bench record"
+        )
+    elif regression > ceiling:
+        problems.append(
+            f"profiler-on vs profiler-off throughput regression "
+            f"{regression:.4f} > budget {ceiling} (kernel attribution "
+            "grew a steady-state cost — it must stay warm-time/"
+            "epoch-level, never per-batch)"
+        )
+    for key in _RECIPE_KEYS:
+        pinned = budget.get(key)
+        if pinned is None:
+            continue
+        measured = _get(recipe, key)
+        data[f"budget_{key}"] = pinned
+        if measured is None:
+            problems.append(f"recipe.{key} missing from the bench record")
+        elif float(pinned) != measured:
+            problems.append(
+                f"bench measured with {key}={measured:g} but the budget "
+                f"pins {key}={pinned:g} — re-run `python bench.py "
+                "--kernel-profile`"
+            )
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "kernel-attribution record violates the kernels budget: "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"{len(kernels)} kernels attributed "
+            f"({', '.join(sorted(require_kernels))} required); "
+            f"profiling overhead {regression:+.4f} within budget "
+            f"(<= {ceiling})"
+        ),
+        data=data,
+    )]

@@ -157,6 +157,12 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_perf import perf_findings
 
         findings.extend(perf_findings())
+        # ... and the kernel-attribution gate (BENCH_KERNELS roofline
+        # records: required kernels/fields + profiling-overhead ceiling
+        # vs budgets.json "kernels.profile", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_kernels import kernels_findings
+
+        findings.extend(kernels_findings())
         # ... and the serve front-end capacity gate (BENCH_SERVE's
         # capacity/fleet_capacity sections vs budgets.json
         # "serve.capacity_rps", recipe-pinned)

@@ -18,6 +18,12 @@
   loadable in Perfetto (https://ui.perfetto.dev) or
   ``chrome://tracing`` — train step-phase swimlanes and serve request
   traces in one viewer;
+* ``python -m gene2vec_tpu.cli.obs kernels <run_dir>`` — render the
+  kernel cost-attribution records (``kernels.jsonl``, written by
+  :mod:`gene2vec_tpu.obs.profiler` when a run enables
+  ``kernel_profile``) as a roofline table: static XLA flops/bytes,
+  best observed wall, achieved-vs-peak utilization and the binding
+  resource per kernel (exit 1 when no records exist);
 * ``python -m gene2vec_tpu.cli.obs ledger [root]`` — ingest every
   root bench artifact through the per-family adapters
   (gene2vec_tpu/obs/ledger.py, docs/BENCHMARKS.md) into the unified
@@ -95,6 +101,15 @@ def build_parser() -> argparse.ArgumentParser:
     inc.add_argument("bundle", help="incident bundle directory")
     inc.add_argument("--json", action="store_true",
                      help="emit the bundle facts as JSON")
+    ker = sub.add_parser(
+        "kernels",
+        help="render the kernel cost-attribution records "
+             "(kernels.jsonl) of a run dir as a roofline table",
+    )
+    ker.add_argument("run_dir", help="run directory holding kernels.jsonl "
+                     "(a trainer export dir, or one level above)")
+    ker.add_argument("--json", action="store_true",
+                     help="emit the kernel records as JSON")
     led = sub.add_parser(
         "ledger",
         help="unified bench ledger over the root bench artifacts",
@@ -280,6 +295,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 1
+        return 0
+
+    if args.command == "kernels":
+        from gene2vec_tpu.obs import profiler as profiler_mod
+
+        if not os.path.isdir(args.run_dir):
+            print(f"obs kernels: {args.run_dir} is not a directory",
+                  file=sys.stderr)
+            return 2
+        records = profiler_mod.read_kernels(args.run_dir)
+        if not records:
+            # exit 1 when no attribution exists — scripts assert "the
+            # profiler recorded something" without parsing
+            print(
+                f"obs kernels: no kernels.jsonl records under "
+                f"{args.run_dir} (enable kernel_profile / "
+                "--kernel-profile on the producing run)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.json:
+            print(json.dumps(records, indent=1, default=str))
+        else:
+            print(profiler_mod.format_kernels(records))
         return 0
 
     if args.command == "ledger":

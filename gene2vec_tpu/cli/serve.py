@@ -128,6 +128,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "headroom per query)")
     p.add_argument("--ann-clusters", type=int, default=None,
                    help="IVF centroid count (default ~4*sqrt(vocab))")
+    p.add_argument("--kernel-profile", action="store_true",
+                   help="AOT-compile every engine batch bucket at "
+                        "startup and publish per-bucket kernel cost "
+                        "gauges (flops/bytes/compile seconds) on "
+                        "/metrics; costs one compile pass per bucket "
+                        "(docs/OBSERVABILITY.md"
+                        "#kernel-attribution--rooflines)")
     p.add_argument("--tenant-quota", type=float, default=0.0,
                    metavar="RATE",
                    help="per-tenant token-bucket quota in requests/s "
@@ -260,6 +267,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             index=args.index,
             nprobe=args.nprobe,
             rescore_mult=args.rescore_mult,
+            kernel_profile=args.kernel_profile,
             burst_threshold=args.burst_threshold,
             burst_window_s=args.burst_window,
             tenant_rate=args.tenant_quota,
@@ -274,6 +282,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # flight recorder: 5xx bursts dump into the run dir automatically;
     # SIGQUIT dumps on demand (kill -QUIT <pid> during an incident)
     app.flight_dir = run.run_dir
+    if args.kernel_profile:
+        profiled = app.profile_kernels()
+        print(
+            f"kernel profile: {len(profiled)} engine buckets "
+            f"attributed ({args.index})",
+            file=sys.stderr,
+        )
 
     import signal
 

@@ -198,6 +198,12 @@ class SGNSConfig:
                                    # timeline.py) written to timeline.jsonl;
                                    # overhead gated <= 2% by budgets.json
                                    # "perf" (BENCH_PERF_r10.json)
+    kernel_profile: bool = False   # kernel cost attribution (obs/
+                                   # profiler.py): AOT cost analysis of the
+                                   # epoch step at startup + per-epoch wall
+                                   # accounting, written to kernels.jsonl;
+                                   # overhead gated <= 2% by budgets.json
+                                   # "kernels" (BENCH_KERNELS_r18.json)
 
     # parallelism
     data_axis: str = "data"

@@ -164,6 +164,23 @@ class GGIPNNTrainer:
             )
         return self._fit_epoch_scanned(params, opt_state, x, y, num_batches, key)
 
+    def profile_kernel(
+        self, profiler, params, opt_state, batch_x, batch_y,
+        name: str = "ggipnn_step",
+    ):
+        """AOT kernel attribution of one training step
+        (``obs/profiler.py``): lower+compile cost and XLA static costs
+        under ``name``.  Profiles a fresh jit of the shared step impl —
+        same program as :meth:`train_step` minus the donation, which
+        changes no cost-analysis number — so the donated production
+        entry point's cache is untouched."""
+        # deliberately non-donating: AOT-only, never on the train path
+        step = jax.jit(self._train_step_impl)  # graftcheck: disable=missing-donate
+        key = jax.random.PRNGKey(self.config.seed)
+        return profiler.attribute(
+            name, step, (params, opt_state, batch_x, batch_y, key)
+        )
+
     # -- loops -------------------------------------------------------------
 
     def fit(

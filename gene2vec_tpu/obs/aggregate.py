@@ -291,6 +291,11 @@ class FleetAggregator:
         # scrapes until the target goes stale, so the skew/age headline
         # doesn't flicker on a single flaky scrape
         self._model_facts: Dict[str, Dict[str, float]] = {}
+        # last fleet-summed jit_compile_events_total — None until the
+        # first scrape, so the first observation seeds the baseline and
+        # fleet_jit_compile_delta starts at 0 rather than the fleet's
+        # whole compile history
+        self._last_compile_sum: Optional[float] = None
         # bounded ring of RAW per-target scrapes — the UN-merged series
         # an incident bundle files so per-replica attribution survives
         self._raw_ring: "collections.deque" = collections.deque(
@@ -591,6 +596,22 @@ class FleetAggregator:
         )
         queue_depth = msum("serve_queue_depth")
         rejection_rate = (rejected / requests) if requests > 0 else 0.0
+        # fleet-wide jit compile events: replicas mirror their
+        # CompileWatcher into the monotone jit_compile_events_total
+        # counter (reset-rebased across restarts by the merge above);
+        # the per-tick delta is what the default jit-recompile-storm
+        # rule watches — compiles during steady-state serving are a
+        # recompile storm.  The first scrape only seeds the baseline,
+        # so an aggregator joining a warm fleet never false-fires on
+        # the backlog.
+        jit_compiles = msum("jit_compile_events_total")
+        if self._last_compile_sum is None:
+            compile_delta = 0.0
+        else:
+            compile_delta = max(
+                0.0, jit_compiles - self._last_compile_sum
+            )
+        self._last_compile_sum = jit_compiles
 
         ok_total = total = throttled = degraded = 0.0
         if self.proxy_registry is not None:
@@ -636,6 +657,8 @@ class FleetAggregator:
             v.gauge("fleet_availability").set(availability)
             v.gauge("fleet_stale_targets").set(len(stale))
             v.gauge("fleet_last_scrape_unix").set(scrape_wall)
+            v.gauge("fleet_jit_compiles").set(jit_compiles)
+            v.gauge("fleet_jit_compile_delta").set(compile_delta)
             for labels in histogram_routes(fresh_hist, self.ROUTE_HISTOGRAM):
                 label_dict = dict(labels)
                 for gauge_name, q in (
@@ -734,6 +757,8 @@ class FleetAggregator:
                 "fleet_undegraded": undegraded,
                 "fleet_quota_rejected": quota_rejected,
                 "fleet_stale_targets": float(len(stale)),
+                "fleet_jit_compiles": jit_compiles,
+                "fleet_jit_compile_delta": compile_delta,
                 "_fresh_targets": float(ok_targets),
             })
             # CSV history: one row per scrape through the standard sink

@@ -271,6 +271,22 @@ def default_rules() -> List[AlertRule]:
             op=">", value=192.0, clear_value=64.0,
             for_s=5.0, clear_for_s=60.0,
         ),
+        AlertRule(
+            name="jit-recompile-storm", kind="threshold",
+            severity="warn",
+            # compiles observed fleet-wide during the last scrape tick
+            # (aggregate.py sums the replicas' jit_compile_events_total
+            # counters and deltas them per tick).  A warm bucketed
+            # engine compiles NOTHING in steady state — every padded
+            # shape is in the jit cache — so sustained nonzero deltas
+            # mean a shape leak or cache churn eating serve ticks
+            # (the hazard class graftcheck's hlo-cache-stability pass
+            # gates statically; this is the live-fleet view).  for_s
+            # spans the legitimate compile burst of a cold replica or
+            # an index-mode rollout warming its buckets.
+            metric="fleet_jit_compile_delta",
+            op=">", value=0.0, for_s=30.0, clear_for_s=60.0,
+        ),
     ]
 
 

@@ -82,6 +82,7 @@ def summarize(
     pairs_total: float = 0.0,
     peak_pairs_per_sec: Optional[float] = None,
     preempted_s: float = 0.0,
+    kernel_seconds: Optional[Dict[str, float]] = None,
 ) -> Dict:
     """The full goodput summary stamped into run manifests.
 
@@ -92,7 +93,14 @@ def summarize(
       caller passes the max per-iteration rate; falls back to pairs
       over compute-bucket seconds when not given);
     * ``utilization`` — achieved/peak: the fraction of the machine's
-      demonstrated capability the run delivered end to end.
+      demonstrated capability the run delivered end to end;
+    * ``compute_kernels`` / ``compute_kernels_s`` (only with
+      ``kernel_seconds``, the profiler's attributed wall per kernel) —
+      per-kernel breakdown OF the compute bucket, same discipline as
+      the buckets themselves: over-attribution scales down to fit the
+      bucket, under-attribution leaves an explicit ``_unattributed``
+      residual, so the kernel seconds sum to the compute bucket
+      exactly (and the wall fractions to the compute fraction).
     """
     records = list(timeline_records)
     buckets = classify(records, wall_s, preempted_s=preempted_s)
@@ -104,6 +112,20 @@ def summarize(
     peak = peak_pairs_per_sec
     if peak is None and buckets["compute"] > 0:
         peak = pairs_total / buckets["compute"]
+    kernels_s: Optional[Dict[str, float]] = None
+    if kernel_seconds is not None:
+        compute_s = buckets["compute"]
+        kernels_s = {
+            str(k): max(float(v), 0.0)
+            for k, v in kernel_seconds.items()
+            if float(v) > 0.0
+        }
+        attributed = sum(kernels_s.values())
+        if attributed > compute_s and attributed > 0.0:
+            scale = compute_s / attributed
+            kernels_s = {k: v * scale for k, v in kernels_s.items()}
+        else:
+            kernels_s["_unattributed"] = compute_s - attributed
     return {
         "wall_s": round(wall_s, 6),
         "buckets_s": {b: round(v, 6) for b, v in buckets.items()},
@@ -115,6 +137,18 @@ def summarize(
         ),
         "utilization": (
             round(achieved / peak, 4) if peak else None
+        ),
+        **(
+            {
+                "compute_kernels_s": {
+                    k: round(v, 6) for k, v in kernels_s.items()
+                },
+                "compute_kernels": {
+                    k: (round(v / wall_s, 6) if wall_s > 0 else 0.0)
+                    for k, v in kernels_s.items()
+                },
+            }
+            if kernels_s is not None else {}
         ),
     }
 
@@ -138,3 +172,7 @@ def stamp(run, summary: Dict) -> None:
         )
     if summary.get("utilization") is not None:
         run.registry.gauge("goodput_utilization").set(summary["utilization"])
+    for kernel, frac in (summary.get("compute_kernels") or {}).items():
+        run.registry.gauge(
+            "goodput_kernel_fraction", labels={"kernel": kernel}
+        ).set(frac)

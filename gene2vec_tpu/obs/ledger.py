@@ -407,6 +407,30 @@ def _adapt_loop(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "loop_answer_churn"
 
 
+def _adapt_kernels(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_KERNELS_* (bench.py --kernel-profile): per-kernel roofline
+    records — static XLA flops/bytes plus best observed wall and
+    achieved-vs-peak utilization at the pinned recipe — flattened to
+    ``kernel_<name>_*`` series, plus the profiling-overhead headline
+    the ``perf.regression`` rules watch (``kernels.profile`` budget)."""
+    m: Dict[str, float] = {}
+    kernels = doc.get("kernels")
+    kernels = kernels if isinstance(kernels, dict) else {}
+    for name, rec in kernels.items():
+        if not isinstance(rec, dict):
+            continue
+        for key in ("flops", "bytes_accessed", "wall_s", "utilization",
+                    "compile_s"):
+            _put(m, f"kernel_{name}_{key}", rec.get(key))
+    sgns = kernels.get("sgns_train_step")
+    if isinstance(sgns, dict):
+        _put(m, "kernel_sgns_utilization", sgns.get("utilization"))
+    overhead = doc.get("overhead")
+    overhead = overhead if isinstance(overhead, dict) else {}
+    _put(m, "kernel_profile_overhead_frac", overhead.get("regression_frac"))
+    return m, "kernel_profile_overhead_frac"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
@@ -417,6 +441,7 @@ ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
     (re.compile(r"^BENCH_ALERTS_\w*\.json$"), "alerts", _adapt_alerts),
     (re.compile(r"^BENCH_AUTOSCALE_\w*\.json$"), "autoscale",
      _adapt_autoscale),
+    (re.compile(r"^BENCH_KERNELS_\w*\.json$"), "kernels", _adapt_kernels),
     (re.compile(r"^BENCH_ANN_\w*\.json$"), "ann", _adapt_ann),
     (re.compile(r"^BENCH_SERVE_\w*\.json$"), "serve_loadgen", _adapt_serve),
     (re.compile(r"^BENCH_FLEET_\w*\.json$"), "fleet_chaos", _adapt_fleet),

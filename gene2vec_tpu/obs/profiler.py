@@ -1,0 +1,532 @@
+"""Kernel cost attribution: rooflines for the compiled hot paths.
+
+The serving/ops planes report *what the system did*; this module reports
+*what the compiled kernels cost*.  A :class:`KernelProfiler` registers
+named jitted hot paths (the SGNS train step, the CBOW-HS step, the
+GGIPNN step, each serve top-k bucket per index mode, the int8 ANN scan)
+and captures, per kernel:
+
+* **static cost** — XLA's compiled-computation cost analysis (FLOPs,
+  bytes accessed, peak memory) plus the lowering and compile wall time,
+  via the AOT path (``fn.lower(...).compile()``);
+* **dynamic throughput** — wall time of timed executions
+  (:meth:`KernelProfiler.observe` / :meth:`KernelProfiler.measure`),
+  from which achieved FLOP/s and bytes/s are derived;
+* **roofline position** — achieved-vs-peak utilization against a
+  per-backend peak table (:func:`peak_table`): conservative constants
+  on CPU, device-fact lookups on TPU, and an explicitly-labeled
+  conservative fallback on anything unknown.
+
+Records flow to ``kernels.jsonl`` in the run dir (one JSON object per
+kernel, schema :data:`RECORD_SCHEMA`) and, when a registry is attached,
+surface as ``kernel_*`` gauges labeled by kernel name — so
+``metrics.prom`` and the serve ``/metrics`` endpoint carry the same
+numbers ``cli.obs kernels`` renders.
+
+Attribution is warm-time/epoch-level by design: ``attribute`` runs once
+per kernel (AOT lower+compile, off the hot path) and ``observe`` costs
+one ``perf_counter`` subtraction per *epoch or batch of executions* —
+never per step inside a scan.  The ``profiler-hook-in-jit`` static
+gate enforces the same discipline at review time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+KERNELS_LOG_NAME = "kernels.jsonl"
+
+#: informational — the fields every kernels.jsonl record carries
+RECORD_SCHEMA = (
+    "name", "flops", "bytes_accessed", "peak_memory_bytes",
+    "lower_s", "compile_s", "calls", "wall_s", "best_wall_s",
+    "achieved_flops_per_sec", "achieved_bytes_per_sec",
+    "flops_util", "bytes_util", "utilization", "bound", "backend",
+)
+
+# -- peak table --------------------------------------------------------------
+
+#: deliberately conservative single-core-ish CPU ceilings: a few-wide
+#: AVX2 port budget and dual-channel-DDR4-order bandwidth.  Utilization
+#: against these reads optimistic on a big server — which is the safe
+#: direction for a *regression* gate (the baseline and the candidate
+#: share the same table).
+CPU_PEAK_FLOPS = 5.0e10
+CPU_PEAK_BYTES = 2.0e10
+
+#: per-device (one jax device) peak dense FLOP/s and HBM bytes/s from
+#: published TPU specs, keyed by substring of ``device_kind``.  v2/v3
+#: expose cores as devices (half-chip numbers); v4+ expose chips.
+TPU_DEVICE_PEAKS = {
+    "v2": (22.5e12, 300e9),
+    "v3": (61.5e12, 450e9),
+    "v4": (275e12, 1200e9),
+    "v5e": (197e12, 819e9),
+    "v5litepod": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v6e": (918e12, 1640e9),
+}
+
+
+def backend_facts() -> Dict[str, Optional[str]]:
+    """``{"platform", "device_kind"}`` of the default jax backend, or
+    Nones when jax/devices are unavailable (never raises)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return {
+            "platform": str(dev.platform),
+            "device_kind": str(getattr(dev, "device_kind", "")),
+        }
+    except Exception:
+        return {"platform": None, "device_kind": None}
+
+
+def peak_table(
+    platform: Optional[str] = None, device_kind: Optional[str] = None
+) -> Dict:
+    """Per-backend peak rates: ``{"peak_flops_per_sec",
+    "peak_bytes_per_sec", "provenance"}``.
+
+    * CPU → conservative constants (``provenance="cpu-conservative"``);
+    * TPU → device-fact lookup by ``device_kind``
+      (``provenance="tpu-device-facts"``), falling back to the
+      conservative constants when the kind is unrecognized;
+    * anything else (gpu, unknown, no backend) → conservative constants
+      with ``provenance="unknown-conservative"`` so the record is
+      honest about what the utilization number means.
+    """
+    if platform is None and device_kind is None:
+        facts = backend_facts()
+        platform = facts["platform"]
+        device_kind = facts["device_kind"]
+    plat = (platform or "").lower()
+    kind = (device_kind or "").lower()
+    if plat == "cpu":
+        return {
+            "peak_flops_per_sec": CPU_PEAK_FLOPS,
+            "peak_bytes_per_sec": CPU_PEAK_BYTES,
+            "provenance": "cpu-conservative",
+        }
+    if plat == "tpu":
+        # longest-match so "v5litepod" wins over "v5"
+        for key in sorted(TPU_DEVICE_PEAKS, key=len, reverse=True):
+            if key in kind:
+                flops, byps = TPU_DEVICE_PEAKS[key]
+                return {
+                    "peak_flops_per_sec": flops,
+                    "peak_bytes_per_sec": byps,
+                    "provenance": "tpu-device-facts",
+                }
+    return {
+        "peak_flops_per_sec": CPU_PEAK_FLOPS,
+        "peak_bytes_per_sec": CPU_PEAK_BYTES,
+        "provenance": "unknown-conservative",
+    }
+
+
+# -- static cost extraction --------------------------------------------------
+
+
+def extract_costs(compiled) -> Optional[Dict[str, float]]:
+    """FLOPs / bytes accessed / peak memory from a compiled computation.
+
+    Consumes the object returned by ``jitted.lower(...).compile()``.
+    Tolerates every shape ``cost_analysis`` has had across jax versions
+    (dict, list-of-dict, absent) and backends where ``memory_analysis``
+    is unimplemented; returns ``None`` only when no cost channel worked
+    at all — the probes-module degrade-gracefully contract.
+    """
+    costs: Dict[str, float] = {}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        if isinstance(analysis, dict):
+            flops = analysis.get("flops")
+            if flops is not None:
+                costs["flops"] = float(flops)
+            by = analysis.get("bytes accessed", analysis.get("bytes_accessed"))
+            if by is not None:
+                costs["bytes_accessed"] = float(by)
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        total = 0.0
+        for attr in (
+            "temp_size_in_bytes",
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+        ):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                total += float(v)
+        if total > 0:
+            costs["peak_memory_bytes"] = total
+    except Exception:
+        pass
+    return costs or None
+
+
+def utilization(
+    flops: Optional[float],
+    bytes_accessed: Optional[float],
+    wall_s: Optional[float],
+    peaks: Dict,
+) -> Dict:
+    """Roofline position of one timed execution: achieved rates, their
+    fraction of the peak table, and which wall the kernel leans on
+    (``bound="compute"`` when the FLOP fraction dominates, else
+    ``"memory"``).  Utilization is the max of the two fractions — the
+    roofline convention: a kernel at 80% of memory bandwidth is 80%
+    utilized no matter how few FLOPs it does."""
+    out: Dict = {
+        "achieved_flops_per_sec": None,
+        "achieved_bytes_per_sec": None,
+        "flops_util": None,
+        "bytes_util": None,
+        "utilization": None,
+        "bound": None,
+    }
+    if not wall_s or wall_s <= 0:
+        return out
+    if flops is not None:
+        out["achieved_flops_per_sec"] = flops / wall_s
+        pf = peaks.get("peak_flops_per_sec")
+        if pf:
+            out["flops_util"] = out["achieved_flops_per_sec"] / pf
+    if bytes_accessed is not None:
+        out["achieved_bytes_per_sec"] = bytes_accessed / wall_s
+        pb = peaks.get("peak_bytes_per_sec")
+        if pb:
+            out["bytes_util"] = out["achieved_bytes_per_sec"] / pb
+    fu, bu = out["flops_util"], out["bytes_util"]
+    if fu is not None or bu is not None:
+        out["utilization"] = max(fu or 0.0, bu or 0.0)
+        out["bound"] = "compute" if (fu or 0.0) >= (bu or 0.0) else "memory"
+    return out
+
+
+# -- the profiler ------------------------------------------------------------
+
+
+class KernelProfiler:
+    """Named-kernel attribution for one run.
+
+    * :meth:`attribute` — AOT lower+compile a jitted fn under a name,
+      timing both phases and extracting static costs.  Warm-time only
+      (it does not populate the jit call cache — the first real call
+      still compiles; the duplicate compile is the accepted price of
+      attribution and is itself what ``compile_s`` measures).
+    * :meth:`register_costs` — adopt costs a caller already extracted
+      (the serve engine compiles its buckets itself).
+    * :meth:`observe` — account executed wall time to a kernel: one
+      float add per call site, cheap enough for per-epoch use.
+    * :meth:`measure` — timed executions of a compiled/jitted fn with
+      ``block_until_ready``, feeding :meth:`observe`.
+    * :meth:`flush` — write ``kernels.jsonl`` + ``kernel_*`` gauges.
+    """
+
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        registry=None,
+        peaks: Optional[Dict] = None,
+        backend: Optional[Dict] = None,
+    ):
+        self.run_dir = run_dir
+        self.registry = registry
+        self.backend = dict(backend) if backend else backend_facts()
+        self.peaks = dict(peaks) if peaks else peak_table(
+            self.backend.get("platform"), self.backend.get("device_kind")
+        )
+        self._static: Dict[str, Dict] = {}
+        self._calls: Dict[str, int] = {}
+        self._wall: Dict[str, float] = {}
+        self._best: Dict[str, float] = {}
+        self._order: List[str] = []
+
+    # -- registration --------------------------------------------------------
+
+    def _touch(self, name: str) -> None:
+        if name not in self._order:
+            self._order.append(name)
+
+    def attribute(
+        self,
+        name: str,
+        fn: Callable,
+        args: Sequence = (),
+        kwargs: Optional[Dict] = None,
+    ) -> Dict:
+        """Lower + compile ``fn(*args, **kwargs)`` ahead of time under
+        ``name``, recording lowering/compile wall seconds and the XLA
+        static costs.  Never raises: a backend that cannot lower still
+        yields a record (with ``lower_s`` alone or empty costs)."""
+        self._touch(name)
+        rec: Dict = {}
+        t0 = time.perf_counter()
+        try:
+            lowered = fn.lower(*args, **(kwargs or {}))
+            rec["lower_s"] = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            rec["compile_s"] = time.perf_counter() - t1
+            costs = extract_costs(compiled)
+            if costs:
+                rec.update(costs)
+        except Exception:
+            rec.setdefault("lower_s", time.perf_counter() - t0)
+        self._static[name] = {**self._static.get(name, {}), **rec}
+        return dict(self._static[name])
+
+    def register_costs(self, name: str, costs: Dict) -> None:
+        """Adopt externally-extracted static costs (flops /
+        bytes_accessed / peak_memory_bytes / lower_s / compile_s) for
+        ``name`` — the serve engine path, which owns its own AOT
+        compiles."""
+        self._touch(name)
+        merged = self._static.get(name, {})
+        merged.update(
+            {k: v for k, v in costs.items() if v is not None}
+        )
+        self._static[name] = merged
+
+    # -- dynamic observation -------------------------------------------------
+
+    def observe(self, name: str, wall_s: float, calls: int = 1) -> None:
+        """Account ``wall_s`` seconds of executed wall time covering
+        ``calls`` executions of ``name``.  Per-epoch granularity: the
+        per-call best (min) drives the roofline, the total drives the
+        wall-share column."""
+        if wall_s < 0:
+            return
+        self._touch(name)
+        self._calls[name] = self._calls.get(name, 0) + int(calls)
+        self._wall[name] = self._wall.get(name, 0.0) + float(wall_s)
+        if calls > 0:
+            per = float(wall_s) / calls
+            prev = self._best.get(name)
+            if prev is None or per < prev:
+                self._best[name] = per
+
+    def measure(
+        self,
+        name: str,
+        fn: Callable,
+        args: Sequence = (),
+        iters: int = 3,
+        warmup: int = 1,
+    ) -> Optional[float]:
+        """Run ``fn(*args)`` ``warmup`` + ``iters`` times with
+        ``block_until_ready``, feeding each timed iteration to
+        :meth:`observe`.  Returns the best per-call wall seconds (None
+        when execution failed)."""
+        try:
+            import jax
+
+            for _ in range(max(warmup, 0)):
+                jax.block_until_ready(fn(*args))
+            for _ in range(max(iters, 1)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                self.observe(name, time.perf_counter() - t0)
+            return self._best.get(name)
+        except Exception:
+            return None
+
+    def attributed_seconds(self) -> Dict[str, float]:
+        """Total observed wall seconds per kernel — the goodput
+        ``compute`` bucket's per-kernel breakdown feed."""
+        return dict(self._wall)
+
+    # -- records + flush -----------------------------------------------------
+
+    def records(self) -> List[Dict]:
+        """One merged record per kernel in registration order, with the
+        roofline derived from the best observed per-call wall."""
+        out = []
+        for name in self._order:
+            static = self._static.get(name, {})
+            best = self._best.get(name)
+            rec = {
+                "name": name,
+                "flops": static.get("flops"),
+                "bytes_accessed": static.get("bytes_accessed"),
+                "peak_memory_bytes": static.get("peak_memory_bytes"),
+                "lower_s": static.get("lower_s"),
+                "compile_s": static.get("compile_s"),
+                "calls": self._calls.get(name, 0),
+                "wall_s": round(self._wall.get(name, 0.0), 9),
+                "best_wall_s": (
+                    round(best, 9) if best is not None else None
+                ),
+                "backend": {**self.backend, **self.peaks},
+            }
+            rec.update(
+                utilization(
+                    rec["flops"], rec["bytes_accessed"], best, self.peaks
+                )
+            )
+            out.append(rec)
+        return out
+
+    def flush(self) -> List[Dict]:
+        """Write ``kernels.jsonl`` into the run dir (atomic replace) and
+        set the ``kernel_*`` gauges on the attached registry.  Returns
+        the records written."""
+        recs = self.records()
+        if self.run_dir is not None and recs:
+            path = os.path.join(self.run_dir, KERNELS_LOG_NAME)
+            os.makedirs(self.run_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+            os.replace(tmp, path)
+        if self.registry is not None:
+            stamp_records(self.registry, recs)
+        return recs
+
+
+def stamp_records(registry, records: List[Dict]) -> None:
+    """Export kernel records as ``kernel_*`` gauges labeled by kernel
+    name — the shape both run snapshots and the serve ``/metrics``
+    endpoint expose."""
+    for rec in records:
+        labels = {"kernel": str(rec["name"])}
+        for field, metric in (
+            ("flops", "kernel_flops"),
+            ("bytes_accessed", "kernel_bytes_accessed"),
+            ("peak_memory_bytes", "kernel_peak_memory_bytes"),
+            ("compile_s", "kernel_compile_seconds"),
+            ("lower_s", "kernel_lower_seconds"),
+            ("wall_s", "kernel_wall_seconds"),
+            ("best_wall_s", "kernel_best_wall_seconds"),
+            ("utilization", "kernel_utilization"),
+        ):
+            v = rec.get(field)
+            if v is not None:
+                registry.gauge(metric, labels=labels).set(float(v))
+
+
+# -- reading back ------------------------------------------------------------
+
+
+def read_kernels(run_dir: str) -> List[Dict]:
+    """Parse ``kernels.jsonl`` from a run dir (searching one directory
+    level down when the top level has none — the multi-run layout
+    ``cli.obs report`` already accepts).  Malformed lines are skipped;
+    a missing file is just an empty list."""
+    paths = [os.path.join(run_dir, KERNELS_LOG_NAME)]
+    if not os.path.isfile(paths[0]) and os.path.isdir(run_dir):
+        for entry in sorted(os.listdir(run_dir)):
+            sub = os.path.join(run_dir, entry, KERNELS_LOG_NAME)
+            if os.path.isfile(sub):
+                paths.append(sub)
+    out: List[Dict] = []
+    for path in paths:
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("name"):
+                        out.append(rec)
+        except OSError:
+            continue
+    return out
+
+
+def _fmt_num(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for div, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suffix}"
+    return f"{v:.3g}"
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{100.0 * float(v):.1f}%"
+
+
+def format_kernels(records: List[Dict]) -> str:
+    """Fixed-width roofline table over kernel records (the
+    ``cli.obs kernels`` rendering)."""
+    header = (
+        f"{'kernel':<28} {'flops':>8} {'bytes':>8} {'best_ms':>9} "
+        f"{'wall_s':>8} {'util':>7} {'bound':>7} {'compile_s':>9}"
+    )
+    lines = [header, "-" * len(header)]
+    for rec in records:
+        best = rec.get("best_wall_s")
+        wall = rec.get("wall_s")
+        compile_s = rec.get("compile_s")
+        best_ms = f"{1e3 * float(best):.3f}" if best is not None else "-"
+        wall_str = f"{float(wall):.3f}" if wall is not None else "-"
+        comp_str = (
+            f"{float(compile_s):.3f}" if compile_s is not None else "-"
+        )
+        lines.append(
+            f"{str(rec.get('name', '')):<28} "
+            f"{_fmt_num(rec.get('flops')):>8} "
+            f"{_fmt_num(rec.get('bytes_accessed')):>8} "
+            f"{best_ms:>9} "
+            f"{wall_str:>8} "
+            f"{_fmt_pct(rec.get('utilization')):>7} "
+            f"{str(rec.get('bound') or '-'):>7} "
+            f"{comp_str:>9}"
+        )
+    if records:
+        backend = records[0].get("backend") or {}
+        prov = backend.get("provenance")
+        if prov:
+            lines.append(
+                f"peaks: {_fmt_num(backend.get('peak_flops_per_sec'))}F/s "
+                f"{_fmt_num(backend.get('peak_bytes_per_sec'))}B/s "
+                f"({prov})"
+            )
+    return "\n".join(lines)
+
+
+def kernel_summary(records: List[Dict], top: int = 5) -> Dict:
+    """Compact per-kernel block for ``cli.obs report``: top kernels by
+    observed wall share, plus utilization and compile seconds."""
+    total_wall = sum(float(r.get("wall_s") or 0.0) for r in records)
+    total_compile = sum(float(r.get("compile_s") or 0.0) for r in records)
+    ranked = sorted(
+        records, key=lambda r: float(r.get("wall_s") or 0.0), reverse=True
+    )
+    rows = []
+    for rec in ranked[: max(top, 1)]:
+        wall = float(rec.get("wall_s") or 0.0)
+        rows.append({
+            "name": rec.get("name"),
+            "wall_s": round(wall, 6),
+            "wall_share": (
+                round(wall / total_wall, 4) if total_wall > 0 else 0.0
+            ),
+            "utilization": rec.get("utilization"),
+            "bound": rec.get("bound"),
+            "compile_s": rec.get("compile_s"),
+        })
+    return {
+        "kernels": len(records),
+        "wall_s": round(total_wall, 6),
+        "compile_s": round(total_compile, 6),
+        "top": rows,
+    }

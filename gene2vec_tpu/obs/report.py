@@ -8,7 +8,11 @@ standard artifacts (``manifest.json`` + ``events.jsonl`` + optional
 * per-phase wall time, aggregated over ``span_end`` events by name;
 * throughput, from ``pairs``/``seconds`` span attrs when present;
 * peak HBM / host RSS across ``probe`` events;
-* every ``stall`` event with the budget it broke.
+* every ``stall`` event with the budget it broke;
+* when the run attributed kernels (``kernels.jsonl``,
+  :mod:`gene2vec_tpu.obs.profiler`): the compact per-kernel block —
+  top kernels by wall share with utilization and compile seconds
+  (``cli.obs kernels`` renders the full roofline table).
 """
 
 from __future__ import annotations
@@ -95,8 +99,15 @@ def summarize(run_dir: str) -> Dict:
 
     walls = [e["wall"] for e in events if "wall" in e]
     processes = sorted({e.get("pid") for e in events if e.get("pid")})
+    from gene2vec_tpu.obs import profiler
+
+    kernel_records = profiler.read_kernels(run_dir)
     return {
         "goodput": manifest.get("goodput"),
+        "kernels": (
+            profiler.kernel_summary(kernel_records)
+            if kernel_records else None
+        ),
         "run_dir": os.path.abspath(run_dir),
         "name": manifest.get("name"),
         "config_hash": manifest.get("config_hash"),
@@ -176,6 +187,23 @@ def format_report(run_dir: str) -> str:
                 f"  achieved {achieved:,.0f} pairs/s vs peak "
                 f"{peak_rate:,.0f} (utilization "
                 f"{g.get('utilization', 0) or 0:.1%})"
+            )
+    if s.get("kernels"):
+        ks = s["kernels"]
+        lines.append("")
+        lines.append(
+            f"kernels: {ks.get('kernels', 0)} attributed, "
+            f"{_fmt_s(ks.get('wall_s', 0.0))} observed wall, "
+            f"{_fmt_s(ks.get('compile_s', 0.0))} compiling "
+            "(full table: cli.obs kernels)"
+        )
+        for top in ks.get("top") or []:
+            util = top.get("utilization")
+            lines.append(
+                f"  {top['name']:<26}{100 * top.get('wall_share', 0.0):>6.1f}"
+                f"% wall  "
+                + (f"util {util:.1%}" if util is not None else "util ?")
+                + (f"  [{top['bound']}-bound]" if top.get("bound") else "")
             )
     if s["peak"]:
         lines.append("")

@@ -151,6 +151,8 @@ class BucketedTopKEngine:
         # per-mode jitted ANN kernels, bound lazily on first use so an
         # exact-only server never traces them
         self._ann_fns: Dict[str, object] = {}
+        #: static kernel costs per profiled bucket (profile_buckets)
+        self._kernel_costs: Dict[str, Dict] = {}
 
     # -- jit-cache accounting ---------------------------------------------
 
@@ -322,6 +324,78 @@ class BucketedTopKEngine:
             scores = np.asarray(scores)
             idx = np.asarray(idx)
         return scores[:n, :k], idx[:n, :k]
+
+    # -- kernel attribution -------------------------------------------------
+
+    def profile_buckets(
+        self,
+        unit,
+        valid: Optional[int] = None,
+        k: int = 16,
+        ann_index: Optional[AnnIndex] = None,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> Dict[str, Dict]:
+        """AOT lower+compile the active index mode's kernel for each
+        batch bucket, recording per-bucket static costs (FLOPs, bytes
+        accessed, peak memory) and lowering/compile wall seconds via
+        :mod:`gene2vec_tpu.obs.profiler`.  Warm-time only — called once
+        at model load/swap, never on the request path (AOT compiles do
+        not populate the jit call cache, so bucket-stability accounting
+        is unaffected).  Results accumulate on the engine
+        (:meth:`kernel_costs`) keyed ``serve_topk_<mode>/b<bucket>``
+        for ``/metrics`` publication."""
+        from gene2vec_tpu.obs import profiler as prof
+
+        import jax.numpy as jnp
+
+        mode = self.index_mode
+        if mode != "exact" and ann_index is None:
+            raise ValueError(
+                f"profile_buckets needs an AnnIndex for mode {mode!r}"
+            )
+        dim = int(unit.shape[1])
+        vocab_size = int(valid if valid is not None else unit.shape[0])
+        kb = self.k_bucket(max(1, min(int(k), vocab_size)), vocab_size)
+        valid_arg = (
+            int(valid) if valid is not None and valid < int(unit.shape[0])
+            else None
+        )
+        p = prof.KernelProfiler(run_dir=None, registry=None)
+        out: Dict[str, Dict] = {}
+        for b in tuple(buckets) if buckets else self.buckets:
+            b = int(b)
+            q = jnp.zeros((b, dim), jnp.float32)
+            if mode == "exact":
+                fn, args = self._topk_fn, (unit, q, kb, valid_arg)
+            elif mode == "quant":
+                rb = self.r_bucket(kb, vocab_size)
+                fn = self._ann_fn("quant")
+                args = (
+                    ann_index.table_q, ann_index.scale, unit, q, kb, rb,
+                    valid_arg,
+                )
+            else:  # ivf
+                rb = self.r_bucket(kb, vocab_size)
+                nprobe = min(self.nprobe, ann_index.n_clusters)
+                fn = self._ann_fn("ivf")
+                args = (
+                    ann_index.centroids, ann_index.lists,
+                    ann_index.table_q, ann_index.scale, unit, q,
+                    nprobe, kb, rb, valid_arg,
+                )
+            name = f"serve_topk_{mode}/b{b}"
+            rec = p.attribute(name, fn, args)
+            rec["bucket"] = b
+            rec["k_bucket"] = kb
+            rec["mode"] = mode
+            out[name] = rec
+        self._kernel_costs.update(out)
+        return out
+
+    def kernel_costs(self) -> Dict[str, Dict]:
+        """Static costs recorded by :meth:`profile_buckets` so far,
+        keyed by kernel name (copies — safe to mutate)."""
+        return {k: dict(v) for k, v in self._kernel_costs.items()}
 
     # -- model-level entry points ------------------------------------------
 

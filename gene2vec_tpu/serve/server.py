@@ -64,6 +64,7 @@ from urllib.parse import parse_qs, unquote_plus, urlparse
 import numpy as np
 
 from gene2vec_tpu.obs import flight as flight_mod
+from gene2vec_tpu.obs import probes
 from gene2vec_tpu.obs import tracecontext
 from gene2vec_tpu.obs.alerts import RateLimiter
 from gene2vec_tpu.obs.flight import FlightRecorder
@@ -125,6 +126,12 @@ class ServeConfig:
     nprobe: int = 8
     # exact-rescore tail size multiplier: r = rescore_mult * k
     rescore_mult: int = 4
+    # warm-time per-bucket kernel attribution (engine.profile_buckets):
+    # AOT-compile every batch bucket at startup/swap and publish
+    # kernel_* cost gauges on /metrics (docs/OBSERVABILITY.md
+    # #kernel-attribution--rooflines).  Costs one extra compile pass
+    # per bucket, so it is opt-in (cli/serve.py --kernel-profile)
+    kernel_profile: bool = False
     # per-request read deadline: once the first byte of a request has
     # arrived the WHOLE request must arrive within this window
     # (slow-loris guard; expiry -> 408 + close)
@@ -240,6 +247,13 @@ class ServeApp:
         self._scorer: Optional[InteractionScorer] = None
         self._scorer_lock = threading.Lock()
         self._started = time.monotonic()
+        # jit compile-event visibility: the process-wide CompileWatcher
+        # feeds a monotone counter on /metrics (publish_engine_metrics
+        # mirrors the watcher by delta), which the fleet aggregator
+        # sums into fleet_jit_compiles and the default
+        # jit-recompile-storm alert rule watches per scrape tick
+        self._compile_watcher = probes.CompileWatcher.install()
+        self._compile_events_published = 0
         # head sampler for headerless traffic; propagated sampled
         # contexts bypass it (the root already decided)
         self.sampler = (
@@ -689,6 +703,29 @@ class ServeApp:
             "genes": list(model.tokens[offset : offset + limit]),
         }
 
+    def profile_kernels(self, k: int = 16) -> Dict[str, Dict]:
+        """Warm-time per-bucket kernel attribution: AOT-compile the
+        active index mode's kernel at every batch bucket against the
+        served model and publish the static costs + compile seconds as
+        ``kernel_*`` gauges (``publish_engine_metrics``).  No-op (empty
+        dict) when no model is loaded or the mode needs an ANN index
+        the snapshot doesn't carry — a mid-rollout replica must not
+        crash over its own telemetry."""
+        if not self.registry.loaded:
+            return {}
+        model = self.registry.model
+        ann_index = getattr(model, "ann", None)
+        if self.engine.index_mode != "exact" and ann_index is None:
+            return {}
+        try:
+            costs = self.engine.profile_buckets(
+                model.unit, valid=len(model), k=k, ann_index=ann_index,
+            )
+        except Exception:
+            return {}
+        self.publish_engine_metrics()
+        return costs
+
     def publish_engine_metrics(self) -> None:
         """Export the engine's per-index-mode jit-cache entry counts as
         ``engine_jit_cache_entries{mode=...}`` — refreshed at each
@@ -700,6 +737,39 @@ class ServeApp:
                 self.metrics.gauge(
                     "engine_jit_cache_entries", labels={"mode": mode}
                 ).set(size)
+        # per-bucket kernel attribution (profile_kernels), as the same
+        # kernel_* gauge family run snapshots use — bounded: buckets x
+        # modes stays far under the registry's label-cardinality cap
+        for name, costs in self.engine.kernel_costs().items():
+            labels = {"kernel": name}
+            for field, metric in (
+                ("flops", "kernel_flops"),
+                ("bytes_accessed", "kernel_bytes_accessed"),
+                ("peak_memory_bytes", "kernel_peak_memory_bytes"),
+                ("lower_s", "kernel_lower_seconds"),
+                ("compile_s", "kernel_compile_seconds"),
+            ):
+                v = costs.get(field)
+                if v is not None:
+                    self.metrics.gauge(metric, labels=labels).set(
+                        float(v)
+                    )
+        # compile events observed since the last scrape -> monotone
+        # counter (counters survive the aggregator's reset-rebasing;
+        # the raw watcher count would read as a gauge and lose deltas)
+        if self._compile_watcher is not None:
+            delta = (
+                self._compile_watcher.count
+                - self._compile_events_published
+            )
+            if delta > 0:
+                self.metrics.counter(
+                    "jit_compile_events_total",
+                    "jax compilation events seen by this process",
+                ).inc(delta)
+                self._compile_events_published = (
+                    self._compile_watcher.count
+                )
         # served-model freshness facts, refreshed per scrape: the fleet
         # aggregator lifts these into fleet_model_iteration{target=} /
         # fleet_model_age_seconds{target=} and the default staleness

@@ -411,6 +411,21 @@ class CBOWHSTrainer:
     def train_epoch(self, params: SGNSParams, key: jax.Array):
         return self._epoch_fn(params, self.pairs, key)
 
+    def profile_kernel(
+        self, profiler, params: Optional[SGNSParams] = None,
+        name: str = "cbow_hs_step",
+    ):
+        """AOT kernel attribution of the compiled epoch step
+        (``obs/profiler.py``): lower+compile cost and XLA static costs
+        under ``name``.  Warm-time only — bench.py and the
+        ``kernel_profile`` run path call it once before training."""
+        if params is None:
+            params = self.init()
+        key = jax.random.PRNGKey(self.config.seed)
+        return profiler.attribute(
+            name, self._epoch_fn, (params, self.pairs, key)
+        )
+
     def run(
         self,
         export_dir: str,
@@ -442,6 +457,15 @@ class CBOWHSTrainer:
         # per-iteration phase timeline + goodput, same wiring as the SGNS
         # trainer (obs/timeline.py, obs/goodput.py)
         tl = PhaseTimeline(enabled=cfg.timeline)
+        # kernel cost attribution, same wiring as the SGNS trainer:
+        # one AOT lower+compile at startup, one float add per epoch
+        kp = None
+        if cfg.kernel_profile:
+            from gene2vec_tpu.obs.profiler import KernelProfiler
+
+            kp = KernelProfiler(
+                run_dir=export_dir, registry=run.registry
+            )
         wall_t0 = time.perf_counter()
         pairs_done = 0.0
         best_rate = 0.0
@@ -476,6 +500,11 @@ class CBOWHSTrainer:
                 start_iter = 1
 
             root_key = jax.random.PRNGKey(cfg.seed)
+            if kp is not None:
+                with run.span(
+                    "kernel_attribution", kernel="cbow_hs_step"
+                ):
+                    self.profile_kernel(kp, params=params)
             pairs_per_epoch = self.num_batches * cfg.batch_pairs
             pairs_counter = run.registry.counter("pairs_total")
             for it in range(start_iter, cfg.num_iters + 1):
@@ -494,6 +523,8 @@ class CBOWHSTrainer:
                     span_out["loss"] = loss
                 dt = time.perf_counter() - t0
                 rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+                if kp is not None:
+                    kp.observe("cbow_hs_step", dt)
                 self.timer.record(pairs_per_epoch, dt)
                 pairs_counter.inc(pairs_per_epoch)
                 pairs_done += pairs_per_epoch
@@ -542,10 +573,16 @@ class CBOWHSTrainer:
                         max(time.time() - preempt.received_wall, 0.0), wall_s
                     )
                 tl.flush(os.path.join(run.run_dir, TIMELINE_NAME))
+                if kp is not None:
+                    kp.flush()
                 goodput.stamp(run, goodput.summarize(
                     tl.records(), wall_s, pairs_total=pairs_done,
                     peak_pairs_per_sec=best_rate or None,
                     preempted_s=preempted_s,
+                    kernel_seconds=(
+                        kp.attributed_seconds() if kp is not None
+                        else None
+                    ),
                 ))
             run.close()
         return params

@@ -630,6 +630,17 @@ class SGNSTrainer:
         # bounded ring, flushed to timeline.jsonl at run close and
         # classified into goodput buckets for the manifest
         tl = PhaseTimeline(enabled=cfg.timeline)
+        # kernel cost attribution (obs/profiler.py): one AOT
+        # lower+compile of the epoch step at startup, one float add per
+        # epoch after that — never per batch inside the scan (the
+        # profiler-hook-in-jit gate)
+        kp = None
+        if cfg.kernel_profile:
+            from gene2vec_tpu.obs.profiler import KernelProfiler
+
+            kp = KernelProfiler(
+                run_dir=export_dir, registry=run.registry
+            )
         wall_t0 = time.perf_counter()
         pairs_done = 0.0
         best_rate = 0.0
@@ -655,6 +666,13 @@ class SGNSTrainer:
                 start_iter = 1
 
             root_key = jax.random.PRNGKey(cfg.seed)
+            if kp is not None:
+                with run.span("kernel_attribution", kernel="sgns_train_step"):
+                    kp.attribute(
+                        "sgns_train_step", self._epoch_fn,
+                        (params, self.pairs, self.noise,
+                         jax.random.fold_in(root_key, 0)),
+                    )
             pairs_per_epoch = self.num_batches * cfg.batch_pairs
             pairs_counter = run.registry.counter("pairs_total")
             for it in range(start_iter, cfg.num_iters + 1):
@@ -677,6 +695,8 @@ class SGNSTrainer:
                         span_out["loss"] = loss
                 dt = time.perf_counter() - t0
                 rate = pairs_per_epoch / dt if dt > 0 else float("inf")
+                if kp is not None:
+                    kp.observe("sgns_train_step", dt)
                 self.timer.record(pairs_per_epoch, dt)
                 pairs_counter.inc(pairs_per_epoch)
                 pairs_done += pairs_per_epoch
@@ -739,10 +759,16 @@ class SGNSTrainer:
                         max(time.time() - preempt.received_wall, 0.0), wall_s
                     )
                 tl.flush(os.path.join(run.run_dir, TIMELINE_NAME))
+                if kp is not None:
+                    kp.flush()
                 goodput.stamp(run, goodput.summarize(
                     tl.records(), wall_s, pairs_total=pairs_done,
                     peak_pairs_per_sec=best_rate or None,
                     preempted_s=preempted_s,
+                    kernel_seconds=(
+                        kp.attributed_seconds() if kp is not None
+                        else None
+                    ),
                 ))
             run.close()
         return params

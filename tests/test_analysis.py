@@ -112,6 +112,17 @@ class AcceptorLoop:
         time.sleep(0.01)
         return now
 """,
+    "profiler-hook-in-jit": """
+import time
+
+import jax
+
+
+@jax.jit
+def scoring(params, batch):
+    t0 = time.perf_counter()
+    return params * batch + 0.0 * t0
+""",
 }
 
 CLEAN_FIXTURE = """

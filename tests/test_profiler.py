@@ -1,0 +1,489 @@
+"""Kernel cost-attribution plane (obs/profiler.py) tier-1: static XLA
+cost extraction, the per-backend peak table, kernels.jsonl round trips,
+serve-engine per-bucket publication, the recompile-storm counter +
+alert rule, the ``cli.obs kernels`` exit-code contract, the
+passes_kernels gate fixtures, and the BENCH_KERNELS ledger adapter
+over a copy of the committed artifact.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gene2vec_tpu.obs import profiler  # noqa: E402
+from gene2vec_tpu.obs.registry import MetricsRegistry  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_KERNELS = os.path.join(REPO, "BENCH_KERNELS_r18.json")
+
+V, D = 32, 8
+
+
+def _toy_fn(a, b):
+    return (a @ b).sum(axis=1)
+
+
+def _toy_args():
+    rng = np.random.RandomState(0)
+    return (
+        jnp.asarray(rng.randn(16, D).astype(np.float32)),
+        jnp.asarray(rng.randn(D, D).astype(np.float32)),
+    )
+
+
+# -- static cost extraction --------------------------------------------------
+
+
+def test_extract_costs_on_toy_jitted_fn():
+    args = _toy_args()
+    compiled = jax.jit(_toy_fn).lower(*args).compile()
+    costs = profiler.extract_costs(compiled)
+    assert costs is not None
+    assert costs["flops"] and costs["flops"] > 0
+    assert costs["bytes_accessed"] and costs["bytes_accessed"] > 0
+
+
+def test_attribute_records_costs_and_compile_walls():
+    p = profiler.KernelProfiler()
+    rec = p.attribute("toy", jax.jit(_toy_fn), _toy_args())
+    assert rec["flops"] > 0 and rec["bytes_accessed"] > 0
+    assert rec["lower_s"] > 0 and rec["compile_s"] > 0
+    # attribute alone -> no dynamic observations yet
+    (merged,) = p.records()
+    assert merged["name"] == "toy"
+    assert merged["calls"] == 0 and merged["best_wall_s"] is None
+    assert merged["utilization"] is None
+    # measure feeds the roofline: utilization lands in (0, 1]-ish and
+    # the binding resource is named
+    best = p.measure("toy", jax.jit(_toy_fn), _toy_args())
+    assert best is not None and best > 0
+    (merged,) = p.records()
+    assert merged["best_wall_s"] == pytest.approx(best)
+    assert merged["utilization"] is not None and merged["utilization"] > 0
+    assert merged["bound"] in ("compute", "memory")
+
+
+def test_attribute_never_raises_on_unjittable():
+    p = profiler.KernelProfiler()
+    # a plain callable has no .lower: attribute degrades to a record
+    # with lowering wall only, and records() still carries the name
+    rec = p.attribute("broken", lambda x: x.nonsense(), (object(),))
+    assert rec.get("flops") is None and "compile_s" not in rec
+    (merged,) = p.records()
+    assert merged["name"] == "broken" and merged["flops"] is None
+
+
+# -- peak table --------------------------------------------------------------
+
+
+def test_peak_table_cpu_and_unknown_fallbacks():
+    cpu = profiler.peak_table("cpu", "cpu")
+    assert cpu["provenance"] == "cpu-conservative"
+    assert cpu["peak_flops_per_sec"] == profiler.CPU_PEAK_FLOPS
+    # unknown platform/device: a conservative table, never a KeyError
+    unk = profiler.peak_table("rocm", "gizmo9000")
+    assert unk["provenance"] == "unknown-conservative"
+    assert unk["peak_flops_per_sec"] > 0
+
+
+def test_peak_table_tpu_device_facts_longest_match():
+    v4 = profiler.peak_table("tpu", "TPU v4")
+    assert v4["provenance"] == "tpu-device-facts"
+    assert v4["peak_flops_per_sec"] == pytest.approx(275e12)
+    # longest substring wins: "v5e" must not resolve via "v5p"
+    v5e = profiler.peak_table("tpu", "TPU v5e")
+    assert v5e["peak_flops_per_sec"] == pytest.approx(197e12)
+    # an unknown TPU generation still degrades, not crashes
+    future = profiler.peak_table("tpu", "TPU v99")
+    assert future["provenance"] == "unknown-conservative"
+
+
+def test_utilization_roofline_bound():
+    peaks = {"peak_flops_per_sec": 100.0, "peak_bytes_per_sec": 100.0}
+    u = profiler.utilization(50.0, 10.0, 1.0, peaks)
+    assert u["utilization"] == pytest.approx(0.5)
+    assert u["bound"] == "compute"
+    u = profiler.utilization(10.0, 50.0, 1.0, peaks)
+    assert u["bound"] == "memory"
+    assert profiler.utilization(None, 10.0, 1.0, peaks)["flops_util"] is None
+    assert profiler.utilization(10.0, 10.0, None, peaks)["utilization"] is None
+
+
+# -- kernels.jsonl round trip ------------------------------------------------
+
+
+def test_kernels_jsonl_round_trip_and_gauges(tmp_path):
+    reg = MetricsRegistry()
+    p = profiler.KernelProfiler(run_dir=str(tmp_path), registry=reg)
+    p.attribute("toy", jax.jit(_toy_fn), _toy_args())
+    p.measure("toy", jax.jit(_toy_fn), _toy_args())
+    written = p.flush()
+    assert (tmp_path / profiler.KERNELS_LOG_NAME).exists()
+    back = profiler.read_kernels(str(tmp_path))
+    assert [r["name"] for r in back] == ["toy"]
+    assert back[0]["flops"] == written[0]["flops"]
+    assert back[0]["backend"]["provenance"]
+    text = reg.prometheus_text()
+    assert 'kernel_flops{kernel="toy"}' in text
+    assert 'kernel_utilization{kernel="toy"}' in text
+    assert 'kernel_compile_seconds{kernel="toy"}' in text
+    # the renderers consume the same records
+    table = profiler.format_kernels(back)
+    assert "toy" in table and "peaks:" in table
+    summary = profiler.kernel_summary(back)
+    assert summary["kernels"] == 1
+    assert summary["top"][0]["name"] == "toy"
+    assert summary["top"][0]["wall_share"] == pytest.approx(1.0)
+
+
+def test_read_kernels_nested_and_malformed(tmp_path):
+    sub = tmp_path / "run"
+    sub.mkdir()
+    (sub / profiler.KERNELS_LOG_NAME).write_text(
+        json.dumps({"name": "a", "flops": 1.0}) + "\n"
+        + "{not json\n"
+        + json.dumps({"name": "b"}) + "\n"
+    )
+    # one level down is found; malformed lines are skipped, not fatal
+    recs = profiler.read_kernels(str(tmp_path))
+    assert [r["name"] for r in recs] == ["a", "b"]
+    assert profiler.read_kernels(str(tmp_path / "nothing-here")) == []
+
+
+# -- goodput per-kernel breakdown --------------------------------------------
+
+
+def test_goodput_kernel_breakdown_sums_to_compute_bucket():
+    from gene2vec_tpu.obs import goodput
+
+    records = [{"name": "compute", "dur": 8.0}]
+    s = goodput.summarize(
+        records, wall_s=10.0, pairs_total=100.0,
+        kernel_seconds={"sgns_train_step": 6.0},
+    )
+    ks = s["compute_kernels_s"]
+    # under-attribution leaves an explicit residual; the kernel seconds
+    # sum to the compute bucket EXACTLY
+    assert ks["_unattributed"] == pytest.approx(2.0)
+    assert sum(ks.values()) == pytest.approx(s["buckets_s"]["compute"])
+    assert s["compute_kernels"]["sgns_train_step"] == pytest.approx(0.6)
+    # over-attribution scales DOWN to fit the bucket, same discipline
+    # as the buckets themselves vs the wall clock
+    s2 = goodput.summarize(
+        records, wall_s=10.0,
+        kernel_seconds={"a": 6.0, "b": 10.0},
+    )
+    ks2 = s2["compute_kernels_s"]
+    assert "_unattributed" not in ks2
+    assert sum(ks2.values()) == pytest.approx(s2["buckets_s"]["compute"])
+
+
+# -- serve engine per-bucket publication -------------------------------------
+
+
+def _write_export(export_dir, iteration=1, seed=0):
+    from gene2vec_tpu.io.checkpoint import save_iteration
+    from gene2vec_tpu.io.vocab import Vocab
+    from gene2vec_tpu.sgns.model import SGNSParams
+
+    rng = np.random.RandomState(seed)
+    vocab = Vocab([f"G{i}" for i in range(V)], np.arange(V, 0, -1))
+    params = SGNSParams(
+        emb=jnp.asarray(rng.randn(V, D).astype(np.float32)),
+        ctx=jnp.asarray(np.zeros((V, D), np.float32)),
+    )
+    save_iteration(str(export_dir), D, iteration, params, vocab)
+
+
+def test_engine_profile_buckets_and_serve_publication(tmp_path):
+    from gene2vec_tpu.serve.registry import ModelRegistry
+    from gene2vec_tpu.serve.server import ServeApp, ServeConfig
+
+    export = tmp_path / "exports"
+    _write_export(export)
+    reg = ModelRegistry(str(export))
+    assert reg.refresh()
+    app = ServeApp(
+        reg, ServeConfig(max_batch=4, max_delay_ms=1.0)
+    ).start()
+    try:
+        # the exact-mode jit cache is process-global (other tests may
+        # have warmed it): assert no GROWTH from AOT attribution
+        before = app.engine.cache_sizes().get("exact", 0)
+        costs = app.profile_kernels(k=4)
+        assert costs, "exact-mode profiling must attribute buckets"
+        # one record per batch bucket, keyed serve_topk_<mode>/b<n>
+        assert set(costs) == {
+            f"serve_topk_exact/b{b}" for b in app.engine.buckets
+        }
+        for rec in costs.values():
+            assert rec["flops"] > 0 and rec["compile_s"] > 0
+            assert rec["mode"] == "exact"
+        text = app.metrics.prometheus_text()
+        assert 'kernel_flops{kernel="serve_topk_exact/b1"}' in text
+        assert (
+            'kernel_compile_seconds{kernel="serve_topk_exact/b1"}' in text
+        )
+        # AOT attribution must not populate the request-path jit cache
+        assert app.engine.cache_sizes().get("exact", 0) == before
+    finally:
+        app.stop()
+
+
+def test_engine_profile_buckets_needs_index_for_ann_modes():
+    from gene2vec_tpu.serve.engine import BucketedTopKEngine
+
+    eng = BucketedTopKEngine(max_batch=2, index="ivf")
+    unit = jnp.asarray(np.eye(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="AnnIndex"):
+        eng.profile_buckets(unit, k=2)
+
+
+# -- recompile-storm counter + alert rule ------------------------------------
+
+
+def _replica_text(compiles):
+    r = MetricsRegistry()
+    r.counter("serve_requests_total").inc(10)
+    if compiles:
+        r.counter("jit_compile_events_total").inc(compiles)
+    return r.prometheus_text()
+
+
+def test_aggregator_compile_delta_seeds_then_tracks():
+    from gene2vec_tpu.obs.aggregate import FleetAggregator
+
+    texts = {"http://r0": _replica_text(5)}
+    agg = FleetAggregator(
+        lambda: list(texts), fetch=lambda url, t: texts[url],
+    )
+    # the full snapshot (what the alert evaluator sees) flows to
+    # observers; scrape_once() returns only the small headline dict
+    seen = []
+    agg.observers.append(lambda snap, wall=None: seen.append(dict(snap)))
+    # first scrape SEEDS the baseline: a warm fleet joining mid-life
+    # must not read as a storm
+    agg.scrape_once()
+    assert seen[-1]["fleet_jit_compiles"] == 5.0
+    assert seen[-1]["fleet_jit_compile_delta"] == 0.0
+    agg.scrape_once()
+    assert seen[-1]["fleet_jit_compile_delta"] == 0.0
+    texts["http://r0"] = _replica_text(9)
+    agg.scrape_once()
+    assert seen[-1]["fleet_jit_compiles"] == 9.0
+    assert seen[-1]["fleet_jit_compile_delta"] == 4.0
+
+
+def test_recompile_storm_rule_fires_and_clears():
+    from gene2vec_tpu.obs.alerts import AlertEvaluator, default_rules
+
+    (rule,) = [
+        r for r in default_rules() if r.name == "jit-recompile-storm"
+    ]
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    ev = AlertEvaluator([rule], clock=clk)
+    quiet = {"fleet_jit_compile_delta": 0.0, "_fresh_targets": 1}
+    storm = {"fleet_jit_compile_delta": 3.0, "_fresh_targets": 1}
+    ev.observe(quiet)
+    assert ev.firing() == []
+    # sustained compiling past for_s fires; a single cold-start burst
+    # shorter than the debounce must NOT
+    for _ in range(3):
+        clk.t += 10.0
+        ev.observe(storm)
+    assert ev.firing() == []  # 30s not yet exceeded-and-held from 10s
+    clk.t += rule.for_s
+    ev.observe(storm)
+    assert ev.firing() == ["jit-recompile-storm"]
+    # back to zero for clear_for_s clears
+    clk.t += 1.0
+    ev.observe(quiet)
+    clk.t += rule.clear_for_s + 1.0
+    ev.observe(quiet)
+    assert ev.firing() == []
+
+
+# -- cli.obs kernels exit codes ----------------------------------------------
+
+
+def test_cli_obs_kernels_exit_codes(tmp_path, capsys):
+    from gene2vec_tpu.cli.obs import main as obs_main
+
+    assert obs_main(["kernels", str(tmp_path / "nope")]) == 2
+    assert obs_main(["kernels", str(tmp_path)]) == 1
+    p = profiler.KernelProfiler(run_dir=str(tmp_path))
+    p.attribute("toy", jax.jit(_toy_fn), _toy_args())
+    p.flush()
+    capsys.readouterr()
+    assert obs_main(["kernels", str(tmp_path)]) == 0
+    assert "toy" in capsys.readouterr().out
+    assert obs_main(["kernels", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[0]["name"] == "toy"
+
+
+def test_obs_report_carries_kernel_block(tmp_path):
+    from gene2vec_tpu.obs import report
+
+    p = profiler.KernelProfiler(run_dir=str(tmp_path))
+    p.attribute("toy", jax.jit(_toy_fn), _toy_args())
+    p.measure("toy", jax.jit(_toy_fn), _toy_args())
+    p.flush()
+    s = report.summarize(str(tmp_path))
+    assert s["kernels"]["kernels"] == 1
+    assert s["kernels"]["top"][0]["name"] == "toy"
+    text = report.format_report(str(tmp_path))
+    assert "kernels: 1 attributed" in text
+
+
+# -- passes_kernels gate -----------------------------------------------------
+
+
+def _budget():
+    from gene2vec_tpu.analysis.passes_hlo import load_budgets
+
+    return load_budgets()["kernels"]["profile"]
+
+
+def _kernels_doc(**over):
+    b = _budget()
+    kernel = {
+        "flops": 1e9, "bytes_accessed": 1e8, "peak_memory_bytes": 1e7,
+        "lower_s": 0.1, "compile_s": 0.5, "calls": 3, "wall_s": 0.05,
+        "utilization": 0.02, "bound": "compute",
+    }
+    doc = {
+        "schema_version": 1,
+        "bench": "kernels",
+        "recipe": {
+            k: b[k] for k in (
+                "dim", "vocab", "num_pairs", "batch_pairs", "serve_rows",
+                "serve_dim", "serve_batch", "serve_k", "serve_clusters",
+                "rounds", "epochs_per_window",
+            )
+        },
+        "backend": {"platform": "cpu", "device_kind": "cpu",
+                    "provenance": "cpu-conservative"},
+        "kernels": {
+            name: dict(kernel) for name in b["require_kernels"]
+        },
+        "overhead": {"regression_frac": 0.001},
+    }
+    doc.update(over)
+    return doc
+
+
+def test_kernels_gate_passes_on_committed_bench():
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_kernels import kernels_findings
+
+    bad = gating(kernels_findings(root=REPO))
+    assert bad == [], "\n".join(f.format() for f in bad)
+
+
+def test_kernels_gate_missing_bench_is_info(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_kernels import kernels_findings
+
+    findings = kernels_findings(root=str(tmp_path))
+    assert gating(findings) == []
+    assert findings[0].severity == "info"
+    assert "bench.py --kernel-profile" in findings[0].message
+
+
+def test_kernels_gate_planted_violations_fire_exactly_once(tmp_path):
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.passes_kernels import kernels_findings
+
+    ok = _kernels_doc()
+    path = tmp_path / "BENCH_KERNELS_r99.json"
+    path.write_text(json.dumps(ok))
+    assert gating(kernels_findings(root=str(tmp_path))) == []
+
+    # overhead past the ceiling
+    doc = _kernels_doc(overhead={"regression_frac": 0.5})
+    path.write_text(json.dumps(doc))
+    (bad,) = gating(kernels_findings(root=str(tmp_path)))
+    assert "0.5000 > budget" in bad.message
+
+    # a silently dropped required kernel gates
+    doc = _kernels_doc()
+    del doc["kernels"]["serve_topk_ivf"]
+    path.write_text(json.dumps(doc))
+    (bad,) = gating(kernels_findings(root=str(tmp_path)))
+    assert "'serve_topk_ivf' missing" in bad.message
+
+    # a dropped required field gates
+    doc = _kernels_doc()
+    del doc["kernels"]["sgns_train_step"]["utilization"]
+    path.write_text(json.dumps(doc))
+    (bad,) = gating(kernels_findings(root=str(tmp_path)))
+    assert "missing required field 'utilization'" in bad.message
+
+    # off-recipe gates
+    doc = _kernels_doc()
+    doc["recipe"]["batch_pairs"] = 64
+    path.write_text(json.dumps(doc))
+    (bad,) = gating(kernels_findings(root=str(tmp_path)))
+    assert "pins batch_pairs" in bad.message
+
+    # unreadable gates
+    path.write_text("{torn")
+    (bad,) = gating(kernels_findings(root=str(tmp_path)))
+    assert "unreadable" in bad.message
+
+
+def test_analyze_cli_exits_1_via_kernels_env_root(tmp_path):
+    doc = _kernels_doc(overhead={"regression_frac": 0.5})
+    (tmp_path / "BENCH_KERNELS_r99.json").write_text(json.dumps(doc))
+    env = {**os.environ, "GENE2VEC_TPU_KERNELS_ROOT": str(tmp_path)}
+    proc = subprocess.run(
+        [sys.executable, "-m", "gene2vec_tpu.cli.analyze", "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    fired = [f for f in out["findings"]
+             if f["pass"] == "kernels-attribution-budget"
+             and f["severity"] != "info"]
+    assert len(fired) == 1
+
+
+# -- ledger adapter ----------------------------------------------------------
+
+
+def test_ledger_adapts_kernels_family_from_committed_artifact(tmp_path):
+    from gene2vec_tpu.obs import ledger
+
+    assert os.path.exists(BENCH_KERNELS), (
+        "committed BENCH_KERNELS_r18.json is part of the contract"
+    )
+    shutil.copy(BENCH_KERNELS, tmp_path / "BENCH_KERNELS_r18.json")
+    (rec,) = ledger.ingest_root(str(tmp_path))
+    assert rec["family"] == "kernels" and rec["round"] == 18
+    assert rec["headline_metric"] == "kernel_profile_overhead_frac"
+    assert not rec["legacy_unstamped"]
+    m = rec["metrics"]
+    assert m["kernel_profile_overhead_frac"] is not None
+    for name in _budget()["require_kernels"]:
+        assert m[f"kernel_{name}_flops"] > 0
+        assert m[f"kernel_{name}_wall_s"] > 0
+        assert m[f"kernel_{name}_utilization"] > 0
+    assert m["kernel_sgns_utilization"] == (
+        m["kernel_sgns_train_step_utilization"]
+    )
