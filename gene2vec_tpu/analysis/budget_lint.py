@@ -1,0 +1,150 @@
+"""Dead-budget lint: budgets.json keys and gating passes must anchor.
+
+Two silent-rot failure modes this gates (docs/STATIC_ANALYSIS.md):
+
+* a ``budgets.json`` entry nothing reads — a budget that was renamed or
+  whose consumer was deleted keeps "passing" forever.  Every
+  ``section.subkey`` must be consumed: some ``.py`` file under
+  ``gene2vec_tpu/``, ``scripts/`` or ``tests/`` mentions BOTH the quoted
+  section name and the quoted subkey (the access idiom everywhere is
+  ``load_budgets().get("serve", {}).get("capacity_rps")`` or
+  ``budgets["resilience"]["async_ckpt"]``, so the literals are present
+  exactly when the budget is load-bearing);
+* a gating pass with no anchor — a pass id registered in the analyzer
+  but exercised by no planted-violation fixture and tied to no budget
+  can regress to never-fires without any signal.  Every AST and
+  concurrency pass id must appear quoted under ``tests/`` (its fixture)
+  or in ``budgets.json``.
+
+Both conditions gate as errors in the default ``cli.analyze`` tier,
+pass id ``budget-lint``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+
+PASS_ID = "budget-lint"
+
+#: sources scanned for budget-key consumption
+_CONSUMER_DIRS = ("gene2vec_tpu", "scripts", "tests")
+
+
+def _iter_sources(root: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for sub in _CONSUMER_DIRS:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in filenames:
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root)
+                if rel.endswith(os.path.join("analysis", "budget_lint.py")):
+                    continue  # the lint itself never counts as a consumer
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        out[rel] = f.read()
+                except OSError:
+                    continue
+    return out
+
+
+def _quoted_in(needle: str, text: str) -> bool:
+    return f'"{needle}"' in text or f"'{needle}'" in text
+
+
+def _anchor_line(lines: List[str], section: str, sub: str) -> int:
+    """The budgets.json line of ``"sub"`` inside ``"section"`` (best
+    effort; 1 when not found)."""
+    in_section = False
+    for i, text in enumerate(lines, start=1):
+        if f'"{section}"' in text:
+            in_section = True
+            continue
+        if in_section and f'"{sub}"' in text:
+            return i
+    return 1
+
+
+def budget_lint_findings(repo_root: Optional[str] = None) -> List[Finding]:
+    from gene2vec_tpu.analysis.runner import REPO_ROOT, pass_ids
+
+    root = repo_root or REPO_ROOT
+    budgets_rel = os.path.join("gene2vec_tpu", "analysis", "budgets.json")
+    budgets_path = os.path.join(root, budgets_rel)
+    with open(budgets_path, "r", encoding="utf-8") as f:
+        raw = f.read()
+    budgets = json.loads(raw)
+    lines = raw.splitlines()
+    sources = _iter_sources(root)
+
+    findings: List[Finding] = []
+
+    # ---- stale budget keys ----------------------------------------------
+    for section, entry in sorted(budgets.items()):
+        if section.startswith("_"):
+            continue
+        subkeys = sorted(entry) if isinstance(entry, dict) else [None]
+        # `budgets["sgns"].items()` iterates every subkey — that
+        # consumes the whole section without quoting the subkey names
+        iterated = re.compile(
+            r"[\"']" + re.escape(section) + r"[\"'].{0,40}\.items\(\)"
+        )
+        section_iterated = any(
+            iterated.search(text) for text in sources.values()
+        )
+        for sub in subkeys:
+            consumed = section_iterated or any(
+                _quoted_in(section, text)
+                and (sub is None or _quoted_in(sub, text))
+                for text in sources.values()
+            )
+            if consumed:
+                continue
+            key = section if sub is None else f"{section}.{sub}"
+            findings.append(Finding(
+                pass_id=PASS_ID,
+                message=(
+                    f"budgets.json key '{key}' is consumed by no pass, "
+                    "script, or test — a budget nothing reads cannot "
+                    "gate; delete the key or restore its consumer"
+                ),
+                path=budgets_rel,
+                line=_anchor_line(lines, section, sub or section),
+                snippet="",
+                data={"key": key},
+            ))
+
+    # ---- unanchored gating passes ---------------------------------------
+    from gene2vec_tpu.analysis.passes_concurrency import (
+        CONCURRENCY_PASS_IDS,
+    )
+
+    test_corpus = "".join(
+        text for rel, text in sources.items()
+        if rel.split(os.sep, 1)[0] == "tests"
+    )
+    for pid in list(pass_ids()) + list(CONCURRENCY_PASS_IDS) + [PASS_ID]:
+        if _quoted_in(pid, test_corpus) or _quoted_in(pid, raw):
+            continue
+        findings.append(Finding(
+            pass_id=PASS_ID,
+            message=(
+                f"gating pass '{pid}' has no fixture or budget anchor — "
+                "a pass no planted violation exercises can silently "
+                "stop firing; add a fixture under tests/ or tie it to "
+                "a budgets.json entry"
+            ),
+            path=budgets_rel,
+            line=1,
+            snippet="",
+            data={"pass": pid},
+        ))
+    return findings

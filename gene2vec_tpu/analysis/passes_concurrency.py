@@ -1,0 +1,310 @@
+"""Concurrency tier: lock discipline, loop blocking, lock order.
+
+Four project-level passes over the :mod:`threadflow` role/lock model
+(docs/STATIC_ANALYSIS.md "Concurrency tier").  Unlike the per-module
+AST passes these need the cross-module call graph, so they run as one
+unit from ``cli.analyze``'s default tier and from the fixture tests via
+:func:`concurrency_findings(files=..., select=...)`:
+
+* ``lock-discipline`` (error) — an attribute written from two or more
+  thread roles must have a common lock across all its write sites, be
+  handed off via a queue instead of written, or be declared with the
+  ``# graftcheck: shared=<reason>`` pragma (single-reference hot-swap
+  and monotonic-flag idioms).  Declared attrs emit an ``info`` finding
+  carrying the justification, so ``--json`` output surfaces every
+  suppression's written reason.
+* ``loop-thread-blocking`` (error) — generalizes passes_ast's
+  ``event-loop-blocking`` from the ``_on_*`` syntactic allowlist to
+  everything *reachable* from a loop-thread entry point; findings carry
+  the entry → ... → site witness chain.
+* ``blocking-while-locked`` (warning) — a blocking call made while
+  holding a lock that loop/worker threads also take stalls the serve
+  path behind slow I/O.
+* ``lock-order`` (error) — cycles in the static lock-acquisition graph
+  (nested ``with``-lock scopes, direct or through resolved calls) gate
+  with per-edge witness paths.
+
+The ``# graftcheck: disable=<pass-id>`` line pragma works here exactly
+as in the AST tier (routed through :func:`runner.suppressed`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.threadflow import (
+    ROLE_LOOP,
+    ROLE_WORKER,
+    FuncInfo,
+    LockId,
+    ThreadModel,
+    build_model,
+)
+
+CONCURRENCY_PASS_IDS = (
+    "lock-discipline",
+    "loop-thread-blocking",
+    "blocking-while-locked",
+    "lock-order",
+)
+
+
+def concurrency_findings(
+    repo_root: Optional[str] = None,
+    files: Optional[List[str]] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the concurrency tier; ``files`` scopes the model to explicit
+    modules (the fixture-test entry point), ``select`` to a subset of
+    :data:`CONCURRENCY_PASS_IDS`."""
+    from gene2vec_tpu.analysis.runner import REPO_ROOT, suppressed
+
+    root = repo_root or REPO_ROOT
+    want = set(select) if select is not None else set(CONCURRENCY_PASS_IDS)
+    unknown = want - set(CONCURRENCY_PASS_IDS)
+    if unknown:
+        raise ValueError(
+            f"unknown concurrency pass(es) {sorted(unknown)}; "
+            f"known: {list(CONCURRENCY_PASS_IDS)}"
+        )
+    model = build_model(root, files=files)
+    out: List[Finding] = []
+    if "lock-discipline" in want:
+        out.extend(_lock_discipline(model))
+    if "loop-thread-blocking" in want:
+        out.extend(_loop_thread_blocking(model))
+    if "blocking-while-locked" in want:
+        out.extend(_blocking_while_locked(model))
+    if "lock-order" in want:
+        out.extend(_lock_order(model))
+    kept = []
+    for f in out:
+        mod = model.modules.get(f.path)
+        if mod is not None and suppressed(mod, f):
+            continue
+        kept.append(f)
+    return kept
+
+
+def _held_at(site) -> FrozenSet[LockId]:
+    return site.held | (site.func.inherited or frozenset())
+
+
+def _lock_discipline(model: ThreadModel) -> List[Finding]:
+    by_attr: Dict[Tuple[str, Optional[str], str], List] = {}
+    for fn in model.funcs.values():
+        for w in fn.writes:
+            by_attr.setdefault(w.attr_id, []).append(w)
+
+    out: List[Finding] = []
+    for attr_id, sites in sorted(
+        by_attr.items(), key=lambda kv: (kv[0][0], kv[0][2])
+    ):
+        rel, cls, attr = attr_id
+        roles: Set[str] = set()
+        for w in sites:
+            roles |= model.roles_of(w.func)
+        if len(roles) < 2:
+            continue  # single-role attr: no cross-thread write hazard
+        common = None
+        for w in sites:
+            held = _held_at(w)
+            common = held if common is None else (common & held)
+        label = f"{cls}.{attr}" if cls else attr
+        declared = model.shared_declared.get(attr_id)
+        anchor = min(sites, key=lambda w: (w.line,))
+        detail = {
+            "attr": label,
+            "roles": sorted(roles),
+            "writes": [
+                {
+                    "path": w.func.mod.rel,
+                    "line": w.line,
+                    "func": w.func.qual,
+                    "roles": sorted(model.roles_of(w.func)),
+                    "locks": sorted(_held_at(w)),
+                }
+                for w in sorted(sites, key=lambda w: (w.func.mod.rel, w.line))
+            ],
+        }
+        if common:
+            continue  # every write path shares a lock: disciplined
+        if declared is not None:
+            detail["justification"] = declared
+            out.append(Finding(
+                pass_id="lock-discipline",
+                severity="info",
+                message=(
+                    f"shared attr {label} declared via pragma: {declared}"
+                ),
+                path=rel, line=anchor.line,
+                snippet=anchor.func.mod.line(anchor.line),
+                data=detail,
+            ))
+            continue
+        out.append(Finding(
+            pass_id="lock-discipline",
+            message=(
+                f"attr {label} written from roles "
+                f"{{{', '.join(sorted(roles))}}} with no common lock — "
+                "add a lock, hand off via a queue, or declare "
+                "`# graftcheck: shared=<reason>`"
+            ),
+            path=rel, line=anchor.line,
+            snippet=anchor.func.mod.line(anchor.line),
+            data=detail,
+        ))
+    return out
+
+
+def _loop_thread_blocking(model: ThreadModel) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for fn in sorted(
+        model.funcs.values(), key=lambda f: (f.mod.rel, f.node.lineno)
+    ):
+        if ROLE_LOOP not in fn.roles:
+            continue
+        chain = model.role_chain(fn, ROLE_LOOP)
+        for b in fn.blocking:
+            key = (fn.mod.rel, b.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                pass_id="loop-thread-blocking",
+                message=(
+                    f"{b.desc} reachable from a loop-thread entry "
+                    f"({' -> '.join(chain)}) — the event loop must "
+                    "never block"
+                ),
+                path=fn.mod.rel, line=b.line,
+                snippet=fn.mod.line(b.line),
+                data={"call": b.desc, "witness": chain},
+            ))
+    return out
+
+
+def _blocking_while_locked(model: ThreadModel) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in sorted(
+        model.funcs.values(), key=lambda f: (f.mod.rel, f.node.lineno)
+    ):
+        for b in fn.blocking:
+            held = _held_at(b)
+            if not held:
+                continue
+            serve_locks = sorted(
+                lock for lock in held
+                if model.lock_roles.get(lock, set()) & {ROLE_LOOP, ROLE_WORKER}
+            )
+            if not serve_locks:
+                continue
+            out.append(Finding(
+                pass_id="blocking-while-locked",
+                severity="warning",
+                message=(
+                    f"{b.desc} while holding {', '.join(serve_locks)} — "
+                    "serve threads contending on this lock stall behind "
+                    "the blocking call"
+                ),
+                path=fn.mod.rel, line=b.line,
+                snippet=fn.mod.line(b.line),
+                data={
+                    "call": b.desc, "locks": serve_locks,
+                    "func": fn.qual,
+                },
+            ))
+    return out
+
+
+def _lock_order(model: ThreadModel) -> List[Finding]:
+    # reachable acquisitions per function, with one witness path each
+    acq_star: Dict[str, Dict[LockId, Tuple[str, ...]]] = {
+        f.key: {} for f in model.funcs.values()
+    }
+    for f in model.funcs.values():
+        for lock, line, _held in f.acquires:
+            acq_star[f.key].setdefault(lock, (f"{f.qual} ({f.mod.rel}:{line})",))
+    for _ in range(24):
+        changed = False
+        for f in model.funcs.values():
+            mine = acq_star[f.key]
+            for site in f.calls:
+                for lock, path in acq_star[site.callee.key].items():
+                    if lock not in mine and len(path) < 8:
+                        mine[lock] = (
+                            f"{f.qual} ({f.mod.rel}:{site.line})",
+                        ) + path
+                        changed = True
+        if not changed:
+            break
+
+    # edges: holding L0, acquire L1 (directly or via a call)
+    edges: Dict[LockId, Dict[LockId, Tuple[str, int, str]]] = {}
+
+    def add_edge(l0: LockId, l1: LockId, rel: str, line: int, why: str):
+        if l0 == l1:
+            return  # RLock re-entry / self-edge: not an ordering edge
+        edges.setdefault(l0, {}).setdefault(l1, (rel, line, why))
+
+    for f in model.funcs.values():
+        inherited = f.inherited or frozenset()
+        for lock, line, held_before in f.acquires:
+            for l0 in held_before | inherited:
+                add_edge(
+                    l0, lock, f.mod.rel, line,
+                    f"{f.qual} ({f.mod.rel}:{line}) acquires {lock} "
+                    f"while holding {l0}",
+                )
+        for site in f.calls:
+            held = site.held | inherited
+            if not held:
+                continue
+            for lock, path in acq_star[site.callee.key].items():
+                for l0 in held:
+                    add_edge(
+                        l0, lock, f.mod.rel, site.line,
+                        f"{f.qual} ({f.mod.rel}:{site.line}) holding "
+                        f"{l0} -> " + " -> ".join(path),
+                    )
+
+    # cycle detection over the lock digraph
+    out: List[Finding] = []
+    seen_cycles: Set[Tuple[LockId, ...]] = set()
+    for start in sorted(edges):
+        stack = [(start, (start,))]
+        visited: Set[LockId] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start:
+                    cycle = path
+                    # canonical rotation so each cycle reports once
+                    i = cycle.index(min(cycle))
+                    canon = cycle[i:] + cycle[:i]
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    witness = []
+                    ring = list(cycle) + [cycle[0]]
+                    for a, b in zip(ring, ring[1:]):
+                        witness.append(edges[a][b][2])
+                    rel, line, _ = edges[cycle[-1]][start]
+                    out.append(Finding(
+                        pass_id="lock-order",
+                        message=(
+                            "lock-acquisition cycle "
+                            + " -> ".join(ring)
+                            + " (potential deadlock)"
+                        ),
+                        path=rel, line=line,
+                        snippet=model.modules[rel].line(line)
+                        if rel in model.modules else "",
+                        data={"cycle": list(canon), "witness": witness},
+                    ))
+                elif nxt not in path and nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + (nxt,)))
+    return out

@@ -203,12 +203,18 @@ def _libstdcxx_path() -> str:
 
 
 def run_parity(
-    kind: str, timeout: int = 600, options: Optional[str] = None
+    kind: str,
+    timeout: int = 600,
+    options: Optional[str] = None,
+    extra_env: Optional[dict] = None,
 ) -> subprocess.CompletedProcess:
     """Run :data:`PARITY_SCRIPT` in a sanitized child process.
     ``options`` overrides the default ``*SAN_OPTIONS`` (e.g. an
-    unsuppressed TSAN audit)."""
+    unsuppressed TSAN audit); ``extra_env`` adds child-only variables
+    (e.g. ``GRAFTCHECK_SMALL``) without mutating the caller's env."""
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     # pin the CHILD to CPU (it imports jax transitively and must not
     # claim an accelerator) — scoped here so the calling process's env
     # is never mutated by the sanitizer tier
@@ -241,6 +247,86 @@ def run_parity(
             stderr=_text(e.stderr)
             + f"\n[graftcheck] {kind} parity child timed out after {timeout}s",
         )
+
+
+def _tsan_supp_patterns() -> List[str]:
+    """The symbol patterns in native/tsan.supp (``race:X`` lines)."""
+    patterns: List[str] = []
+    try:
+        with open(os.path.join(NATIVE_DIR, "tsan.supp"), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#") and ":" in line:
+                    patterns.append(line.split(":", 1)[1])
+    except OSError:
+        pass
+    return patterns
+
+
+def tsan_control_findings(timeout: int = 600) -> List[Finding]:
+    """The unsuppressed control run: the same Hogwild workload under
+    TSAN *without* native/tsan.supp MUST report the intended lock-free
+    table races — they are the algorithm.  Zero reports means the
+    suppressed green run is vacuous (serialized workers, uninstrumented
+    build, or a supp pattern that now swallows everything); a supp
+    pattern matching no control report is a stale entry that would hide
+    a future real race symbolizing under that name.  Shrinks the epoch
+    via ``GRAFTCHECK_SMALL`` — unsuppressed TSAN logs every racy access,
+    and the full-size epoch would spend minutes printing."""
+    label = "sanitizer:tsan-control"
+    proc = run_parity(
+        "tsan", timeout=timeout,
+        options="halt_on_error=0:exitcode=66",
+        extra_env={"GRAFTCHECK_SMALL": "1"},
+    )
+    stderr = proc.stderr or ""
+    if proc.returncode == 124:
+        return [Finding(
+            pass_id="sanitizer",
+            path=label,
+            message="unsuppressed tsan control run timed out",
+            data={"stderr_tail": stderr[-4000:]},
+        )]
+    if "WARNING: ThreadSanitizer: data race" not in stderr:
+        return [Finding(
+            pass_id="sanitizer",
+            severity="warning",
+            path=label,
+            message=(
+                "unsuppressed tsan control run reported NO data races — "
+                "the Hogwild workers are no longer racing (serialized "
+                "build?) or TSAN is not engaging, so the suppressed "
+                "green run proves nothing; native/tsan.supp may be stale"
+            ),
+            data={"stderr_tail": stderr[-4000:]},
+        )]
+    findings: List[Finding] = []
+    for pattern in _tsan_supp_patterns():
+        if pattern in stderr:
+            continue
+        findings.append(Finding(
+            pass_id="sanitizer",
+            severity="warning",
+            path=label,
+            message=(
+                f"tsan.supp entry '{pattern}' matched no report in the "
+                "unsuppressed control run — a stale suppression would "
+                "hide a future real race symbolizing under that name"
+            ),
+            data={"pattern": pattern},
+        ))
+    if not findings:
+        findings.append(Finding(
+            pass_id="sanitizer",
+            severity="info",
+            path=label,
+            message=(
+                "unsuppressed control run reports the intended Hogwild "
+                "races and every tsan.supp entry matches — the "
+                "suppressions are load-bearing"
+            ),
+        ))
+    return findings
 
 
 def sanitizer_findings(kinds=("asan", "ubsan")) -> List[Finding]:
@@ -288,4 +374,9 @@ def sanitizer_findings(kinds=("asan", "ubsan")) -> List[Finding]:
                 path=label,
                 message=f"{kind} parity run clean",
             ))
+            if kind == "tsan":
+                # the suppressed run was green — prove it means
+                # something: the unsuppressed control binary must still
+                # report the intended Hogwild races
+                findings.extend(tsan_control_findings())
     return findings

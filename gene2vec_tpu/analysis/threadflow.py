@@ -1,0 +1,961 @@
+"""Interprocedural thread-role and lock model for the concurrency tier.
+
+The serve/fleet/loop planes are threaded Python: event-loop acceptors,
+HandlerPool workers, the micro-batcher worker, fleet supervisors and
+respawn monitors, registry watchers, shadow workers, async checkpoint
+writers.  Their invariants (hot-swap-by-single-reference, queue handoff,
+"never block the loop thread") were enforced only by tests and reviewer
+memory; this module gives :mod:`passes_concurrency` the static facts it
+needs to check them mechanically:
+
+* **thread entry points** — ``threading.Thread(target=...)``, event-loop
+  ``_on_*`` callbacks, ``HandlerPool.submit`` / ``submit_async`` /
+  ``observers.append`` / ``on_done=`` callback registrations — each
+  classified into a role (``loop`` / ``worker`` / ``monitor`` /
+  ``writer``; unthreaded code is implicitly ``main``);
+* a **conservative call graph** (``self.method()``, lexical bare names
+  via :func:`astpass._scope_index`'s approach, typed attributes
+  ``self.pool.submit`` where ``self.pool = HandlerPool(...)``, package
+  imports) through which roles propagate breadth-first with a witness
+  chain per (function, role);
+* the **lock model** — attributes/module globals initialized from
+  ``threading.Lock/RLock/Condition``, lexical ``with``-lock scopes, the
+  set of locks held at every call / attribute-write / blocking-call
+  site, plus an *inherited-held* fixpoint (a helper whose every call
+  site holds lock L is treated as running under L).
+
+Resolution is deliberately conservative: an ``obj.method()`` whose
+receiver cannot be typed is **not** followed (bounds false reach), and
+``__init__`` bodies are construction — they happen-before any thread
+start and are exempt from role accounting.
+
+The model is heuristic and lexical, like the rest of graftcheck tier 1;
+docs/STATIC_ANALYSIS.md ("Concurrency tier") documents the role model
+and its escape hatches (``# graftcheck: disable=<pass>`` and the
+``# graftcheck: shared=<reason>`` registry).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from gene2vec_tpu.analysis.astpass import (
+    ModuleSource,
+    chain_of,
+    iter_py_files,
+    resolve_chain,
+)
+
+#: thread roles.  ``main`` is implicit: a function no thread entry
+#: reaches runs only on the importing/CLI thread.
+ROLE_LOOP = "loop"
+ROLE_WORKER = "worker"
+ROLE_MONITOR = "monitor"
+ROLE_WRITER = "writer"
+ROLE_MAIN = "main"
+
+#: the event-loop callback shape (mirrors passes_ast's
+#: ``event-loop-blocking`` allowlist, which this tier generalizes)
+_CALLBACK_RE = re.compile(r"^_?on_[a-z0-9_]+$")
+
+#: classify a Thread by its ``name=`` literal / target-function name
+_ROLE_NAME_RULES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    (ROLE_LOOP, ("eventloop", "event_loop", "acceptor", "reactor")),
+    (ROLE_WRITER, ("writer", "write", "flush", "ckpt", "checkpoint")),
+    (ROLE_MONITOR, (
+        "monitor", "watch", "poll", "respawn", "scrape", "refresh",
+        "supervis", "reap", "sweep", "janitor", "timer", "tick",
+        "heartbeat", "probe", "canary",
+    )),
+)
+
+_LOCK_FACTORIES = ("threading.Lock", "threading.RLock", "threading.Condition")
+
+#: blocking calls by resolved dotted chain (the ISSUE-17 set: sleep /
+#: fsync / json encode / subprocess; jax dispatch via _BLOCKING_PREFIXES)
+_BLOCKING_CHAINS = {
+    "time.sleep", "json.dumps", "json.dump", "os.fsync", "os.fdatasync",
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+    "subprocess.call", "subprocess.Popen", "socket.create_connection",
+    "open",
+}
+_BLOCKING_PREFIXES = ("jax.",)  # any jax dispatch blocks the caller
+#: blocking by method name on an untyped receiver (socket I/O + device
+#: sync).  Deliberately excludes send/sendmsg: the loop's _flush path
+#: writes to non-blocking sockets and the noise would drown the signal.
+_BLOCKING_ATTRS = {
+    "sendall", "recv", "recv_into", "makefile", "accept",
+    "block_until_ready",
+}
+
+_SHARED_PRAGMA = re.compile(r"#\s*graftcheck:\s*shared=(.+?)\s*$")
+
+#: container-mutating method names counted as writes of the receiver
+#: attribute.  queue.put/put_nowait are deliberately absent: a bounded
+#: queue IS the sanctioned cross-thread handoff idiom.
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "move_to_end",
+}
+
+FuncKey = str          # "rel::Class.name" / "rel::name" / "rel::<lambda>@L17"
+LockId = str           # "rel::Class._lock" / "rel::_cache_lock"
+ClassKey = Tuple[str, str]  # (rel, class name)
+
+
+@dataclasses.dataclass
+class CallSite:
+    callee: "FuncInfo"
+    line: int
+    held: FrozenSet[LockId]          # lexically held at the call
+
+
+@dataclasses.dataclass
+class WriteSite:
+    attr_id: Tuple[str, Optional[str], str]  # (rel, class|None, attr)
+    line: int
+    held: FrozenSet[LockId]
+    func: "FuncInfo"
+
+
+@dataclasses.dataclass
+class BlockSite:
+    desc: str                        # "time.sleep", ".recv", "jax dispatch"
+    line: int
+    held: FrozenSet[LockId]
+    func: "FuncInfo"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: FuncKey
+    node: ast.AST                    # FunctionDef | AsyncFunctionDef | Lambda
+    mod: ModuleSource
+    cls: Optional[str]               # enclosing class name
+    name: str
+    roles: Set[str] = dataclasses.field(default_factory=set)
+    #: role -> (reason, caller FuncInfo | None, line) — witness link for
+    #: rendering entry -> ... -> here call chains
+    role_via: Dict[str, Tuple[str, Optional["FuncInfo"], int]] = (
+        dataclasses.field(default_factory=dict)
+    )
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    acquires: List[Tuple[LockId, int, FrozenSet[LockId]]] = (
+        dataclasses.field(default_factory=list)
+    )
+    writes: List[WriteSite] = dataclasses.field(default_factory=list)
+    blocking: List[BlockSite] = dataclasses.field(default_factory=list)
+    #: locks held at EVERY call site of this function (inherited-held
+    #: fixpoint); None until computed, frozenset() when nothing common
+    inherited: Optional[FrozenSet[LockId]] = None
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclasses.dataclass
+class ThreadModel:
+    """The whole-package concurrency model passes query."""
+
+    modules: Dict[str, ModuleSource]            # rel -> module
+    funcs: Dict[FuncKey, FuncInfo]
+    #: (rel, class|None, attr) -> declared justification from the
+    #: ``# graftcheck: shared=<reason>`` pragma registry
+    shared_declared: Dict[Tuple[str, Optional[str], str], str]
+    #: lock id -> roles of every function that acquires it
+    lock_roles: Dict[LockId, Set[str]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def roles_of(self, fn: FuncInfo) -> Set[str]:
+        return fn.roles if fn.roles else {ROLE_MAIN}
+
+    def role_chain(self, fn: FuncInfo, role: str) -> List[str]:
+        """Witness path entry -> ... -> fn for one propagated role."""
+        hops: List[str] = []
+        cur: Optional[FuncInfo] = fn
+        guard = 0
+        while cur is not None and guard < 32:
+            guard += 1
+            via = cur.role_via.get(role)
+            if via is None:
+                hops.append(cur.qual)
+                break
+            reason, parent, line = via
+            if parent is None:
+                hops.append(f"{cur.qual} [{reason}]")
+                break
+            hops.append(f"{cur.qual} (called at {parent.mod.rel}:{line})")
+            cur = parent
+        return list(reversed(hops))
+
+
+def _classify_thread_name(text: str) -> str:
+    low = text.lower()
+    for role, needles in _ROLE_NAME_RULES:
+        if any(n in low for n in needles):
+            return role
+    return ROLE_WORKER
+
+
+def _str_fragments(node: Optional[ast.AST]) -> str:
+    """Literal text of a str constant or the literal parts of an
+    f-string (``f"{name}-{i}"`` -> "-")."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return "".join(
+            v.value for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        )
+    return ""
+
+
+def _iter_own(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function
+    definitions (they are separate FuncInfos with their own sites)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+def _module_rel_of(dotted: str, modules: Dict[str, ModuleSource]) -> Optional[str]:
+    """"gene2vec_tpu.serve.eventloop" -> its rel path, if loaded."""
+    rel = dotted.replace(".", os.sep) + ".py"
+    if rel in modules:
+        return rel
+    rel_init = dotted.replace(".", os.sep) + os.sep + "__init__.py"
+    return rel_init if rel_init in modules else None
+
+
+class _ModuleIndex:
+    """Per-module symbol tables the resolver needs."""
+
+    def __init__(self, mod: ModuleSource):
+        self.mod = mod
+        self.toplevel: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, Dict[str, ast.AST]] = {}   # cls -> methods
+        self.class_of_method: Dict[str, List[str]] = {}    # method -> classes
+        self.module_locks: Set[str] = set()
+        #: (cls, attr) -> ClassKey of the instance stored there
+        self.attr_types: Dict[Tuple[str, str], ClassKey] = {}
+        #: (cls, attr) -> element ClassKey for list-of-instances attrs
+        self.attr_elem_types: Dict[Tuple[str, str], ClassKey] = {}
+        self.lock_attrs: Dict[Tuple[str, str], int] = {}   # (cls, attr) -> line
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.toplevel[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        methods[item.name] = item
+                        self.class_of_method.setdefault(
+                            item.name, []
+                        ).append(node.name)
+                self.classes[node.name] = methods
+            elif isinstance(node, ast.Assign):
+                if (
+                    isinstance(node.value, ast.Call)
+                    and self._is_lock_factory(node.value)
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module_locks.add(tgt.id)
+
+    def _is_lock_factory(self, call: ast.Call) -> bool:
+        chain = chain_of(call.func)
+        if chain is None:
+            return False
+        return resolve_chain(chain, self.mod.imports()) in _LOCK_FACTORIES
+
+
+def build_model(
+    repo_root: str,
+    files: Optional[List[str]] = None,
+    package_dir: str = "gene2vec_tpu",
+) -> ThreadModel:
+    """Parse the package (or an explicit file list) and derive the
+    role/lock model.  Pure and jax-free; ~100ms for the full package."""
+    modules: Dict[str, ModuleSource] = {}
+    if files is not None:
+        paths = [os.path.abspath(f) for f in files]
+    else:
+        paths = list(iter_py_files(os.path.join(repo_root, package_dir)))
+    for path in paths:
+        mod = ModuleSource.load(path, repo_root)
+        if mod is not None:
+            modules[mod.rel] = mod
+
+    indexes = {rel: _ModuleIndex(m) for rel, m in modules.items()}
+    model = ThreadModel(modules=modules, funcs={}, shared_declared={})
+
+    # ---- function inventory (incl. nested defs and lambdas) --------------
+    func_of_node: Dict[int, FuncInfo] = {}
+    class_stack_of: Dict[int, Optional[str]] = {}
+
+    for rel, mod in modules.items():
+        def visit(parent: ast.AST, cls: Optional[str], fn_depth: int) -> None:
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, ast.ClassDef):
+                    # only top-level classes own methods for role keys;
+                    # nested classes keep the outer name for display
+                    visit(child, child.name if fn_depth == 0 else cls, fn_depth)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    name = getattr(child, "name", f"<lambda>@{child.lineno}")
+                    in_class = cls if fn_depth == 0 else None
+                    qual = f"{in_class}.{name}" if in_class else name
+                    key = f"{rel}::{qual}"
+                    if key in model.funcs:          # same-named siblings
+                        key = f"{rel}::{qual}@{child.lineno}"
+                    fi = FuncInfo(key, child, mod, in_class or cls, name)
+                    model.funcs[key] = fi
+                    func_of_node[id(child)] = fi
+                    class_stack_of[id(child)] = cls
+                    visit(child, cls, fn_depth + 1)
+                else:
+                    visit(child, cls, fn_depth)
+
+        visit(mod.tree, None, 0)
+
+    # ---- attribute types + lock attrs (from any method body) -------------
+    def resolve_class(chain: str, mod: ModuleSource) -> Optional[ClassKey]:
+        resolved = resolve_chain(chain, mod.imports())
+        idx = indexes[mod.rel]
+        if resolved in idx.classes:
+            return (mod.rel, resolved)
+        head, _, cls_name = resolved.rpartition(".")
+        target_rel = _module_rel_of(head, modules) if head else None
+        if target_rel and cls_name in indexes[target_rel].classes:
+            return (target_rel, cls_name)
+        return None
+
+    for rel, mod in modules.items():
+        idx = indexes[rel]
+        for fi in (f for f in model.funcs.values() if f.mod is mod and f.cls):
+            for node in _iter_own(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        continue
+                    val = node.value
+                    if isinstance(val, ast.Call):
+                        if idx._is_lock_factory(val):
+                            idx.lock_attrs[(fi.cls, tgt.attr)] = node.lineno
+                            continue
+                        chain = chain_of(val.func)
+                        ck = resolve_class(chain, mod) if chain else None
+                        if ck is not None:
+                            idx.attr_types[(fi.cls, tgt.attr)] = ck
+                    elif isinstance(val, ast.Name):
+                        # ``self.app = app`` where the enclosing method
+                        # annotates ``app: ServeApp`` — param typing
+                        ann = _param_annotation(fi.node, val.id)
+                        chain = chain_of(ann) if ann is not None else None
+                        ck = resolve_class(chain, mod) if chain else None
+                        if ck is not None:
+                            idx.attr_types[(fi.cls, tgt.attr)] = ck
+                    elif isinstance(val, ast.ListComp) and isinstance(
+                        val.elt, ast.Call
+                    ):
+                        chain = chain_of(val.elt.func)
+                        ck = resolve_class(chain, mod) if chain else None
+                        if ck is not None:
+                            idx.attr_elem_types[(fi.cls, tgt.attr)] = ck
+
+    # ---- shared= pragma registry -----------------------------------------
+    for rel, mod in modules.items():
+        for lineno, text in enumerate(mod.lines, start=1):
+            m = _SHARED_PRAGMA.search(text)
+            if not m:
+                continue
+            # the pragma anchors a `self.attr = ...` (or `global`-write)
+            # line; register the attr it declares
+            code = text.split("#", 1)[0]
+            owner = _owning_class_at(mod, lineno, model)
+            registered = False
+            try:
+                stmt = ast.parse(code.strip()).body
+            except SyntaxError:
+                stmt = []
+            for node in stmt[:1]:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        model.shared_declared[(rel, owner, tgt.attr)] = (
+                            m.group(1)
+                        )
+                        registered = True
+                    elif isinstance(tgt, ast.Name):
+                        model.shared_declared[(rel, None, tgt.id)] = m.group(1)
+                        registered = True
+            if not registered and "=" in code:
+                # multi-line statement head (`self.x: T = (` won't parse
+                # alone): fall back to a lexical target match
+                m2 = re.match(r"\s*self\.(\w+)\b", code)
+                if m2:
+                    model.shared_declared[(rel, owner, m2.group(1))] = (
+                        m.group(1)
+                    )
+
+    # ---- call edges + lock scopes + write/blocking sites -----------------
+    for fi in model.funcs.values():
+        _scan_function(fi, indexes, modules, model, func_of_node)
+
+    # ---- thread entry discovery ------------------------------------------
+    _discover_entries(model, indexes, modules, func_of_node)
+
+    # ---- role propagation (BFS over call edges) --------------------------
+    frontier = [f for f in model.funcs.values() if f.roles]
+    while frontier:
+        nxt: List[FuncInfo] = []
+        for f in frontier:
+            for site in f.calls:
+                g = site.callee
+                if g.name == "__init__":
+                    continue  # construction happens-before thread start
+                new = f.roles - g.roles
+                if new:
+                    g.roles |= new
+                    for role in new:
+                        g.role_via.setdefault(role, ("call", f, site.line))
+                    nxt.append(g)
+        frontier = nxt
+
+    # ---- inherited-held fixpoint -----------------------------------------
+    callers: Dict[FuncKey, List[Tuple[FuncInfo, CallSite]]] = {}
+    for f in model.funcs.values():
+        for site in f.calls:
+            callers.setdefault(site.callee.key, []).append((f, site))
+    entry_funcs = {
+        f.key for f in model.funcs.values()
+        if any(parent is None for _, parent, _ in f.role_via.values())
+    }
+    for _ in range(12):
+        changed = False
+        for g in model.funcs.values():
+            sites = callers.get(g.key)
+            if not sites or g.key in entry_funcs:
+                continue  # entries run with no caller-held locks
+            acc: Optional[FrozenSet[LockId]] = None
+            for caller, site in sites:
+                held = site.held | (caller.inherited or frozenset())
+                acc = held if acc is None else (acc & held)
+            acc = acc or frozenset()
+            if acc != g.inherited:
+                g.inherited = acc
+                changed = True
+        if not changed:
+            break
+    # an entry point / uncalled function inherits nothing
+    for g in model.funcs.values():
+        if g.inherited is None:
+            g.inherited = frozenset()
+
+    # ---- lock -> acquirer roles ------------------------------------------
+    for f in model.funcs.values():
+        for lock_id, _line, _held in f.acquires:
+            model.lock_roles.setdefault(lock_id, set()).update(
+                model.roles_of(f)
+            )
+    return model
+
+
+def _param_annotation(fn_node: ast.AST, name: str) -> Optional[ast.AST]:
+    """The annotation expression of parameter ``name``, if any."""
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return None
+    for a in list(args.args) + list(args.kwonlyargs) + list(args.posonlyargs):
+        if a.arg == name:
+            return a.annotation
+    return None
+
+
+def _owning_class_at(
+    mod: ModuleSource, lineno: int, model: ThreadModel
+) -> Optional[str]:
+    """The class whose method spans ``lineno`` (for pragma anchoring)."""
+    best: Optional[FuncInfo] = None
+    for f in model.funcs.values():
+        if f.mod is not mod or f.cls is None:
+            continue
+        end = getattr(f.node, "end_lineno", f.node.lineno)
+        if f.node.lineno <= lineno <= end:
+            if best is None or f.node.lineno > best.node.lineno:
+                best = f
+    return best.cls if best else None
+
+
+def _scan_function(
+    fi: FuncInfo,
+    indexes: Dict[str, _ModuleIndex],
+    modules: Dict[str, ModuleSource],
+    model: ThreadModel,
+    func_of_node: Dict[int, FuncInfo],
+) -> None:
+    mod = fi.mod
+    idx = indexes[mod.rel]
+    imports = mod.imports()
+    local_types: Dict[str, ClassKey] = {}
+    # seed locals from parameter annotations (`def f(app: ServeApp)`)
+    args = getattr(fi.node, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is None:
+                continue
+            chain = chain_of(a.annotation)
+            if chain is not None:
+                ck = _resolve_class_key(chain, mod, indexes, modules)
+                if ck is not None:
+                    local_types[a.arg] = ck
+
+    def lock_id_of(expr: ast.AST) -> Optional[LockId]:
+        chain = chain_of(expr)
+        if chain is None:
+            return None
+        if chain.startswith("self.") and fi.cls:
+            attr = chain[5:]
+            if (fi.cls, attr) in idx.lock_attrs:
+                return f"{mod.rel}::{fi.cls}.{attr}"
+            return None
+        if "." not in chain and chain in idx.module_locks:
+            return f"{mod.rel}::{chain}"
+        return None
+
+    def class_of_receiver(parts: List[str]) -> Optional[ClassKey]:
+        """Type a dotted receiver: ``self[.attr]*`` / ``var[.attr]*``,
+        folding each hop through the owning module's attr_types."""
+        if not parts:
+            return None
+        if parts[0] == "self":
+            if not fi.cls:
+                return None
+            cur: Optional[ClassKey] = (mod.rel, fi.cls)
+        elif parts[0] in local_types:
+            cur = local_types[parts[0]]
+        else:
+            return None
+        for attr in parts[1:]:
+            cur = indexes[cur[0]].attr_types.get((cur[1], attr))
+            if cur is None:
+                return None
+        return cur
+
+    def resolve_callee(call: ast.Call) -> Optional[FuncInfo]:
+        chain = chain_of(call.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        # bare name: lexical nested def, then module top level, then
+        # `from package.mod import fn` imports
+        if len(parts) == 1:
+            name = parts[0]
+            hit = _resolve_bare(name, fi, idx, func_of_node)
+            if hit is not None:
+                return hit
+            resolved = imports.get(name)
+            if resolved and resolved.startswith("gene2vec_tpu."):
+                head, _, fn_name = resolved.rpartition(".")
+                target_rel = _module_rel_of(head, modules)
+                if target_rel:
+                    node = indexes[target_rel].toplevel.get(fn_name)
+                    return (
+                        func_of_node.get(id(node)) if node is not None else None
+                    )
+            return None
+        # typed receiver: self.m() / self.attr.m() / var.m() /
+        # var.attr.m() / self.a.b.m() ... through attr_types hops
+        ck = class_of_receiver(parts[:-1])
+        if ck is not None:
+            node = indexes[ck[0]].classes.get(ck[1], {}).get(parts[-1])
+            return func_of_node.get(id(node)) if node is not None else None
+        # alias.fn() through a package-module import
+        if len(parts) == 2:
+            base = imports.get(parts[0], parts[0])
+            target_rel = _module_rel_of(base, modules)
+            if target_rel:
+                node = indexes[target_rel].toplevel.get(parts[1])
+                return func_of_node.get(id(node)) if node is not None else None
+        return None
+
+    def visit(node: ast.AST, held: FrozenSet[LockId]) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return  # nested defs scanned as their own FuncInfo
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                lid = lock_id_of(item.context_expr)
+                if lid is not None:
+                    fi.acquires.append((lid, node.lineno, inner))
+                    inner = inner | {lid}
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            # local instance typing: x = ClassName(...) / x = self.attr
+            if len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                ck = None
+                if isinstance(node.value, ast.Call):
+                    chain = chain_of(node.value.func)
+                    if chain is not None:
+                        ck = _resolve_class_key(chain, mod, indexes, modules)
+                elif isinstance(node.value, ast.Attribute):
+                    chain = chain_of(node.value)
+                    if chain is not None:
+                        parts = chain.split(".")
+                        ck = class_of_receiver(parts[:-1])
+                        if ck is not None:
+                            ck = indexes[ck[0]].attr_types.get(
+                                (ck[1], parts[-1])
+                            )
+                if ck is not None:
+                    local_types[node.targets[0].id] = ck
+            _record_write_targets(node.targets, node.lineno, held)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if not (isinstance(node, ast.AnnAssign) and node.value is None):
+                _record_write_targets([node.target], node.lineno, held)
+        if isinstance(node, ast.Call):
+            callee = resolve_callee(node)
+            if callee is not None:
+                fi.calls.append(CallSite(callee, node.lineno, held))
+            _record_blocking(node, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    def _record_write_targets(
+        targets: List[ast.AST], lineno: int, held: FrozenSet[LockId]
+    ) -> None:
+        if fi.name == "__init__":
+            return  # construction happens-before thread start
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                _record_write_targets(list(tgt.elts), lineno, held)
+                continue
+            if isinstance(tgt, ast.Subscript):
+                # self.x[k] = v mutates the container self.x holds
+                _record_write_targets([tgt.value], lineno, held)
+                continue
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and fi.cls
+            ):
+                fi.writes.append(WriteSite(
+                    (mod.rel, fi.cls, tgt.attr), lineno, held, fi
+                ))
+            elif isinstance(tgt, ast.Name) and tgt.id in _globals_of(fi):
+                fi.writes.append(WriteSite(
+                    (mod.rel, None, tgt.id), lineno, held, fi
+                ))
+
+    def _record_blocking(call: ast.Call, held: FrozenSet[LockId]) -> None:
+        chain = chain_of(call.func)
+        if chain is None:
+            return
+        # self.x.append(...) / cache.update(...): a container mutation
+        # is a write of the receiver attribute for lock discipline
+        parts = chain.split(".")
+        if parts[-1] in _MUTATOR_METHODS and len(parts) >= 2:
+            if parts[0] == "self" and len(parts) == 3 and fi.cls:
+                _record_write_targets(
+                    [ast.Attribute(
+                        value=ast.Name(id="self", ctx=ast.Load()),
+                        attr=parts[1], ctx=ast.Store(),
+                    )],
+                    call.lineno, held,
+                )
+            elif len(parts) == 2 and parts[0] in _globals_of(fi):
+                fi.writes.append(WriteSite(
+                    (mod.rel, None, parts[0]), call.lineno, held, fi
+                ))
+        resolved = resolve_chain(chain, imports)
+        if resolved in _BLOCKING_CHAINS:
+            fi.blocking.append(BlockSite(resolved, call.lineno, held, fi))
+            return
+        if any(resolved.startswith(p) for p in _BLOCKING_PREFIXES):
+            fi.blocking.append(
+                BlockSite(f"jax dispatch ({resolved})", call.lineno, held, fi)
+            )
+            return
+        attr = chain.rsplit(".", 1)[-1]
+        if "." in chain and attr in _BLOCKING_ATTRS:
+            fi.blocking.append(BlockSite(f".{attr}", call.lineno, held, fi))
+
+    for top in ast.iter_child_nodes(fi.node):
+        visit(top, frozenset())
+
+
+def _globals_of(fi: FuncInfo) -> Set[str]:
+    names: Set[str] = set()
+    for node in _iter_own(fi.node):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+def _resolve_bare(
+    name: str, fi: FuncInfo, idx: _ModuleIndex,
+    func_of_node: Dict[int, FuncInfo],
+) -> Optional[FuncInfo]:
+    """A bare callee name: nested def in this function, else module top
+    level (a sibling method is never assumed — that needs ``self.``)."""
+    for node in _iter_own(fi.node):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == name
+        ):
+            return func_of_node.get(id(node))
+    node = idx.toplevel.get(name)
+    return func_of_node.get(id(node)) if node is not None else None
+
+
+def _resolve_class_key(
+    chain: str, mod: ModuleSource,
+    indexes: Dict[str, _ModuleIndex],
+    modules: Dict[str, ModuleSource],
+) -> Optional[ClassKey]:
+    resolved = resolve_chain(chain, mod.imports())
+    if resolved in indexes[mod.rel].classes:
+        return (mod.rel, resolved)
+    head, _, cls_name = resolved.rpartition(".")
+    target_rel = _module_rel_of(head, modules) if head else None
+    if target_rel and cls_name in indexes[target_rel].classes:
+        return (target_rel, cls_name)
+    return None
+
+
+def _discover_entries(
+    model: ThreadModel,
+    indexes: Dict[str, _ModuleIndex],
+    modules: Dict[str, ModuleSource],
+    func_of_node: Dict[int, FuncInfo],
+) -> None:
+    """Tag thread entry points with their roles + entry reasons."""
+    # (1) event-loop callbacks: _on_* methods.  Scoped to serve/ (the
+    # event-loop plane — same jurisdiction passes_ast's
+    # event-loop-blocking has): obs/resilience reuse the on_* naming for
+    # alert/signal callbacks that run on monitor or main threads.
+    for fi in model.funcs.values():
+        if (
+            fi.cls and _CALLBACK_RE.match(fi.name)
+            and f"serve{os.sep}" in fi.mod.rel
+        ):
+            _tag(fi, ROLE_LOOP, "event-loop callback (_on_*)")
+
+    for fi in list(model.funcs.values()):
+        idx = indexes[fi.mod.rel]
+        imports = fi.mod.imports()
+        # local instance typing for handler registration: the
+        # `adapter = ServeAdapter(app); EventLoopHTTPServer(adapter)`
+        # idiom needs the var's class to find its __call__
+        local_types: Dict[str, ClassKey] = {}
+        for node in _iter_own(fi.node):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                chain = chain_of(node.value.func)
+                ck = (
+                    _resolve_class_key(chain, fi.mod, indexes, modules)
+                    if chain else None
+                )
+                if ck is not None:
+                    local_types[node.targets[0].id] = ck
+        for node in _iter_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = chain_of(node.func)
+            resolved = resolve_chain(chain, imports) if chain else None
+            # (2) threading.Thread(target=..., name=...)
+            if resolved == "threading.Thread":
+                target = _kwarg(node, "target")
+                name_txt = _str_fragments(_kwarg(node, "name"))
+                cb = _callback_func(
+                    target, fi, idx, indexes, modules, func_of_node
+                )
+                if cb is not None:
+                    role = _classify_thread_name(name_txt or cb.name)
+                    _tag(cb, role, f"Thread target at {fi.mod.rel}:{node.lineno}")
+                continue
+            # (2b) event-loop server construction: the ``handler`` /
+            # ``on_*`` callables handed to a serve/-plane class
+            # constructor are invoked on the loop thread
+            ctor = (
+                _resolve_class_key(chain, fi.mod, indexes, modules)
+                if chain else None
+            )
+            if ctor is not None and f"serve{os.sep}" in ctor[0]:
+                init = indexes[ctor[0]].classes.get(ctor[1], {}).get("__init__")
+                if init is not None:
+                    params = [a.arg for a in init.args.args[1:]]
+                    bound: List[Tuple[str, ast.AST]] = list(
+                        zip(params, node.args)
+                    )
+                    bound.extend(
+                        (kw.arg, kw.value)
+                        for kw in node.keywords if kw.arg
+                    )
+                    for pname, arg in bound:
+                        if pname != "handler" and not _CALLBACK_RE.match(pname):
+                            continue
+                        cb = None
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in local_types
+                        ):
+                            ck2 = local_types[arg.id]
+                            mnode = indexes[ck2[0]].classes.get(
+                                ck2[1], {}
+                            ).get("__call__")
+                            cb = (
+                                func_of_node.get(id(mnode))
+                                if mnode is not None else None
+                            )
+                        if cb is None:
+                            cb = _callback_func(
+                                arg, fi, idx, indexes, modules, func_of_node
+                            )
+                        if cb is not None:
+                            _tag(
+                                cb, ROLE_LOOP,
+                                f"event-loop handler registered at "
+                                f"{fi.mod.rel}:{node.lineno}",
+                            )
+                continue
+            # (3) pool.submit(fn) / submit_async(..., on_done=fn) /
+            #     observers.append(fn) / add_observer(fn)
+            attr = chain.rsplit(".", 1)[-1] if chain and "." in chain else None
+            cb_args: List[Tuple[ast.AST, str]] = []
+            if attr in ("submit", "submit_async"):
+                receiver = chain.rsplit(".", 1)[0]
+                role = (
+                    ROLE_WRITER if "writer" in receiver.lower()
+                    or "ckpt" in receiver.lower() else ROLE_WORKER
+                )
+                for a in node.args[:1]:
+                    cb_args.append((a, role))
+                od = _kwarg(node, "on_done")
+                if od is not None:
+                    cb_args.append((od, ROLE_WORKER))
+            elif attr in ("add_observer", "register_observer"):
+                for a in node.args[:1]:
+                    cb_args.append((a, ROLE_WORKER))
+            elif attr == "append" and chain.endswith("observers.append"):
+                for a in node.args[:1]:
+                    cb_args.append((a, ROLE_WORKER))
+            else:
+                od = _kwarg(node, "on_done")
+                if od is not None:
+                    cb_args.append((od, ROLE_WORKER))
+            for arg, role in cb_args:
+                cb = _callback_func(
+                    arg, fi, idx, indexes, modules, func_of_node
+                )
+                if cb is not None:
+                    _tag(
+                        cb, role,
+                        f"callback registered at {fi.mod.rel}:{node.lineno}",
+                    )
+
+
+def _tag(fi: FuncInfo, role: str, reason: str) -> None:
+    if role not in fi.roles:
+        fi.roles.add(role)
+        fi.role_via.setdefault(role, (reason, None, fi.node.lineno))
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _callback_func(
+    expr: Optional[ast.AST],
+    fi: FuncInfo,
+    idx: _ModuleIndex,
+    indexes: Dict[str, _ModuleIndex],
+    modules: Dict[str, ModuleSource],
+    func_of_node: Dict[int, FuncInfo],
+) -> Optional[FuncInfo]:
+    """Resolve a function-valued expression to its FuncInfo: a lambda,
+    ``self.method``, a bare def name, or ``obj.method`` through local /
+    attribute / unique-in-module typing."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Lambda):
+        return func_of_node.get(id(expr))
+    chain = chain_of(expr)
+    if chain is None:
+        return None
+    parts = chain.split(".")
+    if parts[0] == "self" and fi.cls:
+        if len(parts) == 2:
+            node = idx.classes.get(fi.cls, {}).get(parts[1])
+            return func_of_node.get(id(node)) if node is not None else None
+        if len(parts) == 3:
+            ck = idx.attr_types.get((fi.cls, parts[1]))
+            if ck is None:
+                ck = idx.attr_elem_types.get((fi.cls, parts[1]))
+            if ck is not None:
+                node = indexes[ck[0]].classes.get(ck[1], {}).get(parts[2])
+                return func_of_node.get(id(node)) if node is not None else None
+        return None
+    if len(parts) == 1:
+        return _resolve_bare(parts[0], fi, idx, func_of_node)
+    if len(parts) == 2:
+        method = parts[1]
+        # last resort: a method name defined by exactly ONE class in
+        # this module (covers `Thread(target=loop.run)` where `loop`
+        # iterates a typed list attribute), else by exactly one class
+        # package-wide (`Thread(target=server.serve_forever)`) —
+        # common names (run, submit, get, ...) stay ambiguous and are
+        # conservatively not followed
+        owners = idx.class_of_method.get(method, [])
+        if len(owners) == 1:
+            node = idx.classes[owners[0]].get(method)
+            return func_of_node.get(id(node)) if node is not None else None
+        hits = [
+            other.classes[c][method]
+            for other in indexes.values()
+            for c in other.class_of_method.get(method, [])
+        ]
+        if len(hits) == 1:
+            return func_of_node.get(id(hits[0]))
+    return None

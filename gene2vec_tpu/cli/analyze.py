@@ -3,8 +3,11 @@
 Tiers (docs/STATIC_ANALYSIS.md):
 
 * default — the fast AST lint passes over ``gene2vec_tpu/`` (+
-  ``experiments/`` for stdout discipline) and the round-summary claim
-  scan; jax never imports;
+  ``experiments/`` for stdout discipline), the concurrency tier
+  (threadflow role inference: lock-discipline, loop-thread-blocking,
+  blocking-while-locked, lock-order), the dead-budget lint
+  (``budget-lint``), and the round-summary claim scan; jax never
+  imports;
 * ``--hlo hot`` — compile small SGNS / CBOW-HS / GGIPNN instances on the
   virtual 8-device CPU backend and check host callbacks, dtype
   discipline, jit cache stability;
@@ -107,13 +110,35 @@ def _run(args) -> int:
         run_ast_passes,
     )
 
+    from gene2vec_tpu.analysis.budget_lint import PASS_ID as BUDGET_LINT
+    from gene2vec_tpu.analysis.passes_concurrency import (
+        CONCURRENCY_PASS_IDS,
+    )
+
     if args.list_passes:
-        for pid in pass_ids():
+        for pid in list(pass_ids()) + list(CONCURRENCY_PASS_IDS) + [
+            BUDGET_LINT
+        ]:
             print(pid)
         return 0
 
     select = args.select.split(",") if args.select else None
     skip = args.skip.split(",") if args.skip else None
+
+    # the concurrency tier and budget lint are project-level passes with
+    # their own ids: split them out so `--select lock-discipline` runs
+    # just that pass and the AST runner never sees a foreign id
+    project_ids = set(CONCURRENCY_PASS_IDS) | {BUDGET_LINT}
+    conc_select = list(CONCURRENCY_PASS_IDS)
+    run_lint = True
+    if select is not None:
+        conc_select = [p for p in select if p in CONCURRENCY_PASS_IDS]
+        run_lint = BUDGET_LINT in select
+        select = [p for p in select if p not in project_ids]
+    if skip is not None:
+        conc_select = [p for p in conc_select if p not in skip]
+        run_lint = run_lint and BUDGET_LINT not in skip
+        skip = [p for p in skip if p not in project_ids] or None
 
     # validate sanitizer kinds up front — a typo must fail in
     # milliseconds, not after minutes of HLO compilation
@@ -127,9 +152,27 @@ def _run(args) -> int:
             print(f"error: unknown sanitizer(s) {unknown}", file=sys.stderr)
             return 2
 
-    findings = run_ast_passes(
-        select=select, skip=skip, files=args.files or None,
-    )
+    findings = []
+    if select is None or select:
+        findings.extend(run_ast_passes(
+            select=select, skip=skip, files=args.files or None,
+        ))
+
+    # concurrency tier: default, or whatever --select asked for (it
+    # honors explicit files the way the AST passes do)
+    if conc_select and (args.select or not args.files):
+        from gene2vec_tpu.analysis.passes_concurrency import (
+            concurrency_findings,
+        )
+
+        findings.extend(concurrency_findings(
+            files=args.files or None,
+            select=conc_select,
+        ))
+    if run_lint and not args.files:
+        from gene2vec_tpu.analysis.budget_lint import budget_lint_findings
+
+        findings.extend(budget_lint_findings())
 
     if not args.no_summaries and not args.files and select is None:
         from gene2vec_tpu.analysis.summaries import (

@@ -332,7 +332,10 @@ class _AcceptorLoop:
         self.lsock = lsock
         self.sel = selectors.DefaultSelector()
         self.conns: Dict[int, _Conn] = {}
-        self._completions: Deque[Tuple[_Conn, int, str, object]] = (
+        # cross-thread completion handoff: workers append, the loop
+        # drains after a self-pipe wake; deque.append/popleft are
+        # GIL-atomic and stale entries are dropped by the seq check
+        self._completions: Deque[Tuple[_Conn, int, str, object]] = (  # graftcheck: shared=GIL-atomic deque handoff; loop drains after self-pipe wake, seq check drops stale entries
             collections.deque()
         )
         self._wake_r, self._wake_w = socket.socketpair()
@@ -388,7 +391,9 @@ class _AcceptorLoop:
     def _on_accept(self) -> None:
         for _ in range(128):  # bounded accept burst per wakeup
             try:
-                sock, _addr = self.lsock.accept()
+                # non-blocking listener: accept() raises BlockingIOError
+                # instead of waiting
+                sock, _addr = self.lsock.accept()  # graftcheck: disable=loop-thread-blocking
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
@@ -458,7 +463,8 @@ class _AcceptorLoop:
     def _drain_waker(self) -> None:
         """Drain the (non-blocking) self-pipe."""
         try:
-            while self._wake_r.recv(4096):
+            # non-blocking self-pipe read; loop exits on BlockingIOError
+            while self._wake_r.recv(4096):  # graftcheck: disable=loop-thread-blocking
                 pass
         except (BlockingIOError, InterruptedError):
             pass
@@ -467,7 +473,8 @@ class _AcceptorLoop:
         """Read what the socket has.  False when the connection died
         (and was cleaned up)."""
         try:
-            chunk = conn.sock.recv(262144)
+            # conn sockets are non-blocking (setblocking(False) at accept)
+            chunk = conn.sock.recv(262144)  # graftcheck: disable=loop-thread-blocking
         except (BlockingIOError, InterruptedError):
             return True
         except OSError:
@@ -795,6 +802,7 @@ class EventLoopHTTPServer:
             ))
         self._loops = [_AcceptorLoop(self, s) for s in socks]
         self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
         self._started = threading.Event()
         self._stopped = threading.Event()
 
@@ -805,12 +813,15 @@ class EventLoopHTTPServer:
         the calling thread) until :meth:`shutdown`."""
         self._stopped.clear()
         self._started.set()
-        for loop in self._loops[1:]:
-            t = threading.Thread(
-                target=loop.run, name="http-eventloop", daemon=True
-            )
-            t.start()
-            self._threads.append(t)
+        # spawn under the lock: a shutdown() racing this loop would
+        # otherwise join a partial list and leak later-started threads
+        with self._threads_lock:
+            for loop in self._loops[1:]:
+                t = threading.Thread(
+                    target=loop.run, name="http-eventloop", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
         try:
             self._loops[0].run()
         finally:
@@ -819,9 +830,10 @@ class EventLoopHTTPServer:
     def shutdown(self) -> None:
         for loop in self._loops:
             loop.stop()
-        for t in self._threads:
+        with self._threads_lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
             t.join(timeout=5.0)
-        self._threads = []
 
     def server_close(self) -> None:
         self.shutdown()

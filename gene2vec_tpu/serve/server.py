@@ -995,10 +995,19 @@ class ServeAdapter:
             app.metrics.counter(f"serve_http_{status}_total").inc()
         burst = app.flight.record(route, status, dur)
         if burst and app.flight_dir:
-            try:
-                app.flight.dump(app.flight_dir, "5xx-burst")
-            except OSError:
-                pass
+            # dump on a pool worker: _account runs on the loop thread
+            # for the fast path, and a 5xx burst is the worst moment to
+            # stall the loop behind flight-dump file I/O.  Dropped when
+            # the pool is saturated — the burst window re-arms and the
+            # next 5xx re-triggers the dump.
+            self.pool.submit(self._dump_flight)
+
+    def _dump_flight(self) -> None:
+        app = self.app
+        try:
+            app.flight.dump(app.flight_dir, "5xx-burst")
+        except OSError:
+            pass
 
     def account_protocol_error(self, status: int) -> None:
         """Loop-generated 400/408 responses (malformed request line,

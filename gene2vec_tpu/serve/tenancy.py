@@ -273,7 +273,7 @@ class FairQueue:
             collections.OrderedDict()
         )
         self._credit: Dict[str, float] = {}
-        self._len = 0
+        self._len = 0  # graftcheck: shared=externally synchronized; FairQueue is not thread-safe by contract — every caller holds the micro-batcher condition lock
 
     def __len__(self) -> int:
         return self._len

@@ -8,8 +8,12 @@
 # engine's per-query collective-bytes ceiling via `--hlo budgets`,
 # budgets.json section "serve").  The default tier also runs the
 # span-hygiene pass (no obs span enter/exit inside jitted/traced code,
-# no span context manager left unclosed on early return) and the
-# committed-bench budget gates: fleet availability (BENCH_FLEET vs
+# no span context manager left unclosed on early return), the
+# concurrency tier (threadflow role inference: lock-discipline,
+# loop-thread-blocking, blocking-while-locked, lock-order — all four in
+# --fast; docs/STATIC_ANALYSIS.md#concurrency-tier), the dead-budget
+# lint (budget-lint: stale budgets.json keys / unanchored gating
+# passes), and the committed-bench budget gates: fleet availability (BENCH_FLEET vs
 # budgets.json "fleet"), tracing overhead (BENCH_OBS vs "obs"), and the
 # perf plane (BENCH_PERF timeline overhead + unified-ledger trajectory
 # regressions vs "perf"; docs/BENCHMARKS.md).  The ledger ingest +
@@ -119,6 +123,10 @@ except (OSError, ValueError):
 s = doc["summary"]
 print(f"graftcheck: {s['gating']} gating / {s['total']} total finding(s) "
       f"-> {sys.argv[1]}", file=sys.stderr)
+by_pass = s.get("by_pass", {})
+if by_pass:
+    counts = " ".join(f"{k}={v}" for k, v in sorted(by_pass.items()))
+    print(f"graftcheck: per-pass counts: {counts}", file=sys.stderr)
 for f in doc["findings"]:
     if f["severity"] != "info":
         loc = f"{f['path']}:{f['line']}" if f.get("line") else f["path"]

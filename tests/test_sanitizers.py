@@ -50,16 +50,30 @@ def test_tsan_suppressions_are_load_bearing():
     they are the algorithm.  This guards against a future build change
     (e.g. accidentally serializing the workers) silently turning the
     suppressed TSAN run into a vacuous pass."""
-    import os
-
     _built("tsan")
-    os.environ["GRAFTCHECK_SMALL"] = "1"
-    try:
-        proc = run_parity("tsan", options="halt_on_error=0")
-    finally:
-        os.environ.pop("GRAFTCHECK_SMALL", None)
+    proc = run_parity(
+        "tsan", options="halt_on_error=0",
+        extra_env={"GRAFTCHECK_SMALL": "1"},
+    )
     assert "WARNING: ThreadSanitizer: data race" in proc.stderr, (
         "unsuppressed TSAN saw no races — the Hogwild workers are no "
         "longer racing (serialized build?) or TSAN is not engaging:\n"
         + proc.stderr[-2000:]
     )
+
+
+def test_tsan_control_findings_confirm_supp_entries():
+    """The ``--sanitizers tsan`` control run (sanitize.
+    tsan_control_findings): races must be reported AND every tsan.supp
+    entry must match one, so stale suppressions surface as warnings
+    instead of silently hiding future real races."""
+    from gene2vec_tpu.analysis.findings import gating
+    from gene2vec_tpu.analysis.sanitize import tsan_control_findings
+
+    _built("tsan")
+    findings = tsan_control_findings()
+    assert gating(findings) == [], (
+        "tsan control run gated:\n"
+        + "\n".join(f.message for f in findings)
+    )
+    assert any("load-bearing" in f.message for f in findings)

@@ -661,3 +661,142 @@ def test_capacity_gate_missing_bench_is_info(tmp_path):
     assert gating(findings) == []
     assert findings[0].severity == "info"
     assert "no serve bench recorded yet" in findings[0].message
+
+
+# -- concurrency regression: shutdown vs serve_forever spawn race ------------
+
+
+def test_shutdown_joins_threads_spawned_concurrently(monkeypatch):
+    """shutdown() racing serve_forever's spawn loop must join EVERY
+    spawned thread (graftcheck lock-discipline: EventLoopHTTPServer
+    _threads).  Barrier-injected FakeThreads hold the spawn window open
+    while a concurrent shutdown runs; the _threads_lock forces the
+    shutdown to wait for the full spawn, so no thread leaks unjoined.
+    Event choreography only — no sleeps."""
+    from gene2vec_tpu.serve.eventloop import EventLoopHTTPServer
+
+    real_thread = threading.Thread
+
+    class _FakeLoop:
+        def __init__(self):
+            self.stop_evt = threading.Event()
+
+        def run(self):
+            assert self.stop_evt.wait(5.0)
+
+        def stop(self):
+            self.stop_evt.set()
+
+    spawn2_entered = threading.Event()
+    release_spawn2 = threading.Event()
+    started = []
+
+    class _FakeThread:
+        def __init__(self, target=None, name=None, daemon=None):
+            self.joined = False
+            started.append(self)
+            self._nth = len(started)
+
+        def start(self):
+            if self._nth == 2:
+                # hold the race window open: the second spawn is
+                # mid-start while shutdown runs on another thread
+                spawn2_entered.set()
+                assert release_spawn2.wait(5.0)
+
+        def join(self, timeout=None):
+            self.joined = True
+
+    class _SignalLock:
+        """A Lock that reports acquisition attempts, so the test can
+        observe shutdown arriving at the spawn lock deterministically."""
+
+        def __init__(self):
+            self._lk = threading.Lock()
+            self.acquiring = threading.Event()
+
+        def __enter__(self):
+            self.acquiring.set()
+            self._lk.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self._lk.release()
+
+    server = EventLoopHTTPServer(lambda req, peer: None, "127.0.0.1", 0)
+    orig_sock = server._loops[0].lsock
+    try:
+        server._loops = [_FakeLoop(), _FakeLoop(), _FakeLoop()]
+        lock = _SignalLock()
+        server._threads_lock = lock
+        monkeypatch.setattr(threading, "Thread", _FakeThread)
+
+        t = real_thread(target=server.serve_forever, daemon=True)
+        t.start()
+        assert spawn2_entered.wait(5.0)  # spawn #2 holds the window open
+
+        lock.acquiring.clear()
+        s = real_thread(target=server.shutdown, daemon=True)
+        s.start()
+        # shutdown reached the spawn lock — it CANNOT have read the
+        # (still partial) thread list, because the read is under it
+        assert lock.acquiring.wait(5.0)
+
+        release_spawn2.set()
+        t.join(5.0)
+        s.join(5.0)
+        assert not t.is_alive() and not s.is_alive()
+        assert len(started) == 2
+        assert all(ft.joined for ft in started)
+        assert server._threads == []
+    finally:
+        orig_sock.close()
+
+
+def test_flight_burst_dump_deferred_off_loop_thread(tmp_path):
+    """A 5xx-burst flight dump triggered on the fast path must not do
+    file I/O inline (graftcheck loop-thread-blocking: _account runs on
+    the event-loop thread) — it is handed to the worker pool."""
+    from gene2vec_tpu.obs.flight import FLIGHT_PREFIX, FlightRecorder
+    from gene2vec_tpu.obs.registry import MetricsRegistry
+    from gene2vec_tpu.serve.server import ServeAdapter
+
+    class _App:
+        pass
+
+    class _Pool:
+        def __init__(self):
+            self.fns = []
+
+        def submit(self, fn):
+            self.fns.append(fn)
+            return True
+
+    app = _App()
+    app.metrics = MetricsRegistry()
+    # threshold 1: the first 5xx is a burst (fake clock, no sleeps)
+    app.flight = FlightRecorder(
+        capacity=8, burst_threshold=1, burst_window_s=5.0,
+        clock=lambda: 100.0,
+    )
+    app.flight_dir = str(tmp_path)
+    adapter = ServeAdapter.__new__(ServeAdapter)
+    adapter.app = app
+    adapter.pool = _Pool()
+
+    adapter._account("/v1/similar", 500, 0.01)
+
+    dumps_on_disk = [
+        p for p in os.listdir(tmp_path) if p.startswith(FLIGHT_PREFIX)
+    ]
+    assert dumps_on_disk == []  # nothing written on the calling thread
+    assert len(adapter.pool.fns) == 1  # exactly one deferred dump
+
+    adapter.pool.fns[0]()  # the pool worker writes it
+    dumps_on_disk = [
+        p for p in os.listdir(tmp_path) if p.startswith(FLIGHT_PREFIX)
+    ]
+    assert len(dumps_on_disk) == 1
+    doc = json.loads((tmp_path / dumps_on_disk[0]).read_text())
+    assert doc["reason"] == "5xx-burst"
+    assert doc["records"][0]["status"] == 500
