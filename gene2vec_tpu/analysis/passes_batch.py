@@ -1,0 +1,268 @@
+"""Batch-plane budget gate: BENCH_BATCH vs budgets.json ``batch``.
+
+``python scripts/chaos_drill.py --only batch --batch-out
+BENCH_BATCH_r*.json`` stamps the offline analytics plane's record —
+full-vocab kNN graph throughput through the live front door's
+background lane, sampled recall@k vs the brute-force cosine oracle,
+SIGKILL-resume bit-identity, the 1M-row sampled-query scaling
+measurement, and the mixed-workload interactive p99 delta.  This pass
+re-checks the NEWEST committed record against the ``graph`` entry of
+the ``batch`` budgets section every ``cli.analyze`` run, so a batch
+plane that quietly starts losing neighbors, breaking resume
+bit-identity, or bleeding into the interactive SLO fails the analyzer
+exactly like a collective-bytes regression does.
+
+Rules (the passes_ann / passes_loop shape — jax-free, I/O-only, so it
+rides the DEFAULT tier):
+
+* no ``BENCH_BATCH_r*`` artifact at all → *info* (a fresh checkout
+  must not fail lint before its first drill);
+* the budget pins the **measurement recipe** (rows/dim/k at both
+  geometries, shards, chunk_rows, query sample, batch tenant weight):
+  a record measured off-recipe gates hard — throughput at k=2 must
+  not pass a gate whose contract is k=10;
+* graph recall@k below ``min_recall_at_10`` (24k, as served through
+  the fleet) or ``min_recall_at_10_1m`` (the ivf scaling table)
+  gates; a missing budgeted quantity gates like a violation —
+  dropping the key must never be the way to pass;
+* ``require_resume_bit_exact``: the SIGKILLed-and-resumed artifact
+  must be byte-identical to the uninterrupted control;
+* the mixed-workload interactive p99 delta must stay within
+  ``max_p99_delta_frac`` **or** ``max_p99_delta_ms`` — either
+  suffices, because a short window's p99 swings several ms between
+  identical runs on this container's CPU and a fast baseline must not
+  turn scheduler noise into a gate;
+* a drill that stamped ``passed: false`` gates on its own verdict.
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (shared with
+``passes_perf``/``passes_ann`` so staged fixture dirs work
+uniformly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.passes_perf import perf_root
+
+_PASS = "batch-graph-budget"
+
+#: budget recipe key -> bench record recipe key (identical names; the
+#: indirection exists so the pinning loop is data, not code)
+_RECIPE_KEYS = (
+    "rows_24k",
+    "dim_24k",
+    "k",
+    "shards",
+    "chunk_rows",
+    "rows_1m",
+    "dim_1m",
+    "queries_1m",
+    "batch_weight",
+)
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_batch_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_BATCH_*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties)."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched is not None and matched[0] == "batch":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime,
+                               path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def batch_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the newest committed batch drill against ``batch.graph``."""
+    budget = load_budgets(budgets_path).get("batch", {}).get("graph")
+    if not isinstance(budget, dict):
+        return []
+    root = root or perf_root()
+    path = _newest_batch_bench(root)
+    if path is None:
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path="BENCH_BATCH",
+            message=(
+                "no batch drill recorded yet (BENCH_BATCH_r*.json "
+                "missing); run `python scripts/chaos_drill.py --only "
+                "batch --batch-out BENCH_BATCH_rNN.json` (it reads the "
+                "pinned recipe from budgets.json 'batch') to stamp one"
+            ),
+        )]
+    label = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable batch drill record: {e}",
+        )]
+
+    problems: List[str] = []
+    data: Dict = {"budget": "batch.graph"}
+    section = bench.get("batch")
+    section = section if isinstance(section, dict) else {}
+
+    recipe = section.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    for key in _RECIPE_KEYS:
+        pinned = _get(budget, key)
+        if pinned is None:
+            continue
+        measured = _get(recipe, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(
+                f"recipe.{key} missing from the drill record"
+            )
+        elif measured != pinned:
+            problems.append(
+                f"drill measured with {key}={measured:g} but the "
+                f"budget pins {key}={pinned:g} — re-run the batch "
+                "drill"
+            )
+
+    graph = section.get("graph_24k")
+    graph = graph if isinstance(graph, dict) else {}
+    floor = _get(budget, "min_recall_at_10")
+    recall = _get(graph, "recall_at_10")
+    data["recall_at_10"] = recall
+    if floor is not None:
+        if recall is None:
+            problems.append(
+                "graph_24k.recall_at_10 missing from the drill record"
+            )
+        elif recall < floor:
+            problems.append(
+                f"graph_24k.recall_at_10 {recall:g} < budget {floor:g} "
+                "(the batch-built graph is losing true neighbors)"
+            )
+    rows_per_sec = _get(graph, "rows_per_sec")
+    data["rows_per_sec"] = rows_per_sec
+    if rows_per_sec is None:
+        problems.append(
+            "graph_24k.rows_per_sec missing from the drill record"
+        )
+    if _get(budget, "require_resume_bit_exact"):
+        bit_exact = _get(graph, "resume_bit_exact")
+        data["resume_bit_exact"] = bit_exact
+        if not bit_exact:
+            problems.append(
+                "graph_24k.resume_bit_exact is not 1 — the SIGKILLed-"
+                "and-resumed artifact diverged from the uninterrupted "
+                "control"
+            )
+
+    floor_1m = _get(budget, "min_recall_at_10_1m")
+    g1m = section.get("graph_1m")
+    g1m = g1m if isinstance(g1m, dict) else {}
+    if floor_1m is not None:
+        recall_1m = _get(g1m, "recall_at_10")
+        data["recall_at_10_1m"] = recall_1m
+        if recall_1m is None:
+            problems.append(
+                "graph_1m.recall_at_10 missing from the drill record"
+            )
+        elif recall_1m < floor_1m:
+            problems.append(
+                f"graph_1m.recall_at_10 {recall_1m:g} < budget "
+                f"{floor_1m:g}"
+            )
+        if _get(g1m, "rows_per_sec") is None:
+            problems.append(
+                "graph_1m.rows_per_sec missing from the drill record"
+            )
+
+    max_frac = _get(budget, "max_p99_delta_frac")
+    max_ms = _get(budget, "max_p99_delta_ms")
+    mixed = section.get("mixed")
+    mixed = mixed if isinstance(mixed, dict) else {}
+    if max_frac is not None or max_ms is not None:
+        delta_frac = _get(mixed, "p99_delta_frac")
+        delta_ms = _get(mixed, "p99_delta_ms")
+        data["p99_delta_frac"] = delta_frac
+        data["p99_delta_ms"] = delta_ms
+        if delta_frac is None and delta_ms is None:
+            problems.append(
+                "mixed.p99_delta_frac / p99_delta_ms missing from the "
+                "drill record — the SLO-protection claim is unmeasured"
+            )
+        else:
+            frac_ok = (
+                max_frac is not None and delta_frac is not None
+                and delta_frac <= max_frac
+            )
+            ms_ok = (
+                max_ms is not None and delta_ms is not None
+                and delta_ms <= max_ms
+            )
+            if not (frac_ok or ms_ok):
+                problems.append(
+                    f"interactive p99 under batch load regressed by "
+                    f"{delta_frac} ({delta_ms} ms) — outside BOTH "
+                    f"max_p99_delta_frac {max_frac} and "
+                    f"max_p99_delta_ms {max_ms}; the background lane "
+                    "is eating the interactive SLO"
+                )
+
+    if bench.get("passed") is False:
+        problems.append("the drill itself stamped passed=false")
+
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "batch drill record violates budget 'batch.graph': "
+                + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"batch graph {data.get('rows_per_sec')} rows/s at recall "
+            f"{data.get('recall_at_10')} (1M table "
+            f"{data.get('recall_at_10_1m')}), p99 delta "
+            f"{data.get('p99_delta_ms')} ms within budget "
+            "'batch.graph'"
+        ),
+        data=data,
+    )]

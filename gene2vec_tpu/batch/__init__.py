@@ -1,0 +1,31 @@
+"""Offline analytics plane: resumable batch jobs on the serve fleet.
+
+Three job types run at background priority against live serving state
+(docs/BATCH.md):
+
+* ``knn_graph`` — the full-vocab kNN graph: every row as a query
+  through the retrieval engine (exact-rescored in quant/ivf modes),
+  packed per-row so the final artifact is bit-identical no matter how
+  the build was chunked or how many times it was killed and resumed;
+* ``pair_scores`` — bulk GGIPNN interaction scoring over a candidate
+  pair list, one text line per pair;
+* ``export`` — a streaming word2vec-format embedding export, chunked
+  through the same commit protocol.
+
+The plane is three layers: :mod:`artifact` (CRC'd-cursor chunk store,
+the resilience commit protocol), :mod:`runner` (job loops generic over
+a query backend — in-process engine, batcher lane, or shard-group
+scatter), and :mod:`jobs` (the journal + worker + ``/v1/jobs``
+lifecycle surface mounted on the serve front doors).
+"""
+
+from gene2vec_tpu.batch.artifact import ChunkedArtifact, load_graph
+from gene2vec_tpu.batch.jobs import JobManager, JobSpec, dispatch_jobs
+
+__all__ = [
+    "ChunkedArtifact",
+    "JobManager",
+    "JobSpec",
+    "dispatch_jobs",
+    "load_graph",
+]

@@ -246,6 +246,12 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_loop import loop_findings
 
         findings.extend(loop_findings())
+        # ... and the batch-plane gate (BENCH_BATCH graph throughput/
+        # oracle recall/SIGKILL-resume bit-identity + mixed-workload
+        # p99 delta vs budgets.json "batch.graph", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_batch import batch_findings
+
+        findings.extend(batch_findings())
 
     if args.hlo:
         _pin_cpu_backend()

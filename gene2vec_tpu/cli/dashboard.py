@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "fall back to the figure-json path on failure)")
     p.add_argument("--serve-k", type=int, default=10,
                    help="neighbors fetched per --serve-url lookup")
+    p.add_argument("--graph-dir", default=None,
+                   help="finalized knn_graph batch artifact (cli.batch, "
+                        "docs/BATCH.md): powers the Neighbors box "
+                        "offline, and is the fallback when --serve-url "
+                        "is unreachable")
     return p
 
 
@@ -59,6 +64,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         debug=args.debug,
         serve_url=args.serve_url,
         serve_k=args.serve_k,
+        graph_dir=args.graph_dir,
     )
     return 0
 

@@ -32,8 +32,17 @@ def build_parser() -> argparse.ArgumentParser:
         description="Pathway-vs-random cosine similarity ratio of an "
                     "embedding file.",
     )
-    p.add_argument("emb_file", help="matrix-txt or word2vec-format embedding")
+    p.add_argument("emb_file", nargs="?", default=None,
+                   help="matrix-txt or word2vec-format embedding "
+                        "(optional when --graph-dir evaluates a "
+                        "precomputed kNN graph instead)")
     p.add_argument("gmt_file", help="MSigDB .gmt pathway file")
+    p.add_argument("--graph-dir", default=None, metavar="DIR",
+                   help="evaluate a finalized knn_graph batch artifact "
+                        "(gene2vec_tpu/batch/, docs/BATCH.md) instead "
+                        "of an embedding file: pathway neighborhood "
+                        "hit rate vs degree-matched random, as served "
+                        "by the fleet that built the graph")
     p.add_argument("--max-pathway-genes", type=int, default=MAX_PATHWAY_GENES)
     p.add_argument("--num-random-genes", type=int, default=RANDOM_PAIR_GENES)
     p.add_argument("--seed", type=int, default=RANDOM_SEED)
@@ -50,17 +59,37 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    score = target_function(
-        args.emb_file,
-        args.gmt_file,
-        max_pathway_genes=args.max_pathway_genes,
-        num_random_genes=args.num_random_genes,
-        seed=args.seed,
-    )
-    if args.json or args.out:
-        from gene2vec_tpu.obs.ledger import provenance_stamp
+    if args.graph_dir:
+        from gene2vec_tpu.eval.target_function import (
+            graph_neighborhood_ratio,
+        )
 
-        doc = provenance_stamp({
+        facts = graph_neighborhood_ratio(
+            args.graph_dir,
+            args.gmt_file,
+            max_pathway_genes=args.max_pathway_genes,
+            seed=args.seed,
+        )
+        body = {
+            "schema": "gene2vec-tpu/graph-eval/v1",
+            "graph_dir": args.graph_dir,
+            "gmt_file": args.gmt_file,
+            **facts,
+        }
+        score = facts["ratio"]
+    else:
+        if not args.emb_file:
+            raise SystemExit(
+                "error: need an emb_file (or --graph-dir)"
+            )
+        score = target_function(
+            args.emb_file,
+            args.gmt_file,
+            max_pathway_genes=args.max_pathway_genes,
+            num_random_genes=args.num_random_genes,
+            seed=args.seed,
+        )
+        body = {
             "schema": "gene2vec-tpu/intrinsic-eval/v1",
             "trained_target_func_ratio": score,
             "emb_file": args.emb_file,
@@ -68,7 +97,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "max_pathway_genes": args.max_pathway_genes,
             "num_random_genes": args.num_random_genes,
             "seed": args.seed,
-        })
+        }
+    if args.json or args.out:
+        from gene2vec_tpu.obs.ledger import provenance_stamp
+
+        doc = provenance_stamp(body)
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:
                 json.dump(doc, f, indent=1)

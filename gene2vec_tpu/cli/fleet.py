@@ -182,6 +182,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--swap-interval", type=float, default=2.0,
                    help="seconds between the shard swap coordinator's "
                         "export-dir polls (sharded mode only)")
+    p.add_argument("--jobs-dir", default=None, metavar="DIR",
+                   help="batch-job store root: mounts the /v1/jobs "
+                        "lifecycle surface on the front door "
+                        "(docs/BATCH.md); jobs query the fleet at "
+                        "background priority — scatter-gather when "
+                        "sharded, the resilient client otherwise — "
+                        "and resume from their committed cursor "
+                        "across fleet restarts")
     return p
 
 
@@ -483,6 +491,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         # alert evaluator — same snapshot, zero serve-path cost
         assert proxy.aggregator is not None
         proxy.aggregator.observers.append(controller.observe)
+    if args.jobs_dir:
+        from gene2vec_tpu.batch.jobs import JobManager
+        from gene2vec_tpu.batch.runner import (
+            ClientBackend,
+            ShardGroupBackend,
+        )
+
+        # the sharded backend's Pacer yield guard: Σ replica queue
+        # depth (the aggregator publishes it every scrape tick; the
+        # same signal the autoscaler scales on), normalized so ~2
+        # queued interactive requests per replica reads as 1.0 —
+        # batch pauses between chunks while the fleet is backlogged
+        batch_pressure = {"value": 0.0}
+        if proxy.aggregator is not None:
+
+            def _note_batch_pressure(snapshot, wall=None) -> None:
+                depth = float(
+                    snapshot.get("fleet_queue_depth", 0.0) or 0.0
+                )
+                n = max(1, len(supervisor.replicas))
+                batch_pressure["value"] = depth / (2.0 * n)
+
+            proxy.aggregator.observers.append(_note_batch_pressure)
+
+        def _job_backend():
+            # built per job RUN so each pins the iteration the fleet
+            # serves at that moment (batch/runner.py determinism
+            # contract); sharded fleets scatter-gather, unsharded ones
+            # go through the resilient client on the batch tenant lane
+            if proxy.shard_group is not None:
+                return ShardGroupBackend(
+                    proxy.shard_group,
+                    pressure_fn=lambda: batch_pressure["value"],
+                )
+            return ClientBackend(proxy.client)
+
+        proxy.jobs = JobManager(
+            args.jobs_dir, _job_backend, metrics=run.registry,
+        )
     url = proxy.serve(args.host, args.port)
     run.annotate(fleet_url=url)
     run.event(
@@ -498,6 +545,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "replica_pids": [r.pid for r in supervisor.replicas],
                 "run_dir": run.run_dir,
                 "shadow": bool(args.enable_shadow),
+                "jobs_dir": args.jobs_dir,
                 "autoscale": (
                     {
                         "min": autoscale_cfg.min_replicas,

@@ -152,6 +152,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="explicit quota for one tenant (repeatable); "
                         "WEIGHT is its weighted-fair-dequeue share in "
                         "the batcher (default 1)")
+    p.add_argument("--jobs-dir", default=None, metavar="DIR",
+                   help="batch-job store root: mounts the /v1/jobs "
+                        "lifecycle surface (docs/BATCH.md); jobs query "
+                        "this replica's own batcher on the low-weight "
+                        "'batch' tenant lane and resume from their "
+                        "committed cursor across restarts")
+    p.add_argument("--batch-weight", type=float, default=0.05,
+                   help="the batch lane's weighted-fair share against "
+                        "interactive lanes when the queue is contended")
+    p.add_argument("--batch-duty", type=float, default=1.0,
+                   help="fraction of wall time a batch job may consume "
+                        "(1.0 = no idle gap between chunks)")
+    p.add_argument("--batch-guard-max", type=float, default=0.5,
+                   help="queue-fullness fraction above which batch "
+                        "chunks yield entirely until pressure drops")
     return p
 
 
@@ -273,6 +288,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             tenant_rate=args.tenant_quota,
             tenant_burst=args.tenant_burst,
             tenant_overrides=tuple(args.tenant_override),
+            jobs_dir=args.jobs_dir,
+            batch_weight=args.batch_weight,
+            batch_duty=args.batch_duty,
+            batch_guard_max=args.batch_guard_max,
         ),
         metrics=run.registry,
         ggipnn_checkpoint=args.ggipnn_checkpoint,

@@ -2,7 +2,8 @@
 
 The repo root accumulates one JSON artifact per benchmark family per
 round — ``BENCH_r*`` (SGNS headline), ``MULTICHIP_r*``,
-``BENCH_SERVE/FLEET/OBS/RESILIENCE/VIZ_CORPUS_*``, ``MESH_SANITY_*``,
+``BENCH_SERVE/FLEET/OBS/RESILIENCE/VIZ_CORPUS/BATCH_*``,
+``MESH_SANITY_*``,
 ``INTRINSIC_*``, ``REAL_AUC``, ``BENCH_PERF_*`` — each with its own
 shape and no index.  The ledger ingests all of them through per-family
 *adapters* into one versioned record schema, renders the longitudinal
@@ -431,10 +432,50 @@ def _adapt_kernels(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "kernel_profile_overhead_frac"
 
 
+def _adapt_batch(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_BATCH_* (chaos_drill.py --only batch --batch-out): the
+    offline analytics plane end to end — full-vocab kNN graph build
+    through the live fleet's background lane (throughput at the paper's
+    24k vocab, recall@10 vs the brute-force oracle, SIGKILL-resume
+    bit-identity), sampled-query throughput against a 1M-row index,
+    and the mixed-workload interactive p99 delta.  The
+    ``perf.regression`` rules watch graph throughput (higher) and the
+    p99-under-batch delta (lower)."""
+    m: Dict[str, float] = {}
+    section = doc.get("batch")
+    section = section if isinstance(section, dict) else {}
+    g = section.get("graph_24k")
+    if isinstance(g, dict):
+        _put(m, "batch_graph_rows_per_sec", g.get("rows_per_sec"))
+        _put(m, "batch_graph_recall_at_10", g.get("recall_at_10"))
+        _put(m, "batch_graph_rows", g.get("rows"))
+        _put(m, "batch_graph_wall_s", g.get("wall_s"))
+        _put(m, "batch_resume_bit_exact", g.get("resume_bit_exact"))
+        _put(m, "batch_resumed_records", g.get("resumed_records"))
+    g1m = section.get("graph_1m")
+    if isinstance(g1m, dict):
+        _put(m, "batch_graph_1m_rows_per_sec", g1m.get("rows_per_sec"))
+        _put(m, "batch_graph_1m_recall_at_10", g1m.get("recall_at_10"))
+        _put(m, "batch_graph_1m_rows", g1m.get("rows"))
+    mixed = section.get("mixed")
+    if isinstance(mixed, dict):
+        _put(m, "batch_interactive_p99_baseline_ms",
+             mixed.get("interactive_p99_baseline_ms"))
+        _put(m, "batch_interactive_p99_under_batch_ms",
+             mixed.get("interactive_p99_under_batch_ms"))
+        _put(m, "batch_p99_delta_ms", mixed.get("p99_delta_ms"))
+        _put(m, "batch_p99_delta_frac", mixed.get("p99_delta_frac"))
+        _put(m, "batch_goodput_rows_per_sec",
+             mixed.get("batch_goodput_rows_per_sec"))
+    _put(m, "passed", doc.get("passed"))
+    return m, "batch_graph_rows_per_sec"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
+    (re.compile(r"^BENCH_BATCH_\w*\.json$"), "batch", _adapt_batch),
     (re.compile(r"^BENCH_LOOP_\w*\.json$"), "loop", _adapt_loop),
     (re.compile(r"^BENCH_SHARD_\w*\.json$"), "shard", _adapt_shard),
     (re.compile(r"^BENCH_PERF_r?\d*\.json$"), "perf_timeline", _adapt_perf),

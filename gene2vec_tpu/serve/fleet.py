@@ -79,7 +79,15 @@ from gene2vec_tpu.serve.eventloop import (
 # replicas label (one dependency-light constant, so the allowlists
 # cannot drift and the proxy never imports the serving stack);
 # everything else is "other" — no label cardinality from garbage paths
-from gene2vec_tpu.serve.routes import V1_ROUTES as _PROXY_ROUTES
+from gene2vec_tpu.serve.routes import (
+    JOBS_ROUTE,
+    V1_ROUTES,
+    collapse_jobs_route,
+)
+
+#: routes the proxy labels latency under; job sub-routes collapse to
+#: the table entry first (collapse_jobs_route)
+_PROXY_ROUTES = V1_ROUTES | frozenset((JOBS_ROUTE,))
 
 
 class ReplicaState:
@@ -809,6 +817,29 @@ class _ProxyAdapter:
                 status, json.dumps(doc).encode("utf-8")
             ))
             return
+        if route == "/v1/jobs" or route.startswith("/v1/jobs/"):
+            # the batch-job lifecycle surface (gene2vec_tpu/batch/):
+            # handled HERE — never forwarded — because the front door
+            # owns the job store and the fleet-wide query backend
+            # (scatter-gather when sharded, the resilient client
+            # otherwise); a replica never sees job routes.
+            from gene2vec_tpu.batch.jobs import dispatch_jobs
+
+            jbody: Optional[dict] = None
+            if req.method == "POST":
+                jbody, err = parse_json_body(req)
+                if err is not None:
+                    peer.respond(err)
+                    return
+            status, doc = dispatch_jobs(
+                proxy.jobs, req.method, route,
+                parse_qs(urlparse(req.target).query), jbody,
+            )
+            proxy.metrics.counter("fleet_proxy_responses_total").inc()
+            peer.respond(Response(
+                status, json.dumps(doc).encode("utf-8")
+            ))
+            return
         if not route.startswith("/v1/"):
             peer.respond(Response(
                 404,
@@ -1008,9 +1039,15 @@ class FleetProxy:
         alert_rules=None,
         shard_group=None,
         shadow=None,
+        jobs=None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
+        #: gene2vec_tpu/batch/jobs.py JobManager — set when the fleet
+        #: runs with a job store (cli.fleet --jobs-dir); owns the
+        #: /v1/jobs lifecycle surface, handled at the front door and
+        #: never forwarded (like /v1/shadow)
+        self.jobs = jobs
         #: loop/shadow.py ShadowManager — set when the fleet runs with
         #: the continuous-learning canary enabled (cli.fleet
         #: --enable-shadow); owns the /v1/shadow/* admin surface and
@@ -1099,7 +1136,8 @@ class FleetProxy:
             # out of its availability-burn window (queue pressure still
             # reaches it through the rejection-rate signal)
             self.metrics.counter("fleet_proxy_429_total").inc()
-        label = route if route in _PROXY_ROUTES else "other"
+        label = collapse_jobs_route(route)
+        label = label if label in _PROXY_ROUTES else "other"
         self.metrics.histogram(
             "fleet_proxy_seconds", labels={"route": label}
         ).observe(dur_s)
@@ -1167,6 +1205,10 @@ class FleetProxy:
         self._thread.start()
         if self.aggregator is not None:
             self.aggregator.start()
+        if self.jobs is not None:
+            # recover + start the batch worker only once the front
+            # door can actually answer the queries jobs will send
+            self.jobs.start()
         bound_host, bound_port = server.server_address[:2]
         return f"http://{bound_host}:{bound_port}"
 
@@ -1191,6 +1233,11 @@ class FleetProxy:
         return remaining == 0
 
     def stop(self) -> None:
+        if self.jobs is not None:
+            # first: a running job must stop issuing queries before the
+            # replicas it queries go away (it stays journal-"running"
+            # and resumes from its committed cursor on next start)
+            self.jobs.stop()
         if self.aggregator is not None:
             self.aggregator.stop()
         if self.shadow is not None:

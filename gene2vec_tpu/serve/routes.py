@@ -22,3 +22,19 @@ SHARD_ROUTES = frozenset((
     "/v1/shard/topk", "/v1/shard/vectors", "/v1/shard/stage",
     "/v1/shard/flip",
 ))
+
+#: the batch-job lifecycle surface (gene2vec_tpu/batch/jobs.py),
+#: mounted on whichever process owns the job store — a single replica
+#: or the fleet front door (never forwarded, like /v1/shadow).  Routes
+#: under it carry job ids (``/v1/jobs/<id>/artifact``); the label
+#: helpers collapse them all to ``/v1/jobs`` so metric cardinality
+#: stays bounded by the route TABLE, not by job history.
+JOBS_ROUTE = "/v1/jobs"
+
+
+def collapse_jobs_route(route: str) -> str:
+    """``/v1/jobs/<id>[/verb]`` -> ``/v1/jobs`` for metric labels;
+    every other route unchanged."""
+    if route == JOBS_ROUTE or route.startswith(JOBS_ROUTE + "/"):
+        return JOBS_ROUTE
+    return route

@@ -71,7 +71,12 @@ from gene2vec_tpu.obs.flight import FlightRecorder
 from gene2vec_tpu.obs.registry import MetricsRegistry
 from gene2vec_tpu.obs.trace import ambient_span
 from gene2vec_tpu.obs.tracecontext import Sampler, TraceContext
-from gene2vec_tpu.serve.routes import SHARD_ROUTES, V1_ROUTES
+from gene2vec_tpu.serve.routes import (
+    JOBS_ROUTE,
+    SHARD_ROUTES,
+    V1_ROUTES,
+    collapse_jobs_route,
+)
 from gene2vec_tpu.serve.batcher import (
     DeadlineExceeded,
     LRUCache,
@@ -91,6 +96,8 @@ from gene2vec_tpu.serve.eventloop import (
 from gene2vec_tpu.serve.interaction import InteractionScorer
 from gene2vec_tpu.serve.registry import ModelRegistry
 from gene2vec_tpu.serve.tenancy import (
+    BATCH_TENANT,
+    DEFAULT_BATCH_WEIGHT,
     DEFAULT_TENANT,
     TenantAdmission,
     TenantPolicy,
@@ -171,13 +178,34 @@ class ServeConfig:
     # per-tenant overrides, "id:rate[:burst[:weight]]" strings; weight
     # is the batcher's weighted-fair-dequeue share
     tenant_overrides: Tuple[str, ...] = ()
+    # -- offline batch jobs (gene2vec_tpu/batch/; cli/serve.py
+    # --jobs-dir) ---------------------------------------------------------
+    # job store root; None disables the /v1/jobs surface entirely (no
+    # manager, no worker thread)
+    jobs_dir: Optional[str] = None
+    # the batch lane's weighted-fair share against interactive lanes
+    # (docs/BATCH.md#priority-tier-contract); always wired, so batch
+    # submissions stay background-priority even with tenancy off
+    batch_weight: float = DEFAULT_BATCH_WEIGHT
+    # batch pacing (batch/runner.py Pacer): fraction of wall time a job
+    # may consume (1.0 = no idle gap) and the queue-fullness fraction
+    # above which chunks yield entirely
+    batch_duty: float = 1.0
+    batch_guard_max: float = 0.5
 
 
 #: routes whose latency gets its own labeled histogram series; anything
 #: else collapses into "other" so garbage paths can't mint label sets
 _KNOWN_ROUTES = V1_ROUTES | SHARD_ROUTES | frozenset((
-    "/", "/livez", "/healthz", "/metrics",
+    "/", "/livez", "/healthz", "/metrics", JOBS_ROUTE,
 ))
+
+
+def _route_label(route: str) -> str:
+    """The bounded per-route label: job sub-routes collapse to
+    ``/v1/jobs``, anything outside the route table to ``other``."""
+    route = collapse_jobs_route(route)
+    return route if route in _KNOWN_ROUTES else "other"
 
 #: powers-of-two seconds buckets, 0.5 ms .. ~8 s: fine enough that the
 #: fleet aggregator's bucket-edge p50/p99 estimates are within 2x
@@ -239,9 +267,7 @@ class ServeApp:
             cache_size=config.cache_size,
             default_timeout_s=config.timeout_ms / 1000.0,
             metrics=self.metrics,
-            tenant_weights=(
-                self.tenants.weight if self.tenants is not None else None
-            ),
+            tenant_weights=self._tenant_weight,
         )
         self.ggipnn_checkpoint = ggipnn_checkpoint
         self._scorer: Optional[InteractionScorer] = None
@@ -283,12 +309,48 @@ class ServeApp:
         # (peer, deadline, t0) waiting on ONE batcher ticket
         self._coalesce: Dict[tuple, list] = {}
         self._coalesce_lock = threading.Lock()
+        # -- offline batch jobs (gene2vec_tpu/batch/) ------------------
+        # the /v1/jobs lifecycle manager: jobs query THIS replica's
+        # batcher on the low-weight batch tenant lane.  Imported lazily
+        # — serve/__init__ imports this module, and batch/ imports
+        # serve.tenancy (docs/BATCH.md).  None (the default) keeps the
+        # whole plane absent: no store, no worker thread, 404 routes.
+        self.jobs = None
+        if config.jobs_dir:
+            from gene2vec_tpu.batch.jobs import JobManager
+            from gene2vec_tpu.batch.runner import BatcherBackend, Pacer
+
+            self.jobs = JobManager(
+                config.jobs_dir,
+                backend_factory=lambda: BatcherBackend(self),
+                metrics=self.metrics,
+                pacer_factory=lambda backend: Pacer(
+                    guard=backend.pressure,
+                    guard_max=config.batch_guard_max,
+                    duty=config.batch_duty,
+                ),
+            )
+
+    def _tenant_weight(self, tenant: str) -> float:
+        """The batcher's weighted-fair drain share: the reserved batch
+        lane runs at ``batch_weight`` always (even with tenancy off —
+        background priority is not opt-in), everyone else at their
+        quota weight (1.0 untenanted)."""
+        if tenant == BATCH_TENANT:
+            return self.config.batch_weight
+        if self.tenants is not None:
+            return self.tenants.weight(tenant)
+        return 1.0
 
     def start(self) -> "ServeApp":
         self.batcher.start()
+        if self.jobs is not None:
+            self.jobs.start()
         return self
 
     def stop(self) -> None:
+        if self.jobs is not None:
+            self.jobs.stop()
         self.batcher.stop()
         self.registry.stop_watcher()
 
@@ -875,6 +937,10 @@ class ServeApp:
             return 200, self.shard_stage(body or {})
         if method == "POST" and route == "/v1/shard/flip":
             return 200, self.shard_flip(body or {})
+        if route == JOBS_ROUTE or route.startswith(JOBS_ROUTE + "/"):
+            from gene2vec_tpu.batch.jobs import dispatch_jobs
+
+            return dispatch_jobs(self.jobs, method, route, query, body)
         return 404, {"error": f"no route {method} {route}"}
 
     def handle(
@@ -931,9 +997,7 @@ class ServeApp:
             self.metrics.histogram(
                 "serve_route_seconds",
                 buckets=_ROUTE_BUCKETS,
-                labels={
-                    "route": route if route in _KNOWN_ROUTES else "other"
-                },
+                labels={"route": _route_label(route)},
             ).observe(dur)
             burst = self.flight.record(
                 route, status, dur,
@@ -987,9 +1051,7 @@ class ServeAdapter:
         app.metrics.histogram(
             "serve_route_seconds",
             buckets=_ROUTE_BUCKETS,
-            labels={
-                "route": route if route in _KNOWN_ROUTES else "other"
-            },
+            labels={"route": _route_label(route)},
         ).observe(dur)
         if status >= 400:
             app.metrics.counter(f"serve_http_{status}_total").inc()

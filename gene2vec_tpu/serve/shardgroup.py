@@ -87,6 +87,33 @@ from gene2vec_tpu.serve.client import (
 )
 
 
+#: ambient per-thread extras attached to every scatter leg issued
+#: while set — the trace-context pattern, applied to headers.  The
+#: batch plane's ShardGroupBackend tags its legs ``X-Tenant: batch``
+#: this way, so each replica's FairQueue drains background
+#: sub-requests at the batch weight without a header argument
+#: threaded through every verb in the scatter call graph.
+_SCATTER_HEADERS = threading.local()
+
+
+class scatter_headers:
+    """Context manager installing ambient headers for scatter legs
+    issued on this thread (legs fork worker threads, but ``_scatter``
+    captures the headers before forking)."""
+
+    def __init__(self, headers: Optional[Dict[str, str]]):
+        self._headers = headers
+
+    def __enter__(self):
+        self._prev = getattr(_SCATTER_HEADERS, "value", None)
+        _SCATTER_HEADERS.value = self._headers
+        return self
+
+    def __exit__(self, *exc):
+        _SCATTER_HEADERS.value = self._prev
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardGroupConfig:
     """Scatter policy knobs (cli/fleet.py flags)."""
@@ -400,6 +427,9 @@ class ShardGroup:
         remaining budget).  Returns shard → parsed 2xx doc; a shard
         that fails, 409s, or times out simply has no entry — the
         caller degrades."""
+        # captured on the CALLER's thread before the legs fork, so the
+        # ambient batch-tenant tag rides into every sub-request
+        extra_headers = getattr(_SCATTER_HEADERS, "value", None)
         results: Dict[int, dict] = {}
         lock = threading.Lock()
         # the scatter runs on fresh threads: carry the caller's ambient
@@ -419,6 +449,7 @@ class ShardGroup:
                     timeout_s=min(
                         self.config.shard_deadline_s, remaining
                     ),
+                    headers=extra_headers,
                 )
             if r.error_class == "deadline":
                 self._count("fleet_shard_leg_deadline_total")

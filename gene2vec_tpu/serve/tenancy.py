@@ -38,6 +38,8 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 __all__ = [
+    "BATCH_TENANT",
+    "DEFAULT_BATCH_WEIGHT",
     "DEFAULT_TENANT",
     "FairQueue",
     "RateBucket",
@@ -49,6 +51,17 @@ __all__ = [
 
 #: the tenant every untagged request belongs to
 DEFAULT_TENANT = "default"
+
+#: the background-priority lane batch jobs (gene2vec_tpu/batch/) submit
+#: on: a reserved tenant id, never assigned to external traffic, whose
+#: FairQueue weight defaults to DEFAULT_BATCH_WEIGHT so a full-vocab
+#: job drains at a few percent of a contended batch while interactive
+#: lanes keep their shares (docs/BATCH.md#priority-tier-contract)
+BATCH_TENANT = "batch"
+
+#: the batch lane's default weighted-fair share when lanes are
+#: contended (overridable per deployment via ServeConfig.batch_weight)
+DEFAULT_BATCH_WEIGHT = 0.05
 
 #: the shared lane/bucket unknown tenants collapse into once the
 #: bounded tenant table is full

@@ -257,6 +257,46 @@ def fetch_neighbors(
         return None
 
 
+def load_graph_neighbors(graph_dir: str):
+    """Neighbor lookup over a PRECOMPUTED kNN graph — a finalized
+    ``knn_graph`` batch artifact (gene2vec_tpu/batch/, docs/BATCH.md)
+    — as a ``(gene, k) -> [(gene, score), ...] | None`` callable with
+    the same contract as :func:`fetch_neighbors`.  The offline
+    fallback for dashboards with no live ``--serve-url``: the graph
+    was built through the serving stack, so the neighbors shown are
+    exactly what the fleet would have answered.  Loads lazily on the
+    first lookup and returns ``None`` per-gene on any failure
+    (missing/corrupt artifact, unknown gene) so the dashboard
+    degrades instead of crashing."""
+    state = {}
+
+    def lookup(gene, k=10):
+        if "graph" not in state:
+            try:
+                from gene2vec_tpu.batch.artifact import load_graph
+
+                tokens, ids, scores, _meta = load_graph(graph_dir)
+                state["graph"] = (
+                    {t: i for i, t in enumerate(tokens)},
+                    tokens, ids, scores,
+                )
+            except Exception:
+                state["graph"] = None
+        if state["graph"] is None:
+            return None
+        index, tokens, ids, scores = state["graph"]
+        row = index.get(gene)
+        if row is None:
+            return None
+        n = min(int(k), ids.shape[1])
+        return [
+            (tokens[int(ids[row, j])], float(scores[row, j]))
+            for j in range(n)
+        ]
+
+    return lookup
+
+
 def go_description(
     term: GOTerm, member_genes: Sequence[str], gene_rep: str = "Gene Symbol"
 ) -> str:
@@ -359,6 +399,7 @@ def serve(
     run: bool = True,
     serve_url: Optional[str] = None,
     serve_k: int = 10,
+    graph_dir: Optional[str] = None,
 ):  # pragma: no cover - needs dash + a browser
     """Launch the GeneView dashboard (requires the dash package).
 
@@ -375,7 +416,13 @@ def serve(
     them in the description panel — no pre-exported similarity figure
     needed.  Lookup failures (server down, unknown gene) degrade to the
     base coloring; the figure-json annotation dropdowns keep working
-    either way."""
+    either way.
+
+    ``graph_dir`` (a finalized ``knn_graph`` batch artifact,
+    docs/BATCH.md) gives the same Neighbors box WITHOUT a live server
+    — and serves as the fallback when ``serve_url`` is also set but
+    unreachable: the precomputed graph answers what the fleet that
+    built it would have."""
     try:
         import dash
         from dash import dcc, html
@@ -414,7 +461,10 @@ def serve(
                 className="geneview-dropdown",
             )
         ]
-    if serve_url:
+    neighbor_lookup = (
+        load_graph_neighbors(graph_dir) if graph_dir else None
+    )
+    if serve_url or graph_dir:
         sidebar_children += [
             html.Div(
                 [
@@ -453,7 +503,7 @@ def serve(
 
     inputs = [Input(f"dd-{k.lower()}", "value") for k in sources]
     kinds = list(sources)
-    if serve_url:
+    if serve_url or graph_dir:
         inputs.append(Input("gene-search", "value"))
 
     def _selected(values):
@@ -463,7 +513,7 @@ def serve(
         near-invisible highlight state sticks forever."""
         ctx = dash.callback_context
         trigger = ctx.triggered[0]["prop_id"].split(".")[0]
-        if serve_url and trigger == "gene-search":
+        if (serve_url or graph_dir) and trigger == "gene-search":
             gene = values[-1]
             return ("__serve__", gene.strip()) if gene and gene.strip() \
                 else (None, None)
@@ -487,7 +537,14 @@ def serve(
         if cached is not None and now - cached[0] < 5.0:
             hits = cached[1]
         else:
-            hits = fetch_neighbors(serve_url, gene, serve_k)
+            hits = (
+                fetch_neighbors(serve_url, gene, serve_k)
+                if serve_url else None
+            )
+            if hits is None and neighbor_lookup is not None:
+                # no live server (or it failed): the precomputed
+                # batch-built graph answers instead
+                hits = neighbor_lookup(gene, serve_k)
             _neighbor_memo[gene] = (now, hits)
             while len(_neighbor_memo) > 64:
                 _neighbor_memo.pop(next(iter(_neighbor_memo)))
@@ -495,7 +552,8 @@ def serve(
             return None, None
         return [gene] + [g for g, _ in hits], hits
 
-    if sources or serve_url:  # figure-only dashboards have no callbacks
+    if sources or serve_url or graph_dir:
+        # figure-only dashboards have no callbacks
         @app.callback(
             Output("scatter", "figure"), inputs, State("scatter", "figure")
         )
@@ -518,9 +576,10 @@ def serve(
             if kind == "__serve__":
                 genes, hits = _neighbor_genes(term)
                 if hits is None:
+                    source = serve_url or f"graph {graph_dir}"
                     return (
                         f"{term}: neighbor lookup failed "
-                        f"({serve_url} unreachable or unknown gene)"
+                        f"({source} unreachable or unknown gene)"
                     )
                 return f"Nearest to {term}:\n" + "\n".join(
                     f"{g}\t{s:.4f}" for g, s in hits

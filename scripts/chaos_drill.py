@@ -80,6 +80,18 @@ Five phases (docs/RESILIENCE.md runbook):
   decision latency land inside budgets.json ``loop``.  Stamped into
   ``BENCH_LOOP_r16.json`` via ``--loop-out`` and gated by
   ``analysis/passes_loop.py``.
+* **batch** — the offline analytics plane (docs/BATCH.md): submit a
+  full-vocab kNN graph job to a live SHARDED ``cli.fleet --jobs-dir``
+  front door's ``/v1/jobs``, SIGKILL the whole fleet mid-build,
+  restart it on the same dirs and let the journaled job resume from
+  its committed cursor; the fetched artifact must be BYTE-identical
+  to an uninterrupted control built through the same scatter path and
+  hit the sampled brute-force oracle recall floor; then prove the
+  interactive p99 survives a concurrent build in the background lane
+  (``scripts/serve_loadgen.py --batch-phase``) and measure the 1M-row
+  IVF scaling table.  Stamped into ``BENCH_BATCH_r19.json`` via
+  ``--batch-out`` and gated by ``analysis/passes_batch.py``
+  (budgets.json ``batch``).
 
 Exactly ONE JSON document goes to stdout (the machine contract);
 progress chatter goes to stderr.  Exit 0 iff every phase passed.
@@ -3216,8 +3228,412 @@ def drill_loop(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
             pass
 
 
+# -- phase: batch analytics plane (docs/BATCH.md) ----------------------------
+
+
+def _batch_post_json(url: str, body: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _batch_oracle_topk(unit: np.ndarray, q_rows: np.ndarray,
+                       k: int, block: int = 65536) -> np.ndarray:
+    """Brute-force cosine top-k neighbor ids for the given query rows,
+    self excluded (the /v1/similar contract the batch graph inherits) —
+    the referee for both graph geometries.  Chunked over table rows so
+    the 1M x nq score matrix never materializes."""
+    nq = len(q_rows)
+    queries = unit[q_rows]
+    best_scores = np.full((nq, k), -np.inf, dtype=np.float32)
+    best_ids = np.full((nq, k), -1, dtype=np.int64)
+    for s in range(0, unit.shape[0], block):
+        sims = unit[s:s + block] @ queries.T
+        for qi, row in enumerate(q_rows):
+            if s <= row < s + sims.shape[0]:
+                sims[row - s, qi] = -np.inf
+        kk = min(k, sims.shape[0])
+        part = np.argpartition(-sims, kk - 1, axis=0)[:kk]
+        for qi in range(nq):
+            cand_ids = np.concatenate([best_ids[qi], part[:, qi] + s])
+            cand_sc = np.concatenate(
+                [best_scores[qi], sims[part[:, qi], qi]]
+            )
+            keep = np.argsort(-cand_sc, kind="stable")[:k]
+            best_ids[qi] = cand_ids[keep]
+            best_scores[qi] = cand_sc[keep]
+    return best_ids
+
+
+def _batch_clustered_unit(rows: int, dim: int, clusters: int,
+                          seed: int, spread: float = 0.35) -> np.ndarray:
+    """Mixture-of-centroids unit table — the bench.py ANN convention
+    (trained embedding tables cluster by function; the uniform-random
+    adversarial IVF case is covered by the recall harness in
+    tests/)."""
+    from gene2vec_tpu.serve.registry import l2_normalize
+
+    rng = np.random.RandomState(seed)
+    cent = rng.randn(clusters, dim).astype(np.float32)
+    assign = rng.randint(0, clusters, rows)
+    out = np.empty((rows, dim), np.float32)
+    step = 131072  # chunked: rows x dim materializes once, not thrice
+    for s in range(0, rows, step):
+        b = cent[assign[s:s + step]]
+        out[s:s + step] = (
+            b + spread * rng.randn(*b.shape).astype(np.float32)
+        )
+    return l2_normalize(out)
+
+
+def drill_batch(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """The offline analytics plane (docs/BATCH.md): a full-vocab kNN
+    graph built THROUGH the live sharded front door's ``/v1/jobs``
+    background lane, SIGKILLed mid-build (front door + orphaned
+    replicas reaped by contract pid), resumed by a restarted fleet
+    from the journaled cursor, and byte-compared against an
+    uninterrupted control built through the SAME scatter path; sampled
+    brute-force oracle recall; the mixed-workload phase
+    (``scripts/serve_loadgen.py --batch-phase``) proving the
+    interactive p99 survives a concurrent graph build; then the
+    1M-row IVF scaling measurement in-process.  Run WITHOUT --smoke
+    for the committed BENCH_BATCH artifact — a smoke run uses a small
+    geometry and is off the pinned recipe."""
+    from gene2vec_tpu.batch.artifact import (
+        DATA_NAME,
+        TOKENS_NAME,
+        load_graph,
+    )
+    from gene2vec_tpu.serve.fleet import read_contract_line
+    from gene2vec_tpu.serve.registry import l2_normalize
+    from gene2vec_tpu.serve.tenancy import DEFAULT_BATCH_WEIGHT
+
+    if smoke:
+        vocab, dim, k, shards, chunk_rows = 4096, 32, 10, 2, 64
+        rows_1m, dim_1m, queries_1m, clusters = 20000, 16, 64, 128
+        oracle_q = 64
+        mix_level, mix_duration = 50.0, 2.5
+    else:
+        vocab, dim = int(budget["rows_24k"]), int(budget["dim_24k"])
+        k, shards = int(budget["k"]), int(budget["shards"])
+        chunk_rows = int(budget["chunk_rows"])
+        rows_1m, dim_1m = int(budget["rows_1m"]), int(budget["dim_1m"])
+        queries_1m, clusters = int(budget["queries_1m"]), 1024
+        oracle_q = 256
+        # the mixed window measures batch INTERFERENCE, so it must run
+        # at an operating point with headroom: at saturation (this
+        # 2-shard scatter fleet collapses near ~80 rps at the full
+        # 24k x 200 geometry on the CI container) any added work
+        # explodes p99 by plain queueing, telling nothing about the
+        # lane weight / leg sizing / pacing this phase gates
+        mix_level, mix_duration = 25.0, 5.0
+
+    result: dict = {"recipe": {
+        "rows_24k": vocab, "dim_24k": dim, "k": k, "shards": shards,
+        "chunk_rows": chunk_rows, "rows_1m": rows_1m,
+        "dim_1m": dim_1m, "queries_1m": queries_1m,
+        "batch_weight": DEFAULT_BATCH_WEIGHT,
+    }}
+
+    export_dir = os.path.join(tmp, "batch_export")
+    jobs_dir = os.path.join(tmp, "batch_jobs")
+    os.makedirs(jobs_dir, exist_ok=True)
+    it = 1
+    _write_iteration(export_dir, it, vocab_size=vocab, dim=dim)
+    # _write_iteration derives the table from RandomState(iteration):
+    # recompute it locally so the drill can referee the graph
+    emb = np.random.RandomState(it).randn(vocab, dim).astype(np.float32)
+
+    def spawn_fleet():
+        argv = [
+            sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+            "--export-dir", export_dir,
+            "--shard-by-rows", str(shards),
+            "--jobs-dir", jobs_dir,
+            "--port", "0", "--health-interval", "0.25",
+            "--unhealthy-after", "2", "--backoff-base", "0.3",
+            "--swap-interval", "0.4", "--scrape-interval", "0.5",
+            "--proxy-timeout-ms", "8000",
+            "--shard-deadline-ms", "6000",
+            "--seed", str(seed),
+        ]
+        p = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, text=True,
+            env=chaos.child_env(), cwd=REPO,
+        )
+        return p, read_contract_line(p, 180.0)
+
+    def hard_kill(p, contract):
+        # SIGKILL the front door; its supervised replicas are orphaned,
+        # not killed — reap them by contract pid so the restarted fleet
+        # doesn't share the box with dead siblings' survivors
+        p.kill()
+        p.wait(timeout=30)
+        for pid in contract.get("replica_pids", []):
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+
+    log(f"spawning sharded fleet ({shards} shards, jobs dir "
+        f"{jobs_dir}) over {vocab} x {dim}")
+    proc, info = spawn_fleet()
+    try:
+        url = info["url"]
+        assert info.get("jobs_dir") == jobs_dir
+        doc = _batch_post_json(f"{url}/v1/jobs", {
+            "type": "knn_graph", "k": k, "chunk_rows": chunk_rows,
+            "job_id": "drill-graph-a",
+        })
+        assert doc.get("state") in ("pending", "running"), doc
+        # let it commit real progress, then yank the plug mid-build
+        kill_floor = max(2 * chunk_rows, int(vocab * 0.25))
+
+        def mid_build():
+            d = _http_json(f"{url}/v1/jobs/drill-graph-a", timeout=5.0)
+            if d.get("state") in ("done", "failed", "cancelled"):
+                raise AssertionError(
+                    f"job reached {d['state']!r} before the drill "
+                    "could SIGKILL it mid-build — geometry too small "
+                    f"({d.get('records_done')} records)"
+                )
+            return int(d.get("records_done") or 0) >= kill_floor
+
+        wait_until(mid_build, timeout_s=600.0, interval_s=0.05,
+                   what="mid-build kill point")
+        d = _http_json(f"{url}/v1/jobs/drill-graph-a", timeout=5.0)
+        killed_at = int(d.get("records_done") or 0)
+        assert killed_at < vocab, "job finished before the SIGKILL"
+        log(f"SIGKILL at {killed_at}/{vocab} committed records")
+    except BaseException:
+        hard_kill(proc, info)
+        raise
+    hard_kill(proc, info)
+
+    log("restarting the fleet on the same export + jobs dirs")
+    proc, info = spawn_fleet()
+    try:
+        url = info["url"]
+
+        def job_done(job_id):
+            def check():
+                d = _http_json(f"{url}/v1/jobs/{job_id}", timeout=5.0)
+                if d.get("state") in ("failed", "cancelled"):
+                    raise AssertionError(
+                        f"{job_id} -> {d['state']}: {d.get('error')}"
+                    )
+                return d if d.get("state") == "done" else None
+            return check
+
+        def fetch(job_id, out_dir):
+            from gene2vec_tpu.cli.batch import _fetch
+            try:
+                return _fetch(url, job_id, out_dir)
+            except SystemExit as e:  # cli helper -> phase failure
+                raise AssertionError(
+                    f"artifact fetch for {job_id} failed: {e}"
+                ) from e
+
+        # the journaled "running" job resumes from its committed
+        # cursor without being resubmitted — that IS the contract
+        a = wait_until(job_done("drill-graph-a"), timeout_s=900.0,
+                       interval_s=0.2, what="resumed graph job done")
+        resumed = int(a["result"]["resumed_records"])
+        assert 0 < resumed < vocab, (
+            f"resumed_records={resumed}: the restarted fleet did not "
+            "resume from committed progress"
+        )
+        dir_a = os.path.join(tmp, "batch_fetched_a")
+        fetch("drill-graph-a", dir_a)
+
+        # uninterrupted control through the SAME scatter path — the
+        # bit-identity claim is about the pipeline, so the control
+        # must share it (an in-process EngineBackend build could
+        # legally differ in merge tie order)
+        _batch_post_json(f"{url}/v1/jobs", {
+            "type": "knn_graph", "k": k, "chunk_rows": chunk_rows,
+            "job_id": "drill-graph-b",
+        })
+        b = wait_until(job_done("drill-graph-b"), timeout_s=900.0,
+                       interval_s=0.2, what="control graph job done")
+        dir_b = os.path.join(tmp, "batch_fetched_b")
+        fetch("drill-graph-b", dir_b)
+
+        pair = []
+        for d_ in (dir_a, dir_b):
+            with open(os.path.join(d_, DATA_NAME), "rb") as f:
+                data_blob = f.read()
+            with open(os.path.join(d_, TOKENS_NAME), "rb") as f:
+                tok_blob = f.read()
+            pair.append((data_blob, tok_blob))
+        bit_exact = pair[0] == pair[1]
+
+        tokens_g, ids, scores, meta = load_graph(dir_a)
+        assert int(meta["iteration"]) == it
+        assert ids.shape == (vocab, k), ids.shape
+        assert tokens_g == [f"G{i}" for i in range(vocab)]
+        q_rows = np.sort(np.random.RandomState(seed + 7).choice(
+            vocab, size=oracle_q, replace=False
+        ))
+        want = _batch_oracle_topk(l2_normalize(emb), q_rows, k)
+        hits = sum(
+            len(set(ids[int(r)]) & set(want[i]))
+            for i, r in enumerate(q_rows)
+        )
+        recall = hits / float(oracle_q * k)
+        result["graph_24k"] = {
+            "rows": vocab, "dim": dim, "k": k, "shards": shards,
+            "chunk_rows": chunk_rows,
+            "rows_per_sec": b["result"]["rows_per_sec"],
+            "wall_s": b["result"]["wall_s"],
+            "chunks": b["result"]["chunks"],
+            "data_bytes": b["result"]["data_bytes"],
+            "yielded_s": b["result"]["yielded_s"],
+            "recall_at_10": round(recall, 4),
+            "oracle_queries": oracle_q,
+            "killed_at_records": killed_at,
+            "resumed_records": resumed,
+            "resume_bit_exact": bool(bit_exact),
+        }
+        log(f"graph: {json.dumps(result['graph_24k'])}")
+        assert bit_exact, (
+            "SIGKILLed-and-resumed graph artifact diverged from the "
+            "uninterrupted control"
+        )
+        assert recall >= float(budget["min_recall_at_10"]), (
+            f"graph recall@{k} {recall} < budget "
+            f"{budget['min_recall_at_10']}"
+        )
+
+        # -- mixed workload: interactive p99 while a graph job runs in
+        #    the background lane (scripts/serve_loadgen.py owns the
+        #    measurement; the drill just points it at the live fleet)
+        mix_out = os.path.join(tmp, "batch_loadgen_mixed.json")
+        lg = [
+            sys.executable,
+            os.path.join(REPO, "scripts", "serve_loadgen.py"),
+            "--url", url, "--mode", "open",
+            "--levels", f"{mix_level:g}",
+            "--duration", f"{mix_duration:g}",
+            "--batch-phase", "--batch-k", str(k),
+            "--batch-chunk-rows", str(chunk_rows),
+            "--seed", str(seed), "--output", mix_out,
+        ]
+        log("mixed-workload phase: serve_loadgen --batch-phase "
+            "against the live fleet")
+        rc = subprocess.call(
+            lg, stdout=subprocess.DEVNULL, env=chaos.child_env(),
+            cwd=REPO,
+        )
+        assert rc == 0, f"serve_loadgen --batch-phase exited {rc}"
+        with open(mix_out) as f:
+            bm = json.load(f)["batch_mixed"]
+        result["mixed"] = {
+            "level": bm["level"],
+            "interactive_p99_baseline_ms":
+                bm["interactive_p99_baseline_ms"],
+            "interactive_p99_under_batch_ms":
+                bm["interactive_p99_under_batch_ms"],
+            "p99_delta_ms": bm["p99_delta_ms"],
+            "p99_delta_frac": bm["p99_delta_frac"],
+            "batch_goodput_rows_per_sec":
+                bm["batch"]["goodput_rows_per_sec"],
+            "batch_state_after_window":
+                bm["batch"]["state_after_window"],
+        }
+        log(f"mixed: {json.dumps(result['mixed'])}")
+        frac, ms = (result["mixed"]["p99_delta_frac"],
+                    result["mixed"]["p99_delta_ms"])
+        assert (
+            (frac is not None
+             and frac <= float(budget["max_p99_delta_frac"]))
+            or (ms is not None
+                and ms <= float(budget["max_p99_delta_ms"]))
+        ), (
+            f"interactive p99 under batch load regressed by {frac} "
+            f"({ms} ms) — outside both max_p99_delta_frac "
+            f"{budget['max_p99_delta_frac']} and max_p99_delta_ms "
+            f"{budget['max_p99_delta_ms']}"
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # -- 1M-row scaling table, in-process (the EngineBackend + IVF
+    #    path cli.batch local mode uses; at this scale the fleet
+    #    contributes nothing but HTTP overhead) ----------------------
+    log(f"scale table: {rows_1m:,} x {dim_1m} IVF "
+        f"(clusters={clusters})")
+    import jax.numpy as jnp
+
+    from gene2vec_tpu.batch.runner import EngineBackend
+    from gene2vec_tpu.serve.ann import build_index
+    from gene2vec_tpu.serve.engine import SimilarityEngine
+    from gene2vec_tpu.serve.registry import LoadedModel
+
+    unit_1m = _batch_clustered_unit(rows_1m, dim_1m, clusters, seed)
+    ivf = build_index(unit_1m, "ivf", clusters=clusters, seed=seed)
+    model_1m = LoadedModel(
+        dim=dim_1m, iteration=1,
+        tokens=tuple(map(str, range(rows_1m))),
+        index={},  # knn_rows never consults the token index
+        emb=unit_1m, unit=jnp.asarray(unit_1m),
+        source="synthetic", meta={}, ann=ivf,
+    )
+    nprobe, rescore_mult = 32, 4
+    backend = EngineBackend(model_1m, SimilarityEngine(
+        max_batch=128, index="ivf", nprobe=nprobe,
+        rescore_mult=rescore_mult,
+    ))
+    sub = 128
+    start0 = int(np.random.RandomState(seed + 11).randint(
+        0, rows_1m - queries_1m - sub
+    ))
+    backend.knn_rows(start0 + queries_1m, min(sub, queries_1m), k)  # jit warmup
+    t0 = time.monotonic()
+    parts = []
+    done = 0
+    while done < queries_1m:
+        n = min(sub, queries_1m - done)
+        ids_n, _ = backend.knn_rows(start0 + done, n, k)
+        parts.append(ids_n)
+        done += n
+    wall = max(time.monotonic() - t0, 1e-9)
+    ids_1m = np.concatenate(parts)
+    want_1m = _batch_oracle_topk(
+        unit_1m, np.arange(start0, start0 + queries_1m), k
+    )
+    hits = sum(
+        len(set(ids_1m[i]) & set(want_1m[i]))
+        for i in range(queries_1m)
+    )
+    recall_1m = hits / float(queries_1m * k)
+    result["graph_1m"] = {
+        "rows": rows_1m, "dim": dim_1m, "k": k,
+        "queries": queries_1m, "index": "ivf",
+        "clusters": int(ivf.n_clusters), "nprobe": nprobe,
+        "rescore_mult": rescore_mult,
+        "build_seconds": round(float(ivf.build_seconds), 3),
+        "rows_per_sec": round(queries_1m / wall, 3),
+        "recall_at_10": round(recall_1m, 4),
+    }
+    log(f"scale: {json.dumps(result['graph_1m'])}")
+    assert recall_1m >= float(budget["min_recall_at_10_1m"]), (
+        f"1M-row recall@{k} {recall_1m} < budget "
+        f"{budget['min_recall_at_10_1m']}"
+    )
+    return result
+
+
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet", "alerts", "autoscale", "shard", "loop")
+          "fleet", "alerts", "autoscale", "shard", "loop", "batch")
 
 
 def main(argv=None) -> int:
@@ -3260,6 +3676,16 @@ def main(argv=None) -> int:
                          "standalone bench document, e.g. "
                          "BENCH_LOOP_r16.json — the record "
                          "analysis/passes_loop.py gates on")
+    ap.add_argument("--batch-out", default=None, metavar="PATH",
+                    help="also write the batch phase's results (the "
+                         "kNN-graph SIGKILL-resume drill through "
+                         "/v1/jobs + the 1M IVF scaling table + the "
+                         "mixed-workload p99 delta) as a standalone "
+                         "bench document, e.g. BENCH_BATCH_r19.json — "
+                         "the record analysis/passes_batch.py gates "
+                         "on (run WITHOUT --smoke for the committed "
+                         "artifact; a smoke run is off the pinned "
+                         "recipe)")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -3291,6 +3717,7 @@ def main(argv=None) -> int:
     autoscale_budget = budgets["autoscale"]["elasticity"]
     shard_budget = budgets["shard"]["scatter"]
     loop_budget = budgets["loop"]["promotion"]
+    batch_budget = budgets["batch"]["graph"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -3337,6 +3764,10 @@ def main(argv=None) -> int:
             elif phase == "loop":
                 doc["phases"][phase] = drill_loop(
                     tmp, args.smoke, loop_budget, seed
+                )
+            elif phase == "batch":
+                doc["phases"][phase] = drill_batch(
+                    tmp, args.smoke, batch_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -3417,6 +3848,22 @@ def main(argv=None) -> int:
         with open(args.loop_out, "w") as f:
             f.write(json.dumps(loop_doc, indent=1) + "\n")
         log(f"wrote {args.loop_out}")
+    if args.batch_out and "batch" in doc["phases"]:
+        batch_doc = {
+            "schema": "gene2vec-tpu/bench-batch/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "batch_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["batch"],
+            "batch": doc["phases"]["batch"],
+        }
+        with open(args.batch_out, "w") as f:
+            f.write(json.dumps(batch_doc, indent=1) + "\n")
+        log(f"wrote {args.batch_out}")
     if args.shard_out and "shard" in doc["phases"]:
         shard_doc = {
             "schema": "gene2vec-tpu/bench-shard/v1",

@@ -50,6 +50,14 @@
 #                                                  # start -> quality gate ->
 #                                                  # shadow -> promote) with a
 #                                                  # SIGKILL in every state;
+#                                                  # AND the batch smoke: a
+#                                                  # kNN graph job via
+#                                                  # /v1/jobs on a live
+#                                                  # sharded fleet, SIGKILL
+#                                                  # mid-build -> bit-exact
+#                                                  # resume, mixed-load p99
+#                                                  # delta gated;
+#                                                  # docs/BATCH.md +
 #                                                  # docs/RESILIENCE.md +
 #                                                  # docs/OBSERVABILITY.md +
 #                                                  # docs/SERVING.md +
@@ -178,13 +186,21 @@ if [ "$CHAOS" = "1" ]; then
   # every loop state and bit-exact resume asserted against an
   # uninterrupted control (docs/CONTINUOUS.md)
   LOOP_OUT="${LOOP_DRILL_OUT:-/tmp/chaos_drill_loop_smoke.json}"
+  # the batch phase IS the background-analytics smoke: a kNN graph job
+  # submitted through /v1/jobs on a live 2-shard fleet, SIGKILLed mid-
+  # build and resumed bit-identically, a mixed interactive+batch load
+  # window gated on the interactive p99 delta, and a reduced-scale IVF
+  # graph pass (the committed BENCH_BATCH record comes from the full,
+  # non-smoke drill; docs/BATCH.md)
+  BATCH_OUT="${BATCH_DRILL_OUT:-/tmp/chaos_drill_batch_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
     --alerts-out "$ALERTS_OUT" --autoscale-out "$AUTOSCALE_OUT" \
     --shard-out "$SHARD_OUT" --loop-out "$LOOP_OUT" \
+    --batch-out "$BATCH_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
   echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT," >&2
-  echo "  shard: $SHARD_OUT, loop: $LOOP_OUT)" >&2
+  echo "  shard: $SHARD_OUT, loop: $LOOP_OUT, batch: $BATCH_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

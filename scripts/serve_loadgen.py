@@ -87,6 +87,7 @@ import queue as queue_mod
 import random
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -641,6 +642,92 @@ def compute_capacity(rows: List[Dict], p99_budget_ms: float,
     return out
 
 
+def run_batch_phase(url: str, level: float, run_level, mode: str,
+                    args) -> Dict:
+    """Mixed-workload phase (docs/BATCH.md#slo-protection): one
+    baseline interactive window, then the SAME window again while a
+    ``knn_graph`` batch job runs in the front door's background-
+    priority lane.  Reports the interactive p99 delta — the number the
+    batch pacer exists to keep small — next to the batch lane's goodput
+    over the overlap window, so both sides of the priority trade land
+    in one record (``analysis/passes_batch.py`` gates the delta).
+
+    Needs the target to expose ``/v1/jobs`` (started with
+    ``--jobs-dir``; ``--spawn`` targets get a temporary one
+    automatically)."""
+    print(f"batch phase: baseline window level {level:g} ...",
+          file=sys.stderr)
+    base = summarize(level, run_level(level, False), mode)
+    job_id = f"loadgen-mixed-{os.getpid()}-{int(time.time())}"
+    doc = _http_json(
+        f"{url}/v1/jobs",
+        {"type": "knn_graph", "k": args.batch_k,
+         "chunk_rows": args.batch_chunk_rows, "job_id": job_id},
+        timeout=args.timeout,
+    )
+    deadline = time.monotonic() + args.timeout
+    while doc.get("state") == "pending":
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"batch job {job_id} never left 'pending'"
+            )
+        time.sleep(0.05)
+        doc = _http_json(f"{url}/v1/jobs/{job_id}",
+                         timeout=args.timeout)
+    records_start = int(doc.get("records_done") or 0)
+    t0 = time.monotonic()
+    print(f"batch phase: window under job {job_id} ...",
+          file=sys.stderr)
+    under = summarize(level, run_level(level, False), mode)
+    window_s = time.monotonic() - t0
+    doc = _http_json(f"{url}/v1/jobs/{job_id}", timeout=args.timeout)
+    records_end = int(doc.get("records_done") or 0)
+    finished_early = doc.get("state") != "running"
+    if not finished_early:
+        try:
+            _http_json(f"{url}/v1/jobs/{job_id}/cancel", {},
+                       timeout=args.timeout)
+        except urllib.error.HTTPError as e:
+            e.close()  # raced to completion: 409, nothing to clean up
+    p99_b, p99_u = base.get("p99_ms"), under.get("p99_ms")
+    out = {
+        "level": level,
+        "mode": mode,
+        "baseline": base,
+        "under_batch": under,
+        "interactive_p99_baseline_ms": p99_b,
+        "interactive_p99_under_batch_ms": p99_u,
+        "p99_delta_ms": (
+            round(p99_u - p99_b, 3)
+            if p99_b is not None and p99_u is not None else None
+        ),
+        "p99_delta_frac": (
+            round((p99_u - p99_b) / p99_b, 4)
+            if p99_b and p99_u is not None else None
+        ),
+        "batch": {
+            "job_id": job_id,
+            "type": "knn_graph",
+            "k": args.batch_k,
+            "chunk_rows": args.batch_chunk_rows,
+            "state_after_window": doc.get("state"),
+            "records_start": records_start,
+            "records_end": records_end,
+            "window_s": round(window_s, 3),
+            "goodput_rows_per_sec": round(
+                (records_end - records_start) / window_s, 2
+            ) if window_s > 0 else None,
+            "finished_early": finished_early,
+            "result": doc.get("result"),
+        },
+    }
+    print(f"batch mixed: p99 {p99_b} -> {p99_u} ms, goodput "
+          f"{out['batch']['goodput_rows_per_sec']} rows/s "
+          f"({records_end - records_start} records in "
+          f"{window_s:.1f}s)", file=sys.stderr)
+    return out
+
+
 def fetch_verify_ref(url: str, genes: List[str], k: int,
                      timeout_s: float) -> Dict:
     """One reference answer per query gene, fetched BEFORE the load
@@ -879,6 +966,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="RPS",
                     help="exit 1 unless fleet_capacity.sustained_rps "
                          ">= RPS")
+    ap.add_argument("--batch-phase", action="store_true",
+                    help="after the main levels, measure the mixed "
+                         "workload: one baseline interactive window, "
+                         "then the same window while a knn_graph batch "
+                         "job runs in the background lane; reports the "
+                         "interactive p99 delta and batch goodput "
+                         "(docs/BATCH.md#slo-protection; --spawn "
+                         "targets get a temporary --jobs-dir "
+                         "automatically)")
+    ap.add_argument("--batch-level", type=float, default=None,
+                    help="interactive level for --batch-phase "
+                         "(default: first --levels entry)")
+    ap.add_argument("--batch-k", type=int, default=10,
+                    help="neighbors per row for the --batch-phase job")
+    ap.add_argument("--batch-chunk-rows", type=int, default=64,
+                    help="records per committed chunk for the "
+                         "--batch-phase job (small chunks yield to the "
+                         "interactive lane often)")
     ap.add_argument("--trace-sample", type=int, default=0, metavar="N",
                     help="send a sampled traceparent root on EVERY "
                          "request and report the N slowest requests' "
@@ -916,7 +1021,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     fleet_proc = None
     try:
         if args.spawn is not None:
-            proc, info = spawn_server(args.spawn, args.spawn_arg)
+            spawn_extra = list(args.spawn_arg)
+            if args.batch_phase and not any(
+                a.startswith("--jobs-dir") for a in spawn_extra
+            ):
+                spawn_extra += [
+                    "--jobs-dir",
+                    tempfile.mkdtemp(prefix="loadgen_jobs_"),
+                ]
+            proc, info = spawn_server(args.spawn, spawn_extra)
             url = info["url"]
             print(f"spawned serve at {url} (iteration "
                   f"{info['iteration']})", file=sys.stderr)
@@ -1117,6 +1230,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             print(f"capacity: {json.dumps(capacity)}", file=sys.stderr)
 
+        batch_mixed = None
+        if args.batch_phase and not args.trace_overhead:
+            batch_mixed = run_batch_phase(
+                url,
+                args.batch_level if args.batch_level is not None
+                else levels[0],
+                run_level, args.mode, args,
+            )
+
         fleet_results = None
         fleet_capacity = None
         fleet_info = None
@@ -1202,6 +1324,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         }
         if capacity is not None:
             doc["capacity"] = capacity
+        if batch_mixed is not None:
+            doc["batch_mixed"] = batch_mixed
         if fleet_results is not None:
             doc["fleet_replicas"] = args.fleet
             doc["fleet_levels"] = fleet_results

@@ -1,0 +1,795 @@
+"""Batch plane (gene2vec_tpu/batch/): the chunk-commit artifact
+protocol, SIGKILL/interrupt-resume bit-identity for every job type, the
+job manager + /v1/jobs dispatch, the background-priority machinery
+(FairQueue weights, Pacer yield guard, tenant-tagged scatter legs), the
+precomputed-graph intrinsic eval, and the passes_batch budget gate
+(docs/BATCH.md)."""
+
+import base64
+import json
+import os
+import threading
+import time
+import types
+import zlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gene2vec_tpu.batch.artifact import (
+    CURSOR_NAME,
+    CURSOR_PREV_NAME,
+    DATA_NAME,
+    MANIFEST_NAME,
+    TOKENS_NAME,
+    ChunkedArtifact,
+    load_graph,
+    pack_graph_rows,
+    unpack_graph,
+    write_fetched_artifact,
+)
+from gene2vec_tpu.batch.jobs import JobManager, JobSpec, dispatch_jobs
+from gene2vec_tpu.batch.runner import (
+    ChunkFailed,
+    EngineBackend,
+    JobCancelled,
+    Pacer,
+    ShardGroupBackend,
+    run_job,
+)
+from gene2vec_tpu.serve.engine import SimilarityEngine
+from gene2vec_tpu.serve.registry import LoadedModel, l2_normalize
+from gene2vec_tpu.serve.tenancy import (
+    BATCH_TENANT,
+    DEFAULT_BATCH_WEIGHT,
+    FairQueue,
+)
+
+V, D, K = 24, 6, 4
+
+
+def _model(v=V, d=D, iteration=1, seed=0):
+    emb = np.random.RandomState(seed).randn(v, d).astype(np.float32)
+    tokens = tuple(f"G{i}" for i in range(v))
+    return LoadedModel(
+        dim=d, iteration=iteration, tokens=tokens,
+        index={t: i for i, t in enumerate(tokens)},
+        emb=emb, unit=jnp.asarray(l2_normalize(emb)),
+        source="synthetic", meta={},
+    )
+
+
+def _backend(model=None):
+    return EngineBackend(
+        model if model is not None else _model(),
+        SimilarityEngine(max_batch=8),
+    )
+
+
+def _spec(kind="knn_graph", **kw):
+    body = {"type": kind, "k": K, "chunk_rows": 4}
+    body.update(kw)
+    return JobSpec.from_body(body)
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# -- artifact commit protocol -------------------------------------------------
+
+
+def test_pack_unpack_graph_roundtrip():
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 100, size=(5, K)).astype(np.int32)
+    scores = rng.rand(5, K).astype(np.float32)
+    out_ids, out_scores = unpack_graph(pack_graph_rows(ids, scores), K)
+    np.testing.assert_array_equal(out_ids, ids)
+    np.testing.assert_array_equal(out_scores, scores)
+    with pytest.raises(ValueError, match="matching"):
+        pack_graph_rows(ids, scores[:3])
+
+
+def test_artifact_truncates_torn_tail(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"aaaa", 1)
+    art.append_chunk(b"bbbb", 1)
+    committed = _read(art.data_path)
+    # the writer died mid-append: bytes on disk past the committed
+    # cursor offset, no cursor commit
+    with open(art.data_path, "ab") as f:
+        f.write(b"torn!")
+    art2 = ChunkedArtifact(d)
+    assert art2.records_done == 2 and art2.data_bytes == 8
+    assert _read(art2.data_path) == committed
+
+
+def test_artifact_rotted_cursor_falls_back_one_commit(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"aaaa", 1)
+    art.append_chunk(b"bbbb", 1)
+    # CURSOR.json rots after the second commit; CURSOR.prev.json still
+    # holds the first — recovery truncates back one chunk, never zero
+    with open(os.path.join(d, CURSOR_NAME), "w") as f:
+        f.write("{not json")
+    art2 = ChunkedArtifact(d)
+    assert art2.records_done == 1 and art2.data_bytes == 4
+    assert _read(art2.data_path) == b"aaaa"
+
+
+def test_artifact_both_cursors_lost_refuses(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"aaaa", 1)
+    os.unlink(os.path.join(d, CURSOR_NAME))
+    assert not os.path.exists(os.path.join(d, CURSOR_PREV_NAME))
+    with pytest.raises(IOError, match="refusing to truncate"):
+        ChunkedArtifact(d)
+
+
+def test_artifact_post_commit_rot_detected(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"aaaabbbb", 2)
+    with open(art.data_path, "r+b") as f:
+        f.seek(2)
+        f.write(b"X")  # flip a committed byte: CRC must catch it
+    with pytest.raises(IOError, match="CRC mismatch"):
+        ChunkedArtifact(d)
+
+
+def test_artifact_data_truncated_below_commit_refuses(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"aaaabbbb", 2)
+    with open(art.data_path, "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(IOError, match="truncated after commit"):
+        ChunkedArtifact(d)
+
+
+def test_artifact_finalize_idempotent_and_verify(tmp_path):
+    d = str(tmp_path / "job")
+    art = ChunkedArtifact(d)
+    art.append_chunk(b"abcd", 1)
+    p1 = art.finalize({"type": "export"})
+    p2 = art.finalize({"type": "export"})
+    assert p1 == p2 and art.verify()
+    with pytest.raises(IOError, match="already finalized"):
+        art.append_chunk(b"more", 1)
+    with open(art.data_path, "r+b") as f:
+        f.seek(0)
+        f.write(b"Z")
+    # a reader must not trust rotted bytes (the open handle re-reads
+    # the file; a fresh open refuses at the cursor-CRC layer already)
+    assert not art.verify()
+
+
+def test_write_fetched_artifact_rejects_bad_crc(tmp_path):
+    with pytest.raises(IOError, match="CRC"):
+        write_fetched_artifact(
+            str(tmp_path / "f"), b"data", {}, 1, 1, data_crc32=12345,
+        )
+    assert not os.path.exists(str(tmp_path / "f" / DATA_NAME))
+    good = zlib.crc32(b"data") & 0xFFFFFFFF
+    write_fetched_artifact(
+        str(tmp_path / "g"), b"data", {"type": "export"}, 1, 1,
+        data_crc32=good, tokens_bytes=b"G0\n",
+    )
+    art = ChunkedArtifact(str(tmp_path / "g"))
+    assert art.verify() and art.records_done == 1
+
+
+# -- interrupt-resume bit-identity, every job type ---------------------------
+
+
+def _interrupt_then_resume(tmp_path, spec, make_backend, stop_after=2):
+    """Run the job until ``stop_after`` chunks committed, cancel, tear
+    the tail (the SIGKILL-mid-append shape), resume in a fresh
+    artifact handle, and return (resumed DATA.bin, control DATA.bin,
+    resume result)."""
+    d = str(tmp_path / "interrupted")
+    art = ChunkedArtifact(d)
+
+    with pytest.raises(JobCancelled):
+        run_job(
+            spec, make_backend(), art,
+            should_stop=lambda: art.chunks_done >= stop_after,
+        )
+    assert 0 < art.records_done
+    with open(art.data_path, "ab") as f:
+        f.write(b"\x00\x01torn")  # died mid-append after the cancel point
+    art2 = ChunkedArtifact(d)
+    assert art2.chunks_done == stop_after
+    result = run_job(spec, make_backend(), art2)
+    assert result["resumed_records"] == art.records_done
+    control = ChunkedArtifact(str(tmp_path / "control"))
+    run_job(spec, make_backend(), control)
+    return _read(art2.data_path), _read(control.data_path), result
+
+
+def test_knn_graph_resume_bit_identical(tmp_path):
+    resumed, control, result = _interrupt_then_resume(
+        tmp_path, _spec("knn_graph"), _backend,
+    )
+    assert resumed == control
+    assert result["records"] == V and result["chunks"] == -(-V // 4)
+    # and the tokens sidecar written before chunk 0 survived the resume
+    tokens, ids, scores, meta = load_graph(str(tmp_path / "interrupted"))
+    assert tokens == [f"G{i}" for i in range(V)]
+    assert ids.shape == (V, K) and meta["type"] == "knn_graph"
+    assert not (ids == np.arange(V)[:, None]).any()  # self excluded
+
+
+def test_pair_scores_resume_bit_identical(tmp_path):
+    pairs = [[f"G{i}", f"G{(i * 7 + 3) % V}"] for i in range(17)]
+    resumed, control, result = _interrupt_then_resume(
+        tmp_path, _spec("pair_scores", pairs=pairs), _backend,
+    )
+    assert resumed == control and result["records"] == len(pairs)
+    lines = resumed.decode("utf-8").splitlines()
+    assert len(lines) == len(pairs)
+    a, b, s = lines[0].split("\t")
+    assert [a, b] == pairs[0] and 0.0 <= float(s) <= 1.0
+
+
+def test_export_resume_bit_identical_and_w2v_parity(tmp_path):
+    model = _model(seed=5)
+    resumed, control, result = _interrupt_then_resume(
+        tmp_path, _spec("export"), lambda: _backend(model),
+    )
+    assert resumed == control and result["records"] == V
+    # byte parity with the online writer: the artifact IS a word2vec
+    # text export
+    from gene2vec_tpu.io.emb_io import write_word2vec_format
+
+    ref = str(tmp_path / "ref_w2v.txt")
+    write_word2vec_format(ref, list(model.tokens), model.emb)
+    assert resumed == _read(ref)
+
+
+def test_resume_is_noop_past_completion(tmp_path):
+    art = ChunkedArtifact(str(tmp_path / "job"))
+    spec = _spec("knn_graph")
+    first = run_job(spec, _backend(), art)
+    again = run_job(spec, _backend(), ChunkedArtifact(str(tmp_path / "job")))
+    assert again["records"] == first["records"]
+    assert again["resumed_records"] == first["records"]
+
+
+# -- the job manager + /v1/jobs dispatch --------------------------------------
+
+
+def _manager(tmp_path, model=None, **kw):
+    return JobManager(
+        str(tmp_path / "jobs"),
+        backend_factory=lambda: _backend(model),
+        **kw,
+    )
+
+
+def _wait_state(mgr, job_id, states=("done", "failed", "cancelled"),
+                timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        status, doc = mgr.status(job_id)
+        if status == 200 and doc["state"] in states:
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"job {job_id} never reached {states}: {doc}")
+
+
+def test_manager_runs_job_to_done(tmp_path):
+    mgr = _manager(tmp_path).start()
+    try:
+        doc = mgr.submit(_spec("knn_graph", job_id="g1"))
+        assert doc["state"] in ("pending", "running")
+        doc = _wait_state(mgr, "g1")
+        assert doc["state"] == "done" and doc["records_done"] == V
+        assert doc["result"]["chunks"] == -(-V // 4)
+        assert doc["iteration"] == 1
+        # resubmitting a done job is idempotent status, not a re-run
+        assert mgr.submit(_spec("knn_graph", job_id="g1"))["state"] == "done"
+        assert [j["job_id"] for j in mgr.list_jobs()["jobs"]] == ["g1"]
+    finally:
+        mgr.stop()
+
+
+def test_manager_shutdown_midjob_resumes_running_first(tmp_path):
+    # a worker stopped mid-job leaves the journal "running"; the next
+    # start() must pick it up BEFORE pending jobs and extend its
+    # committed cursor to the bit-identical artifact
+    model = _model(seed=9)
+    slow = threading.Event()
+
+    class SlowBackend(EngineBackend):
+        def knn_rows(self, start, n, k):
+            if start >= 8 and not slow.is_set():
+                time.sleep(0.05)
+            return super().knn_rows(start, n, k)
+
+    def factory():
+        return SlowBackend(model, SimilarityEngine(max_batch=8))
+
+    mgr = JobManager(str(tmp_path / "jobs"), backend_factory=factory)
+    mgr.start()
+    mgr.submit(_spec("knn_graph", job_id="resume-me"))
+    # poll the journal, never a second ChunkedArtifact: the commit
+    # protocol is single-writer (a concurrent open would "recover" the
+    # live writer's in-flight append out from under it)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 30.0:
+        if (mgr.status("resume-me")[1].get("records_done") or 0) >= 8:
+            break
+        time.sleep(0.01)
+    assert (mgr.status("resume-me")[1].get("records_done") or 0) >= 8
+    mgr.stop()  # shutdown, not cancel: journal must stay "running"
+    doc = mgr._read_journal("resume-me")
+    if doc["state"] == "done":
+        pytest.skip("job finished before shutdown on this host")
+    assert doc["state"] == "running"
+    slow.set()
+    mgr2 = JobManager(str(tmp_path / "jobs"), backend_factory=factory)
+    mgr2.start()
+    try:
+        done = _wait_state(mgr2, "resume-me")
+        assert done["state"] == "done"
+        assert done["result"]["resumed_records"] > 0
+    finally:
+        mgr2.stop()
+    control = ChunkedArtifact(str(tmp_path / "control"))
+    run_job(_spec("knn_graph"), factory(), control)
+    assert _read(
+        os.path.join(mgr2.job_dir("resume-me"), DATA_NAME)
+    ) == _read(control.data_path)
+
+
+def test_manager_pins_iteration_across_swap(tmp_path):
+    # journal says iteration 1, the serving model swapped to 2: the
+    # resume must fail loudly, never mix iterations in one artifact
+    mgr = _manager(tmp_path, model=_model(iteration=2))
+    os.makedirs(mgr.job_dir("stale"), exist_ok=True)
+    mgr._write_journal("stale", {
+        "spec": _spec("knn_graph", job_id="stale").to_doc(),
+        "state": "running", "created_unix": 0,
+        "records_done": 0, "records_total": None,
+        "error": None, "iteration": 1,
+    })
+    mgr.start()
+    try:
+        doc = _wait_state(mgr, "stale")
+        assert doc["state"] == "failed"
+        assert "swapped" in doc["error"]
+    finally:
+        mgr.stop()
+
+
+def test_manager_cancel_pending_job(tmp_path):
+    mgr = _manager(tmp_path)  # worker NOT started: jobs stay pending
+    mgr.submit(_spec("knn_graph", job_id="p1"))
+    status, doc = mgr.cancel("p1")
+    assert status == 200 and doc["state"] == "cancelled"
+    status, doc = mgr.cancel("p1")
+    assert status == 409
+    assert mgr.cancel("ghost")[0] == 404
+
+
+def test_dispatch_jobs_routes(tmp_path):
+    assert dispatch_jobs(None, "GET", "/v1/jobs", {}, None)[0] == 404
+    mgr = _manager(tmp_path).start()
+    try:
+        status, doc = dispatch_jobs(
+            mgr, "POST", "/v1/jobs", {}, {"type": "nope"},
+        )
+        assert status == 400 and "type" in doc["error"]
+        status, doc = dispatch_jobs(
+            mgr, "POST", "/v1/jobs", {},
+            {"type": "knn_graph", "k": K, "chunk_rows": 4,
+             "job_id": "via-http"},
+        )
+        assert status == 200
+        _wait_state(mgr, "via-http")
+        status, doc = dispatch_jobs(mgr, "GET", "/v1/jobs/via-http", {}, None)
+        assert status == 200 and doc["state"] == "done"
+        # artifact paging: reassemble in 64-byte pages, verify CRC
+        blob, offset = b"", 0
+        while True:
+            status, page = dispatch_jobs(
+                mgr, "GET", "/v1/jobs/via-http/artifact",
+                {"offset": [str(offset)], "limit": ["64"]}, None,
+            )
+            assert status == 200
+            blob += base64.b64decode(page["data_b64"])
+            offset = page["offset"] + 64
+            if page["eof"]:
+                break
+        assert (zlib.crc32(blob) & 0xFFFFFFFF) == page["data_crc32"]
+        status, tok = dispatch_jobs(
+            mgr, "GET", "/v1/jobs/via-http/artifact",
+            {"part": ["tokens"]}, None,
+        )
+        assert status == 200
+        assert dispatch_jobs(
+            mgr, "GET", "/v1/jobs/../etc", {}, None,
+        )[0] == 404
+        assert dispatch_jobs(
+            mgr, "GET", "/v1/jobs/via-http/artifact",
+            {"offset": ["x"]}, None,
+        )[0] == 400
+    finally:
+        mgr.stop()
+
+
+def test_jobspec_validation():
+    with pytest.raises(ValueError, match="'type'"):
+        JobSpec.from_body({"type": "mine_bitcoin"})
+    with pytest.raises(ValueError, match="'k'"):
+        JobSpec.from_body({"type": "knn_graph", "k": 0})
+    with pytest.raises(ValueError, match="'pairs'"):
+        JobSpec.from_body({"type": "pair_scores", "pairs": []})
+    with pytest.raises(ValueError, match="job_id"):
+        JobSpec.from_body({"type": "export", "job_id": "../escape"})
+    # pairs are dropped for non-pair jobs (journal stays bounded)
+    assert JobSpec.from_body(
+        {"type": "export", "pairs": [["A", "B"]]}
+    ).pairs is None
+
+
+# -- background priority: FairQueue weights + Pacer ---------------------------
+
+
+def _weights(t):
+    return DEFAULT_BATCH_WEIGHT if t == BATCH_TENANT else 1.0
+
+
+def test_fairqueue_batch_lane_cannot_starve_interactive():
+    q = FairQueue(weight_of=_weights)
+    for i in range(200):
+        q.push(BATCH_TENANT, ("b", i))
+    for i in range(100):
+        q.push("default", ("d", i))
+    # drain a contended window: the batch lane's share must track its
+    # weight (0.05 / 1.05 ≈ 4.8%), so interactive work is never stuck
+    # behind the 200 batch items that arrived first
+    window = [q.pop() for _ in range(100)]
+    batch_served = sum(1 for t, _ in window if t == "b")
+    assert batch_served <= 10  # ~5 expected; generous ceiling
+    # and interactive stays FIFO within its own lane
+    d_order = [i for t, i in window if t == "d"]
+    assert d_order == sorted(d_order)
+
+
+def test_fairqueue_batch_lane_never_fully_starves():
+    q = FairQueue(weight_of=_weights)
+    for i in range(50):
+        q.push(BATCH_TENANT, ("b", i))
+        q.push("default", ("d", i))
+        q.push("default", ("d2", i))
+    served = [q.pop() for _ in range(60)]
+    assert any(t == "b" for t, _ in served)  # weighted, not locked out
+
+
+def test_fairqueue_idle_lane_cannot_hoard_credit():
+    q = FairQueue(weight_of=_weights)
+    q.push(BATCH_TENANT, "b0")
+    assert q.pop() == "b0"  # lane empties: its credit is dropped
+    for i in range(40):
+        q.push(BATCH_TENANT, ("b", i))
+        q.push("default", ("d", i))
+    window = [q.pop() for _ in range(20)]
+    assert sum(1 for t, _ in window if t == "b") <= 2
+
+
+def test_pacer_yields_under_pressure_and_stops():
+    clock = {"t": 0.0}
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        clock["t"] += s
+
+    pressure = {"v": 1.0}
+    p = Pacer(
+        guard=lambda: pressure["v"], guard_max=0.5,
+        clock=lambda: clock["t"], sleep=sleep,
+    )
+
+    def stop():
+        if clock["t"] > 1.0:
+            pressure["v"] = 0.0  # interactive pressure drains
+        return False
+
+    p.wait(0.0, stop)
+    assert p.yielded_s > 1.0 and slept  # it actually backed off
+    assert max(slept) <= 1.0  # backoff is capped
+    # should_stop breaks the yield loop even under sustained pressure
+    pressure["v"] = 1.0
+    p2 = Pacer(
+        guard=lambda: 1.0, guard_max=0.5,
+        clock=lambda: clock["t"], sleep=sleep,
+    )
+    p2.wait(0.0, lambda: True)
+    assert p2.yielded_s <= 0.1
+
+
+def test_pacer_duty_cycle_sleeps_proportionally():
+    slept = []
+    p = Pacer(duty=0.5, clock=lambda: 0.0, sleep=slept.append)
+    p.wait(2.0, None)
+    assert slept == [2.0]  # 50% duty: idle as long as the chunk took
+
+
+# -- ShardGroupBackend: tenant tagging, sub-request cap, pressure -------------
+
+
+class _FakeRouting:
+    def __init__(self, tokens, dim=D):
+        self.tokens = list(tokens)
+        self.dim = dim
+        self.iteration = 1
+        self.index = {t: i for i, t in enumerate(tokens)}
+
+
+class _FakeGroup:
+    """Captures what a scatter leg would see: the ambient scatter
+    headers at call time and each sub-request's query count."""
+
+    def __init__(self, tokens, max_queries=64):
+        self.config = types.SimpleNamespace(
+            max_queries_per_request=max_queries
+        )
+        self.routing = _FakeRouting(tokens)
+        self.calls = []
+
+    def _ambient(self):
+        from gene2vec_tpu.serve.shardgroup import _SCATTER_HEADERS
+
+        return getattr(_SCATTER_HEADERS, "value", None)
+
+    def similar(self, body):
+        self.calls.append((len(body["genes"]), self._ambient()))
+        k = body["k"]
+        return 200, {"results": [
+            {"neighbors": [
+                {"gene": self.routing.tokens[(j + 1) % len(
+                    self.routing.tokens)], "score": 0.5}
+                for j in range(k)
+            ]}
+            for _ in body["genes"]
+        ]}
+
+    def interaction(self, body):
+        self.calls.append((len(body["pairs"]), self._ambient()))
+        return 200, {"scores": [
+            {"pair": p, "score": 0.25} for p in body["pairs"]
+        ]}
+
+    def embedding(self, body):
+        self.calls.append((len(body["genes"]), self._ambient()))
+        return 200, {"embeddings": [
+            {"gene": g, "vector": [0.0] * self.routing.dim}
+            for g in body["genes"]
+        ]}
+
+
+def test_shardgroup_backend_tags_every_leg_with_batch_tenant(tmp_path):
+    group = _FakeGroup([f"G{i}" for i in range(40)])
+    be = ShardGroupBackend(group, sub_queries=16)
+    be.knn_rows(0, 40, 2)
+    be.pair_scores([("G0", "G1")])
+    be.vector_rows(0, 5)
+    assert group.calls  # similar x3 + interaction + embedding
+    for n, headers in group.calls:
+        assert headers == {"X-Tenant": BATCH_TENANT}
+    # ...and the ambient header is scoped to the call, not left set
+    assert group._ambient() is None
+    # sub-request cap: 40 queries at sub=16 -> 16, 16, 8
+    assert [n for n, _ in group.calls[:3]] == [16, 16, 8]
+
+
+def test_shardgroup_backend_sub_respects_front_door_cap():
+    be = ShardGroupBackend(
+        _FakeGroup(["G0", "G1"], max_queries=8), sub_queries=64
+    )
+    assert be._sub == 8  # never larger than the replicas' cap
+
+
+def test_shardgroup_backend_pressure_wiring():
+    group = _FakeGroup(["G0", "G1"])
+    assert ShardGroupBackend(group).pressure() == 0.0
+    assert ShardGroupBackend(
+        group, pressure_fn=lambda: 0.75
+    ).pressure() == 0.75
+
+    def broken():
+        raise RuntimeError("aggregator gone")
+
+    # a broken signal must read as pressure (yield), never as idle
+    assert ShardGroupBackend(group, pressure_fn=broken).pressure() == 1.0
+
+
+def test_shardgroup_backend_degraded_answer_is_retryable_not_recorded():
+    group = _FakeGroup([f"G{i}" for i in range(8)])
+    real = group.similar
+
+    def degraded(body):
+        status, doc = real(body)
+        doc["results"][0]["degraded"] = True
+        return status, doc
+
+    group.similar = degraded
+    be = ShardGroupBackend(group, sub_queries=4)
+    with pytest.raises(ChunkFailed, match="degraded"):
+        be.knn_rows(0, 4, 2)
+
+
+def test_scatter_headers_nesting_restores():
+    from gene2vec_tpu.serve.shardgroup import (
+        _SCATTER_HEADERS,
+        scatter_headers,
+    )
+
+    with scatter_headers({"X-Tenant": "a"}):
+        with scatter_headers({"X-Tenant": "b"}):
+            assert _SCATTER_HEADERS.value == {"X-Tenant": "b"}
+        assert _SCATTER_HEADERS.value == {"X-Tenant": "a"}
+    assert _SCATTER_HEADERS.value is None
+
+
+# -- the precomputed-graph intrinsic eval -------------------------------------
+
+
+def _clustered_model(v=40, d=8, clusters=4, seed=11):
+    rng = np.random.RandomState(seed)
+    cent = rng.randn(clusters, d).astype(np.float32) * 3
+    emb = np.vstack([
+        cent[i % clusters] + 0.2 * rng.randn(d).astype(np.float32)
+        for i in range(v)
+    ])
+    tokens = tuple(f"G{i}" for i in range(v))
+    return LoadedModel(
+        dim=d, iteration=1, tokens=tokens,
+        index={t: i for i, t in enumerate(tokens)},
+        emb=emb, unit=jnp.asarray(l2_normalize(emb)),
+        source="synthetic", meta={},
+    ), clusters
+
+
+def test_graph_neighborhood_ratio_on_batch_artifact(tmp_path):
+    from gene2vec_tpu.eval.target_function import graph_neighborhood_ratio
+
+    model, clusters = _clustered_model()
+    d = str(tmp_path / "graph")
+    run_job(_spec("knn_graph"), _backend(model), ChunkedArtifact(d))
+    gmt = tmp_path / "planted.gmt"
+    gmt.write_text("".join(
+        f"CLUSTER{c}\turl\t" + "\t".join(
+            f"G{i}" for i in range(40) if i % clusters == c
+        ) + "\n"
+        for c in range(clusters)
+    ))
+    out = graph_neighborhood_ratio(d, str(gmt))
+    assert out["genes_scored"] == 40 and out["k"] == K
+    # planted clusters: graph neighbors share a pathway far more often
+    # than degree-matched random picks
+    assert out["ratio"] > 1.5
+    assert out["neighbor_hit_rate"] > out["random_hit_rate"]
+    bad = tmp_path / "mismatch.gmt"
+    bad.write_text("P\turl\tNOT_A_GENE\tALSO_NOT\n")
+    with pytest.raises(ValueError, match="no graph gene"):
+        graph_neighborhood_ratio(d, str(bad))
+
+
+# -- the passes_batch budget gate ---------------------------------------------
+#
+# The pass id "batch-graph-budget" gates cli.analyze's default tier;
+# these planted fixtures pin its shape (the test_shard convention).
+
+
+def _good_batch_doc():
+    return {
+        "schema": "gene2vec-tpu/bench-batch/v1",
+        "passed": True,
+        "batch": {
+            "recipe": {
+                "rows_24k": 24447, "dim_24k": 200, "k": 10,
+                "shards": 2, "chunk_rows": 512, "rows_1m": 1000000,
+                "dim_1m": 64, "queries_1m": 512, "batch_weight": 0.05,
+            },
+            "graph_24k": {
+                "rows_per_sec": 800.0, "recall_at_10": 0.999,
+                "resume_bit_exact": True, "killed_at_records": 6144,
+                "resumed_records": 6144,
+            },
+            "graph_1m": {
+                "rows_per_sec": 900.0, "recall_at_10": 0.97,
+            },
+            "mixed": {
+                "p99_delta_frac": 0.3, "p99_delta_ms": 6.0,
+            },
+        },
+    }
+
+
+def _batch_findings(tmp_path, doc=None, name="BENCH_BATCH_r19.json"):
+    from gene2vec_tpu.analysis.passes_batch import batch_findings
+
+    if doc is not None:
+        (tmp_path / name).write_text(json.dumps(doc))
+    return batch_findings(root=str(tmp_path))
+
+
+def _gating(findings):
+    return [f for f in findings if f.severity in ("error", "warning")]
+
+
+def test_passes_batch_good_record_is_info(tmp_path):
+    fs = _batch_findings(tmp_path, _good_batch_doc())
+    assert len(fs) == 1 and not _gating(fs)
+    assert fs[0].pass_id == "batch-graph-budget"
+
+
+def test_passes_batch_missing_record_is_info(tmp_path):
+    fs = _batch_findings(tmp_path)
+    assert len(fs) == 1 and fs[0].severity == "info"
+    assert "chaos_drill" in fs[0].message
+
+
+def test_passes_batch_low_recall_gates(tmp_path):
+    doc = _good_batch_doc()
+    doc["batch"]["graph_24k"]["recall_at_10"] = 0.9
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "recall_at_10" in fs[0].message
+
+
+def test_passes_batch_off_recipe_gates(tmp_path):
+    doc = _good_batch_doc()
+    doc["batch"]["recipe"]["rows_24k"] = 4096  # a smoke run
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "rows_24k" in fs[0].message
+
+
+def test_passes_batch_resume_divergence_gates(tmp_path):
+    doc = _good_batch_doc()
+    doc["batch"]["graph_24k"]["resume_bit_exact"] = False
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "resume_bit_exact" in fs[0].message
+
+
+def test_passes_batch_dropped_key_gates_like_violation(tmp_path):
+    doc = _good_batch_doc()
+    del doc["batch"]["graph_24k"]["recall_at_10"]
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "recall_at_10 missing" in fs[0].message
+
+
+def test_passes_batch_p99_either_bound_suffices(tmp_path):
+    doc = _good_batch_doc()
+    # frac blows past the budget but the absolute delta is tiny: a
+    # fast baseline must not turn scheduler noise into a gate
+    doc["batch"]["mixed"] = {"p99_delta_frac": 2.5, "p99_delta_ms": 3.0}
+    assert not _gating(_batch_findings(tmp_path, doc))
+    doc["batch"]["mixed"] = {"p99_delta_frac": 2.5, "p99_delta_ms": 80.0}
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "interactive p99" in fs[0].message
+
+
+def test_passes_batch_drill_verdict_gates(tmp_path):
+    doc = _good_batch_doc()
+    doc["passed"] = False
+    fs = _gating(_batch_findings(tmp_path, doc))
+    assert len(fs) == 1 and "passed=false" in fs[0].message
+
+
+def test_passes_batch_newest_round_wins(tmp_path):
+    bad = _good_batch_doc()
+    bad["batch"]["graph_24k"]["recall_at_10"] = 0.5
+    (tmp_path / "BENCH_BATCH_r18.json").write_text(json.dumps(bad))
+    fs = _batch_findings(tmp_path, _good_batch_doc(),
+                         name="BENCH_BATCH_r19.json")
+    assert len(fs) == 1 and not _gating(fs)
