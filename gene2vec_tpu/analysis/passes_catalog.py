@@ -1,0 +1,278 @@
+"""Catalog-plane budget gate: BENCH_CATALOG vs budgets.json
+``catalog``.
+
+``python scripts/chaos_drill.py --only catalog --catalog-out
+BENCH_CATALOG_r*.json`` stamps the multi-model serving plane's
+isolation record — a two-model catalog fleet hot-swaps its default
+model under closed-loop verified load on BOTH models, then ramps the
+second model and proves only that model's pool scales, with every
+answer classified post-hoc for wrong / mixed-iteration / cross-model
+content.  This pass re-checks the NEWEST committed record against the
+``isolation`` entry of the ``catalog`` budgets section every
+``cli.analyze`` run, so a catalog plane that quietly starts answering
+from the wrong model, bleeding swaps across pools, or scaling the
+cold pool fails the analyzer exactly like a collective-bytes
+regression does.
+
+Rules (the passes_batch / passes_loop shape — jax-free, I/O-only, so
+it rides the DEFAULT tier):
+
+* no ``BENCH_CATALOG_r*`` artifact at all → *info* (a fresh checkout
+  must not fail lint before its first drill);
+* the budget pins the **measurement recipe** (model count, replicas
+  per pool, autoscale ceiling, vocab, both dims, k): a record
+  measured off-recipe gates hard — isolation at one model must not
+  pass a gate whose contract is two;
+* ``max_wrong_answers`` / ``max_mixed_answers`` /
+  ``max_cross_model_answers`` are hard counts (all pinned to 0): a
+  single answer from the wrong model, the wrong iteration, or a
+  straddled swap gates; a missing budgeted quantity gates like a
+  violation — dropping the key must never be the way to pass;
+* verified availability over both load windows must hold
+  ``min_availability``;
+* ``require_swap`` / ``require_scale_up``: the record must actually
+  contain the hot-swap and the per-model scale-up it claims to have
+  survived, and the scale-up's end state must show the cold pool
+  still at its floor (``cold_pool_final == 1``) — pool isolation is
+  the whole point;
+* the scale-up decision must land within
+  ``max_scale_up_detection_ticks`` scrape ticks;
+* a drill that stamped ``passed: false`` gates on its own verdict.
+
+``GENE2VEC_TPU_PERF_ROOT`` overrides the artifact root (shared with
+``passes_perf``/``passes_batch`` so staged fixture dirs work
+uniformly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from gene2vec_tpu.analysis.findings import Finding
+from gene2vec_tpu.analysis.passes_hlo import BUDGETS_PATH, load_budgets
+from gene2vec_tpu.analysis.passes_perf import perf_root
+
+_PASS = "catalog-isolation-budget"
+
+#: budget recipe key -> bench record recipe key (identical names; the
+#: indirection exists so the pinning loop is data, not code)
+_RECIPE_KEYS = (
+    "models",
+    "replicas_per_model",
+    "max_replicas",
+    "vocab",
+    "dim_default",
+    "dim_second",
+    "k",
+)
+
+#: verified-answer count key -> budget ceiling key
+_COUNT_CEILINGS = (
+    ("wrong", "max_wrong_answers"),
+    ("mixed", "max_mixed_answers"),
+    ("cross_model", "max_cross_model_answers"),
+)
+
+
+def _get(section: Dict, key: str) -> Optional[float]:
+    v = section.get(key)
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _newest_catalog_bench(root: str) -> Optional[str]:
+    """The newest ``BENCH_CATALOG_*`` artifact under ``root`` (highest
+    round wins, mtime breaks ties)."""
+    from gene2vec_tpu.obs import ledger
+
+    candidates = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    for name in names:
+        matched = ledger.match_family(name)
+        if matched is not None and matched[0] == "catalog":
+            path = os.path.join(root, name)
+            rnd = ledger.parse_round(name)
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = 0.0
+            candidates.append((rnd if rnd is not None else -1, mtime,
+                               path))
+    if not candidates:
+        return None
+    return max(candidates)[2]
+
+
+def catalog_findings(
+    root: Optional[str] = None,
+    budgets_path: str = BUDGETS_PATH,
+) -> List[Finding]:
+    """Gate the newest committed catalog drill against
+    ``catalog.isolation``."""
+    budget = load_budgets(budgets_path).get("catalog", {}).get(
+        "isolation")
+    if not isinstance(budget, dict):
+        return []
+    root = root or perf_root()
+    path = _newest_catalog_bench(root)
+    if path is None:
+        return [Finding(
+            pass_id=_PASS,
+            severity="info",
+            path="BENCH_CATALOG",
+            message=(
+                "no catalog drill recorded yet (BENCH_CATALOG_r*.json "
+                "missing); run `python scripts/chaos_drill.py --only "
+                "catalog --catalog-out BENCH_CATALOG_rNN.json` (it "
+                "reads the pinned recipe from budgets.json 'catalog') "
+                "to stamp one"
+            ),
+        )]
+    label = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=f"unreadable catalog drill record: {e}",
+        )]
+
+    problems: List[str] = []
+    data: Dict = {"budget": "catalog.isolation"}
+    section = bench.get("catalog")
+    section = section if isinstance(section, dict) else {}
+
+    recipe = section.get("recipe")
+    recipe = recipe if isinstance(recipe, dict) else {}
+    for key in _RECIPE_KEYS:
+        pinned = _get(budget, key)
+        if pinned is None:
+            continue
+        measured = _get(recipe, key)
+        data[f"budget_{key}"] = pinned
+        data[key] = measured
+        if measured is None:
+            problems.append(
+                f"recipe.{key} missing from the drill record"
+            )
+        elif measured != pinned:
+            problems.append(
+                f"drill measured with {key}={measured:g} but the "
+                f"budget pins {key}={pinned:g} — re-run the catalog "
+                "drill"
+            )
+
+    verified = section.get("verified")
+    verified = verified if isinstance(verified, dict) else {}
+    for count_key, ceiling_key in _COUNT_CEILINGS:
+        ceiling = _get(budget, ceiling_key)
+        if ceiling is None:
+            continue
+        count = _get(verified, count_key)
+        data[count_key] = count
+        if count is None:
+            problems.append(
+                f"verified.{count_key} missing from the drill record"
+            )
+        elif count > ceiling:
+            problems.append(
+                f"verified.{count_key} {count:g} > budget "
+                f"{ceiling_key} {ceiling:g} — answers leaked across "
+                "the catalog's isolation boundary"
+            )
+    floor = _get(budget, "min_availability")
+    availability = _get(verified, "availability")
+    data["availability"] = availability
+    if floor is not None:
+        if availability is None:
+            problems.append(
+                "verified.availability missing from the drill record"
+            )
+        elif availability < floor:
+            problems.append(
+                f"verified.availability {availability:g} < budget "
+                f"{floor:g}"
+            )
+
+    swap = section.get("swap")
+    swap = swap if isinstance(swap, dict) else {}
+    if _get(budget, "require_swap"):
+        if _get(swap, "to_iteration") != 2:
+            problems.append(
+                "swap.to_iteration is not 2 — the record does not "
+                "show the default model's hot swap it claims to have "
+                "survived"
+            )
+        data["swap_visible_s"] = _get(swap, "visible_s")
+
+    scale = section.get("scale_up")
+    scale = scale if isinstance(scale, dict) else {}
+    if _get(budget, "require_scale_up"):
+        ceiling = _get(budget, "max_replicas")
+        hot = _get(scale, "hot_pool_final")
+        cold = _get(scale, "cold_pool_final")
+        data["hot_pool_final"] = hot
+        data["cold_pool_final"] = cold
+        if hot is None or (ceiling is not None and hot < ceiling):
+            problems.append(
+                f"scale_up.hot_pool_final {hot} never reached "
+                f"max_replicas {ceiling} — the ramped model's pool "
+                "did not scale"
+            )
+        if cold != 1:
+            problems.append(
+                f"scale_up.cold_pool_final {cold} != 1 — the ramp on "
+                "one model moved the OTHER model's pool; isolation is "
+                "broken"
+            )
+    max_ticks = _get(budget, "max_scale_up_detection_ticks")
+    ticks = _get(scale, "detection_ticks")
+    data["detection_ticks"] = ticks
+    if max_ticks is not None:
+        if ticks is None:
+            problems.append(
+                "scale_up.detection_ticks missing from the drill "
+                "record"
+            )
+        elif ticks > max_ticks:
+            problems.append(
+                f"scale_up.detection_ticks {ticks:g} > budget "
+                f"{max_ticks:g} — the per-model scaler is slow to see "
+                "a single hot pool"
+            )
+
+    if bench.get("passed") is False:
+        problems.append("the drill itself stamped passed=false")
+
+    if problems:
+        return [Finding(
+            pass_id=_PASS,
+            path=label,
+            message=(
+                "catalog drill record violates budget "
+                "'catalog.isolation': " + "; ".join(problems)
+            ),
+            data=data,
+        )]
+    return [Finding(
+        pass_id=_PASS,
+        severity="info",
+        path=label,
+        message=(
+            f"catalog isolation held: availability "
+            f"{data.get('availability')}, 0 wrong/mixed/cross-model "
+            f"answers, swap visible in {data.get('swap_visible_s')} s, "
+            f"scale-up decided in {data.get('detection_ticks')} ticks "
+            f"with the cold pool untouched, within budget "
+            "'catalog.isolation'"
+        ),
+        data=data,
+    )]

@@ -252,6 +252,15 @@ def _run(args) -> int:
         from gene2vec_tpu.analysis.passes_batch import batch_findings
 
         findings.extend(batch_findings())
+        # ... and the multi-model catalog gate (BENCH_CATALOG verified
+        # isolation: 0 wrong/mixed/cross-model answers, per-model
+        # scale-up with the cold pool untouched, vs budgets.json
+        # "catalog.isolation", recipe-pinned)
+        from gene2vec_tpu.analysis.passes_catalog import (
+            catalog_findings,
+        )
+
+        findings.extend(catalog_findings())
 
     if args.hlo:
         _pin_cpu_backend()

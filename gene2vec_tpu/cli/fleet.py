@@ -182,6 +182,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--swap-interval", type=float, default=2.0,
                    help="seconds between the shard swap coordinator's "
                         "export-dir polls (sharded mode only)")
+    p.add_argument("--catalog", default=None, metavar="SPEC.json",
+                   help="serve a multi-model catalog (serve/catalog.py "
+                        "spec): replicas partition into one pool PER "
+                        "MODEL (each pool sized by the entry's "
+                        "'replicas'), the front door routes "
+                        "/v1/<model>/* to the owning pool and "
+                        "unprefixed /v1/* to the spec's default, "
+                        "per-model token buckets 429 a hot model "
+                        "before it starves a cold one, and with "
+                        "--max-replicas the autoscaler runs one "
+                        "policy per (model) pool — hottest signal "
+                        "wins, one action per tick.  Overrides "
+                        "--replicas; excludes --shard-by-rows "
+                        "(docs/SERVING.md#multi-model-catalog)")
     p.add_argument("--jobs-dir", default=None, metavar="DIR",
                    help="batch-job store root: mounts the /v1/jobs "
                         "lifecycle surface on the front door "
@@ -244,18 +258,75 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.catalog and args.shard_by_rows:
+        # a catalog partitions replicas by MODEL, row sharding by row
+        # range of ONE model's table — combining them would need a
+        # (model, shard) grid per entry, which nothing routes yet
+        print(
+            "error: --catalog cannot combine with --shard-by-rows "
+            "(model pools and row shards are different fleet "
+            "partitions)",
+            file=sys.stderr,
+        )
+        return 2
     if args.shard_by_rows:
         args.replicas = args.shard_by_rows * args.replicas_per_shard
+
+    # parse + validate the catalog spec BEFORE paying N replica spawns;
+    # slots partition into contiguous per-model pools in spec order,
+    # and each pool's flags override the supervisor's defaults via
+    # argparse last-wins (same mechanism as per-shard flags)
+    catalog_spec = None
+    model_admission = None
+    model_of = None
+    model_args = None
+    if args.catalog:
+        from gene2vec_tpu.serve.catalog import (
+            ModelAdmission,
+            load_catalog_spec,
+        )
+
+        try:
+            catalog_spec = load_catalog_spec(args.catalog)
+        except (ValueError, OSError) as e:
+            print(
+                f"error: bad catalog spec {args.catalog!r}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        model_of = {}
+        model_args = {}
+        slot = 0
+        for entry in catalog_spec.entries:
+            for _ in range(entry.replicas):
+                model_of[slot] = entry.name
+                slot += 1
+            flags = ["--export-dir", entry.export_dir,
+                     "--model-name", entry.name,
+                     "--index", entry.index_mode]
+            if entry.dim is not None:
+                flags += ["--dim", str(entry.dim)]
+            if entry.ggipnn_checkpoint:
+                flags += ["--ggipnn-checkpoint", entry.ggipnn_checkpoint]
+            flags += list(entry.extra_args)
+            model_args[entry.name] = flags
+        args.replicas = slot
+        model_admission = ModelAdmission(catalog_spec)
 
     # validate the autoscale flags BEFORE paying N replica spawns.  In
     # sharded mode the min/max bounds apply to each SHARD's replica
     # pool: the scaler grows the hot shard's group, never the shard
     # count (shards partition one table — a fixed set)
     autoscale_cfg = None
-    pool_base = (
-        args.replicas_per_shard if args.shard_by_rows
-        else args.replicas
-    )
+    if args.shard_by_rows:
+        pool_base = args.replicas_per_shard
+    elif catalog_spec is not None:
+        # default floor for every model pool: the smallest boot-time
+        # pool (a per-model floor above some entry's own size would
+        # scale it up at the first tick)
+        pool_base = min(e.replicas for e in catalog_spec.entries)
+    else:
+        pool_base = args.replicas
     if args.max_replicas > 0:
         from gene2vec_tpu.serve.autoscale import AutoscaleConfig
 
@@ -281,7 +352,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"error: bad autoscale flags: {e}", file=sys.stderr)
             return 2
-        if pool_base < autoscale_cfg.min_replicas or (
+        if catalog_spec is not None:
+            # the bounds apply to each MODEL's pool: every entry's
+            # boot-time size must sit inside them or the scaler's
+            # first tick would immediately fight the spec
+            for entry in catalog_spec.entries:
+                if not (autoscale_cfg.min_replicas <= entry.replicas
+                        <= autoscale_cfg.max_replicas):
+                    print(
+                        f"error: catalog model {entry.name!r} "
+                        f"replicas {entry.replicas} outside "
+                        f"[{autoscale_cfg.min_replicas}, "
+                        f"{autoscale_cfg.max_replicas}]",
+                        file=sys.stderr,
+                    )
+                    return 2
+        elif pool_base < autoscale_cfg.min_replicas or (
             pool_base > autoscale_cfg.max_replicas
         ):
             what = (
@@ -342,6 +428,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         rng=random.Random(args.seed),
         shard_of=shard_of,
         shard_args=shard_args,
+        model_of=model_of,
+        model_args=model_args,
     )
     # validate the alert rules BEFORE paying N replica spawns — a typo'd
     # alerts.json must fail in milliseconds
@@ -395,7 +483,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         acceptors=args.proxy_acceptors,
         alert_rules=alert_rules,
         shadow=shadow,
+        catalog=catalog_spec,
+        model_admission=model_admission,
     )
+    if catalog_spec is not None and proxy.aggregator is not None:
+        # per-model telemetry projections: queue depth, staleness, and
+        # replica-up gauges keyed by the supervisor's slot->model map
+        proxy.aggregator.model_of = supervisor.model_of_url
+        proxy.aggregator.model_pool_facts = supervisor.model_up_counts
     coordinator = None
     group = None
     if args.shard_by_rows:
@@ -477,6 +572,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 metrics=run.registry,
                 drain_timeout_s=args.drain_timeout,
             )
+        elif catalog_spec is not None:
+            from gene2vec_tpu.serve.autoscale import (
+                PoolElasticController,
+            )
+
+            # one policy per MODEL pool; the hottest pool's signal
+            # wins the tick, scale-down never drains a model's last
+            # UP replica (the default's surface must stay answerable)
+            controller = PoolElasticController(
+                supervisor,
+                proxy,
+                autoscale_cfg,
+                pools=[(name, None) for name in catalog_spec.names],
+                metrics=run.registry,
+                drain_timeout_s=args.drain_timeout,
+            )
         else:
             from gene2vec_tpu.serve.autoscale import ElasticController
 
@@ -552,6 +663,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "max": autoscale_cfg.max_replicas,
                     }
                     if autoscale_cfg is not None else None
+                ),
+                "catalog": (
+                    {
+                        "default": catalog_spec.default,
+                        # slot indices per model — the drill targets
+                        # one model's pool (kill, swap, scale) by these
+                        "models": {
+                            e.name: {
+                                "replicas": e.replicas,
+                                "slots": [
+                                    r.index
+                                    for r in supervisor.replicas
+                                    if r.model == e.name
+                                ],
+                            }
+                            for e in catalog_spec.entries
+                        },
+                    }
+                    if catalog_spec is not None else None
                 ),
                 "shards": (
                     {

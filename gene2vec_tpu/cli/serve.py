@@ -36,6 +36,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dim", type=int, default=None,
                    help="serve only this table width (default: newest of "
                         "any dim)")
+    p.add_argument("--model-name", default="default",
+                   help="catalog name this replica serves under "
+                        "(serve/catalog.py): /v1/<name>/* aliases the "
+                        "unprefixed routes, metrics gain a bounded "
+                        "model= label, and healthz reports the name.  "
+                        "'default' (the default) keeps every label set "
+                        "and response byte-identical to a pre-catalog "
+                        "replica")
+    p.add_argument("--catalog", default=None, metavar="SPEC.json",
+                   help="serve a multi-model catalog spec instead of "
+                        "one export dir: one registry + engine + "
+                        "watcher per named model, addressed at "
+                        "/v1/<model>/* (unprefixed /v1/* serves the "
+                        "spec's default model).  --export-dir still "
+                        "anchors the run dir; per-model export dirs "
+                        "come from the spec.  Incompatible with row "
+                        "sharding (docs/SERVING.md#multi-model-catalog)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000,
                    help="0 picks an ephemeral port (printed in the JSON "
@@ -200,6 +217,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.catalog and (
+        args.shard_rows or args.shard_index is not None
+    ):
+        # a catalog partitions replicas by MODEL, row sharding by row
+        # range; one replica cannot sit in both grids at once
+        print(
+            "error: --catalog cannot combine with --shard-rows/"
+            "--shard-index (model pools and row shards are different "
+            "fleet partitions)",
+            file=sys.stderr,
+        )
+        return 2
     if args.shard_index is not None:
         if not 0 <= args.shard_index < args.num_shards:
             print(
@@ -228,76 +257,123 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"FAULT INJECTION ACTIVE: {fault_injector.spec.to_json()}",
             file=sys.stderr,
         )
-    sharding = None
     mesh = None
+    partition_rules = None
     if args.shard_rows:
         import jax
 
         from gene2vec_tpu.config import MeshConfig
         from gene2vec_tpu.parallel.mesh import make_mesh
-        from gene2vec_tpu.parallel.sharding import row_sharding
+        from gene2vec_tpu.parallel.partition_rules import (
+            DEFAULT_SERVE_RULES,
+        )
 
         mesh = make_mesh(MeshConfig(data=1, model=len(jax.devices())))
-        sharding = row_sharding(mesh)
-    registry = ModelRegistry(
-        args.export_dir, dim=args.dim, sharding=sharding,
-        metrics=run.registry, index_mode=args.index,
-        ann_clusters=args.ann_clusters,
-        shard=shard,
+        # declarative placement (parallel/partition_rules.py): the
+        # registry derives the row sharding by matching the rule table
+        # against its table names, replacing the imperative
+        # row_sharding() construction this path used to hand-build
+        partition_rules = DEFAULT_SERVE_RULES
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        max_queue=args.max_queue,
+        cache_size=args.cache_size,
+        timeout_ms=args.timeout_ms,
+        read_timeout_s=args.read_timeout,
+        trace_sample=args.trace_sample,
+        idle_timeout_s=args.idle_timeout,
+        max_conn_requests=args.max_conn_requests,
+        acceptors=args.acceptors,
+        http_workers=args.http_workers,
+        index=args.index,
+        nprobe=args.nprobe,
+        rescore_mult=args.rescore_mult,
+        kernel_profile=args.kernel_profile,
+        burst_threshold=args.burst_threshold,
+        burst_window_s=args.burst_window,
+        tenant_rate=args.tenant_quota,
+        tenant_burst=args.tenant_burst,
+        tenant_overrides=tuple(args.tenant_override),
+        jobs_dir=args.jobs_dir,
+        batch_weight=args.batch_weight,
+        batch_duty=args.batch_duty,
+        batch_guard_max=args.batch_guard_max,
     )
-    if not registry.refresh():
+    catalog = None
+    if args.catalog:
+        from gene2vec_tpu.serve.catalog import (
+            ModelCatalog,
+            load_catalog_spec,
+        )
+
+        try:
+            spec = load_catalog_spec(args.catalog)
+        except (ValueError, OSError) as e:
+            print(
+                f"error: bad catalog spec {args.catalog!r}: {e}",
+                file=sys.stderr,
+            )
+            run.close()
+            return 2
+        try:
+            catalog = ModelCatalog(
+                spec,
+                config=serve_config,
+                metrics=run.registry,
+                mesh=mesh,
+                fault_injector=fault_injector,
+            ).build()
+        except RuntimeError as e:
+            print(f"error: {e}", file=sys.stderr)
+            run.close()
+            return 2
+        catalog.start(watch_interval_s=args.poll_interval)
+        app = catalog.default_app
         print(
-            f"error: no checkpoint found in {args.export_dir!r} "
-            "(expected gene2vec_dim_<D>_iter_<N>.npz or *_w2v.txt)",
+            f"catalog {args.catalog}: serving "
+            f"{', '.join(catalog.names)} (default {spec.default})",
             file=sys.stderr,
         )
-        run.close()
-        return 2
-    if shard is None:
-        registry.start_watcher(args.poll_interval)
     else:
-        # shard mode: NO self-swap — the fleet's SwapCoordinator
-        # stages + flips every shard as one logical version; a replica
-        # swapping on its own poll cadence is exactly the
-        # mixed-iteration merge the epoch protocol exists to prevent
-        print(
-            f"shard {shard[0]}/{shard[1]}: self-swap watcher disabled "
-            "(coordinator-driven stage/flip)",
-            file=sys.stderr,
+        registry = ModelRegistry(
+            args.export_dir, dim=args.dim,
+            metrics=run.registry, index_mode=args.index,
+            ann_clusters=args.ann_clusters,
+            shard=shard,
+            name=args.model_name,
+            partition_rules=partition_rules,
+            mesh=mesh,
         )
-    app = ServeApp(
-        registry,
-        config=ServeConfig(
-            max_batch=args.max_batch,
-            max_delay_ms=args.max_delay_ms,
-            max_queue=args.max_queue,
-            cache_size=args.cache_size,
-            timeout_ms=args.timeout_ms,
-            read_timeout_s=args.read_timeout,
-            trace_sample=args.trace_sample,
-            idle_timeout_s=args.idle_timeout,
-            max_conn_requests=args.max_conn_requests,
-            acceptors=args.acceptors,
-            http_workers=args.http_workers,
-            index=args.index,
-            nprobe=args.nprobe,
-            rescore_mult=args.rescore_mult,
-            kernel_profile=args.kernel_profile,
-            burst_threshold=args.burst_threshold,
-            burst_window_s=args.burst_window,
-            tenant_rate=args.tenant_quota,
-            tenant_burst=args.tenant_burst,
-            tenant_overrides=tuple(args.tenant_override),
-            jobs_dir=args.jobs_dir,
-            batch_weight=args.batch_weight,
-            batch_duty=args.batch_duty,
-            batch_guard_max=args.batch_guard_max,
-        ),
-        metrics=run.registry,
-        ggipnn_checkpoint=args.ggipnn_checkpoint,
-        mesh=mesh,
-        fault_injector=fault_injector,
-    ).start()
+        if not registry.refresh():
+            print(
+                f"error: no checkpoint found in {args.export_dir!r} "
+                "(expected gene2vec_dim_<D>_iter_<N>.npz or *_w2v.txt)",
+                file=sys.stderr,
+            )
+            run.close()
+            return 2
+        if shard is None:
+            registry.start_watcher(args.poll_interval)
+        else:
+            # shard mode: NO self-swap — the fleet's SwapCoordinator
+            # stages + flips every shard as one logical version; a replica
+            # swapping on its own poll cadence is exactly the
+            # mixed-iteration merge the epoch protocol exists to prevent
+            print(
+                f"shard {shard[0]}/{shard[1]}: self-swap watcher disabled "
+                "(coordinator-driven stage/flip)",
+                file=sys.stderr,
+            )
+        app = ServeApp(
+            registry,
+            config=serve_config,
+            metrics=run.registry,
+            ggipnn_checkpoint=args.ggipnn_checkpoint,
+            mesh=mesh,
+            fault_injector=fault_injector,
+            model_name=args.model_name,
+        ).start()
     # flight recorder: 5xx bursts dump into the run dir automatically;
     # SIGQUIT dumps on demand (kill -QUIT <pid> during an incident)
     app.flight_dir = run.run_dir
@@ -325,7 +401,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = make_server(app, args.host, args.port)
     host, port = server.server_address[:2]
     url = f"http://{host}:{port}"
-    model = registry.model
+    model = app.registry.model
     run.annotate(serve_url=url)
     run.event("serve_start", url=url, iteration=model.iteration)
     # the one stdout line is the machine-readable contract (loadgen
@@ -346,6 +422,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "total_rows": model.total_rows,
             "epoch": model.epoch,
         }
+    if args.model_name != "default":
+        contract["model_name"] = args.model_name
+    if catalog is not None:
+        contract["catalog"] = {
+            "default": catalog.spec.default,
+            "models": list(catalog.names),
+        }
     print(json.dumps(contract), flush=True)
     print(
         f"serving {args.export_dir} (dim {model.dim}, iteration "
@@ -360,7 +443,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         server.shutdown()
         server.server_close()
-        app.stop()
+        if catalog is not None:
+            catalog.stop()  # stops every per-model app + watcher
+        else:
+            app.stop()
         run.close()
     return 0
 

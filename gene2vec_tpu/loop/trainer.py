@@ -41,14 +41,22 @@ from gene2vec_tpu.sgns.model import SGNSParams
 
 
 def extend_params(
-    params: SGNSParams, new_vocab: int, config: SGNSConfig
+    params: SGNSParams, new_vocab: int, config: SGNSConfig,
+    partition_rules=None, mesh=None,
 ) -> SGNSParams:
     """Seed rows for genes the checkpoint has never seen.  The new
     rows come from the init distribution at the NEW vocab size — a
     deterministic function of (config.seed, new_vocab, dim), so a
     resumed adoption and an uninterrupted one seed identical rows
     (the bit-exactness contract).  Existing rows pass through
-    untouched; ctx rows init to zero exactly like a fresh table's."""
+    untouched; ctx rows init to zero exactly like a fresh table's.
+
+    ``partition_rules`` (parallel/partition_rules.py) makes placement
+    declarative: the extended tables round-trip through the
+    rule-matched shardings — ``shard_params`` materializes rows on
+    their owning devices, ``gather_params`` pulls the verified host
+    copy back for the checkpoint writer — instead of the implicit
+    default-device placement a bare ``device_put`` would pick."""
     import jax
 
     old = int(np.asarray(params.emb).shape[0])
@@ -73,6 +81,18 @@ def extend_params(
         [np.asarray(params.ctx),
          np.zeros((new_vocab - old, dim), np.asarray(params.ctx).dtype)]
     )
+    if partition_rules is not None:
+        from gene2vec_tpu.parallel.partition_rules import (
+            gather_params,
+            shard_params,
+        )
+
+        tree = shard_params(
+            partition_rules, {"emb": emb, "ctx": ctx}, mesh=mesh
+        )
+        tree = gather_params(partition_rules, tree, mesh=mesh)
+        emb = np.asarray(tree["emb"])
+        ctx = np.asarray(tree["ctx"])
     return SGNSParams(emb=emb, ctx=ctx)
 
 
@@ -97,6 +117,8 @@ def adopt_checkpoint(
     vocab: Vocab,
     config: SGNSConfig,
     log: Callable[[str], None] = lambda s: None,
+    partition_rules=None,
+    mesh=None,
 ) -> int:
     """Copy the serving export's latest verified iteration into the
     candidate dir with the (possibly tail-extended) loop vocab and
@@ -121,7 +143,10 @@ def adopt_checkpoint(
             "row ids would move; re-init the ingest store from the "
             "current serving model"
         )
-    params = extend_params(params, len(vocab), config)
+    params = extend_params(
+        params, len(vocab), config,
+        partition_rules=partition_rules, mesh=mesh,
+    )
     ckpt.save_iteration(
         candidate_dir, config.dim, base_it, params, vocab,
         txt_output=config.txt_output,

@@ -268,6 +268,23 @@ class FleetAggregator:
         #: trap the model-fact gauges already guard against
         self._shard_queue_series: set = set()
         self._shard_p99_series: set = set()
+        #: catalog-fleet hooks (cli.fleet wires them for --catalog):
+        #: ``model_of(url) -> model name`` maps a scrape target onto
+        #: its catalog model so per-model queue depth can be projected
+        #: out of the merge and the iteration-skew headline can group
+        #: by model (skew ACROSS models is expected — each trains on
+        #: its own cadence), and ``model_pool_facts() -> {name: up}``
+        #: is the supervisor's per-model redundancy view behind
+        #: ``fleet_model_replicas_up{model=}``.  Both None on a
+        #: single-model fleet — no per-model series exist.
+        self.model_of: Optional[Callable[[str], Optional[str]]] = None
+        self.model_pool_facts: Optional[Callable[[], Dict]] = None
+        #: a model whose FRESHEST replica serves an artifact older than
+        #: this counts into the ``fleet_models_stale`` gauge (the
+        #: per-model staleness alert's input)
+        self.model_stale_after_s: float = 2 * 86400.0
+        self._model_queue_series: set = set()
+        self._model_age_series: set = set()
         #: additional per-tick snapshot consumers, called AFTER the
         #: evaluator with the same (snapshot, wall) — the autoscaler
         #: (serve/autoscale.py ElasticController.observe) registers
@@ -482,6 +499,20 @@ class FleetAggregator:
                     self.view.remove(gauge, labels={"target": url})
                 self._model_facts.pop(url, None)
             self._model_targets = set(target_list)
+            # group per-target facts by served catalog model: iteration
+            # skew ACROSS models is expected (each model trains on its
+            # own cadence), so in a catalog fleet the skew headline is
+            # the max WITHIN-model skew — a heterogeneous two-model
+            # fleet must not hold the skew alert firing forever.  On a
+            # single-model fleet every target lands in one group and
+            # the math is unchanged.
+            groups: Dict[Optional[str], List[Dict[str, float]]] = {}
+            for u, f in model_facts.items():
+                m = (
+                    self.model_of(u)
+                    if self.model_of is not None else None
+                )
+                groups.setdefault(m, []).append(f)
             iters = [
                 f["model_iteration"] for f in model_facts.values()
                 if "model_iteration" in f
@@ -494,11 +525,54 @@ class FleetAggregator:
             if iters:
                 model_headline["fleet_model_iteration_min"] = min(iters)
                 model_headline["fleet_model_iteration_max"] = max(iters)
+                skews = []
+                for fs in groups.values():
+                    gi = [
+                        f["model_iteration"] for f in fs
+                        if "model_iteration" in f
+                    ]
+                    if gi:
+                        skews.append(max(gi) - min(gi))
                 model_headline["fleet_model_iteration_skew"] = (
-                    max(iters) - min(iters)
+                    max(skews) if skews else 0.0
                 )
             if ages:
                 model_headline["fleet_model_age_seconds_max"] = max(ages)
+            # per-model labeled age + the stale-models count: a model
+            # counts as stale only when even its FRESHEST replica's
+            # artifact is old — one lagging replica is iteration skew's
+            # problem, a whole model nobody retrains is this one's
+            pub_age_models: set = set()
+            stale_models = 0
+            for m in sorted(k for k in groups if k is not None):
+                ga = [
+                    f["model_age_seconds"] for f in groups[m]
+                    if "model_age_seconds" in f
+                ]
+                if not ga:
+                    continue
+                self.view.gauge(
+                    "fleet_model_age_seconds_max", labels={"model": m}
+                ).set(max(ga))
+                model_headline[
+                    f"fleet_model_age_seconds_max{{model={m}}}"
+                ] = max(ga)
+                pub_age_models.add(m)
+                if min(ga) > self.model_stale_after_s:
+                    stale_models += 1
+            for m in self._model_age_series - pub_age_models:
+                self.view.remove(
+                    "fleet_model_age_seconds_max", labels={"model": m}
+                )
+            self._model_age_series = pub_age_models
+            if pub_age_models:
+                self.view.gauge("fleet_models_stale").set(stale_models)
+                model_headline["fleet_models_stale"] = float(stale_models)
+            else:
+                # no named models reporting: retire the count like the
+                # per-target series — a frozen stale-count would hold
+                # the per-model staleness alert firing forever
+                self.view.remove("fleet_models_stale")
             for key in (
                 "fleet_model_iteration_min",
                 "fleet_model_iteration_max",
@@ -576,6 +650,23 @@ class FleetAggregator:
                         if smp.name == "serve_queue_depth":
                             shard_queue[s] = (
                                 shard_queue.get(s, 0.0) + smp.value
+                            )
+            model_queue: Dict[str, float] = {}
+            if self.model_of is not None:
+                # per-model pool pressure, live-only like the shard
+                # twin: each target's whole queue depth (labeled or
+                # not) belongs to exactly one model in a catalog fleet
+                for url in target_list:
+                    samples = results.get(url)
+                    if samples is None:
+                        continue
+                    m = self.model_of(url)
+                    if m is None:
+                        continue
+                    for smp in samples:
+                        if smp.name == "serve_queue_depth":
+                            model_queue[m] = (
+                                model_queue.get(m, 0.0) + smp.value
                             )
 
         def msum(name: str) -> float:
@@ -739,6 +830,38 @@ class FleetAggregator:
                 if facts:
                     v.gauge("fleet_shards_redundancy_lost").set(lost)
                     snapshot["fleet_shards_redundancy_lost"] = float(lost)
+            # per-model pool signals (docs/SERVING.md#multi-model-
+            # catalog): queue depth per model feeds the (model, shard)
+            # pool autoscaler; fleet_model_replicas_up{model=} is the
+            # per-model redundancy view.  Retirement mirrors the shard
+            # series — a model whose every replica went dark must not
+            # freeze its last queue depth on /metrics/fleet.
+            if self.model_of is not None:
+                pub_mq: set = set()
+                for m, q in sorted(model_queue.items()):
+                    v.gauge(
+                        "fleet_model_queue_depth", labels={"model": m}
+                    ).set(q)
+                    snapshot[f"fleet_model_queue_depth{{model={m}}}"] = q
+                    pub_mq.add(m)
+                for m in self._model_queue_series - pub_mq:
+                    v.remove(
+                        "fleet_model_queue_depth", labels={"model": m}
+                    )
+                self._model_queue_series = pub_mq
+            if self.model_pool_facts is not None:
+                try:
+                    mfacts = self.model_pool_facts() or {}
+                except Exception:
+                    mfacts = {}
+                for m, up in sorted(mfacts.items()):
+                    v.gauge(
+                        "fleet_model_replicas_up",
+                        labels={"model": str(m)},
+                    ).set(float(up))
+                    snapshot[
+                        f"fleet_model_replicas_up{{model={m}}}"
+                    ] = float(up)
             headline = {
                 "fleet_availability": availability,
                 "fleet_queue_depth": queue_depth,

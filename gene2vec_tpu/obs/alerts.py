@@ -266,6 +266,22 @@ def default_rules() -> List[AlertRule]:
             op=">", value=0.0, for_s=120.0, clear_for_s=30.0,
         ),
         AlertRule(
+            name="catalog-model-staleness", kind="threshold",
+            severity="warn",
+            # multi-model catalog fleets (serve/catalog.py): COUNT of
+            # models whose freshest replica serves an artifact older
+            # than the aggregator's model_stale_after_s.  Distinct from
+            # model-staleness above, which watches the single oldest
+            # artifact fleet-wide: in a catalog, one cold rarely-
+            # retrained model would hold that rule firing forever while
+            # a genuinely wedged sibling hides behind it — this rule
+            # fires per-model, on the count.  The gauge exists only on
+            # catalog fleets (mirrors shard-redundancy-lost) —
+            # elsewhere the selector is absent and the rule holds.
+            metric="fleet_models_stale",
+            op=">", value=0.0, for_s=60.0, clear_for_s=60.0,
+        ),
+        AlertRule(
             name="queue-depth", kind="threshold", severity="warn",
             metric="fleet_queue_depth",
             op=">", value=192.0, clear_value=64.0,

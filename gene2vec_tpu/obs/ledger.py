@@ -471,10 +471,42 @@ def _adapt_batch(doc: Dict) -> Tuple[Dict[str, float], str]:
     return m, "batch_graph_rows_per_sec"
 
 
+def _adapt_catalog(doc: Dict) -> Tuple[Dict[str, float], str]:
+    """BENCH_CATALOG_* (chaos_drill.py --only catalog --catalog-out):
+    the multi-model serving plane's isolation drill — a two-model
+    catalog fleet hot-swaps its default model under verified load on
+    both models, then ramps the second model and proves only that
+    model's pool scales.  The ``perf.regression`` rules watch verified
+    availability (higher) and the per-model scale-up detection latency
+    in scrape ticks (lower)."""
+    m: Dict[str, float] = {}
+    section = doc.get("catalog")
+    section = section if isinstance(section, dict) else {}
+    verified = section.get("verified")
+    if isinstance(verified, dict):
+        _put(m, "catalog_availability", verified.get("availability"))
+        _put(m, "catalog_verified_requests", verified.get("requests"))
+        _put(m, "catalog_wrong_answers", verified.get("wrong"))
+        _put(m, "catalog_mixed_answers", verified.get("mixed"))
+        _put(m, "catalog_cross_model_answers", verified.get("cross_model"))
+    swap = section.get("swap")
+    if isinstance(swap, dict):
+        _put(m, "catalog_swap_visible_s", swap.get("visible_s"))
+    scale = section.get("scale_up")
+    if isinstance(scale, dict):
+        _put(m, "catalog_scale_up_detection_ticks",
+             scale.get("detection_ticks"))
+        _put(m, "catalog_scale_up_completed_s", scale.get("completed_s"))
+        _put(m, "catalog_cold_pool_final", scale.get("cold_pool_final"))
+    _put(m, "passed", doc.get("passed"))
+    return m, "catalog_availability"
+
+
 #: ingest order: (compiled filename pattern, family, adapter).
 #: First match wins — BENCH_PERF/SERVE/FLEET/... must precede the bare
 #: BENCH_r catch-all.
 ADAPTERS: Sequence[Tuple[re.Pattern, str, Callable]] = (
+    (re.compile(r"^BENCH_CATALOG_\w*\.json$"), "catalog", _adapt_catalog),
     (re.compile(r"^BENCH_BATCH_\w*\.json$"), "batch", _adapt_batch),
     (re.compile(r"^BENCH_LOOP_\w*\.json$"), "loop", _adapt_loop),
     (re.compile(r"^BENCH_SHARD_\w*\.json$"), "shard", _adapt_shard),

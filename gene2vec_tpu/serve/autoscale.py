@@ -64,9 +64,12 @@ __all__ = [
     "AutoscaleConfig",
     "AutoscalePolicy",
     "ElasticController",
+    "PoolAutoscalePolicy",
+    "PoolElasticController",
     "ScaleDecision",
     "ShardAutoscalePolicy",
     "ShardElasticController",
+    "pool_snapshot",
     "shard_snapshot",
 ]
 
@@ -105,8 +108,9 @@ class AutoscaleConfig:
 class ScaleDecision:
     """One tick's verdict.  ``target`` is the replica count the fleet
     should move to (== ``current`` on hold).  ``shard`` scopes the
-    action to one shard's replica pool (None = the whole fleet — the
-    unsharded pool model)."""
+    action to one shard's replica pool, ``model`` to one catalog
+    model's pool (both None = the whole fleet — the single pool
+    model); together they name a (model, shard) pool."""
 
     action: str                # "up" | "down" | "hold"
     target: int
@@ -114,6 +118,7 @@ class ScaleDecision:
     breach_ticks: int = 0
     clear_ticks: int = 0
     shard: Optional[int] = None
+    model: Optional[str] = None
 
 
 def _route_key(route: str) -> str:
@@ -412,9 +417,9 @@ class ElasticController:
     def _apply(self, decision: ScaleDecision) -> None:
         try:
             if decision.action == "up":
-                self._scale_up(decision.shard)
+                self._scale_up(decision.shard, decision.model)
             else:
-                self._scale_down(decision.shard)
+                self._scale_down(decision.shard, decision.model)
         except Exception as e:
             self._count("fleet_scale_failures_total")
             print(f"autoscale: {decision.action} failed: {e!r}",
@@ -430,13 +435,16 @@ class ElasticController:
                     self.supervisor.active_count()
                 )
 
-    def _scale_up(self, shard: Optional[int] = None) -> None:
-        # keyword passed only when set: unsharded supervisors (and the
-        # test fakes) keep their no-arg signature
-        replica = (
-            self.supervisor.scale_up(shard=shard)
-            if shard is not None else self.supervisor.scale_up()
-        )
+    def _scale_up(self, shard: Optional[int] = None,
+                  model: Optional[str] = None) -> None:
+        # keywords passed only when set: single-pool supervisors (and
+        # the test fakes) keep their no-arg signature
+        kwargs = {}
+        if shard is not None:
+            kwargs["shard"] = shard
+        if model is not None:
+            kwargs["model"] = model
+        replica = self.supervisor.scale_up(**kwargs)
         # hold the action slot until the new replica actually serves
         # (or demonstrably cannot): the breach persists while it warms
         # up, and releasing early would spawn a second replica for the
@@ -456,12 +464,14 @@ class ElasticController:
                 break
             time.sleep(0.1)
 
-    def _scale_down(self, shard: Optional[int] = None) -> None:
-        victim = (
-            self.supervisor.pick_drain_victim(shard=shard)
-            if shard is not None
-            else self.supervisor.pick_drain_victim()
-        )
+    def _scale_down(self, shard: Optional[int] = None,
+                    model: Optional[str] = None) -> None:
+        kwargs = {}
+        if shard is not None:
+            kwargs["shard"] = shard
+        if model is not None:
+            kwargs["model"] = model
+        victim = self.supervisor.pick_drain_victim(**kwargs)
         if victim is None:
             return
         self.supervisor.begin_drain(victim)
@@ -492,65 +502,169 @@ class ElasticController:
         self.supervisor.finish_drain(victim)
 
 
-# -- the per-shard pool model (replicated row shards) ------------------------
+# -- the (model, shard) pool model -------------------------------------------
+#
+# A fleet partitions its slots into POOLS — per row shard
+# (--shard-by-rows), per catalog model (--catalog), or in principle
+# both — and each pool scales independently inside
+# [min_replicas, max_replicas].  The pool key is a (model, shard)
+# tuple with the unused axis None; `pool_snapshot` projects one pool's
+# signals out of the aggregator's flat snapshot, and
+# `PoolAutoscalePolicy` runs one plain AutoscalePolicy per pool with
+# hottest-signal-wins arbitration.  The shard classes below are the
+# pre-catalog API, now thin delegations.
 
 
-def shard_snapshot(snapshot: Dict[str, float], shard: int,
-                   p99_route: str) -> Dict[str, float]:
-    """Project one shard's signals out of the aggregator's flat
-    snapshot into the key names :class:`AutoscalePolicy` reads — the
-    per-shard policies are plain AutoscalePolicy instances evaluating
-    their own shard's queue depth and scatter p99.  The fleet-wide
-    counter pairs are deliberately ABSENT: rejection/availability rates
-    then carry no evidence (None) and neither breach nor block a clear,
-    so a shard pool scales on ITS load, not on another shard's burn."""
+def _pool_queue_key(model: Optional[str], shard: Optional[int]) -> str:
+    """The aggregator gauge one pool's queue pressure lives under:
+    ``fleet_model_queue_depth{model=}`` for a model pool,
+    ``fleet_shard_queue_depth{shard=}`` for a shard pool (a hybrid
+    pool reads the model axis — the finer partition in practice)."""
+    if model is not None:
+        return f"fleet_model_queue_depth{{model={model}}}"
+    return f"fleet_shard_queue_depth{{shard={shard}}}"
+
+
+def _pool_desc(model: Optional[str], shard: Optional[int]) -> str:
+    parts = []
+    if model is not None:
+        parts.append(f"model {model}")
+    if shard is not None:
+        parts.append(f"shard {shard}")
+    return " ".join(parts) if parts else "fleet"
+
+
+def pool_snapshot(snapshot: Dict[str, float], model: Optional[str],
+                  shard: Optional[int],
+                  p99_route: str) -> Dict[str, float]:
+    """Project one (model, shard) pool's signals out of the
+    aggregator's flat snapshot into the key names
+    :class:`AutoscalePolicy` reads — the per-pool policies are plain
+    AutoscalePolicy instances evaluating their own pool's queue depth
+    (and per-shard scatter p99, when the pool has a shard axis).  The
+    fleet-wide counter pairs are deliberately ABSENT: rejection/
+    availability rates then carry no evidence (None) and neither
+    breach nor block a clear, so a pool scales on ITS load, not on
+    another pool's burn."""
     sub: Dict[str, float] = {}
     fresh = snapshot.get("_fresh_targets")
     if fresh is not None:
         sub["_fresh_targets"] = fresh
-    q = snapshot.get(f"fleet_shard_queue_depth{{shard={shard}}}")
+    q = snapshot.get(_pool_queue_key(model, shard))
     if q is None:
-        # no queue evidence from ANY of this shard's replicas this
+        # no queue evidence from ANY of this pool's replicas this
         # round (every scrape missed — the aggregator only publishes
         # the key from successful scrapes): the fleet-wide freshness
-        # guard can't see a single dark shard, so zero THIS pool's
+        # guard can't see a single dark pool, so zero THIS pool's
         # freshness — the policy must HOLD, not read "idle" and drain
         # capacity from exactly the pool it is blind to
         sub["_fresh_targets"] = 0.0
         sub["fleet_queue_depth"] = 0.0
     else:
         sub["fleet_queue_depth"] = float(q)
-    p99 = snapshot.get(f"fleet_shard_p99_seconds{{shard={shard}}}")
-    if p99 is not None:
-        sub[_route_key(p99_route)] = float(p99)
+    if shard is not None:
+        p99 = snapshot.get(f"fleet_shard_p99_seconds{{shard={shard}}}")
+        if p99 is not None:
+            sub[_route_key(p99_route)] = float(p99)
     return sub
 
 
-class ShardAutoscalePolicy:
-    """Per-shard pool model: one :class:`AutoscalePolicy` per row
-    shard, each fed its own shard's signals, deciding that shard's
+def shard_snapshot(snapshot: Dict[str, float], shard: int,
+                   p99_route: str) -> Dict[str, float]:
+    """One shard pool's projection — ``pool_snapshot`` with no model
+    axis (the pre-catalog name, kept for callers and tests)."""
+    return pool_snapshot(snapshot, None, shard, p99_route)
+
+
+class PoolAutoscalePolicy:
+    """Per-pool model: one :class:`AutoscalePolicy` per (model, shard)
+    pool, each fed its own pool's signals, deciding that pool's
     replica count inside [min_replicas, max_replicas].  Pure like the
     underlying policies; one :meth:`observe` per scrape tick returns
     at most ONE non-hold decision (scale-ups first, hottest-queue
-    shard wins ties) because the controller applies one action at a
-    time anyway — a shard whose decision lost the tie re-breaches and
+    pool wins ties) because the controller applies one action at a
+    time anyway — a pool whose decision lost the tie re-breaches and
     wins a later tick (its breach window re-accumulates under the
     fleet-wide cooldown, the same anti-flap the single pool has)."""
+
+    def __init__(self, config: AutoscaleConfig, pools):
+        pools = [
+            (m, None if s is None else int(s)) for m, s in pools
+        ]
+        if not pools:
+            raise ValueError("need at least one pool")
+        if len(set(pools)) != len(pools):
+            raise ValueError(f"duplicate pool keys in {pools}")
+        self.config = config
+        self.pools = pools
+        self.pool_policies = {
+            p: AutoscalePolicy(config) for p in pools
+        }
+        #: per-pool policy table; the shard subclass re-keys this view
+        #: by shard index (the pre-catalog API) over the SAME instances
+        self.policies = self.pool_policies
+
+    def note_action_done(self, now: float) -> None:
+        # cooldown is FLEET-wide: every pool re-arms, or two pools
+        # could interleave actions faster than any one pool allows
+        for p in self.pool_policies.values():
+            p.note_action_done(now)
+
+    def observe(
+        self,
+        snapshot: Dict[str, float],
+        now: float,
+        current_of: Dict[Tuple[Optional[str], Optional[int]], int],
+    ) -> ScaleDecision:
+        decisions: Dict[tuple, ScaleDecision] = {}
+        for pool, policy in self.pool_policies.items():
+            model, shard = pool
+            sub = pool_snapshot(
+                snapshot, model, shard, self.config.p99_route
+            )
+            decisions[pool] = policy.observe(
+                sub, now=now, current=current_of.get(pool, 0)
+            )
+
+        def queue_of(pool: tuple) -> float:
+            return float(snapshot.get(_pool_queue_key(*pool), 0.0))
+
+        def tag(pool: tuple, d: ScaleDecision) -> ScaleDecision:
+            return dataclasses.replace(
+                d, model=pool[0], shard=pool[1],
+                reason=f"{_pool_desc(*pool)}: {d.reason}",
+            )
+
+        for action in ("up", "down"):
+            picked = [
+                p for p, d in decisions.items() if d.action == action
+            ]
+            if picked:
+                p = max(picked, key=queue_of) if action == "up" else (
+                    min(picked, key=queue_of)
+                )
+                return tag(p, decisions[p])
+        # all holds: surface the busiest pool's reason for telemetry
+        p = max(decisions, key=queue_of)
+        return tag(p, decisions[p])
+
+
+class ShardAutoscalePolicy(PoolAutoscalePolicy):
+    """Per-shard pool model — :class:`PoolAutoscalePolicy` over the
+    shard-only pool keys, keeping the pre-catalog shard-keyed
+    ``observe(current_of: {shard: count})`` signature."""
 
     def __init__(self, config: AutoscaleConfig, num_shards: int):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
-        self.config = config
+        super().__init__(
+            config, [(None, s) for s in range(int(num_shards))]
+        )
         self.num_shards = int(num_shards)
         self.policies = {
-            s: AutoscalePolicy(config) for s in range(self.num_shards)
+            s: self.pool_policies[(None, s)]
+            for s in range(self.num_shards)
         }
-
-    def note_action_done(self, now: float) -> None:
-        # cooldown is FLEET-wide: every pool re-arms, or two shards
-        # could interleave actions faster than any one pool allows
-        for p in self.policies.values():
-            p.note_action_done(now)
 
     def observe(
         self,
@@ -558,35 +672,11 @@ class ShardAutoscalePolicy:
         now: float,
         current_of: Dict[int, int],
     ) -> ScaleDecision:
-        decisions: Dict[int, ScaleDecision] = {}
-        for s, policy in self.policies.items():
-            sub = shard_snapshot(snapshot, s, self.config.p99_route)
-            decisions[s] = policy.observe(
-                sub, now=now, current=current_of.get(s, 0)
-            )
-
-        def queue_of(s: int) -> float:
-            return float(snapshot.get(
-                f"fleet_shard_queue_depth{{shard={s}}}", 0.0
-            ))
-
-        for action in ("up", "down"):
-            picked = [
-                s for s, d in decisions.items() if d.action == action
-            ]
-            if picked:
-                s = max(picked, key=queue_of) if action == "up" else (
-                    min(picked, key=queue_of)
-                )
-                d = decisions[s]
-                return dataclasses.replace(
-                    d, shard=s, reason=f"shard {s}: {d.reason}"
-                )
-        # all holds: surface the busiest shard's reason for telemetry
-        s = max(decisions, key=queue_of)
-        d = decisions[s]
-        return dataclasses.replace(
-            d, shard=s, reason=f"shard {s}: {d.reason}"
+        return super().observe(
+            snapshot, now=now,
+            current_of={
+                (None, s): n for s, n in current_of.items()
+            },
         )
 
 
@@ -656,4 +746,76 @@ class ShardElasticController(ElasticController):
         return (
             f"{decision.action} shard {decision.shard} -> "
             f"{decision.target} replicas ({decision.reason})"
+        )
+
+
+class PoolElasticController(ElasticController):
+    """The elastic controller for a multi-model catalog fleet: the
+    same one-action-at-a-time shell, drain path, and metrics, driving
+    a :class:`PoolAutoscalePolicy` over (model, shard) pool keys —
+    scale-up spawns a new member into the hot model's pool
+    (``FleetSupervisor.scale_up(model=)``), scale-down drains the
+    newest member of an idle pool and never a model's last UP replica
+    (``pick_drain_victim(model=)``)."""
+
+    def __init__(self, supervisor, proxy, config: AutoscaleConfig,
+                 pools, metrics=None, **kw):
+        super().__init__(
+            supervisor, proxy, config, metrics=metrics,
+            policy=PoolAutoscalePolicy(config, pools),
+            **kw,
+        )
+        self.pool_policy = self.policy
+        self.pools = list(self.pool_policy.pools)
+        # the deciding pool's size at _decide time, consumed by
+        # _publish in the same tick (observe is single-threaded per
+        # aggregator tick) to translate the pool target fleet-wide
+        self._decision_pool = 0
+
+    def _decide(
+        self, snapshot: Dict[str, float], now: float
+    ) -> Tuple[ScaleDecision, int]:
+        current_of = {
+            (m, s): self.supervisor.active_count(shard=s, model=m)
+            for m, s in self.pools
+        }
+        decision = self.pool_policy.observe(
+            snapshot, now=now, current_of=current_of,
+        )
+        self._decision_pool = current_of.get(
+            (decision.model, decision.shard), 0
+        )
+        if self.metrics is not None:
+            # every pool, every tick — publishing only the deciding
+            # pool would freeze the other pools' gauges at whatever
+            # size they had the last time they happened to decide
+            for (m, s), n in current_of.items():
+                if m is not None:
+                    self.metrics.gauge(
+                        "fleet_model_replicas_active",
+                        labels={"model": m},
+                    ).set(n)
+                if s is not None:
+                    self.metrics.gauge(
+                        "fleet_shard_replicas_active",
+                        labels={"shard": str(s)},
+                    ).set(n)
+        return decision, sum(current_of.values())
+
+    def _publish(self, decision: ScaleDecision, current: int) -> None:
+        # decision.target is the chosen POOL's target; the
+        # fleet_replicas_active/fleet_replicas_target gauge pair is
+        # documented as comparable (docs/SERVING.md), so export the
+        # post-action FLEET-wide total instead of one pool's target
+        if decision.model is not None or decision.shard is not None:
+            decision = dataclasses.replace(
+                decision,
+                target=current + (decision.target - self._decision_pool),
+            )
+        super()._publish(decision, current)
+
+    def _describe(self, decision: ScaleDecision) -> str:
+        return (
+            f"{decision.action} {_pool_desc(decision.model, decision.shard)} "
+            f"-> {decision.target} replicas ({decision.reason})"
         )

@@ -176,6 +176,7 @@ class MicroBatcher:
         default_timeout_s: float = 2.0,
         metrics=None,
         tenant_weights: Optional[Callable[[str], float]] = None,
+        labels: Optional[dict] = None,
     ):
         self.compute = compute
         self.max_batch = max_batch
@@ -184,6 +185,13 @@ class MicroBatcher:
         self.default_timeout_s = default_timeout_s
         self.cache = LRUCache(cache_size)
         self.metrics = metrics
+        # extra label set on every instrument this batcher touches —
+        # the multi-model catalog (serve/catalog.py) runs one batcher
+        # per model against ONE shared registry, and ``{model=}``
+        # labels are what keep sibling queues from fighting over the
+        # same serve_queue_depth gauge.  None (single-model) keeps the
+        # historical unlabeled series.
+        self.labels = dict(labels) if labels else None
         # per-tenant lanes, weighted-fair drained; accessed only under
         # self._cv (FairQueue itself is lock-free by contract)
         self._q = FairQueue(weight_of=tenant_weights)
@@ -215,15 +223,17 @@ class MicroBatcher:
 
     def _count(self, name: str, amount: int = 1) -> None:
         if self.metrics is not None:
-            self.metrics.counter(name).inc(amount)
+            self.metrics.counter(name, labels=self.labels).inc(amount)
 
     def _observe(self, name: str, value: float) -> None:
         if self.metrics is not None:
-            self.metrics.histogram(name).observe(value)
+            self.metrics.histogram(name, labels=self.labels).observe(value)
 
     def _gauge_depth(self) -> None:
         if self.metrics is not None:
-            self.metrics.gauge("serve_queue_depth").set(len(self._q))
+            self.metrics.gauge(
+                "serve_queue_depth", labels=self.labels
+            ).set(len(self._q))
 
     # -- submission --------------------------------------------------------
 

@@ -83,6 +83,8 @@ from gene2vec_tpu.serve.routes import (
     JOBS_ROUTE,
     V1_ROUTES,
     collapse_jobs_route,
+    model_label,
+    split_model_route,
 )
 
 #: routes the proxy labels latency under; job sub-routes collapse to
@@ -124,11 +126,17 @@ class Replica:
     ``shard`` is the row shard this slot serves (None in an unsharded
     fleet).  With ``--replicas-per-shard`` several slots share one
     shard — the (shard, replica) grid — and the front door's scatter
-    treats them as interchangeable siblings."""
+    treats them as interchangeable siblings.  ``model`` is the catalog
+    model this slot serves (None in a single-model fleet): a catalog
+    fleet partitions its slots into per-model pools the same way a
+    sharded fleet partitions them into per-shard pools, and the two
+    never combine (cli.fleet rejects ``--catalog`` + ``--shard-by-rows``)."""
 
-    def __init__(self, index: int, shard: Optional[int] = None):
+    def __init__(self, index: int, shard: Optional[int] = None,
+                 model: Optional[str] = None):
         self.index = index
         self.shard = shard
+        self.model = model
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.state = ReplicaState.STARTING
@@ -195,6 +203,8 @@ class FleetSupervisor:
         rng: Optional[random.Random] = None,
         shard_of: Optional[Dict[int, int]] = None,
         shard_args: Optional[Dict[int, Sequence[str]]] = None,
+        model_of: Optional[Dict[int, str]] = None,
+        model_args: Optional[Dict[str, Sequence[str]]] = None,
     ):
         self.export_dir = export_dir
         self.config = config
@@ -215,8 +225,21 @@ class FleetSupervisor:
         self._shard_args: Dict[int, List[str]] = {
             int(k): list(v) for k, v in (shard_args or {}).items()
         }
+        # the (model, replica) grid (serve/catalog.py): slot index ->
+        # catalog model name, and the per-MODEL extra flags every slot
+        # of that pool spawns with (--export-dir override + --model-name
+        # + the entry's extra_args) — keyed by name, not slot, so an
+        # elastically-added pool member inherits its model's exact flags
+        # (argparse last-wins lets the override shadow the defaults)
+        self._model_of: Dict[int, str] = {
+            int(k): str(v) for k, v in (model_of or {}).items()
+        }
+        self._model_args: Dict[str, List[str]] = {
+            str(k): list(v) for k, v in (model_args or {}).items()
+        }
         self.replicas = [
-            Replica(i, shard=self._shard_of.get(i))
+            Replica(i, shard=self._shard_of.get(i),
+                    model=self._model_of.get(i))
             for i in range(config.replicas)
         ]
         #: next index for an elastically-added replica — indices are
@@ -252,10 +275,14 @@ class FleetSupervisor:
         shard_flags = (
             self._shard_args.get(shard, []) if shard is not None else []
         )
+        model = self._model_of.get(index)
+        model_flags = (
+            self._model_args.get(model, []) if model is not None else []
+        )
         return [
             sys.executable, "-m", "gene2vec_tpu.cli.serve",
             "--export-dir", self.export_dir, "--port", "0",
-            *self.serve_args, *shard_flags,
+            *self.serve_args, *shard_flags, *model_flags,
             *self.replica_args.get(index, []),
         ]
 
@@ -385,6 +412,7 @@ class FleetSupervisor:
                     "url": r.url,
                     "pid": r.pid,
                     "shard": r.shard,
+                    "model": r.model,
                     "restarts": r.restarts,
                     "last_error": r.last_error,
                 }
@@ -448,35 +476,84 @@ class FleetSupervisor:
                 f["desired"] += 1
             return out
 
+    # -- the (model, replica) grid -----------------------------------------
+
+    def model_urls(self, model: str) -> List[str]:
+        """Every UP replica of one catalog model — the target list the
+        front door's per-model client routes over.  The model-axis twin
+        of :meth:`shard_urls`: a pool member leaves on the next tick,
+        the client's breakers absorb it until then."""
+        with self._lock:
+            return [
+                r.url for r in self.replicas
+                if r.model == model and r.state == ReplicaState.UP
+                and r.url
+            ]
+
+    def model_up_counts(self) -> Dict[str, int]:
+        """UP replicas per catalog model — the per-model redundancy
+        view behind ``fleet_model_replicas_up{model=}``."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for r in self.replicas:
+                if r.model is None:
+                    continue
+                out.setdefault(r.model, 0)
+                if r.state == ReplicaState.UP:
+                    out[r.model] += 1
+            return out
+
+    def model_of_url(self, url: str) -> Optional[str]:
+        """The catalog model a replica URL serves (None when unknown or
+        single-model) — the aggregator's hook for grouping per-target
+        facts into per-model gauges without parsing label soup."""
+        if not url:
+            return None
+        url = url.rstrip("/")
+        with self._lock:
+            for r in self.replicas:
+                if r.url == url:
+                    return r.model
+        return None
+
     # -- elasticity (serve/autoscale.py ElasticController) -----------------
 
-    def active_count(self, shard: Optional[int] = None) -> int:
+    def active_count(self, shard: Optional[int] = None,
+                     model: Optional[str] = None) -> int:
         """Replica slots that count toward capacity: everything except
         abandoned (FAILED) and departing (DRAINING) slots — a dead slot
         in backoff still counts, because a restart is coming and
         scaling on top of it would double-provision.  ``shard``
         restricts the count to one shard's pool (the per-shard
-        autoscaler's notion of "current")."""
+        autoscaler's notion of "current"); ``model`` to one catalog
+        model's pool — the (model, shard) autoscaler passes whichever
+        axis the fleet actually partitions on."""
         with self._lock:
             return sum(
                 1 for r in self.replicas
                 if r.state not in (
                     ReplicaState.FAILED, ReplicaState.DRAINING
                 ) and (shard is None or r.shard == shard)
+                and (model is None or r.model == model)
             )
 
-    def scale_up(self, shard: Optional[int] = None) -> Replica:
+    def scale_up(self, shard: Optional[int] = None,
+                 model: Optional[str] = None) -> Replica:
         """Spawn one NEW replica slot (never reusing an index).  Blocks
         on the child's startup contract line; the monitor loop admits
         it to rotation once readiness probes pass.  A spawn failure
         removes the slot again and propagates — the policy's cooldown
         decides when to try again.  ``shard`` spawns the slot into one
         shard's pool: it inherits that shard's flags and joins its
-        scatter rotation on readiness."""
+        scatter rotation on readiness.  ``model`` spawns it into one
+        catalog model's pool: it inherits that model's export dir and
+        name flags and joins that model's front-door rotation."""
         with self._lock:
-            replica = Replica(self._next_index, shard=shard)
+            replica = Replica(self._next_index, shard=shard, model=model)
             if shard is not None:
                 self._shard_of[replica.index] = shard
+            if model is not None:
+                self._model_of[replica.index] = model
             self._next_index += 1
             replica.spawning = True
             self.replicas.append(replica)
@@ -502,7 +579,8 @@ class FleetSupervisor:
         self._publish()
         return replica
 
-    def pick_drain_victim(self, shard: Optional[int] = None
+    def pick_drain_victim(self, shard: Optional[int] = None,
+                          model: Optional[str] = None
                           ) -> Optional[Replica]:
         """The replica a scale-down should remove: a dead/not-ready
         slot first (removing one is trivially zero-drop), else the
@@ -511,7 +589,9 @@ class FleetSupervisor:
         it would race the spawn and orphan the freshly-forked child.
         ``shard`` scopes the choice to one shard's pool; "last in
         rotation" then means the last UP replica of THAT shard —
-        draining it would un-serve the shard's rows."""
+        draining it would un-serve the shard's rows.  ``model`` scopes
+        it to one catalog model's pool with the same last-UP guard: a
+        scale-down must never un-serve a whole model."""
         with self._lock:
             candidates = [
                 r for r in self.replicas
@@ -519,6 +599,7 @@ class FleetSupervisor:
                     ReplicaState.FAILED, ReplicaState.DRAINING
                 ) and not r.spawning
                 and (shard is None or r.shard == shard)
+                and (model is None or r.model == model)
             ]
             not_up = [
                 r for r in candidates if r.state != ReplicaState.UP
@@ -848,6 +929,48 @@ class _ProxyAdapter:
                 ).encode("utf-8"),
             ))
             return
+        # catalog routing: /v1/<model>/* goes to the NAMED model's pool
+        # (the prefixed target forwards verbatim — a replica accepts its
+        # own name as an alias), unprefixed /v1/* to the default pool.
+        # Unknown names 404 and over-quota models 429 HERE, before a
+        # replica round trip — and before any metric label is minted
+        # from the raw name (model= stays bounded by the catalog).
+        name: Optional[str] = None
+        model: Optional[str] = None
+        canonical = route
+        if proxy.catalog is not None:
+            name, canonical = split_model_route(route)
+            if name is not None and name not in proxy.model_clients:
+                proxy.metrics.counter("fleet_http_404_total").inc()
+                peer.respond(Response(
+                    404,
+                    json.dumps(
+                        {"error": f"unknown model {name!r}"}
+                    ).encode("utf-8"),
+                ))
+                return
+            model = name if name is not None else proxy.catalog.default
+            if (
+                proxy.model_admission is not None
+                and not proxy.model_admission.admit(model)
+            ):
+                proxy.metrics.counter(
+                    "fleet_model_rejected_total",
+                    labels={
+                        "model": model_label(model, proxy.model_clients)
+                    },
+                ).inc()
+                proxy.metrics.counter("fleet_http_429_total").inc()
+                peer.respond(Response(
+                    429,
+                    json.dumps({
+                        "error": (
+                            f"model {model!r} over its request "
+                            "budget; retry later"
+                        )
+                    }).encode("utf-8"),
+                ))
+                return
         body: Optional[dict] = None
         if req.method == "POST":
             body, err = parse_json_body(req)
@@ -857,7 +980,12 @@ class _ProxyAdapter:
         if proxy.shard_group is not None:
             self._scatter_dispatch(req, peer, route, body)
             return
-        self._forward(req, peer, route, body)
+        self._forward(
+            req, peer, canonical, body,
+            client=proxy.model_clients.get(model) if model else None,
+            model=model,
+            shadow_ok=name is None,
+        )
 
     # -- sharded mode: scatter-gather instead of round-robin ---------------
 
@@ -947,8 +1075,13 @@ class _ProxyAdapter:
         peer.respond(Response(status, payload))
 
     def _forward(self, req: HTTPRequest, peer: ConnHandle, route: str,
-                 body: Optional[dict]) -> None:
+                 body: Optional[dict],
+                 client: Optional[ResilientClient] = None,
+                 model: Optional[str] = None,
+                 shadow_ok: bool = True) -> None:
         proxy = self.proxy
+        if client is None:
+            client = proxy.client
         # the proxy is the fleet's trace ingress: honor a propagated
         # context (child it), else maybe start a root; the resilient
         # client below picks the installed context up as its base, so
@@ -969,7 +1102,7 @@ class _ProxyAdapter:
         t0 = time.monotonic()
         with tracecontext.use(ctx):
             with ambient_span("proxy_request", route=route) as span:
-                resp = proxy.client.request(
+                resp = client.request(
                     req.target, body=body, method=req.method,
                     timeout_s=(
                         float(body["timeout_ms"]) / 1000.0
@@ -1002,10 +1135,11 @@ class _ProxyAdapter:
         # the availability view and the flight ring
         dur = time.monotonic() - t0
         proxy.account(route, status, dur,
-                      ctx.trace_id if ctx is not None else None)
+                      ctx.trace_id if ctx is not None else None,
+                      model=model)
         if (
             proxy.shadow is not None and route == "/v1/similar"
-            and 200 <= status < 300
+            and shadow_ok and 200 <= status < 300
         ):
             # shadow-traffic canary (loop/shadow.py): maybe duplicate
             # this request to the candidate replica — fire-and-forget,
@@ -1040,9 +1174,20 @@ class FleetProxy:
         shard_group=None,
         shadow=None,
         jobs=None,
+        catalog=None,
+        model_admission=None,
     ):
         self.supervisor = supervisor
         self.metrics = metrics
+        #: serve/catalog.py CatalogSpec — set when the fleet serves a
+        #: multi-model catalog (cli.fleet --catalog): slots partition
+        #: into per-model pools, ``/v1/<model>/*`` routes to the named
+        #: pool, unprefixed ``/v1/*`` keeps serving the default model
+        self.catalog = catalog
+        #: serve/catalog.py ModelAdmission — the front door's per-model
+        #: token buckets; crossed with the replicas' per-tenant buckets
+        #: (a request must clear both gates)
+        self.model_admission = model_admission
         #: gene2vec_tpu/batch/jobs.py JobManager — set when the fleet
         #: runs with a job store (cli.fleet --jobs-dir); owns the
         #: /v1/jobs lifecycle surface, handled at the front door and
@@ -1067,16 +1212,35 @@ class FleetProxy:
         # draining replica is terminated only once its count here
         # settles to zero (serve/autoscale.py, FleetProxy.drain)
         self.inflight = InFlightTracker()
-        self.client = ResilientClient(
-            supervisor.healthy_urls,
-            policy=policy if policy is not None else RetryPolicy(
-                max_attempts=3,
-                connect_timeout_s=1.0,
-                default_timeout_s=5.0,
-            ),
-            metrics=metrics,
-            inflight=self.inflight,
+        _policy = policy if policy is not None else RetryPolicy(
+            max_attempts=3,
+            connect_timeout_s=1.0,
+            default_timeout_s=5.0,
         )
+        if catalog is not None:
+            # per-model pools: one resilient client per catalog model,
+            # all sharing ONE in-flight tracker (the drain contract is
+            # per-URL, not per-pool).  Unprefixed /v1/* routes over the
+            # DEFAULT model's pool — a dim512 replica answering an
+            # unprefixed request would silently serve the wrong model.
+            self.model_clients: Dict[str, ResilientClient] = {
+                name: ResilientClient(
+                    (lambda n=name: supervisor.model_urls(n)),
+                    policy=_policy,
+                    metrics=metrics,
+                    inflight=self.inflight,
+                )
+                for name in catalog.names
+            }
+            self.client = self.model_clients[catalog.default]
+        else:
+            self.model_clients = {}
+            self.client = ResilientClient(
+                supervisor.healthy_urls,
+                policy=_policy,
+                metrics=metrics,
+                inflight=self.inflight,
+            )
         self.sampler = Sampler(trace_sample) if trace_sample > 0 else None
         # the telemetry plane: scrape every LIVE replica (not just the
         # rotation) + this registry's own availability counters
@@ -1122,10 +1286,15 @@ class FleetProxy:
         self._thread: Optional[threading.Thread] = None
 
     def account(self, route: str, status: int, dur_s: float,
-                trace_id: Optional[str]) -> None:
+                trace_id: Optional[str],
+                model: Optional[str] = None) -> None:
         """Per-forwarded-response bookkeeping: the availability
         counters the aggregator reads, the per-route latency series,
-        and the proxy's flight-recorder ring."""
+        and the proxy's flight-recorder ring.  ``route`` is always the
+        CANONICAL route (a model prefix is normalized away before
+        accounting); in catalog mode the model rides along as its own
+        bounded ``model=`` label instead — a single-model fleet's label
+        sets stay byte-identical."""
         self.metrics.counter("fleet_proxy_responses_total").inc()
         if 200 <= status < 300:
             self.metrics.counter("fleet_proxy_ok_total").inc()
@@ -1138,8 +1307,14 @@ class FleetProxy:
             self.metrics.counter("fleet_proxy_429_total").inc()
         label = collapse_jobs_route(route)
         label = label if label in _PROXY_ROUTES else "other"
+        labels = {"route": label}
+        if self.catalog is not None:
+            labels["model"] = model_label(
+                model if model is not None else self.catalog.default,
+                self.model_clients,
+            )
         self.metrics.histogram(
-            "fleet_proxy_seconds", labels={"route": label}
+            "fleet_proxy_seconds", labels=labels
         ).observe(dur_s)
         burst = self.flight.record(route, status, dur_s, trace_id=trace_id)
         if burst and self.flight_dir:
@@ -1156,6 +1331,32 @@ class FleetProxy:
             "replicas_up": len(up),
             "replicas": states,
         }
+        if self.catalog is not None:
+            # the per-model grid: pool membership + UP count per
+            # catalog model, so loadgen and the chaos drill learn the
+            # whole (model, replica) layout from one probe.  A fleet
+            # with SOME empty pool is "degraded", not down — the
+            # default model's surface may still be fully up.
+            counts = self.supervisor.model_up_counts()
+            doc["default_model"] = self.catalog.default
+            doc["models"] = {
+                name: {
+                    "up": counts.get(name, 0),
+                    "replicas": [
+                        {
+                            "index": s["index"],
+                            "up": s["state"] == ReplicaState.UP,
+                            "pid": s["pid"],
+                        }
+                        for s in states if s.get("model") == name
+                    ],
+                }
+                for name in self.catalog.names
+            }
+            if up and any(
+                counts.get(n, 0) == 0 for n in self.catalog.names
+            ):
+                doc["status"] = "degraded"
         if self.shard_group is not None:
             # per-shard state: row range, replica-GROUP membership, and
             # the epoch each cell was last seen serving — the operator's

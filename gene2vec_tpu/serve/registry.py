@@ -210,6 +210,23 @@ class ModelRegistry:
     meanwhile ``refresh`` falls back to the next-newest verified
     candidate, and the served model — immutable, already resident —
     stays up regardless.
+
+    ``name`` is the catalog model name this registry serves.  With
+    multiple registries over sibling export dirs (serve/catalog.py) the
+    name disambiguates what path-keyed state alone cannot: failure and
+    quarantine metrics gain a ``{model=}`` series and every load/
+    quarantine trace event carries ``model=`` context.  The default
+    name keeps the historical unlabeled series as the only ones, so a
+    single-model deployment's scrape is byte-identical to before.
+
+    ``partition_rules`` (an ordered ``(regex, PartitionSpec)`` list —
+    see :mod:`gene2vec_tpu.parallel.partition_rules`) makes placement
+    declarative: the registry matches its table name
+    (``"<name>/embedding/unit"``) against the rules, derives the
+    ``NamedSharding`` under ``mesh``, and places loaded tables through
+    one jit-compiled shard closure per registry (the pjit idiom) —
+    replacing the imperative ``sharding=`` wiring, which remains as the
+    explicit-override escape hatch.
     """
 
     def __init__(
@@ -224,6 +241,9 @@ class ModelRegistry:
         ann_clusters: Optional[int] = None,
         ann_seed: int = 0,
         shard: Optional[Tuple[int, int]] = None,
+        name: str = "default",
+        partition_rules=None,
+        mesh=None,
     ):
         from gene2vec_tpu.serve.ann import INDEX_MODES
 
@@ -242,7 +262,35 @@ class ModelRegistry:
             shard = (idx, n)
         self.export_dir = export_dir
         self.dim = dim
+        self.name = str(name)
+        #: extra per-model label set for failure/quarantine series —
+        #: None under the default name so a single-model deployment's
+        #: metric names/labels are unchanged
+        self._mlabels = (
+            {"model": self.name} if self.name != "default" else None
+        )
+        self.partition_rules = partition_rules
+        if partition_rules is not None and sharding is None:
+            # declarative placement: the rules list decides how this
+            # registry's table lands on the mesh (replicated unless a
+            # rule row-shards it)
+            from jax.sharding import NamedSharding
+
+            from gene2vec_tpu.parallel.mesh import single_device_mesh
+            from gene2vec_tpu.parallel.partition_rules import spec_for_name
+
+            mesh = single_device_mesh() if mesh is None else mesh
+            sharding = NamedSharding(
+                mesh,
+                spec_for_name(
+                    partition_rules, f"{self.name}/embedding/unit"
+                ),
+            )
         self.sharding = sharding
+        # jit-compiled shard/gather closures, built lazily on first
+        # load (one per registry == one compiled transfer per model)
+        self._shard_fn = None
+        self._gather_fn = None
         self.metrics = metrics
         self.retry_backoff_s = retry_backoff_s
         self.quarantine_after = quarantine_after
@@ -410,7 +458,23 @@ class ModelRegistry:
                 # device transfer under _refresh_lock is the load path's
                 # contract: serve reads use the published _model
                 # reference and never contend on this lock
-                unit = jax.device_put(jnp.asarray(unit_np), self.sharding)  # graftcheck: disable=blocking-while-locked
+                if self.partition_rules is not None:
+                    # declarative path: one jit-compiled shard closure
+                    # per registry (pjit out_shardings), reused across
+                    # swaps of the same geometry
+                    if self._shard_fn is None:
+                        from gene2vec_tpu.parallel.partition_rules import (
+                            make_shard_and_gather_fns,
+                        )
+
+                        self._shard_fn, self._gather_fn = (
+                            make_shard_and_gather_fns(
+                                self.sharding.spec, self.sharding.mesh
+                            )
+                        )
+                    unit = self._shard_fn(unit_np)  # graftcheck: disable=blocking-while-locked
+                else:
+                    unit = jax.device_put(jnp.asarray(unit_np), self.sharding)  # graftcheck: disable=blocking-while-locked
             else:
                 unit = jnp.asarray(unit_np)  # graftcheck: disable=blocking-while-locked
             unit.block_until_ready()  # graftcheck: disable=blocking-while-locked
@@ -436,6 +500,20 @@ class ModelRegistry:
 
         return stat_sig(path)
 
+    def _count_labeled(self, metric: str) -> None:
+        """Increment the unlabeled series (the historical contract every
+        single-model consumer reads) and, under a non-default name, the
+        per-model ``{model=}`` twin — so sibling registries stay
+        distinguishable without breaking anyone's existing scrape."""
+        self.metrics.counter(metric).inc()
+        if self._mlabels is not None:
+            self.metrics.counter(metric, labels=self._mlabels).inc()
+
+    def _gauge_labeled(self, metric: str, value: float) -> None:
+        self.metrics.gauge(metric).set(value)
+        if self._mlabels is not None:
+            self.metrics.gauge(metric, labels=self._mlabels).set(value)
+
     def _record_failure(self, path: str, err: BaseException) -> None:
         n = self._failures.get(path, (0, None))[0] + 1
         self._failures[path] = (n, self._stat_sig(path))
@@ -446,26 +524,32 @@ class ModelRegistry:
             self.retry_backoff_s * (2 ** (n - 1)), 300.0
         )
         if self.metrics is not None:
-            self.metrics.counter("model_load_failures_total").inc()
+            self._count_labeled("model_load_failures_total")
         _trace_event(
-            "model_load_error", path=path, attempt=n, error=repr(err)[:200]
+            "model_load_error", model=self.name, path=path, attempt=n,
+            error=repr(err)[:200],
         )
         if n >= self.quarantine_after and path not in self._quarantined:
             self._quarantined[path] = (repr(err)[:200], self._stat_sig(path))
-            _trace_event("model_quarantined", path=path, error=repr(err)[:200])
+            _trace_event(
+                "model_quarantined", model=self.name, path=path,
+                error=repr(err)[:200],
+            )
             if self.metrics is not None:
-                self.metrics.gauge("model_quarantined").set(
-                    len(self._quarantined)
+                self._gauge_labeled(
+                    "model_quarantined", len(self._quarantined)
                 )
 
     def _clear_failure_state(self, path: str) -> None:
         self._failures.pop(path, None)
         self._next_retry.pop(path, None)
         if self._quarantined.pop(path, None) is not None:
-            _trace_event("model_quarantine_cleared", path=path)
+            _trace_event(
+                "model_quarantine_cleared", model=self.name, path=path
+            )
             if self.metrics is not None:
-                self.metrics.gauge("model_quarantined").set(
-                    len(self._quarantined)
+                self._gauge_labeled(
+                    "model_quarantined", len(self._quarantined)
                 )
 
     def _skip_for_failures(self, path: str, now: float) -> bool:
@@ -530,9 +614,9 @@ class ModelRegistry:
             # the old immutable model, new readers see the new one
             self._model = model
         if self.metrics is not None:
-            self.metrics.counter("model_swaps_total").inc()
-            self.metrics.gauge("model_iteration").set(model.iteration)
-            self.metrics.gauge("model_vocab_size").set(len(model))
+            self._count_labeled("model_swaps_total")
+            self._gauge_labeled("model_iteration", model.iteration)
+            self._gauge_labeled("model_vocab_size", len(model))
         return True
 
     # -- shard-atomic staged swap (serve/shardgroup.py SwapCoordinator) ----
@@ -589,10 +673,10 @@ class ModelRegistry:
             self._model = model
             self._staged = None
         if self.metrics is not None:
-            self.metrics.counter("model_swaps_total").inc()
-            self.metrics.gauge("model_iteration").set(model.iteration)
-            self.metrics.gauge("model_epoch").set(epoch)
-            self.metrics.gauge("model_vocab_size").set(len(model))
+            self._count_labeled("model_swaps_total")
+            self._gauge_labeled("model_iteration", model.iteration)
+            self._gauge_labeled("model_epoch", epoch)
+            self._gauge_labeled("model_vocab_size", len(model))
         return model
 
     # -- watching ----------------------------------------------------------

@@ -76,6 +76,7 @@ from gene2vec_tpu.serve.routes import (
     SHARD_ROUTES,
     V1_ROUTES,
     collapse_jobs_route,
+    split_model_route,
 )
 from gene2vec_tpu.serve.batcher import (
     DeadlineExceeded,
@@ -223,9 +224,25 @@ class ServeApp:
         ggipnn_checkpoint: Optional[str] = None,
         mesh=None,
         fault_injector=None,
+        model_name: str = "default",
     ):
         self.registry = registry
-        self.config = config
+        self.config = config if config is not None else ServeConfig()
+        config = self.config
+        #: this app's catalog name.  "default" (single-model serving)
+        #: keeps every metric series label-free and every response
+        #: shape byte-identical to the pre-catalog stack; a named app
+        #: (serve/catalog.py) labels its route/batcher series with
+        #: ``{model=}`` and stamps the name into response model docs.
+        self.model_name = str(model_name)
+        self._mlabels = (
+            {"model": self.model_name}
+            if self.model_name != "default" else None
+        )
+        #: name -> sibling ServeApp table, set by ModelCatalog so
+        #: ``/v1/<name>/*`` delegates across models; None outside a
+        #: catalog (model-prefixed paths then 404)
+        self.catalog_apps: Optional[Dict[str, "ServeApp"]] = None
         # resilience/faults.py FaultInjector — None means no fault code
         # runs at all (the production default)
         self.faults = fault_injector
@@ -236,11 +253,12 @@ class ServeApp:
             registry.metrics = self.metrics
         if registry.loaded:
             # the registry publishes these on swap; backfill for a model
-            # loaded before the metrics registry was attached
-            self.metrics.gauge("model_iteration").set(
-                registry.model.iteration
+            # loaded before the metrics registry was attached (labeled
+            # twin included under a non-default registry name)
+            registry._gauge_labeled(
+                "model_iteration", registry.model.iteration
             )
-            self.metrics.gauge("model_vocab_size").set(len(registry.model))
+            registry._gauge_labeled("model_vocab_size", len(registry.model))
         # mesh set => the two-stage distributed top-k over the
         # registry's row-sharded matrix (engine._make_topk_sharded)
         self.engine = SimilarityEngine(
@@ -268,6 +286,7 @@ class ServeApp:
             default_timeout_s=config.timeout_ms / 1000.0,
             metrics=self.metrics,
             tenant_weights=self._tenant_weight,
+            labels=self._mlabels,
         )
         self.ggipnn_checkpoint = ggipnn_checkpoint
         self._scorer: Optional[InteractionScorer] = None
@@ -330,6 +349,27 @@ class ServeApp:
                     duty=config.batch_duty,
                 ),
             )
+
+    def _route_labels(self, route: str) -> Dict[str, str]:
+        """The bounded label set for per-route latency series: the
+        canonical route (model prefixes already stripped by dispatch)
+        plus — only under a catalog name — ``model=``.  Single-model
+        deployments keep the exact historical label sets, so the fleet
+        aggregator's route-p99 snapshot keys (and the default alert
+        rules watching them) are unchanged."""
+        labels = {"route": _route_label(route)}
+        if self._mlabels is not None:
+            labels["model"] = self.model_name
+        return labels
+
+    def _model_doc(self, model) -> dict:
+        """The response's ``model`` object; carries the catalog name so
+        a client (and the chaos drill's cross-model checker) can verify
+        WHICH model answered."""
+        doc = {"dim": model.dim, "iteration": model.iteration}
+        if self.model_name != "default":
+            doc["name"] = self.model_name
+        return doc
 
     def _tenant_weight(self, tenant: str) -> float:
         """The batcher's weighted-fair drain share: the reserved batch
@@ -485,6 +525,15 @@ class ServeApp:
         except RejectedError as e:
             raise ApiError(429, str(e)) from e
         results = []
+        # the iteration that ACTUALLY answered: the batcher resolves
+        # items against its own model snapshot at compute time, so a
+        # hot swap between admission and compute would otherwise stamp
+        # this response with an iteration its neighbors did not come
+        # from — the mixed-iteration answer every chaos drill gates at
+        # zero.  One request's queries landing in batches on opposite
+        # sides of a swap is refused as a retryable 503 (the front
+        # door's client retries it off the caller's path).
+        served_iteration: Optional[int] = None
         for q, ticket in tickets:
             try:
                 r = ticket.get()
@@ -492,13 +541,26 @@ class ServeApp:
                 raise ApiError(504, str(e)) from e
             if "error" in r:
                 raise ApiError(400, r["error"])
+            it = r.get("iteration")
+            if served_iteration is None:
+                served_iteration = it
+            elif it is not None and it != served_iteration:
+                raise ApiError(
+                    503,
+                    f"hot swap landed mid-request (iterations "
+                    f"{served_iteration} and {it} in one response); "
+                    "retry",
+                )
             results.append(
                 {"query": q.get("gene"), "neighbors": r["neighbors"]}
             )
-        return {
-            "model": {"dim": model.dim, "iteration": model.iteration},
+        doc = {
+            "model": self._model_doc(model),
             "results": results,
         }
+        if served_iteration is not None:
+            doc["model"]["iteration"] = served_iteration
+        return doc
 
     def embedding(self, body: dict) -> dict:
         model = self._model_or_503()
@@ -524,7 +586,7 @@ class ServeApp:
                 {"gene": g, "vector": [float(v) for v in model.emb[row]]}
             )
         return {
-            "model": {"dim": model.dim, "iteration": model.iteration},
+            "model": self._model_doc(model),
             "embeddings": rows,
         }
 
@@ -568,7 +630,7 @@ class ServeApp:
             len(pairs)
         )
         return {
-            "model": {"dim": model.dim, "iteration": model.iteration},
+            "model": self._model_doc(model),
             "trained_head": scorer.trained,
             "scores": [
                 {"pair": p, "score": round(s, 6)}
@@ -797,7 +859,8 @@ class ServeApp:
         for mode, size in self.engine.cache_sizes().items():
             if size is not None:
                 self.metrics.gauge(
-                    "engine_jit_cache_entries", labels={"mode": mode}
+                    "engine_jit_cache_entries",
+                    labels={"mode": mode, **(self._mlabels or {})},
                 ).set(size)
         # per-bucket kernel attribution (profile_kernels), as the same
         # kernel_* gauge family run snapshots use — bounded: buckets x
@@ -840,8 +903,9 @@ class ServeApp:
         # promotion) must fire, not linger (docs/CONTINUOUS.md)
         if self.registry.loaded:
             model = self.registry.model
-            self.metrics.gauge("model_age_seconds").set(
-                max(0.0, time.time() - model.created_unix)
+            self.registry._gauge_labeled(
+                "model_age_seconds",
+                max(0.0, time.time() - model.created_unix),
             )
 
     def livez(self) -> dict:
@@ -879,6 +943,10 @@ class ServeApp:
             "vocab_size": len(m),
             "source": m.source,
         }
+        if self.model_name != "default":
+            out["model"]["name"] = self.model_name
+        if self.catalog_apps is not None:
+            out["catalog"] = sorted(self.catalog_apps)
         out["index"] = self.engine.index_mode
         if self.registry.shard is not None:
             out["shard"] = self._shard_facts(m)
@@ -963,6 +1031,28 @@ class ServeApp:
         lane."""
         url = urlparse(path)
         route = url.path.rstrip("/") or "/"
+        # -- multi-model catalog dispatch (serve/catalog.py) -----------
+        # /v1/<name>/similar etc. resolves against the catalog table:
+        # a sibling app serves it (its OWN registry, engine, cache,
+        # labels), this app's own name is an alias for its unprefixed
+        # routes, and an unknown name 404s BEFORE any label is minted —
+        # model= cardinality is bounded by the catalog, not by traffic.
+        name, canonical = split_model_route(route)
+        if name is not None:
+            target = self if name == self.model_name else (
+                self.catalog_apps.get(name)
+                if self.catalog_apps is not None else None
+            )
+            if target is None:
+                self.metrics.counter("serve_http_404_total").inc()
+                return 404, {"error": f"unknown model {name!r}"}
+            if target is not self:
+                suffix = f"?{url.query}" if url.query else ""
+                return target.handle(
+                    method, canonical + suffix, body,
+                    traceparent=traceparent, tenant=tenant,
+                )
+            route = canonical
         query = parse_qs(url.query)
         tenant = tenant if tenant else DEFAULT_TENANT
         incoming = TraceContext.from_header(traceparent)
@@ -997,7 +1087,7 @@ class ServeApp:
             self.metrics.histogram(
                 "serve_route_seconds",
                 buckets=_ROUTE_BUCKETS,
-                labels={"route": _route_label(route)},
+                labels=self._route_labels(route),
             ).observe(dur)
             burst = self.flight.record(
                 route, status, dur,
@@ -1045,13 +1135,14 @@ class ServeAdapter:
 
     # -- accounting (hot path only; ServeApp.handle does its own) ---------
 
-    def _account(self, route: str, status: int, dur: float) -> None:
-        app = self.app
+    def _account(self, route: str, status: int, dur: float,
+                 app: Optional[ServeApp] = None) -> None:
+        app = self.app if app is None else app
         app.metrics.histogram("serve_handle_seconds").observe(dur)
         app.metrics.histogram(
             "serve_route_seconds",
             buckets=_ROUTE_BUCKETS,
-            labels={"route": _route_label(route)},
+            labels=app._route_labels(route),
         ).observe(dur)
         if status >= 400:
             app.metrics.counter(f"serve_http_{status}_total").inc()
@@ -1101,11 +1192,33 @@ class ServeAdapter:
             and app.faults is None
             and app.sampler is None
             and "traceparent" not in req.headers
-            and req.target.startswith("/v1/similar?")
         ):
-            out = self._similar_get_fast(req, peer, tenant)
-            if out is not _SLOW_PATH:
-                return out
+            # resolve which app's hot path this GET belongs to:
+            # unprefixed -> this (default) app, /v1/<name>/similar? ->
+            # the named sibling — each with its OWN response cache and
+            # coalescing table, so two models can never share bytes
+            fast = None
+            query_str = ""
+            if req.target.startswith("/v1/similar?"):
+                fast = app
+                query_str = req.target[len("/v1/similar?"):]
+            elif req.target.startswith("/v1/"):
+                name, sep, tail = (
+                    req.target[len("/v1/"):].partition("/")
+                )
+                if sep and tail.startswith("similar?"):
+                    fast = (
+                        app.catalog_apps.get(name)
+                        if app.catalog_apps is not None
+                        else (app if name == app.model_name else None)
+                    )
+                    query_str = tail[len("similar?"):]
+            if fast is not None:
+                out = self._similar_get_fast(
+                    fast, query_str, peer, tenant
+                )
+                if out is not _SLOW_PATH:
+                    return out
         if not self.pool.submit(
             lambda: self._run_full(req, peer, tenant)
         ):
@@ -1123,6 +1236,12 @@ class ServeAdapter:
             return
         if req.method == "GET" and route == "/metrics":
             app.publish_engine_metrics()
+            if app.catalog_apps is not None:
+                # one scrape refreshes EVERY cataloged model's engine
+                # and freshness gauges (shared metrics registry)
+                for sibling in app.catalog_apps.values():
+                    if sibling is not app:
+                        sibling.publish_engine_metrics()
             peer.respond(Response(
                 200,
                 app.metrics.prometheus_text().encode("utf-8"),
@@ -1193,19 +1312,21 @@ class ServeAdapter:
 
     # -- the hot read path (loop thread; must never block) -----------------
 
-    def _similar_get_fast(self, req: HTTPRequest, peer: ConnHandle,
+    def _similar_get_fast(self, app: ServeApp, query_str: str,
+                          peer: ConnHandle,
                           tenant: str = DEFAULT_TENANT):
         """``GET /v1/similar?gene=...&k=...`` without the full pipeline:
         response-bytes cache hit -> reused bytes; miss -> coalesce onto
-        one batcher ticket.  Returns ``_SLOW_PATH`` for anything the
-        fast path cannot answer with identical semantics (unknown
-        params, bad k, unknown gene, no model) so the full pipeline
-        produces its exact error shapes."""
-        app = self.app
+        one batcher ticket.  ``app`` is the resolved target (the
+        default app, or a catalog sibling for a model-prefixed GET) —
+        its cache, coalescing table, batcher, and labels.  Returns
+        ``_SLOW_PATH`` for anything the fast path cannot answer with
+        identical semantics (unknown params, bad k, unknown gene, no
+        model) so the full pipeline produces its exact error shapes."""
         gene: Optional[str] = None
         k = 10
         try:
-            for part in req.target[len("/v1/similar?"):].split("&"):
+            for part in query_str.split("&"):
                 name, sep, value = part.partition("=")
                 if not sep:
                     return _SLOW_PATH
@@ -1231,7 +1352,9 @@ class ServeAdapter:
         body = app.response_cache.get(key)
         if body is not None:
             app.metrics.counter("serve_response_cache_hits_total").inc()
-            self._account("/v1/similar", 200, time.monotonic() - t0)
+            self._account(
+                "/v1/similar", 200, time.monotonic() - t0, app=app
+            )
             return Response(200, body)
         if gene not in model.index:
             return _SLOW_PATH  # 400 with the canonical unknown-gene text
@@ -1257,14 +1380,14 @@ class ServeAdapter:
             if in_submit[0]:
                 if not self.pool.submit(
                     lambda: self._finish_similar_get(
-                        key, model, gene, result, error
+                        app, key, model, gene, result, error
                     )
                 ):
                     self._fail_coalesced(
-                        key, 429, _POOL_FULL_BODY
+                        app, key, 429, _POOL_FULL_BODY
                     )
                 return
-            self._finish_similar_get(key, model, gene, result, error)
+            self._finish_similar_get(app, key, model, gene, result, error)
 
         try:
             app.batcher.submit_async(
@@ -1277,25 +1400,25 @@ class ServeAdapter:
             # queue full (or batcher not started): fail everyone waiting
             # on this key with explicit backpressure (_account owns the
             # 429 counter — one increment per rejected request)
-            self._fail_coalesced(key, 429, self._queue_full_body)
+            self._fail_coalesced(app, key, 429, self._queue_full_body)
         in_submit[0] = False
         return None
 
-    def _fail_coalesced(self, key, status: int, body: bytes) -> None:
-        """Fail every waiter coalesced on ``key`` with one pre-encoded
-        error body (thread-safe)."""
-        with self.app._coalesce_lock:
-            waiters = self.app._coalesce.pop(key, [])
+    def _fail_coalesced(self, app: ServeApp, key, status: int,
+                        body: bytes) -> None:
+        """Fail every waiter coalesced on ``key`` (in ``app``'s table)
+        with one pre-encoded error body (thread-safe)."""
+        with app._coalesce_lock:
+            waiters = app._coalesce.pop(key, [])
         now = time.monotonic()
         for w_peer, _dl, w_t0 in waiters:
             w_peer.respond(Response(status, body))
-            self._account("/v1/similar", status, now - w_t0)
+            self._account("/v1/similar", status, now - w_t0, app=app)
 
-    def _finish_similar_get(self, key, model, gene: str,
+    def _finish_similar_get(self, app: ServeApp, key, model, gene: str,
                             result, error) -> None:
         """Batcher completion (worker thread): build + cache the
         response bytes ONCE, then fan out to every coalesced waiter."""
-        app = self.app
         with app._coalesce_lock:
             waiters = app._coalesce.pop(key, [])
         now = time.monotonic()
@@ -1315,13 +1438,18 @@ class ServeAdapter:
             ).encode("utf-8")
         else:
             doc = {
-                "model": {
-                    "dim": model.dim, "iteration": model.iteration,
-                },
+                "model": app._model_doc(model),
                 "results": [
                     {"query": gene, "neighbors": result["neighbors"]}
                 ],
             }
+            # stamp the iteration the batcher ACTUALLY computed
+            # against: a hot swap between admission and compute must
+            # not label new neighbors with the old iteration (or vice
+            # versa) — that is the mixed-iteration answer the chaos
+            # drills gate at zero
+            if result.get("iteration") is not None:
+                doc["model"]["iteration"] = result["iteration"]
             body = json.dumps(doc).encode("utf-8")
             app.response_cache.put(key, body)
         for peer, w_deadline, w_t0 in waiters:
@@ -1330,10 +1458,10 @@ class ServeAdapter:
                 # batcher contract says it gets a 504, not a late answer
                 app.metrics.counter("serve_deadline_expired_total").inc()
                 peer.respond(Response(504, _DEADLINE_BODY))
-                self._account("/v1/similar", 504, now - w_t0)
+                self._account("/v1/similar", 504, now - w_t0, app=app)
             else:
                 peer.respond(Response(status, body))
-                self._account("/v1/similar", status, now - w_t0)
+                self._account("/v1/similar", status, now - w_t0, app=app)
 
 
 #: sentinel: the fast path punts this request to the full pipeline

@@ -58,6 +58,14 @@ the front door's half of the story:
 
 Everything here runs in the fleet front-door process (``cli.fleet``)
 and is stdlib+numpy only; the heavy tables live in the shard replicas.
+
+Row sharding and the multi-model catalog (``serve/catalog.py``) are
+DIFFERENT fleet partitions and deliberately exclusive: shards split one
+model's table by row range, a catalog splits replicas by model — both
+CLIs reject the combination rather than route a (model, shard) grid
+nothing merges yet.  The autoscaler already speaks both axes
+(``serve/autoscale.py`` keys pools by ``(model, shard)``), so lifting
+the restriction is a routing problem, not a scaling one.
 """
 
 from __future__ import annotations

@@ -27,6 +27,15 @@ docs/SERVING.md#multi-tenant-admission covers sizing.  Everything here
 is stdlib, lock-per-object, and clock-injectable for tests; with no
 :class:`TenantPolicy` configured the serve path never touches any of
 it.
+
+On a multi-model fleet the tenant axis crosses with a MODEL axis:
+``serve/catalog.py:ModelAdmission`` runs per-model :class:`RateBucket`
+instances at the front door (bounded by the catalog table the way the
+tenant table is bounded by ``max_tenants``), so a request must clear
+both gates — its tenant's budget on the replica AND its model's budget
+at the door.  A catalog replica shares ONE :class:`TenantAdmission`
+across all of its per-model apps: a tenant's quota is a property of
+the caller, not of which model they happen to query.
 """
 
 from __future__ import annotations

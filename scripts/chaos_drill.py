@@ -3632,8 +3632,362 @@ def drill_batch(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
     return result
 
 
+# -- phase: multi-model catalog isolation -----------------------------------
+
+
+def drill_catalog(tmp: str, smoke: bool, budget: dict, seed: int) -> dict:
+    """The multi-model serving plane's isolation drill: a two-model
+    catalog fleet (``cli.fleet --catalog``) under continuous verified
+    load must survive (A) a hot swap of the DEFAULT model — only its
+    pool flips iteration, the sibling's answers never move — and (B) a
+    load ramp on the second model that scales ONLY that model's pool,
+    while verified light load on the cold default model stays clean.
+    Every verified answer is checked for WHICH model answered
+    (``model.name`` + ``model.dim``): the gate is zero wrong, zero
+    mixed-iteration, zero cross-model answers, availability >= the
+    budget floor."""
+    import threading
+
+    from gene2vec_tpu.serve.fleet import read_contract_line
+
+    vocab = int(budget.get("vocab", 48))
+    dim_a = int(budget.get("dim_default", 8))
+    dim_b = int(budget.get("dim_second", 16))
+    k = int(budget.get("k", 4))
+    max_replicas = int(budget.get("max_replicas", 2))
+    scrape_s = float(budget.get("scrape_interval_s", 0.25))
+    max_ticks = float(budget.get("max_scale_up_detection_ticks", 40))
+    swap_window_s = 6.0 if smoke else 10.0
+    ramp_workers = 48
+
+    name_a = f"dim{dim_a}"   # the default model (gets the hot swap)
+    name_b = f"dim{dim_b}"   # the second model (gets the load ramp)
+    export_a = os.path.join(tmp, "catalog_export_a")
+    export_b = os.path.join(tmp, "catalog_export_b")
+    _write_iteration(export_a, 1, vocab_size=vocab, dim=dim_a)
+    _write_iteration(export_b, 1, vocab_size=vocab, dim=dim_b)
+    spec_path = os.path.join(tmp, "catalog_spec.json")
+    with open(spec_path, "w") as f:
+        json.dump({
+            "schema": "gene2vec-tpu/catalog/v1",
+            "default": name_a,
+            "models": {
+                name_a: {"export_dir": export_a, "dim": dim_a,
+                         "replicas": 1},
+                name_b: {"export_dir": export_b, "dim": dim_b,
+                         "replicas": 1},
+            },
+        }, f)
+
+    argv = [
+        sys.executable, "-m", "gene2vec_tpu.cli.fleet",
+        "--export-dir", export_a, "--catalog", spec_path,
+        "--min-replicas", "1", "--max-replicas", str(max_replicas),
+        "--port", "0", "--health-interval", "0.25",
+        "--backoff-base", "0.3", "--proxy-timeout-ms", "4000",
+        "--proxy-workers", "64",
+        "--scrape-interval", str(scrape_s),
+        "--alert-rules", "none",
+        "--seed", str(seed),
+        # scaler drill knobs (the drill_autoscale geometry: breach in 2
+        # ticks, slow clear, short cooldown)
+        "--scale-up-queue", "4", "--scale-up-rejection", "0.02",
+        "--scale-up-after", "2", "--scale-down-after", "60",
+        "--scale-down-queue", "3", "--scale-cooldown", "1.0",
+        "--drain-timeout", "15",
+        # replica geometry: saturable by a CPU drill (no LRU, tiny
+        # batch, small bounded queue) + a fast swap watcher poll so
+        # the hot-swap window fits the smoke budget
+        "--serve-arg=--cache-size", "--serve-arg=0",
+        "--serve-arg=--max-delay-ms", "--serve-arg=100",
+        "--serve-arg=--max-batch", "--serve-arg=4",
+        "--serve-arg=--max-queue", "--serve-arg=8",
+        "--serve-arg=--http-workers", "--serve-arg=32",
+        "--serve-arg=--poll-interval", "--serve-arg=0.3",
+    ]
+    log(f"spawning catalog fleet: {name_a} (default) + {name_b}, "
+        f"1 -> {max_replicas} replicas per pool")
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, text=True, env=chaos.child_env(),
+        cwd=REPO,
+    )
+    result: dict = {
+        "recipe": {
+            "models": 2, "replicas_per_model": 1,
+            "max_replicas": max_replicas, "vocab": vocab,
+            "dim_default": dim_a, "dim_second": dim_b, "k": k,
+        },
+        "models": [name_a, name_b],
+        "default": name_a,
+    }
+    try:
+        info = read_contract_line(proc, 240.0)
+        url = info["url"]
+        contract = info.get("catalog") or {}
+        assert contract.get("default") == name_a, (
+            f"contract line missing catalog facts: {info}"
+        )
+        log(f"catalog fleet front door at {url}; pools "
+            f"{ {m: d['slots'] for m, d in contract['models'].items()} }")
+
+        query_genes = [f"G{i}" for i in range(8)]
+
+        def post(model: str, gene: str, timeout: float = 10.0):
+            """(status, doc-or-None); the default model goes through
+            the UNPREFIXED route — its backward-compat surface is part
+            of what this drill verifies."""
+            path = (
+                "/v1/similar" if model == name_a
+                else f"/v1/{model}/similar"
+            )
+            body = json.dumps({"genes": [gene], "k": k}).encode("utf-8")
+            req = urllib.request.Request(
+                url + path, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, json.loads(r.read().decode("utf-8"))
+            except urllib.error.HTTPError as e:
+                e.read()
+                e.close()
+                return e.code, None
+            except Exception:
+                return 0, None
+
+        def answer_key(doc: dict):
+            m = doc["model"]
+            return (
+                m.get("name"), m.get("dim"), m["iteration"],
+                tuple(n["gene"]
+                      for n in doc["results"][0]["neighbors"]),
+            )
+
+        def reference_for(model: str) -> tuple:
+            status, doc = post(model, "G0", timeout=15.0)
+            assert status == 200, (
+                f"reference query for {model} failed ({status})"
+            )
+            refs = {}
+            for g in query_genes:
+                status, doc = post(model, g, timeout=15.0)
+                assert status == 200, (
+                    f"reference query {model}/{g} failed ({status})"
+                )
+                refs[g] = answer_key(doc)
+            return refs
+
+        ref1 = {name_a: reference_for(name_a),
+                name_b: reference_for(name_b)}
+        for m, dim in ((name_a, dim_a), (name_b, dim_b)):
+            for key in ref1[m].values():
+                assert key[0] == m and key[1] == dim, (
+                    f"reference answer for {m} came from "
+                    f"{key[0]}/dim={key[1]} — catalog routing is broken"
+                )
+
+        # --- (A) hot-swap the DEFAULT model under verified load -------
+        # every in-window answer is logged raw and classified POST-HOC:
+        # iteration 1 answers must match the pre-swap reference,
+        # iteration 2 answers the post-swap one (collected after the
+        # swap settles); anything else is wrong/mixed.  The sibling
+        # model must never leave iteration 1.
+        window_log = {name_a: [], name_b: []}  # (status, key-or-None)
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def verified_worker(model: str, widx: int) -> None:
+            wrng = np.random.RandomState(seed + widx)
+            while not stop.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                status, doc = post(model, g)
+                with lock:
+                    window_log[model].append(
+                        (g, status,
+                         answer_key(doc) if status == 200 else None)
+                    )
+                time.sleep(0.05)
+
+        workers = [
+            threading.Thread(
+                target=verified_worker, args=(m, i), daemon=True,
+            )
+            for i, m in enumerate((name_a, name_a, name_b, name_b))
+        ]
+        for t in workers:
+            t.start()
+        time.sleep(swap_window_s / 3.0)
+        _write_iteration(export_a, 2, vocab_size=vocab, dim=dim_a)
+        t_swap = time.monotonic()
+        log(f"staged iteration 2 for {name_a}; waiting for the swap")
+
+        def swapped():
+            status, doc = post(name_a, "G0")
+            return (
+                status == 200 and doc["model"]["iteration"] == 2
+            ) or None
+
+        wait_until(swapped, 120.0, interval_s=0.25,
+                   what=f"{name_a} iteration 2 via the front door")
+        swap_visible_s = time.monotonic() - t_swap
+        time.sleep(swap_window_s / 3.0)
+        stop.set()
+        for t in workers:
+            t.join(timeout=30.0)
+        ref2_a = reference_for(name_a)
+        assert all(key[2] == 2 for key in ref2_a.values()), (
+            f"{name_a} post-swap reference still serves iteration 1"
+        )
+        result["swap"] = {
+            "model": name_a, "from_iteration": 1, "to_iteration": 2,
+            "visible_s": round(swap_visible_s, 2),
+        }
+
+        # --- (B) ramp the SECOND model; verify the cold default -------
+        stop = threading.Event()
+
+        def ramp_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 100 + widx)
+            while not stop.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                post(name_b, g)
+
+        def cold_worker(widx: int) -> None:
+            wrng = np.random.RandomState(seed + 900 + widx)
+            while not stop.is_set():
+                g = query_genes[int(wrng.randint(len(query_genes)))]
+                status, doc = post(name_a, g)
+                with lock:
+                    window_log[name_a].append(
+                        (g, status,
+                         answer_key(doc) if status == 200 else None)
+                    )
+                time.sleep(0.1)
+
+        t_ramp = time.monotonic()
+        ramp = [
+            threading.Thread(target=ramp_worker, args=(w,), daemon=True)
+            for w in range(ramp_workers)
+        ] + [
+            threading.Thread(target=cold_worker, args=(w,), daemon=True)
+            for w in range(2)
+        ]
+        for t in ramp:
+            t.start()
+
+        def scale_up_decided():
+            m = _fetch_metrics(url)
+            return m.get("fleet_scale_up_total", 0.0) >= 1.0 or None
+
+        wait_until(scale_up_decided, max_ticks * scrape_s + 10.0,
+                   interval_s=0.1, what="per-model scale-up decision")
+        detection_s = time.monotonic() - t_ramp
+        detection_ticks = max(1, int(np.ceil(detection_s / scrape_s)))
+
+        def pool_scaled():
+            h = _http_json(url + "/healthz", timeout=10.0)
+            models = h.get("models") or {}
+            return (
+                models.get(name_b, {}).get("up", 0) >= max_replicas
+            ) or None
+
+        wait_until(pool_scaled, 240.0, interval_s=0.5,
+                   what=f"{name_b} pool at {max_replicas} replicas")
+        scale_up_completed_s = time.monotonic() - t_ramp
+        stop.set()
+        for t in ramp:
+            t.join(timeout=30.0)
+        health = _http_json(url + "/healthz", timeout=10.0)
+        cold_up = health["models"][name_a]["up"]
+        hot_up = health["models"][name_b]["up"]
+        assert cold_up == 1, (
+            f"the ramp on {name_b} grew the COLD {name_a} pool to "
+            f"{cold_up} — pool isolation is broken"
+        )
+        log(f"scale-up: {name_b} pool at {hot_up} "
+            f"({scale_up_completed_s:.1f}s after the ramp), {name_a} "
+            f"pool still {cold_up}")
+        result["scale_up"] = {
+            "model": name_b,
+            "detection_ticks": detection_ticks,
+            "completed_s": round(scale_up_completed_s, 1),
+            "cold_pool_final": cold_up,
+            "hot_pool_final": hot_up,
+        }
+
+        # --- post-hoc classification of every verified answer ---------
+        counts = {"requests": 0, "ok": 0, "dropped": 0, "wrong": 0,
+                  "mixed": 0, "cross_model": 0}
+        bad_sample: list = []  # first few non-ok answers, for forensics
+        expected = {
+            name_a: {"dim": dim_a,
+                     "refs": {1: ref1[name_a], 2: ref2_a}},
+            name_b: {"dim": dim_b, "refs": {1: ref1[name_b]}},
+        }
+        for model, entries in window_log.items():
+            want = expected[model]
+            for g, status, key in entries:
+                counts["requests"] += 1
+                if status != 200 or key is None:
+                    counts["dropped"] += 1
+                    continue
+                name, dim, it, neighbors = key
+                if name != model or dim != want["dim"]:
+                    counts["cross_model"] += 1
+                    kind = "cross_model"
+                elif it not in want["refs"]:
+                    counts["mixed"] += 1
+                    kind = "mixed"
+                elif key != want["refs"][it][g]:
+                    counts["wrong"] += 1
+                    kind = "wrong"
+                else:
+                    counts["ok"] += 1
+                    continue
+                if len(bad_sample) < 6:
+                    bad_sample.append({
+                        "kind": kind, "model": model, "gene": g,
+                        "got": list(key),
+                        "want": list(want["refs"].get(it, {}).get(g, ())),
+                    })
+        availability = counts["ok"] / max(counts["requests"], 1)
+        counts["availability"] = round(availability, 5)
+        result["verified"] = counts
+        if bad_sample:
+            result["bad_sample"] = bad_sample
+            log(f"bad answers (sample): {bad_sample}")
+        log(f"verified {counts['requests']} answers: "
+            f"{counts['ok']} ok, {counts['dropped']} dropped, "
+            f"{counts['wrong']} wrong, {counts['mixed']} mixed, "
+            f"{counts['cross_model']} cross-model "
+            f"(availability {availability:.4f})")
+        assert counts["cross_model"] <= int(
+            budget.get("max_cross_model_answers", 0)
+        ), f"{counts['cross_model']} answers crossed models"
+        assert counts["wrong"] <= int(
+            budget.get("max_wrong_answers", 0)
+        ), f"{counts['wrong']} wrong answers"
+        assert counts["mixed"] <= int(
+            budget.get("max_mixed_answers", 0)
+        ), f"{counts['mixed']} mixed-iteration answers"
+        floor = float(budget.get("min_availability", 0.99))
+        assert availability >= floor, (
+            f"verified availability {availability:.4f} < {floor}"
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    return result
+
+
 PHASES = ("training_resume", "corruption", "serve", "async_overhead",
-          "fleet", "alerts", "autoscale", "shard", "loop", "batch")
+          "fleet", "alerts", "autoscale", "shard", "loop", "batch",
+          "catalog")
 
 
 def main(argv=None) -> int:
@@ -3686,6 +4040,14 @@ def main(argv=None) -> int:
                          "on (run WITHOUT --smoke for the committed "
                          "artifact; a smoke run is off the pinned "
                          "recipe)")
+    ap.add_argument("--catalog-out", default=None, metavar="PATH",
+                    help="also write the catalog phase's results (the "
+                         "two-model isolation drill: hot-swap the "
+                         "default model under verified load on both "
+                         "models, then ramp the second model and prove "
+                         "only its pool scales) as a standalone bench "
+                         "document, e.g. BENCH_CATALOG_r20.json — the "
+                         "record analysis/passes_catalog.py gates on")
     ap.add_argument("--only", default=None,
                     help=f"comma-separated phases from {PHASES}")
     ap.add_argument("--seed", type=int, default=None,
@@ -3718,6 +4080,7 @@ def main(argv=None) -> int:
     shard_budget = budgets["shard"]["scatter"]
     loop_budget = budgets["loop"]["promotion"]
     batch_budget = budgets["batch"]["graph"]
+    catalog_budget = budgets["catalog"]["isolation"]
     iters = 3 if args.smoke else 5
 
     doc = {
@@ -3768,6 +4131,10 @@ def main(argv=None) -> int:
             elif phase == "batch":
                 doc["phases"][phase] = drill_batch(
                     tmp, args.smoke, batch_budget, seed
+                )
+            elif phase == "catalog":
+                doc["phases"][phase] = drill_catalog(
+                    tmp, args.smoke, catalog_budget, seed
                 )
         except Exception as e:
             failed = f"{phase}: {e}"
@@ -3864,6 +4231,22 @@ def main(argv=None) -> int:
         with open(args.batch_out, "w") as f:
             f.write(json.dumps(batch_doc, indent=1) + "\n")
         log(f"wrote {args.batch_out}")
+    if args.catalog_out and "catalog" in doc["phases"]:
+        catalog_doc = {
+            "schema": "gene2vec-tpu/bench-catalog/v1",
+            "schema_version": 1,
+            "command": doc["command"],
+            "bench": "catalog_chaos_drill",
+            "created_unix": doc["created_unix"],
+            "host": doc["host"],
+            "smoke": doc["smoke"],
+            "seed": seed,
+            "passed": "error" not in doc["phases"]["catalog"],
+            "catalog": doc["phases"]["catalog"],
+        }
+        with open(args.catalog_out, "w") as f:
+            f.write(json.dumps(catalog_doc, indent=1) + "\n")
+        log(f"wrote {args.catalog_out}")
     if args.shard_out and "shard" in doc["phases"]:
         shard_doc = {
             "schema": "gene2vec-tpu/bench-shard/v1",

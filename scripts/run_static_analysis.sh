@@ -56,7 +56,11 @@
 #                                                  # sharded fleet, SIGKILL
 #                                                  # mid-build -> bit-exact
 #                                                  # resume, mixed-load p99
-#                                                  # delta gated;
+#                                                  # delta gated; AND the
+#                                                  # catalog smoke: a two-
+#                                                  # model fleet hot-swap +
+#                                                  # per-model scale-up with
+#                                                  # zero cross-model answers;
 #                                                  # docs/BATCH.md +
 #                                                  # docs/RESILIENCE.md +
 #                                                  # docs/OBSERVABILITY.md +
@@ -193,14 +197,21 @@ if [ "$CHAOS" = "1" ]; then
   # graph pass (the committed BENCH_BATCH record comes from the full,
   # non-smoke drill; docs/BATCH.md)
   BATCH_OUT="${BATCH_DRILL_OUT:-/tmp/chaos_drill_batch_smoke.json}"
+  # the catalog phase is the multi-model smoke: a two-model --catalog
+  # fleet hot-swaps its default model under verified load on both
+  # models, then ramps the second model and proves only that model's
+  # pool scales — 0 wrong/mixed/cross-model answers gated
+  # (docs/SERVING.md#multi-model-catalog)
+  CATALOG_OUT="${CATALOG_DRILL_OUT:-/tmp/chaos_drill_catalog_smoke.json}"
   python scripts/chaos_drill.py --smoke --fleet-out "$FLEET_OUT" \
     --alerts-out "$ALERTS_OUT" --autoscale-out "$AUTOSCALE_OUT" \
     --shard-out "$SHARD_OUT" --loop-out "$LOOP_OUT" \
-    --batch-out "$BATCH_OUT" \
+    --batch-out "$BATCH_OUT" --catalog-out "$CATALOG_OUT" \
     > "$CHAOS_OUT" || rc=$?
   echo "chaos drill: exit $rc -> $CHAOS_OUT (fleet: $FLEET_OUT," >&2
   echo "  alerts: $ALERTS_OUT, autoscale: $AUTOSCALE_OUT," >&2
-  echo "  shard: $SHARD_OUT, loop: $LOOP_OUT, batch: $BATCH_OUT)" >&2
+  echo "  shard: $SHARD_OUT, loop: $LOOP_OUT, batch: $BATCH_OUT," >&2
+  echo "  catalog: $CATALOG_OUT)" >&2
   if [ "$rc" -ne 0 ]; then
     exit "$rc"
   fi

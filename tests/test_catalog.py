@@ -1,0 +1,354 @@
+"""Multi-model serving plane (serve/catalog.py + routes.py +
+autoscale.py pool keys).
+
+Tier-1 fast: spec validation and route splitting are pure functions,
+the admission/policy tests run on injected clocks and synthetic
+snapshots, and the one live piece — a two-model ModelCatalog — serves
+in-process through ``ServeApp.handle`` (no HTTP, no subprocesses)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gene2vec_tpu.io.checkpoint import save_iteration
+from gene2vec_tpu.io.vocab import Vocab
+from gene2vec_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    PoolAutoscalePolicy,
+    ShardAutoscalePolicy,
+)
+from gene2vec_tpu.serve.catalog import (
+    ModelAdmission,
+    ModelCatalog,
+    load_catalog_spec,
+    parse_catalog_spec,
+)
+from gene2vec_tpu.serve.routes import model_label, split_model_route
+from gene2vec_tpu.serve.server import ServeConfig
+from gene2vec_tpu.sgns.model import SGNSParams
+
+
+def _write_export(export_dir, dim, iteration=1, vocab_size=16, seed=0):
+    rng = np.random.RandomState(seed + iteration)
+    vocab = Vocab(
+        [f"G{i}" for i in range(vocab_size)],
+        np.arange(vocab_size, 0, -1),
+    )
+    emb = rng.randn(vocab_size, dim).astype(np.float32)
+    params = SGNSParams(
+        emb=jnp.asarray(emb),
+        ctx=jnp.asarray(np.zeros((vocab_size, dim), np.float32)),
+    )
+    save_iteration(str(export_dir), dim, iteration, params, vocab)
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def _spec_doc(tmp_path, **overrides):
+    doc = {
+        "schema": "gene2vec-tpu/catalog/v1",
+        "default": "alpha",
+        "models": {
+            "alpha": {"export_dir": str(tmp_path / "a"), "dim": 4},
+            "beta": {"export_dir": str(tmp_path / "b"), "dim": 8},
+        },
+    }
+    doc.update(overrides)
+    return doc
+
+
+def test_parse_catalog_spec_round_trip(tmp_path):
+    spec = parse_catalog_spec(_spec_doc(tmp_path))
+    assert spec.names == ("alpha", "beta")
+    assert spec.default == "alpha"
+    assert spec.default_entry.dim == 4
+    assert spec.entry("beta").export_dir == str(tmp_path / "b")
+    with pytest.raises(KeyError):
+        spec.entry("gamma")
+
+
+def test_parse_catalog_spec_default_falls_back_to_first(tmp_path):
+    doc = _spec_doc(tmp_path)
+    del doc["default"]
+    assert parse_catalog_spec(doc).default == "alpha"
+
+
+def test_parse_catalog_spec_rejects_bad_docs(tmp_path):
+    with pytest.raises(ValueError):
+        parse_catalog_spec({"models": {}})
+    with pytest.raises(ValueError):
+        parse_catalog_spec(_spec_doc(tmp_path, default="nope"))
+    # reserved names collide with the /v1 route grammar
+    with pytest.raises(ValueError, match="reserved"):
+        parse_catalog_spec({
+            "models": {"similar": {"export_dir": "/x"}},
+        })
+    # names become URL segments and metric labels
+    with pytest.raises(ValueError, match="must match"):
+        parse_catalog_spec({
+            "models": {"bad name!": {"export_dir": "/x"}},
+        })
+    with pytest.raises(ValueError, match="export_dir"):
+        parse_catalog_spec({"models": {"alpha": {}}})
+    with pytest.raises(ValueError, match="replicas"):
+        parse_catalog_spec({
+            "models": {"alpha": {"export_dir": "/x", "replicas": 0}},
+        })
+    with pytest.raises(ValueError, match="rate/burst"):
+        parse_catalog_spec({
+            "models": {"alpha": {"export_dir": "/x", "rate": -1}},
+        })
+
+
+def test_parse_catalog_spec_model_cap(tmp_path):
+    models = {
+        f"m{i}": {"export_dir": f"/x/{i}"} for i in range(17)
+    }
+    with pytest.raises(ValueError, match="cap"):
+        parse_catalog_spec({"models": models})
+
+
+def test_load_catalog_spec_resolves_relative_paths(tmp_path):
+    p = tmp_path / "catalog.json"
+    p.write_text(json.dumps({
+        "default": "alpha",
+        "models": {"alpha": {"export_dir": "exports/a"}},
+    }))
+    spec = load_catalog_spec(str(p))
+    assert spec.entry("alpha").export_dir == str(
+        tmp_path / "exports" / "a"
+    )
+
+
+# -- route grammar -----------------------------------------------------------
+
+
+def test_split_model_route():
+    assert split_model_route("/v1/alpha/similar") == (
+        "alpha", "/v1/similar"
+    )
+    assert split_model_route("/v1/alpha/genes") == ("alpha", "/v1/genes")
+    # unprefixed routes pass through untouched (the default model's
+    # backward-compat surface)
+    assert split_model_route("/v1/similar") == (None, "/v1/similar")
+    assert split_model_route("/healthz") == (None, "/healthz")
+    # verbs and job ids are NOT model names
+    assert split_model_route("/v1/shard/topk") == (None, "/v1/shard/topk")
+    assert split_model_route("/v1/jobs/j123/artifact") == (
+        None, "/v1/jobs/j123/artifact"
+    )
+    # a model prefix on the jobs plane is recognized
+    assert split_model_route("/v1/alpha/jobs") == ("alpha", "/v1/jobs")
+    # garbage tails are not model routes
+    assert split_model_route("/v1/alpha/doesnotexist") == (
+        None, "/v1/alpha/doesnotexist"
+    )
+
+
+def test_model_label_is_bounded():
+    known = ("alpha", "beta")
+    assert model_label("alpha", known) == "alpha"
+    assert model_label(None, known) != "alpha"
+    overflow = model_label("not-in-catalog", known)
+    assert overflow == model_label("x" * 500, known)
+    assert len(overflow) <= 64
+
+
+# -- per-model admission -----------------------------------------------------
+
+
+def test_model_admission_buckets_per_model(tmp_path):
+    doc = _spec_doc(tmp_path)
+    doc["models"]["alpha"]["rate"] = 1.0
+    doc["models"]["alpha"]["burst"] = 2
+    spec = parse_catalog_spec(doc)
+    now = [100.0]
+    adm = ModelAdmission(spec, clock=lambda: now[0])
+    # alpha's burst of 2, then 429 territory
+    assert adm.admit("alpha")
+    assert adm.admit("alpha")
+    assert not adm.admit("alpha")
+    # beta is unlimited; unknown names admit (they 404 later — the
+    # quota gate is not a validity gate)
+    for _ in range(10):
+        assert adm.admit("beta")
+    assert adm.admit("gamma")
+    assert adm.admit(None)
+    # tokens refill on the injected clock
+    now[0] += 1.0
+    assert adm.admit("alpha")
+
+
+# -- (model, shard) autoscale pools ------------------------------------------
+
+
+def _tick(policy, snapshot, now, current_of):
+    snapshot = dict(snapshot)
+    snapshot.setdefault("_fresh_targets", 2.0)
+    return policy.observe(snapshot, now=now, current_of=current_of)
+
+
+def test_pool_policy_scales_only_the_hot_model():
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_per_replica=4.0,
+        up_after_ticks=2, cooldown_s=0.0,
+    )
+    policy = PoolAutoscalePolicy(
+        cfg, [("alpha", None), ("beta", None)]
+    )
+    current = {("alpha", None): 1, ("beta", None): 1}
+    hot = {
+        "fleet_model_queue_depth{model=alpha}": 40.0,
+        "fleet_model_queue_depth{model=beta}": 0.0,
+    }
+    d = _tick(policy, hot, 1.0, current)
+    assert d.action == "hold"          # first tick seeds baselines
+    d = _tick(policy, hot, 2.0, current)
+    assert d.action == "hold"          # breach window still filling
+    d = _tick(policy, hot, 3.0, current)
+    assert d.action == "up"
+    assert d.model == "alpha"
+    assert d.shard is None
+    assert d.target == 2
+    assert "model alpha" in d.reason
+
+
+def test_pool_policy_hottest_queue_wins_tie():
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_per_replica=4.0,
+        up_after_ticks=1, cooldown_s=0.0,
+    )
+    policy = PoolAutoscalePolicy(
+        cfg, [("alpha", None), ("beta", None)]
+    )
+    current = {("alpha", None): 1, ("beta", None): 1}
+    both_hot = {
+        "fleet_model_queue_depth{model=alpha}": 10.0,
+        "fleet_model_queue_depth{model=beta}": 50.0,
+    }
+    _tick(policy, both_hot, 1.0, current)  # seeds baselines
+    d = _tick(policy, both_hot, 2.0, current)
+    assert (d.action, d.model) == ("up", "beta")
+
+
+def test_pool_policy_rejects_degenerate_pools():
+    cfg = AutoscaleConfig()
+    with pytest.raises(ValueError):
+        PoolAutoscalePolicy(cfg, [])
+    with pytest.raises(ValueError):
+        PoolAutoscalePolicy(
+            cfg, [("alpha", None), ("alpha", None)]
+        )
+
+
+def test_shard_policy_is_a_pool_policy_view():
+    """The pre-catalog shard API is a re-keyed view over the SAME
+    policy instances — not a parallel implementation."""
+    policy = ShardAutoscalePolicy(AutoscaleConfig(), num_shards=2)
+    assert policy.policies[0] is policy.pool_policies[(None, 0)]
+    assert policy.policies[1] is policy.pool_policies[(None, 1)]
+    d = policy.observe(
+        {"_fresh_targets": 2.0,
+         "fleet_shard_queue_depth{shard=1}": 1.0},
+        now=1.0, current_of={0: 1, 1: 1},
+    )
+    assert d.shard in (0, 1) and d.model is None
+
+
+# -- the live two-model catalog ----------------------------------------------
+
+
+@pytest.fixture
+def two_model_catalog(tmp_path):
+    _write_export(tmp_path / "a", dim=4, seed=1)
+    _write_export(tmp_path / "b", dim=8, seed=2)
+    spec = parse_catalog_spec(_spec_doc(tmp_path))
+    catalog = ModelCatalog(
+        spec,
+        config=ServeConfig(max_delay_ms=1.0, cache_size=0),
+    ).build().start()
+    yield catalog
+    catalog.stop()
+
+
+def test_catalog_serves_each_model_by_name(two_model_catalog):
+    app = two_model_catalog.default_app
+    body = {"genes": ["G0"], "k": 3}
+    status, alpha = app.handle("POST", "/v1/alpha/similar", body)
+    assert status == 200
+    assert alpha["model"]["name"] == "alpha"
+    assert alpha["model"]["dim"] == 4
+    status, beta = app.handle("POST", "/v1/beta/similar", body)
+    assert status == 200
+    assert beta["model"]["name"] == "beta"
+    assert beta["model"]["dim"] == 8
+    # different tables answer differently
+    assert (
+        [n["gene"] for n in alpha["results"][0]["neighbors"]]
+        != [n["gene"] for n in beta["results"][0]["neighbors"]]
+    )
+
+
+def test_catalog_unprefixed_routes_serve_the_default(two_model_catalog):
+    app = two_model_catalog.default_app
+    body = {"genes": ["G0"], "k": 3}
+    _, plain = app.handle("POST", "/v1/similar", body)
+    _, named = app.handle("POST", "/v1/alpha/similar", body)
+    assert (
+        plain["results"][0]["neighbors"]
+        == named["results"][0]["neighbors"]
+    )
+
+
+def test_catalog_unknown_model_404s_before_labels(two_model_catalog):
+    app = two_model_catalog.default_app
+    status, doc = app.handle(
+        "POST", "/v1/gamma/similar", {"genes": ["G0"], "k": 3}
+    )
+    assert status == 404
+    assert "unknown model" in doc["error"]
+
+
+def test_catalog_sibling_dispatch_works_from_any_app(two_model_catalog):
+    """The shared catalog table is symmetric: the NON-default app can
+    address its sibling by name too (the fleet front door may land a
+    prefixed request on any replica)."""
+    beta_app = two_model_catalog.apps["beta"]
+    status, doc = beta_app.handle(
+        "POST", "/v1/alpha/similar", {"genes": ["G0"], "k": 3}
+    )
+    assert status == 200
+    assert doc["model"]["name"] == "alpha"
+
+
+def test_catalog_default_must_load(tmp_path):
+    (tmp_path / "a").mkdir()          # empty: no checkpoint at all
+    _write_export(tmp_path / "b", dim=8, seed=2)
+    spec = parse_catalog_spec(_spec_doc(tmp_path))
+    with pytest.raises(RuntimeError, match="default model"):
+        ModelCatalog(spec, config=ServeConfig()).build()
+
+
+def test_catalog_non_default_may_start_empty(tmp_path):
+    _write_export(tmp_path / "a", dim=4, seed=1)
+    (tmp_path / "b").mkdir()
+    spec = parse_catalog_spec(_spec_doc(tmp_path))
+    catalog = ModelCatalog(
+        spec, config=ServeConfig(max_delay_ms=1.0)
+    ).build().start()
+    try:
+        app = catalog.default_app
+        status, _ = app.handle(
+            "POST", "/v1/alpha/similar", {"genes": ["G0"], "k": 3}
+        )
+        assert status == 200
+        # beta exists in the route table but has nothing to serve yet
+        status, _ = app.handle(
+            "POST", "/v1/beta/similar", {"genes": ["G0"], "k": 3}
+        )
+        assert status == 503
+    finally:
+        catalog.stop()

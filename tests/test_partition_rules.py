@@ -1,0 +1,154 @@
+"""Declarative checkpoint->device placement (parallel/partition_rules).
+
+Tier-1 fast: everything runs on the CPU backend's single device (the
+shard/gather closures are jit identities — placement semantics, not
+multi-chip layout, are under test here; the multi-chip layouts ride
+the mesh-sanity harness)."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as PS
+
+from gene2vec_tpu.parallel.partition_rules import (
+    DEFAULT_SERVE_RULES,
+    REPLICATED_RULES,
+    gather_params,
+    match_partition_rules,
+    parse_rules,
+    shard_params,
+    spec_for_name,
+    tree_path_name,
+)
+
+
+def test_first_matching_rule_wins():
+    """Ordering is the API: a specific pattern listed first must beat a
+    catch-all listed after it, and vice versa."""
+    specific_first = (
+        (r"(^|/)emb$", PS("model", None)),
+        (r".*", PS()),
+    )
+    assert spec_for_name(specific_first, "emb", (8, 4)) == PS(
+        "model", None
+    )
+    assert spec_for_name(specific_first, "kernel", (8, 4)) == PS()
+    # a catch-all FIRST shadows everything — first match wins, the
+    # rules are not best-match
+    catchall_first = (
+        (r".*", PS()),
+        (r"(^|/)emb$", PS("model", None)),
+    )
+    assert spec_for_name(catchall_first, "emb", (8, 4)) == PS()
+
+
+def test_scalar_and_size1_leaves_never_partition():
+    """Scalars and size-1 leaves get PS() regardless of what the rules
+    say — partitioning a scalar is always a bug."""
+    rules = ((r".*", PS("model", None)),)
+    assert spec_for_name(rules, "emb", ()) == PS()
+    assert spec_for_name(rules, "emb", (1,)) == PS()
+    assert spec_for_name(rules, "emb", (1, 1)) == PS()
+    # ...but a real 2-D table does take the rule
+    assert spec_for_name(rules, "emb", (8, 4)) == PS("model", None)
+
+
+def test_no_match_replicates_with_warning():
+    """A leaf no rule matches degrades to replicated with a
+    RuntimeWarning naming the leaf — it must not crash the serve
+    loop."""
+    rules = ((r"(^|/)emb$", PS("model", None)),)
+    with pytest.warns(RuntimeWarning, match="new_head/kernel"):
+        spec = spec_for_name(rules, "new_head/kernel", (8, 4))
+    assert spec == PS()
+
+
+def test_match_partition_rules_flax_style_nested_dict():
+    """A Flax-style nested params dict maps to a same-shaped spec tree
+    with /-joined names driving the match."""
+    params = {
+        "params": {
+            "embedding": {"unit": np.zeros((16, 4), np.float32)},
+            "dense_0": {
+                "kernel": np.zeros((4, 4), np.float32),
+                "bias": np.zeros((4,), np.float32),
+            },
+        },
+        "step": np.zeros((), np.int32),
+    }
+    specs = match_partition_rules(DEFAULT_SERVE_RULES, params)
+    assert specs["params"]["embedding"]["unit"] == PS("model", None)
+    assert specs["params"]["dense_0"]["kernel"] == PS()
+    assert specs["params"]["dense_0"]["bias"] == PS()
+    # the scalar step counter is forced replicated before any rule
+    assert specs["step"] == PS()
+    # same tree shape: zipping the two trees must not raise
+    jax.tree_util.tree_map(lambda a, b: None, params, specs)
+
+
+def test_tree_path_name_joins_dict_keys():
+    flat = jax.tree_util.tree_flatten_with_path(
+        {"a": {"b": np.zeros((2,))}}
+    )[0]
+    (path, _leaf), = flat
+    assert tree_path_name(path) == "a/b"
+
+
+def test_shard_gather_round_trip_preserves_values_and_names():
+    """shard_params -> gather_params is an identity on values AND tree
+    structure (what the checkpoint writer needs back)."""
+    rng = np.random.RandomState(0)
+    params = {
+        "emb": rng.randn(12, 4).astype(np.float32),
+        "head": {"kernel": rng.randn(4, 3).astype(np.float32)},
+    }
+    on_device = shard_params(REPLICATED_RULES, params)
+    assert isinstance(on_device["emb"], jax.Array)
+    back = gather_params(REPLICATED_RULES, on_device)
+    assert (
+        jax.tree_util.tree_structure(back)
+        == jax.tree_util.tree_structure(params)
+    )
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(back)[0]:
+        want = params
+        for entry in key_path:
+            want = want[entry.key]
+        np.testing.assert_array_equal(np.asarray(leaf), want)
+
+
+def test_match_partition_rules_emits_no_warning_when_covered():
+    """The shipped default rules cover every param family the repo
+    serves — matching them must be warning-free."""
+    params = {
+        "emb": np.zeros((8, 4), np.float32),
+        "ctx": np.zeros((8, 4), np.float32),
+        "unit": np.zeros((8, 4), np.float32),
+        "kernel": np.zeros((4, 4), np.float32),
+    }
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        match_partition_rules(DEFAULT_SERVE_RULES, params)
+
+
+def test_parse_rules_json_form():
+    rules = parse_rules([
+        ["(^|/)unit$", ["model", None]],
+        [".*", []],
+    ])
+    assert rules == [
+        ("(^|/)unit$", PS("model", None)),
+        (".*", PS()),
+    ]
+    # null axes == replicated
+    assert parse_rules([[".*", None]]) == [(".*", PS())]
+
+
+def test_parse_rules_rejects_bad_shapes():
+    with pytest.raises(Exception):
+        parse_rules([["(unclosed", ["model"]]])   # bad regex
+    with pytest.raises(ValueError):
+        parse_rules([[".*"]])                     # not a pair
+    with pytest.raises(ValueError):
+        parse_rules([[".*", "model"]])            # axes not a list

@@ -759,10 +759,14 @@ def test_flight_burst_dump_deferred_off_loop_thread(tmp_path):
     the event-loop thread) — it is handed to the worker pool."""
     from gene2vec_tpu.obs.flight import FLIGHT_PREFIX, FlightRecorder
     from gene2vec_tpu.obs.registry import MetricsRegistry
-    from gene2vec_tpu.serve.server import ServeAdapter
+    from gene2vec_tpu.serve.server import ServeApp, ServeAdapter
 
     class _App:
-        pass
+        # the real route-label builder (canonical route + optional
+        # bounded model label) — _account feeds it every status line
+        model_name = "default"
+        _mlabels = None
+        _route_labels = ServeApp._route_labels
 
     class _Pool:
         def __init__(self):
